@@ -1,0 +1,27 @@
+"""dpu_operator_tpu — a TPU-native re-imagining of the DPU operator.
+
+A vendor-agnostic Kubernetes operator framework that manages accelerator
+fabric devices (Google TPUs first-class, alongside the DPU vendor model of
+the reference: Intel IPU / Marvell OCTEON / Intel NetSec), advertising
+chips and fabric endpoints as allocatable cluster resources and backing
+pod secondary network interfaces with the TPU ICI fabric.
+
+Layer map (mirrors reference SURVEY §1, re-designed for TPU-VM platforms):
+
+  1. CRD API          dpu_operator_tpu.api         (4 CRs + webhook)
+  2. Operator         dpu_operator_tpu.controller  (reconcilers + render)
+  3. Node daemon      dpu_operator_tpu.daemon      (detection loop, side managers)
+  4. Platform         dpu_operator_tpu.platform    (TPU/fake detectors)
+  5. VSP contract     dpu_operator_tpu.dpu_api     (gRPC, unix socket)
+  6. VSPs             dpu_operator_tpu.vsp         (tpuvsp, mock)
+  7. CNI              dpu_operator_tpu.cni         (shim, server, dataplanes)
+  8. Device plugin    dpu_operator_tpu.daemon.device_plugin
+  9. NRI webhook      dpu_operator_tpu.controller.nri
+ 10. Fabric compute   dpu_operator_tpu.{parallel,ops,models}  (JAX/pallas)
+
+The compute path (fabric diagnostics, telemetry models, ICI collective
+benchmarks) is JAX/pallas/pjit; the runtime around it is Python with
+native C++ components under native/ (control-plane agent, CNI shim).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""dpu_api — the gRPC contract between daemon, VSPs, and kubelet.
+
+Generated protobuf messages live in .gen (built by scripts/genproto.sh via
+protoc); the gRPC service glue is hand-written in .services because this
+image ships grpcio without grpc_tools.
+"""
+
+from .gen import dpu_api_pb2, bridge_port_pb2, kubelet_deviceplugin_pb2
+from . import services
+
+__all__ = ["dpu_api_pb2", "bridge_port_pb2", "kubelet_deviceplugin_pb2", "services"]

@@ -1,0 +1,326 @@
+"""gRPC service glue — hand-written stubs and servicer registration.
+
+Equivalent of protoc-gen-grpc output (the *_pb2_grpc.py modules) for the
+three proto files; written by hand since grpc_tools is not available in
+the runtime image. Service/method names are the wire contract and must
+stay in sync with the .proto files.
+"""
+
+from __future__ import annotations
+
+import grpc
+from google.protobuf import empty_pb2
+
+from .gen import bridge_port_pb2 as bp
+from .gen import dpu_api_pb2 as pb
+from .gen import kubelet_deviceplugin_pb2 as kdp
+
+
+def _unary(pkg, service, method, req_cls, resp_cls):
+    return {
+        "path": f"/{pkg}.{service}/{method}",
+        "request_serializer": req_cls.SerializeToString,
+        "response_deserializer": resp_cls.FromString,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Client stubs
+# ---------------------------------------------------------------------------
+
+
+class LifeCycleStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Init = channel.unary_unary(
+            "/tpudpu.v1.LifeCycleService/Init",
+            request_serializer=pb.InitRequest.SerializeToString,
+            response_deserializer=pb.IpPort.FromString,
+        )
+
+
+class NetworkFunctionStub:
+    def __init__(self, channel: grpc.Channel):
+        self.CreateNetworkFunction = channel.unary_unary(
+            "/tpudpu.v1.NetworkFunctionService/CreateNetworkFunction",
+            request_serializer=pb.NFRequest.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+        self.DeleteNetworkFunction = channel.unary_unary(
+            "/tpudpu.v1.NetworkFunctionService/DeleteNetworkFunction",
+            request_serializer=pb.NFRequest.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+
+
+class DeviceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevices = channel.unary_unary(
+            "/tpudpu.v1.DeviceService/GetDevices",
+            request_serializer=empty_pb2.Empty.SerializeToString,
+            response_deserializer=pb.DeviceListResponse.FromString,
+        )
+        self.SetNumEndpoints = channel.unary_unary(
+            "/tpudpu.v1.DeviceService/SetNumEndpoints",
+            request_serializer=pb.EndpointCount.SerializeToString,
+            response_deserializer=pb.EndpointCount.FromString,
+        )
+
+
+class HeartbeatStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Ping = channel.unary_unary(
+            "/tpudpu.v1.HeartbeatService/Ping",
+            request_serializer=pb.PingRequest.SerializeToString,
+            response_deserializer=pb.PingResponse.FromString,
+        )
+
+
+class BridgePortStub:
+    def __init__(self, channel: grpc.Channel):
+        self.CreateBridgePort = channel.unary_unary(
+            "/tpudpu.opi.v1.BridgePortService/CreateBridgePort",
+            request_serializer=bp.CreateBridgePortRequest.SerializeToString,
+            response_deserializer=bp.BridgePort.FromString,
+        )
+        self.DeleteBridgePort = channel.unary_unary(
+            "/tpudpu.opi.v1.BridgePortService/DeleteBridgePort",
+            request_serializer=bp.DeleteBridgePortRequest.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+
+
+class KubeletRegistrationStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            "/v1beta1.Registration/Register",
+            request_serializer=kdp.RegisterRequest.SerializeToString,
+            response_deserializer=kdp.Empty.FromString,
+        )
+
+
+class DevicePluginStub:
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+            request_serializer=kdp.Empty.SerializeToString,
+            response_deserializer=kdp.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=kdp.Empty.SerializeToString,
+            response_deserializer=kdp.ListAndWatchResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=kdp.AllocateRequest.SerializeToString,
+            response_deserializer=kdp.AllocateResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            "/v1beta1.DevicePlugin/GetPreferredAllocation",
+            request_serializer=kdp.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=kdp.PreferredAllocationResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            "/v1beta1.DevicePlugin/PreStartContainer",
+            request_serializer=kdp.PreStartContainerRequest.SerializeToString,
+            response_deserializer=kdp.PreStartContainerResponse.FromString,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Servicer base classes + registration
+# ---------------------------------------------------------------------------
+
+
+class LifeCycleServicer:
+    def Init(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Init not implemented")
+
+
+class NetworkFunctionServicer:
+    def CreateNetworkFunction(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def DeleteNetworkFunction(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+class DeviceServicer:
+    def GetDevices(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def SetNumEndpoints(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+class HeartbeatServicer:
+    def Ping(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+class BridgePortServicer:
+    def CreateBridgePort(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def DeleteBridgePort(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+class KubeletRegistrationServicer:
+    def Register(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+class DevicePluginServicer:
+    def GetDevicePluginOptions(self, request, context):
+        return kdp.DevicePluginOptions()
+
+    def ListAndWatch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def Allocate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def GetPreferredAllocation(self, request, context):
+        return kdp.PreferredAllocationResponse()
+
+    def PreStartContainer(self, request, context):
+        return kdp.PreStartContainerResponse()
+
+
+def _u(handler, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def _us(handler, req_cls, resp_cls):
+    return grpc.unary_stream_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def add_lifecycle(servicer: LifeCycleServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "tpudpu.v1.LifeCycleService",
+                {"Init": _u(servicer.Init, pb.InitRequest, pb.IpPort)},
+            ),
+        )
+    )
+
+
+def add_network_function(servicer: NetworkFunctionServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "tpudpu.v1.NetworkFunctionService",
+                {
+                    "CreateNetworkFunction": _u(
+                        servicer.CreateNetworkFunction, pb.NFRequest, empty_pb2.Empty
+                    ),
+                    "DeleteNetworkFunction": _u(
+                        servicer.DeleteNetworkFunction, pb.NFRequest, empty_pb2.Empty
+                    ),
+                },
+            ),
+        )
+    )
+
+
+def add_device(servicer: DeviceServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "tpudpu.v1.DeviceService",
+                {
+                    "GetDevices": _u(
+                        servicer.GetDevices, empty_pb2.Empty, pb.DeviceListResponse
+                    ),
+                    "SetNumEndpoints": _u(
+                        servicer.SetNumEndpoints, pb.EndpointCount, pb.EndpointCount
+                    ),
+                },
+            ),
+        )
+    )
+
+
+def add_heartbeat(servicer: HeartbeatServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "tpudpu.v1.HeartbeatService",
+                {"Ping": _u(servicer.Ping, pb.PingRequest, pb.PingResponse)},
+            ),
+        )
+    )
+
+
+def add_bridge_port(servicer: BridgePortServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "tpudpu.opi.v1.BridgePortService",
+                {
+                    "CreateBridgePort": _u(
+                        servicer.CreateBridgePort, bp.CreateBridgePortRequest, bp.BridgePort
+                    ),
+                    "DeleteBridgePort": _u(
+                        servicer.DeleteBridgePort,
+                        bp.DeleteBridgePortRequest,
+                        empty_pb2.Empty,
+                    ),
+                },
+            ),
+        )
+    )
+
+
+def add_kubelet_registration(
+    servicer: KubeletRegistrationServicer, server: grpc.Server
+) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "v1beta1.Registration",
+                {"Register": _u(servicer.Register, kdp.RegisterRequest, kdp.Empty)},
+            ),
+        )
+    )
+
+
+def add_device_plugin(servicer: DevicePluginServicer, server: grpc.Server) -> None:
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "v1beta1.DevicePlugin",
+                {
+                    "GetDevicePluginOptions": _u(
+                        servicer.GetDevicePluginOptions, kdp.Empty, kdp.DevicePluginOptions
+                    ),
+                    "ListAndWatch": _us(
+                        servicer.ListAndWatch, kdp.Empty, kdp.ListAndWatchResponse
+                    ),
+                    "Allocate": _u(
+                        servicer.Allocate, kdp.AllocateRequest, kdp.AllocateResponse
+                    ),
+                    "GetPreferredAllocation": _u(
+                        servicer.GetPreferredAllocation,
+                        kdp.PreferredAllocationRequest,
+                        kdp.PreferredAllocationResponse,
+                    ),
+                    "PreStartContainer": _u(
+                        servicer.PreStartContainer,
+                        kdp.PreStartContainerRequest,
+                        kdp.PreStartContainerResponse,
+                    ),
+                },
+            ),
+        )
+    )

@@ -1,0 +1,74 @@
+"""Image manager — maps logical image keys to container image refs.
+
+Counterpart of reference internal/images/ (images.go:5-14, env_manager.go:14-33,
+dummy_manager.go:11-26). Image refs arrive as env vars on the operator and
+daemon pods; DummyImageManager serves tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Mapping
+
+# Logical image keys (reference images.go:5-14, plus the TPU VSP).
+DPU_DAEMON_IMAGE = "dpu_daemon"
+VSP_IMAGE_TPU = "tpu_vsp"
+VSP_IMAGE_MOCK = "mock_vsp"
+VSP_IMAGE_INTEL = "intel_ipu"
+VSP_IMAGE_MARVELL = "marvell_dpu"
+VSP_IMAGE_NETSEC = "intel_netsec"
+NRI_IMAGE = "network_resources_injector"
+
+ALL_KEYS = (
+    DPU_DAEMON_IMAGE,
+    VSP_IMAGE_TPU,
+    VSP_IMAGE_MOCK,
+    VSP_IMAGE_INTEL,
+    VSP_IMAGE_MARVELL,
+    VSP_IMAGE_NETSEC,
+    NRI_IMAGE,
+)
+
+_ENV_PREFIX = "DPU_IMAGE_"
+
+
+class ImageManager:
+    """Interface: get_image(key) -> ref (reference images.go:16-19)."""
+
+    def get_image(self, key: str) -> str:
+        raise NotImplementedError
+
+
+class EnvImageManager(ImageManager):
+    """Reads DPU_IMAGE_<KEY> env vars (reference env_manager.go:14-33)."""
+
+    def __init__(self, env: Mapping[str, str] | None = None):
+        self._env = dict(env if env is not None else os.environ)
+
+    def get_image(self, key: str) -> str:
+        var = _ENV_PREFIX + key.upper()
+        val = self._env.get(var)
+        if not val:
+            raise KeyError(f"image env var {var} not set")
+        return val
+
+
+class DummyImageManager(ImageManager):
+    """Deterministic refs for tests (reference dummy_manager.go:11-26)."""
+
+    def get_image(self, key: str) -> str:
+        return f"{key}-mock-image"
+
+
+def merge_vars_with_images(
+    mgr: ImageManager,
+    template_vars: Dict[str, str],
+    keys=ALL_KEYS,
+) -> Dict[str, str]:
+    """Feed image refs into the manifest template vars, failing loudly on a
+    missing ref (reference images.go:42-60 MergeVarsWithImages returns an
+    error rather than rendering a broken manifest later)."""
+    out = dict(template_vars)
+    for key in keys:
+        out[f"Image_{key}"] = mgr.get_image(key)
+    return out

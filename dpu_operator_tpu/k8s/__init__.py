@@ -1,0 +1,35 @@
+from .objects import (
+    name_of,
+    namespace_of,
+    set_condition,
+    get_condition,
+    owner_reference,
+    has_finalizer,
+    add_finalizer,
+    remove_finalizer,
+)
+from .store import InMemoryCluster, Conflict, NotFound, AlreadyExists, WatchEvent
+from .client import Client, InMemoryClient
+from .controller import Manager, Reconciler, Result, Request
+
+__all__ = [
+    "name_of",
+    "namespace_of",
+    "set_condition",
+    "get_condition",
+    "owner_reference",
+    "has_finalizer",
+    "add_finalizer",
+    "remove_finalizer",
+    "InMemoryCluster",
+    "Conflict",
+    "NotFound",
+    "AlreadyExists",
+    "WatchEvent",
+    "Client",
+    "InMemoryClient",
+    "Manager",
+    "Reconciler",
+    "Result",
+    "Request",
+]

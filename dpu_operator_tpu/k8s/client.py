@@ -1,0 +1,122 @@
+"""Client — the one API-access interface every component uses.
+
+Controllers, daemon, and webhooks all speak this interface; InMemoryClient
+binds it to the in-process store (test/standalone), and HttpClient (see
+http_client.py) binds it to a real kube-apiserver. This is the seam the
+reference gets from controller-runtime's client.Client.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .objects import K8sObject
+from .store import InMemoryCluster, NotFound
+
+
+class Client:
+    def create(self, obj: K8sObject) -> K8sObject:
+        raise NotImplementedError
+
+    def get(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> K8sObject:
+        raise NotImplementedError
+
+    def get_or_none(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> Optional[K8sObject]:
+        try:
+            return self.get(api_version, kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[K8sObject]:
+        raise NotImplementedError
+
+    def update(self, obj: K8sObject) -> K8sObject:
+        raise NotImplementedError
+
+    def update_status(self, obj: K8sObject) -> K8sObject:
+        raise NotImplementedError
+
+    def delete(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> None:
+        raise NotImplementedError
+
+    def delete_if_exists(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> None:
+        try:
+            self.delete(api_version, kind, namespace, name)
+        except NotFound:
+            pass
+
+    def apply(self, obj: K8sObject) -> K8sObject:
+        """Create-or-update merge apply (the reference uses
+        sriov-network-operator's pkg/apply; render.go:26-80)."""
+        from .objects import name_of, namespace_of
+
+        cur = self.get_or_none(
+            obj["apiVersion"], obj["kind"], namespace_of(obj), name_of(obj)
+        )
+        if cur is None:
+            return self.create(obj)
+        merged = dict(cur)
+        for k, v in obj.items():
+            if k == "metadata":
+                m = dict(cur.get("metadata", {}))
+                for mk, mv in obj["metadata"].items():
+                    if mk in ("labels", "annotations") and mk in m and isinstance(mv, dict):
+                        merged_map = dict(m[mk] or {})
+                        merged_map.update(mv)
+                        m[mk] = merged_map
+                    elif mk not in ("resourceVersion", "uid", "creationTimestamp"):
+                        m[mk] = mv
+                merged["metadata"] = m
+            elif k != "status":
+                merged[k] = v
+        merged["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+        return self.update(merged)
+
+    def watch(self, api_version: str, kind: str, namespace: Optional[str] = None):
+        raise NotImplementedError
+
+    def stop_watch(self, watcher) -> None:
+        raise NotImplementedError
+
+
+class InMemoryClient(Client):
+    def __init__(self, cluster: InMemoryCluster):
+        self.cluster = cluster
+
+    def create(self, obj):
+        return self.cluster.create(obj)
+
+    def get(self, api_version, kind, namespace, name):
+        return self.cluster.get(api_version, kind, namespace, name)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        return self.cluster.list(api_version, kind, namespace, label_selector)
+
+    def update(self, obj):
+        return self.cluster.update(obj)
+
+    def update_status(self, obj):
+        return self.cluster.update_status(obj)
+
+    def delete(self, api_version, kind, namespace, name):
+        return self.cluster.delete(api_version, kind, namespace, name)
+
+    def watch(self, api_version, kind, namespace=None):
+        return self.cluster.watch(api_version, kind, namespace)
+
+    def stop_watch(self, watcher):
+        return self.cluster.stop_watch(watcher)

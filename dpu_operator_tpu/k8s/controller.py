@@ -1,0 +1,203 @@
+"""Controller framework — workqueue reconcilers driven by watches.
+
+Python counterpart of controller-runtime's manager/controller machinery
+that the reference builds on (cmd/main.go:80-148). Each controller owns a
+watch on its primary kind (plus optional secondary kinds mapped to
+requests), a deduplicating workqueue, and a worker thread that calls
+Reconcile with retry-on-error exponential backoff.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from .client import Client
+from .objects import K8sObject, name_of, namespace_of
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: Optional[str]
+    name: str
+
+
+@dataclass
+class Result:
+    requeue_after: Optional[float] = None
+
+
+class Reconciler:
+    def reconcile(self, req: Request) -> Result:
+        raise NotImplementedError
+
+
+@dataclass
+class _WatchSpec:
+    api_version: str
+    kind: str
+    namespace: Optional[str]
+    # Maps an event object to reconcile Requests (identity for the primary
+    # kind; owner-lookup or constant mapping for secondary kinds).
+    mapper: Callable[[K8sObject], List[Request]]
+
+
+class Controller:
+    _MAX_BACKOFF = 16.0
+
+    def __init__(self, name: str, reconciler: Reconciler, client: Client):
+        self.name = name
+        self.reconciler = reconciler
+        self.client = client
+        self._watch_specs: List[_WatchSpec] = []
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._pending: set = set()
+        self._pending_lock = threading.Lock()
+        self._failures: dict = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._watchers: List[Tuple[object, _WatchSpec]] = []
+
+    # -- wiring --------------------------------------------------------------
+
+    def watches(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        mapper: Optional[Callable[[K8sObject], List[Request]]] = None,
+    ) -> "Controller":
+        if mapper is None:
+            mapper = lambda obj: [Request(namespace_of(obj), name_of(obj))]
+        self._watch_specs.append(_WatchSpec(api_version, kind, namespace, mapper))
+        return self
+
+    def enqueue(self, req: Request) -> None:
+        with self._pending_lock:
+            if req in self._pending:
+                return
+            self._pending.add(req)
+        self._queue.put(req)
+
+    # -- run loop ------------------------------------------------------------
+
+    def start(self) -> None:
+        for spec in self._watch_specs:
+            w = self.client.watch(spec.api_version, spec.kind, spec.namespace)
+            self._watchers.append((w, spec))
+            t = threading.Thread(
+                target=self._watch_loop, args=(w, spec), daemon=True,
+                name=f"ctrl-{self.name}-watch-{spec.kind}",
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(
+            target=self._worker, daemon=True, name=f"ctrl-{self.name}-worker"
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w, _ in self._watchers:
+            try:
+                self.client.stop_watch(w)
+            except Exception:
+                pass
+
+    def _watch_loop(self, watcher, spec: _WatchSpec) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = watcher.events.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                for req in spec.mapper(ev.object):
+                    self.enqueue(req)
+            except Exception:
+                log.exception("%s: watch mapper failed", self.name)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._pending_lock:
+                self._pending.discard(req)
+            try:
+                result = self.reconciler.reconcile(req)
+                self._failures.pop(req, None)
+                if result and result.requeue_after:
+                    self._requeue_later(req, result.requeue_after)
+            except Exception:
+                log.exception("%s: reconcile %s failed", self.name, req)
+                n = self._failures.get(req, 0) + 1
+                self._failures[req] = n
+                self._requeue_later(req, min(0.05 * (2 ** n), self._MAX_BACKOFF))
+
+    def _requeue_later(self, req: Request, delay: float) -> None:
+        def fire():
+            if not self._stop.is_set():
+                self.enqueue(req)
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+
+
+class Manager:
+    """Holds controllers and runs them; the process-level lifecycle object
+    (reference: ctrl.NewManager + mgr.Start, cmd/main.go:80-161)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+        self._controllers: List[Controller] = []
+        self._runnables: List[Callable[[], None]] = []
+        self._stop_fns: List[Callable[[], None]] = []
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    def new_controller(self, name: str, reconciler: Reconciler) -> Controller:
+        c = Controller(name, reconciler, self.client)
+        self._controllers.append(c)
+        return c
+
+    def add_runnable(
+        self, run: Callable[[], None], stop: Optional[Callable[[], None]] = None
+    ) -> None:
+        self._runnables.append(run)
+        if stop:
+            self._stop_fns.append(stop)
+
+    def start(self) -> None:
+        self._started = True
+        for c in self._controllers:
+            c.start()
+        for run in self._runnables:
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        for c in self._controllers:
+            c.stop()
+        for fn in self._stop_fns:
+            try:
+                fn()
+            except Exception:
+                log.exception("runnable stop failed")
+
+    def wait_until(self, predicate: Callable[[], bool], timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return predicate()

@@ -1,0 +1,140 @@
+"""Helpers over plain-dict Kubernetes objects.
+
+We deliberately represent every API object as a plain dict (apiVersion /
+kind / metadata / spec / status), matching the wire format — the Python
+counterpart of the reference's typed Go structs + unstructured rendering.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional
+
+K8sObject = Dict[str, Any]
+
+
+def now_rfc3339() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def name_of(obj: K8sObject) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace_of(obj: K8sObject) -> Optional[str]:
+    return obj.get("metadata", {}).get("namespace")
+
+
+def uid_of(obj: K8sObject) -> str:
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def gvk_of(obj: K8sObject) -> tuple:
+    return (obj.get("apiVersion", ""), obj.get("kind", ""))
+
+
+# -- conditions (status.conditions, metav1.Condition semantics) --------------
+
+
+def set_condition(
+    obj: K8sObject,
+    type_: str,
+    status: str,
+    reason: str = "",
+    message: str = "",
+) -> bool:
+    """Set/refresh a status condition. Returns True if it changed.
+
+    Mirrors the Ready-condition plumbing the reference daemon does on
+    DataProcessingUnit CRs (internal/daemon/daemon.go:173-204)."""
+    status_block = obj.setdefault("status", {})
+    conds: List[dict] = status_block.setdefault("conditions", [])
+    for c in conds:
+        if c.get("type") == type_:
+            changed = (
+                c.get("status") != status
+                or c.get("reason") != reason
+                or c.get("message") != message
+            )
+            if changed:
+                c.update(
+                    status=status,
+                    reason=reason,
+                    message=message,
+                    lastTransitionTime=now_rfc3339(),
+                )
+            return changed
+    conds.append(
+        {
+            "type": type_,
+            "status": status,
+            "reason": reason,
+            "message": message,
+            "lastTransitionTime": now_rfc3339(),
+        }
+    )
+    return True
+
+
+def get_condition(obj: K8sObject, type_: str) -> Optional[dict]:
+    for c in obj.get("status", {}).get("conditions", []):
+        if c.get("type") == type_:
+            return c
+    return None
+
+
+# -- owner references --------------------------------------------------------
+
+
+def owner_reference(owner: K8sObject, controller: bool = True) -> dict:
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": name_of(owner),
+        "uid": uid_of(owner),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+def set_owner(obj: K8sObject, owner: K8sObject) -> None:
+    meta = obj.setdefault("metadata", {})
+    refs = meta.setdefault("ownerReferences", [])
+    for r in refs:
+        if r.get("uid") == uid_of(owner):
+            return
+    refs.append(owner_reference(owner))
+
+
+# -- finalizers --------------------------------------------------------------
+
+
+def has_finalizer(obj: K8sObject, finalizer: str) -> bool:
+    return finalizer in obj.get("metadata", {}).get("finalizers", [])
+
+
+def add_finalizer(obj: K8sObject, finalizer: str) -> bool:
+    meta = obj.setdefault("metadata", {})
+    fins = meta.setdefault("finalizers", [])
+    if finalizer in fins:
+        return False
+    fins.append(finalizer)
+    return True
+
+
+def remove_finalizer(obj: K8sObject, finalizer: str) -> bool:
+    fins = obj.get("metadata", {}).get("finalizers", [])
+    if finalizer not in fins:
+        return False
+    fins.remove(finalizer)
+    return True
+
+
+# -- label selectors ---------------------------------------------------------
+
+
+def matches_selector(obj: K8sObject, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())

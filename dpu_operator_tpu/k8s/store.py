@@ -1,0 +1,239 @@
+"""InMemoryCluster — a minimal but semantically-faithful API server.
+
+This is the test/standalone seam of the framework: the counterpart of the
+reference's envtest/Kind clusters (internal/testutils/kindcluster.go). It
+implements the API-machinery semantics the controllers depend on:
+
+  * resourceVersion bump on every write + optimistic-concurrency Conflict
+  * watch streams (ADDED/MODIFIED/DELETED) with per-watcher queues
+  * deletionTimestamp + finalizer gating of actual removal
+  * ownerReference cascade garbage collection
+  * namespaced and cluster-scoped resources, label-selector list
+
+Production deployments talk to a real kube-apiserver through the same
+Client interface (client.py); controllers cannot tell the difference.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .objects import K8sObject, name_of, namespace_of, now_rfc3339, uid_of
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: K8sObject
+
+
+Key = Tuple[str, str, Optional[str], str]  # (apiVersion, kind, namespace, name)
+
+
+class _Watcher:
+    def __init__(self, api_version: str, kind: str, namespace: Optional[str]):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.events: "queue.Queue[WatchEvent]" = queue.Queue()
+
+    def matches(self, obj: K8sObject) -> bool:
+        if obj.get("apiVersion") != self.api_version or obj.get("kind") != self.kind:
+            return False
+        if self.namespace is not None and namespace_of(obj) != self.namespace:
+            return False
+        return True
+
+
+class InMemoryCluster:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[Key, K8sObject] = {}
+        self._rv = 0
+        self._watchers: List[_Watcher] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _key(self, obj: K8sObject) -> Key:
+        return (obj["apiVersion"], obj["kind"], namespace_of(obj), name_of(obj))
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, etype: str, obj: K8sObject) -> None:
+        for w in self._watchers:
+            if w.matches(obj):
+                w.events.put(WatchEvent(etype, copy.deepcopy(obj)))
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, obj: K8sObject) -> K8sObject:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            key = self._key(obj)
+            if key in self._objects:
+                raise AlreadyExists(f"{key} already exists")
+            meta = obj.setdefault("metadata", {})
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = self._next_rv()
+            meta["creationTimestamp"] = meta.get("creationTimestamp") or now_rfc3339()
+            self._objects[key] = obj
+            self._emit("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def get(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> K8sObject:
+        with self._lock:
+            key = (api_version, kind, namespace, name)
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFound(f"{key} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[K8sObject]:
+        from .objects import matches_selector
+
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in self._objects.items():
+                if av != api_version or k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if not matches_selector(obj, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj: K8sObject) -> K8sObject:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            key = self._key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{key} not found")
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{key}: resourceVersion {sent_rv} != {cur['metadata']['resourceVersion']}"
+                )
+            # Immutable fields survive the write.
+            obj["metadata"]["uid"] = cur["metadata"]["uid"]
+            obj["metadata"]["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
+            if "deletionTimestamp" in cur["metadata"]:
+                obj["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._objects[key] = obj
+            self._emit("MODIFIED", obj)
+            # A finalizer removal on a deleting object may allow reaping.
+            if "deletionTimestamp" in obj["metadata"] and not obj["metadata"].get(
+                "finalizers"
+            ):
+                self._reap(key)
+            return copy.deepcopy(obj)
+
+    def update_status(self, obj: K8sObject) -> K8sObject:
+        """Status-subresource write: only .status is applied."""
+        with self._lock:
+            key = self._key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{key} not found")
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{key}: resourceVersion {sent_rv} != {cur['metadata']['resourceVersion']}"
+                )
+            cur = copy.deepcopy(cur)
+            cur["status"] = copy.deepcopy(obj.get("status", {}))
+            cur["metadata"]["resourceVersion"] = self._next_rv()
+            self._objects[key] = cur
+            self._emit("MODIFIED", cur)
+            return copy.deepcopy(cur)
+
+    def delete(
+        self, api_version: str, kind: str, namespace: Optional[str], name: str
+    ) -> None:
+        with self._lock:
+            key = (api_version, kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{key} not found")
+            if cur["metadata"].get("finalizers"):
+                if "deletionTimestamp" not in cur["metadata"]:
+                    cur["metadata"]["deletionTimestamp"] = now_rfc3339()
+                    cur["metadata"]["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", cur)
+                return
+            self._reap(key)
+
+    def _reap(self, key: Key) -> None:
+        cur = self._objects.pop(key, None)
+        if cur is None:
+            return
+        self._emit("DELETED", cur)
+        self._gc_orphans(uid_of(cur))
+
+    def _gc_orphans(self, owner_uid: str) -> None:
+        """Cascade-delete objects whose sole controller owner vanished."""
+        to_delete = []
+        for key, obj in list(self._objects.items()):
+            refs = obj.get("metadata", {}).get("ownerReferences", [])
+            if any(r.get("uid") == owner_uid for r in refs):
+                remaining = [r for r in refs if r.get("uid") != owner_uid]
+                if remaining:
+                    obj["metadata"]["ownerReferences"] = remaining
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", obj)
+                else:
+                    to_delete.append(key)
+        for key in to_delete:
+            av, k, ns, n = key
+            try:
+                self.delete(av, k, ns, n)
+            except NotFound:
+                pass
+
+    # -- watches -------------------------------------------------------------
+
+    def watch(
+        self, api_version: str, kind: str, namespace: Optional[str] = None
+    ) -> _Watcher:
+        """Returns a watcher primed with synthetic ADDED events for existing
+        objects (list+watch semantics collapsed, as informers present it)."""
+        with self._lock:
+            w = _Watcher(api_version, kind, namespace)
+            for obj in self.list(api_version, kind, namespace):
+                w.events.put(WatchEvent("ADDED", obj))
+            self._watchers.append(w)
+            return w
+
+    def stop_watch(self, watcher: _Watcher) -> None:
+        with self._lock:
+            if watcher in self._watchers:
+                self._watchers.remove(watcher)

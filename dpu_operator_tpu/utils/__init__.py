@@ -1,0 +1,13 @@
+from .path_manager import PathManager
+from .cluster_environment import ClusterEnvironment, Flavour
+from .filesystem_mode import FilesystemMode, FilesystemModeDetector
+from . import fileutils
+
+__all__ = [
+    "PathManager",
+    "ClusterEnvironment",
+    "Flavour",
+    "FilesystemMode",
+    "FilesystemModeDetector",
+    "fileutils",
+]

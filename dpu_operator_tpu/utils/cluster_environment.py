@@ -1,0 +1,61 @@
+"""Cluster flavour detection.
+
+TPU-native counterpart of reference internal/utils/cluster_environment.go:25-60:
+MicroShift is recognised by the kube-public `microshift-version` ConfigMap,
+OpenShift by the presence of the `clusterversions.config.openshift.io` CRD,
+Kind by node name/provider heuristics; everything else is VANILLA (a plain
+k8s cluster, e.g. GKE on TPU-VMs — the primary deployment target here).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Flavour(enum.Enum):
+    OPENSHIFT = "openshift"
+    MICROSHIFT = "microshift"
+    KIND = "kind"
+    VANILLA = "kubernetes"
+
+
+class ClusterEnvironment:
+    def __init__(self, client):
+        self._client = client
+
+    def flavour(self) -> Flavour:
+        if self._has_configmap("kube-public", "microshift-version"):
+            return Flavour.MICROSHIFT
+        if self._has_crd("clusterversions.config.openshift.io"):
+            return Flavour.OPENSHIFT
+        if self._looks_like_kind():
+            return Flavour.KIND
+        return Flavour.VANILLA
+
+    def _has_configmap(self, namespace: str, name: str) -> bool:
+        try:
+            return self._client.get("v1", "ConfigMap", namespace, name) is not None
+        except Exception:
+            return False
+
+    def _has_crd(self, name: str) -> bool:
+        try:
+            obj = self._client.get(
+                "apiextensions.k8s.io/v1", "CustomResourceDefinition", None, name
+            )
+            return obj is not None
+        except Exception:
+            return False
+
+    def _looks_like_kind(self) -> bool:
+        try:
+            nodes = self._client.list("v1", "Node", None)
+        except Exception:
+            return False
+        for n in nodes:
+            pid = (n.get("spec") or {}).get("providerID", "")
+            if pid.startswith("kind://"):
+                return True
+            if n.get("metadata", {}).get("name", "").endswith("-control-plane"):
+                return True
+        return False

@@ -1,0 +1,30 @@
+"""Filesystem mode detection: package-mode vs image-mode (ostree) hosts.
+
+Counterpart of reference internal/utils/filesystem_mode_detector.go:10-60 —
+an ostree-booted host (/run/ostree-booted exists, or / is a composefs/
+ostree deployment) is IMAGE mode, where only /var is writable and the CNI
+binary must be installed under /var/lib/cni/bin.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+
+
+class FilesystemMode(enum.Enum):
+    PACKAGE = "package"
+    IMAGE = "image"
+
+
+class FilesystemModeDetector:
+    def __init__(self, root: str = "/"):
+        self._root = root
+
+    def detect(self) -> FilesystemMode:
+        if os.path.exists(os.path.join(self._root, "run/ostree-booted")):
+            return FilesystemMode.IMAGE
+        ostree_dir = os.path.join(self._root, "ostree")
+        if os.path.isdir(ostree_dir):
+            return FilesystemMode.IMAGE
+        return FilesystemMode.PACKAGE

@@ -1,0 +1,39 @@
+"""Small file helpers (counterpart of reference internal/utils/fileutils.go)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+
+
+def _ensure_parent(path: str) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+
+
+def copy_file(src: str, dst: str) -> None:
+    _ensure_parent(dst)
+    tmp = dst + ".tmp"
+    shutil.copy2(src, tmp)
+    os.replace(tmp, dst)
+
+
+def make_executable(path: str) -> None:
+    st = os.stat(path)
+    os.chmod(path, st.st_mode | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)
+
+
+def touch(path: str) -> None:
+    _ensure_parent(path)
+    with open(path, "a"):
+        os.utime(path)
+
+
+def atomic_write(path: str, data: str) -> None:
+    _ensure_parent(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)

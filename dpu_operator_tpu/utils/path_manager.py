@@ -1,0 +1,97 @@
+"""PathManager — single source of truth for every socket/dir path.
+
+TPU-native counterpart of the reference's internal/utils/path_manager.go:12-64.
+Every path is derived from a root prefix so tests can re-root the whole
+filesystem layout into a temp dir (reference tests do the same via
+`utils.PathManager(rootDir)`).
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+from dataclasses import dataclass
+
+from ..utils.cluster_environment import Flavour
+from ..utils.filesystem_mode import FilesystemMode
+
+
+@dataclass(frozen=True)
+class PathManager:
+    root: str = "/"
+
+    # -- daemon-owned sockets ------------------------------------------------
+
+    def daemon_base_dir(self) -> str:
+        return self._p("var/run/dpu-daemon")
+
+    def cni_server_socket(self) -> str:
+        """Unix socket the CNI shim POSTs requests to
+        (reference: /var/run/dpu-daemon/dpu-cni/dpu-cni-server.sock)."""
+        return os.path.join(self.daemon_base_dir(), "dpu-cni", "dpu-cni-server.sock")
+
+    def vendor_plugin_socket(self) -> str:
+        """Unix socket every VSP serves its gRPC services on
+        (reference: internal/utils/path_manager.go:58-60)."""
+        return os.path.join(self.daemon_base_dir(), "vendor-plugin", "vendor-plugin.sock")
+
+    def cp_agent_socket(self) -> str:
+        """Local socket of the native C++ control-plane agent (the octep
+        plugin-server analogue for TPU node health/topology)."""
+        return os.path.join(self.daemon_base_dir(), "cp-agent", "cp-agent.sock")
+
+    # -- kubelet integration -------------------------------------------------
+
+    def kubelet_plugin_dir(self) -> str:
+        return self._p("var/lib/kubelet/device-plugins")
+
+    def kubelet_registry_socket(self) -> str:
+        return os.path.join(self.kubelet_plugin_dir(), "kubelet.sock")
+
+    def device_plugin_socket(self) -> str:
+        return os.path.join(self.kubelet_plugin_dir(), "tpu-dpu.sock")
+
+    # -- CNI install locations ----------------------------------------------
+
+    def cni_state_dir(self) -> str:
+        """On-disk NetConf cache + endpoint allocations so CmdDel survives
+        daemon restarts (reference: sriov.go:492-503 DefaultCNIDir)."""
+        return self._p("var/lib/cni/dpu")
+
+    def cni_host_dir(self, flavour: Flavour, fs_mode: FilesystemMode) -> str:
+        """Where the CNI shim binary must be installed, by (flavour, fsmode)
+        — same decision as reference path_manager.go:41-56: ostree
+        (image-mode) hosts have a read-only /opt, so the binary must land
+        in a writable runtime dir instead."""
+        if flavour == Flavour.MICROSHIFT:
+            if fs_mode == FilesystemMode.IMAGE:
+                return self._p("run/cni/bin")
+            return self._p("opt/cni/bin")
+        if flavour == Flavour.KIND:
+            return self._p("opt/cni/bin")
+        if fs_mode == FilesystemMode.IMAGE:
+            return self._p("var/lib/cni/bin")
+        return self._p("opt/cni/bin")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _p(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def ensure_socket_dir(self, socket_path: str) -> None:
+        """Create the socket's parent dir with root-only perms and verify
+        ownership — reference secure-socket check path_manager.go:67-100."""
+        d = os.path.dirname(socket_path)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.stat(d)
+        if st.st_uid != os.getuid():
+            raise PermissionError(f"socket dir {d} not owned by uid {os.getuid()}")
+        mode = stat.S_IMODE(st.st_mode)
+        if mode & 0o077:
+            os.chmod(d, 0o700)
+
+    def remove_stale_socket(self, socket_path: str) -> None:
+        try:
+            os.unlink(socket_path)
+        except FileNotFoundError:
+            pass

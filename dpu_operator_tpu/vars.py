@@ -1,0 +1,42 @@
+"""Cluster-wide constants.
+
+TPU-native counterpart of the reference's pkgs/vars/vars.go:3-13. We keep
+the operator namespace and singleton-config naming contract, and add the
+TPU resource/label vocabulary that replaces the SR-IOV one.
+"""
+
+# Namespace every operand (daemon, VSP pods, NRI) is deployed into.
+NAMESPACE = "tpu-dpu-operator"
+
+# The singleton DpuOperatorConfig must use exactly this name; enforced by
+# the validating webhook (reference: api/v1/dpuoperatorconfig_webhook.go:52-58).
+DPU_OPERATOR_CONFIG_NAME = "dpu-operator-config"
+
+# Extended resource advertised by the device plugin for fabric endpoints
+# (reference resource: "openshift.io/dpu", deviceplugin.go:25).
+DPU_RESOURCE_NAME = "tpu.dpu.io/endpoint"
+
+# Default NetworkAttachmentDefinition for host-side secondary interfaces
+# (reference: vars.go DefaultHostNADName="default-sriov-net").
+DEFAULT_HOST_NAD_NAME = "default-ici-net"
+
+# NAD used by network-function (SFC) pods; attached twice per NF pod.
+NF_NAD_NAME = "dpunfcni-conf"
+
+# Node opt-in label (reference: bindata/daemon/99.daemonset.yaml:20-21).
+NODE_OPT_IN_LABEL = "dpu"
+NODE_OPT_IN_VALUE = "true"
+
+# Derived side label maintained by the daemon
+# (reference: internal/daemon/daemon.go:30).
+DPU_SIDE_LABEL = "dpu.config.tpu.io/dpuside"
+DPU_SIDE_DPU = "dpu"
+DPU_SIDE_HOST = "dpu-host"
+
+# Metrics service name (reference: vars.go:12).
+METRICS_SERVICE_NAME = "tpu-dpu-operator-metrics"
+
+# API group/version for our CRDs.
+API_GROUP = "config.tpu.io"
+API_VERSION = "v1"
+API_GROUP_VERSION = API_GROUP + "/" + API_VERSION

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate protobuf message bindings (the gRPC glue is hand-written in
+# dpu_operator_tpu/dpu_api/services.py — keep it in sync on contract edits).
+set -euo pipefail
+cd "$(dirname "$0")/../dpu_operator_tpu/dpu_api"
+mkdir -p gen
+protoc --python_out=gen -I protos -I /usr/include \
+  protos/dpu_api.proto protos/bridge_port.proto protos/kubelet_deviceplugin.proto
+touch gen/__init__.py
+echo "generated: $(ls gen)"

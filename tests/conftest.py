@@ -1,0 +1,59 @@
+"""Test configuration.
+
+Sharding/mesh tests run on a virtual 8-device CPU platform (the same
+technique the driver uses for the multi-chip dry-run); env vars must be
+set before jax initialises its backends, hence at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def netns():
+    """CNI dataplane tests need root + netlink; probe lazily (only when a
+    test actually asks for the fixture) and skip gracefully elsewhere."""
+    import subprocess
+    import uuid
+
+    if os.geteuid() != 0:
+        pytest.skip("needs root for netns/veth")
+    probe = f"pr{uuid.uuid4().hex[:8]}"
+    r = subprocess.run(
+        ["ip", "link", "add", probe + "a", "type", "veth", "peer", "name", probe + "b"],
+        capture_output=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"veth creation unavailable: {r.stderr.decode().strip()}")
+    subprocess.run(["ip", "link", "del", probe + "a"], capture_output=True)
+    return True
+
+
+@pytest.fixture
+def tmp_root():
+    """A re-rooted PathManager temp dir (reference tests re-root every
+    socket path the same way, internal/utils/path_manager.go:16-18).
+
+    Unix socket paths are capped at ~107 chars, so this uses a short
+    /tmp/dpu-* dir rather than pytest's deeply nested tmp_path."""
+    import shutil
+    import tempfile
+
+    from dpu_operator_tpu.utils import PathManager
+
+    d = tempfile.mkdtemp(prefix="dpu-")
+    try:
+        yield PathManager(root=d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)

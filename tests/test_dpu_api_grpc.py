@@ -1,0 +1,63 @@
+"""Round-trip the gRPC contract over a real unix socket — the same process
+boundary the daemon↔VSP split crosses in production."""
+
+import concurrent.futures
+
+import grpc
+from google.protobuf import empty_pb2
+
+from dpu_operator_tpu.dpu_api import dpu_api_pb2 as pb
+from dpu_operator_tpu.dpu_api import services
+
+
+class _Life(services.LifeCycleServicer):
+    def Init(self, request, context):
+        assert request.dpu_mode == pb.DPU_MODE_DPU
+        return pb.IpPort(ip="127.0.0.1", port=50051)
+
+
+class _Dev(services.DeviceServicer):
+    def GetDevices(self, request, context):
+        resp = pb.DeviceListResponse()
+        d = resp.devices["tpu-0-ep0"]
+        d.id = "tpu-0-ep0"
+        d.health = pb.HEALTHY
+        d.topology.coords = "0,0,0"
+        d.topology.links.add(neighbor="1,0,0", gbps=400)
+        return resp
+
+    def SetNumEndpoints(self, request, context):
+        return pb.EndpointCount(count=request.count)
+
+
+class _Beat(services.HeartbeatServicer):
+    def Ping(self, request, context):
+        return pb.PingResponse(healthy=True)
+
+
+def test_vsp_contract_over_unix_socket(tmp_root):
+    sock = tmp_root.vendor_plugin_socket()
+    tmp_root.ensure_socket_dir(sock)
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=4))
+    services.add_lifecycle(_Life(), server)
+    services.add_device(_Dev(), server)
+    services.add_heartbeat(_Beat(), server)
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        life = services.LifeCycleStub(channel)
+        ipport = life.Init(
+            pb.InitRequest(dpu_mode=pb.DPU_MODE_DPU, dpu_identifier="tpu-v5e-w0")
+        )
+        assert (ipport.ip, ipport.port) == ("127.0.0.1", 50051)
+
+        dev = services.DeviceStub(channel)
+        devices = dev.GetDevices(empty_pb2.Empty()).devices
+        assert devices["tpu-0-ep0"].topology.links[0].gbps == 400
+        assert dev.SetNumEndpoints(pb.EndpointCount(count=8)).count == 8
+
+        beat = services.HeartbeatStub(channel)
+        assert beat.Ping(pb.PingRequest(timestamp_ns=1, sender_id="host")).healthy
+    finally:
+        server.stop(0)

@@ -1,0 +1,155 @@
+"""API-machinery tests: store semantics (RV/conflict/finalizers/GC/watch)
+and the controller framework's reconcile loop."""
+
+import threading
+import time
+
+import pytest
+
+from dpu_operator_tpu.k8s import (
+    AlreadyExists,
+    Conflict,
+    InMemoryClient,
+    InMemoryCluster,
+    Manager,
+    NotFound,
+    Reconciler,
+    Request,
+    Result,
+    add_finalizer,
+    remove_finalizer,
+    set_condition,
+    get_condition,
+)
+from dpu_operator_tpu.k8s.objects import set_owner
+
+
+def mk(kind, name, namespace=None, api_version="v1", **extra):
+    obj = {"apiVersion": api_version, "kind": kind, "metadata": {"name": name}}
+    if namespace:
+        obj["metadata"]["namespace"] = namespace
+    obj.update(extra)
+    return obj
+
+
+@pytest.fixture
+def client():
+    return InMemoryClient(InMemoryCluster())
+
+
+def test_create_get_conflict(client):
+    obj = client.create(mk("ConfigMap", "a", "ns1", data={"k": "v"}))
+    assert obj["metadata"]["uid"]
+    with pytest.raises(AlreadyExists):
+        client.create(mk("ConfigMap", "a", "ns1"))
+    got = client.get("v1", "ConfigMap", "ns1", "a")
+    got_stale = client.get("v1", "ConfigMap", "ns1", "a")
+    got["data"] = {"k": "v2"}
+    client.update(got)
+    got_stale["data"] = {"k": "v3"}
+    with pytest.raises(Conflict):
+        client.update(got_stale)
+
+
+def test_finalizer_blocks_deletion(client):
+    obj = mk("Pod", "p", "ns1")
+    add_finalizer(obj, "test/finalizer")
+    client.create(obj)
+    client.delete("v1", "Pod", "ns1", "p")
+    cur = client.get("v1", "Pod", "ns1", "p")
+    assert "deletionTimestamp" in cur["metadata"]
+    remove_finalizer(cur, "test/finalizer")
+    client.update(cur)
+    assert client.get_or_none("v1", "Pod", "ns1", "p") is None
+
+
+def test_owner_gc_cascade(client):
+    owner = client.create(mk("DpuOperatorConfig", "cfg", "ns1", api_version="config.tpu.io/v1"))
+    child = mk("DaemonSet", "ds", "ns1", api_version="apps/v1")
+    set_owner(child, owner)
+    client.create(child)
+    client.delete("config.tpu.io/v1", "DpuOperatorConfig", "ns1", "cfg")
+    assert client.get_or_none("apps/v1", "DaemonSet", "ns1", "ds") is None
+
+
+def test_status_subresource_isolated(client):
+    obj = client.create(mk("DataProcessingUnit", "d", None, api_version="config.tpu.io/v1"))
+    obj["status"] = {}
+    set_condition(obj, "Ready", "True", "Up", "all good")
+    client.update_status(obj)
+    cur = client.get("config.tpu.io/v1", "DataProcessingUnit", None, "d")
+    assert get_condition(cur, "Ready")["status"] == "True"
+
+
+def test_apply_create_then_merge(client):
+    obj = mk("ConfigMap", "c", "ns1", data={"a": "1"})
+    client.apply(obj)
+    obj2 = mk("ConfigMap", "c", "ns1", data={"a": "2"})
+    obj2["metadata"]["labels"] = {"x": "y"}
+    client.apply(obj2)
+    cur = client.get("v1", "ConfigMap", "ns1", "c")
+    assert cur["data"] == {"a": "2"}
+    assert cur["metadata"]["labels"] == {"x": "y"}
+
+
+def test_watch_stream(client):
+    client.create(mk("Node", "n0"))
+    w = client.watch("v1", "Node")
+    ev = w.events.get(timeout=1)
+    assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "n0"
+    client.create(mk("Node", "n1"))
+    ev = w.events.get(timeout=1)
+    assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "n1"
+    client.delete("v1", "Node", None, "n1")
+    ev = w.events.get(timeout=1)
+    assert ev.type == "DELETED"
+
+
+class _Recorder(Reconciler):
+    def __init__(self):
+        self.seen = []
+        self.event = threading.Event()
+
+    def reconcile(self, req):
+        self.seen.append(req)
+        self.event.set()
+        return Result()
+
+
+def test_controller_reconciles_on_events(client):
+    mgr = Manager(client)
+    rec = _Recorder()
+    mgr.new_controller("test", rec).watches("v1", "ConfigMap", "ns1")
+    mgr.start()
+    try:
+        client.create(mk("ConfigMap", "x", "ns1"))
+        assert rec.event.wait(timeout=3)
+        assert Request("ns1", "x") in rec.seen
+    finally:
+        mgr.stop()
+
+
+class _FailOnce(Reconciler):
+    def __init__(self):
+        self.calls = 0
+        self.done = threading.Event()
+
+    def reconcile(self, req):
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("transient")
+        self.done.set()
+        return Result()
+
+
+def test_controller_retries_with_backoff(client):
+    mgr = Manager(client)
+    rec = _FailOnce()
+    mgr.new_controller("retry", rec).watches("v1", "Secret", "ns1")
+    mgr.start()
+    try:
+        client.create(mk("Secret", "s", "ns1"))
+        assert rec.done.wait(timeout=5)
+        assert rec.calls >= 2
+    finally:
+        mgr.stop()

@@ -1,0 +1,60 @@
+"""Utils-layer tests (counterpart of reference internal/utils tests:
+filesystem_mode_detector_test.go, path_manager behavior)."""
+
+import os
+
+from dpu_operator_tpu.utils import (
+    FilesystemMode,
+    FilesystemModeDetector,
+    Flavour,
+    PathManager,
+    fileutils,
+)
+
+
+def test_path_manager_rerooting(tmp_path):
+    pm = PathManager(root=str(tmp_path))
+    assert pm.cni_server_socket().startswith(str(tmp_path))
+    assert pm.vendor_plugin_socket().endswith("vendor-plugin/vendor-plugin.sock")
+    assert pm.device_plugin_socket().endswith("device-plugins/tpu-dpu.sock")
+
+
+def test_path_manager_cni_host_dir_matrix(tmp_path):
+    pm = PathManager(root=str(tmp_path))
+    assert pm.cni_host_dir(Flavour.MICROSHIFT, FilesystemMode.PACKAGE).endswith(
+        "opt/cni/bin"
+    )
+    assert pm.cni_host_dir(Flavour.OPENSHIFT, FilesystemMode.IMAGE).endswith(
+        "var/lib/cni/bin"
+    )
+
+
+def test_ensure_socket_dir_perms(tmp_path):
+    pm = PathManager(root=str(tmp_path))
+    sock = pm.vendor_plugin_socket()
+    pm.ensure_socket_dir(sock)
+    st = os.stat(os.path.dirname(sock))
+    assert (st.st_mode & 0o077) == 0
+
+
+def test_filesystem_mode_detector(tmp_path):
+    det = FilesystemModeDetector(root=str(tmp_path))
+    assert det.detect() == FilesystemMode.PACKAGE
+    os.makedirs(tmp_path / "run", exist_ok=True)
+    (tmp_path / "run" / "ostree-booted").touch()
+    assert det.detect() == FilesystemMode.IMAGE
+
+
+def test_fileutils_copy_and_executable(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_text("#!/bin/sh\necho hi\n")
+    dst = str(tmp_path / "sub" / "dst.bin")
+    fileutils.copy_file(str(src), dst)
+    fileutils.make_executable(dst)
+    assert os.access(dst, os.X_OK)
+
+
+def test_atomic_write(tmp_path):
+    p = str(tmp_path / "d" / "f.json")
+    fileutils.atomic_write(p, "{}")
+    assert open(p).read() == "{}"
