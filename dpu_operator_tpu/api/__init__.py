@@ -1,0 +1,4 @@
+from . import v1
+from .webhook import AdmissionWebhook, validate_dpu_operator_config
+
+__all__ = ["v1", "AdmissionWebhook", "validate_dpu_operator_config"]

@@ -1,0 +1,145 @@
+"""api/v1 — the four custom resources.
+
+TPU-native counterpart of the reference CRD schemas:
+  DpuOperatorConfig        reference api/v1/dpuoperatorconfig_types.go:49
+  DataProcessingUnit       reference api/v1/dataprocessingunit_types.go:130
+  ServiceFunctionChain     reference api/v1/servicefunctionchain_types.go:195
+  DataProcessingUnitConfig reference api/v1/dataprocessingunitconfig_types.go:268
+
+Objects are plain dicts in wire format; this module provides constructors,
+kind/GV constants, and field-level validation shared with the webhook.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import vars as v
+
+GROUP_VERSION = v.API_GROUP_VERSION
+
+KIND_DPU_OPERATOR_CONFIG = "DpuOperatorConfig"
+KIND_DATA_PROCESSING_UNIT = "DataProcessingUnit"
+KIND_SERVICE_FUNCTION_CHAIN = "ServiceFunctionChain"
+KIND_DATA_PROCESSING_UNIT_CONFIG = "DataProcessingUnitConfig"
+
+LOG_LEVELS = (0, 1, 2, 3)
+
+# Condition types used on DpuOperatorConfig / DataProcessingUnit status.
+COND_READY = "Ready"
+
+
+def new_dpu_operator_config(
+    name: str = v.DPU_OPERATOR_CONFIG_NAME,
+    namespace: str = v.NAMESPACE,
+    mode: str = "auto",
+    log_level: int = 0,
+) -> dict:
+    """The singleton cluster configuration CR.
+
+    spec.mode: "auto" | "host" | "dpu" — forces the daemon side role
+    (reference uses the detected platform; we add an explicit override).
+    spec.logLevel: verbosity plumbed to daemon/VSP pods
+    (reference dpuoperatorconfig_types.go:31)."""
+    return {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND_DPU_OPERATOR_CONFIG,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"mode": mode, "logLevel": log_level},
+    }
+
+
+def new_data_processing_unit(
+    name: str,
+    product_name: str,
+    is_dpu_side: bool,
+    node_name: str,
+    namespace: str = v.NAMESPACE,
+) -> dict:
+    """One CR per detected accelerator per side; created and synced by the
+    node daemon (reference dataprocessingunit_types.go:100-110, daemon
+    sync at internal/daemon/daemon.go:265-306)."""
+    return {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND_DATA_PROCESSING_UNIT,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "dpuProductName": product_name,
+            "isDpuSide": is_dpu_side,
+            "nodeName": node_name,
+        },
+    }
+
+
+def new_service_function_chain(
+    name: str,
+    namespace: str = v.NAMESPACE,
+    node_selector: Optional[Dict[str, str]] = None,
+    network_functions: Optional[List[dict]] = None,
+) -> dict:
+    """Ordered chain of network functions; each NF is {name, image}
+    (reference servicefunctionchain_types.go:176-188)."""
+    return {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND_SERVICE_FUNCTION_CHAIN,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "nodeSelector": node_selector or {},
+            "networkFunctions": network_functions or [],
+        },
+    }
+
+
+def new_data_processing_unit_config(
+    name: str,
+    namespace: str = v.NAMESPACE,
+    dpu_selector: Optional[Dict[str, str]] = None,
+    num_endpoints: Optional[int] = None,
+) -> dict:
+    """Per-DPU tuning CR. The reference ships this as a placeholder
+    (dataprocessingunitconfig_types.go:251-254, spec.Foo); we give it the
+    obvious real field: fabric endpoint partitioning."""
+    spec: dict = {"dpuSelector": dpu_selector or {}}
+    if num_endpoints is not None:
+        spec["numEndpoints"] = num_endpoints
+    return {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND_DATA_PROCESSING_UNIT_CONFIG,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+# -- validation (shared by webhook and clients) ------------------------------
+
+
+class ValidationError(Exception):
+    pass
+
+
+def validate_dpu_operator_config_spec(obj: dict) -> None:
+    """Singleton-name rule + field checks (reference webhook
+    api/v1/dpuoperatorconfig_webhook.go:35-58)."""
+    name = obj.get("metadata", {}).get("name")
+    if name != v.DPU_OPERATOR_CONFIG_NAME:
+        raise ValidationError(
+            f"DpuOperatorConfig must be named {v.DPU_OPERATOR_CONFIG_NAME!r}, got {name!r}"
+        )
+    spec = obj.get("spec", {})
+    mode = spec.get("mode", "auto")
+    if mode not in ("auto", "host", "dpu"):
+        raise ValidationError(f"spec.mode must be auto|host|dpu, got {mode!r}")
+    ll = spec.get("logLevel", 0)
+    if not isinstance(ll, int) or ll not in LOG_LEVELS:
+        raise ValidationError(f"spec.logLevel must be one of {LOG_LEVELS}, got {ll!r}")
+
+
+def validate_service_function_chain_spec(obj: dict) -> None:
+    nfs = obj.get("spec", {}).get("networkFunctions", [])
+    seen = set()
+    for nf in nfs:
+        if not nf.get("name") or not nf.get("image"):
+            raise ValidationError("each networkFunction needs name and image")
+        if nf["name"] in seen:
+            raise ValidationError(f"duplicate networkFunction name {nf['name']!r}")
+        seen.add(nf["name"])

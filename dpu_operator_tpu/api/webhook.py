@@ -1,0 +1,150 @@
+"""Admission webhook server — AdmissionReview v1 over HTTP(S).
+
+Validating counterpart of the reference's DpuOperatorConfig webhook
+(api/v1/dpuoperatorconfig_webhook.go:35-58, served by controller-runtime
+on :9443). The same server class also carries the mutating /mutate
+endpoint used by the network-resources-injector (cmd/nri/
+networkresourcesinjector.go:137-146) — handlers are registered per path.
+
+Stdlib HTTP server; TLS via ssl context when cert/key provided (cert
+hot-reload is handled by re-creating the server — the reference uses
+fsnotify, nri:190-230)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# A handler takes the AdmissionRequest dict and returns
+# (allowed, message, json_patch_or_None).
+AdmissionHandler = Callable[[dict], Tuple[bool, str, Optional[list]]]
+
+
+def validate_dpu_operator_config(request: dict) -> Tuple[bool, str, Optional[list]]:
+    from . import v1
+
+    obj = request.get("object") or {}
+    try:
+        v1.validate_dpu_operator_config_spec(obj)
+    except v1.ValidationError as e:
+        return False, str(e), None
+    return True, "", None
+
+
+def validate_service_function_chain(request: dict) -> Tuple[bool, str, Optional[list]]:
+    from . import v1
+
+    obj = request.get("object") or {}
+    try:
+        v1.validate_service_function_chain_spec(obj)
+    except v1.ValidationError as e:
+        return False, str(e), None
+    return True, "", None
+
+
+class AdmissionWebhook:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+    ):
+        self._handlers: Dict[str, AdmissionHandler] = {}
+        self._host = host
+        self._port = port
+        self._certfile = certfile
+        self._keyfile = keyfile
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, path: str, handler: AdmissionHandler) -> None:
+        self._handlers[path] = handler
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        webhook = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("webhook: " + fmt, *args)
+
+            def do_POST(self):
+                handler = webhook._handlers.get(self.path)
+                if handler is None:
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    review = json.loads(self.rfile.read(length))
+                    request = review.get("request", {})
+                    allowed, message, patch = handler(request)
+                    response = {"uid": request.get("uid", ""), "allowed": allowed}
+                    if message:
+                        response["status"] = {"message": message}
+                    if patch is not None:
+                        response["patchType"] = "JSONPatch"
+                        response["patch"] = base64.b64encode(
+                            json.dumps(patch).encode()
+                        ).decode()
+                    body = json.dumps(
+                        {
+                            "apiVersion": "admission.k8s.io/v1",
+                            "kind": "AdmissionReview",
+                            "response": response,
+                        }
+                    ).encode()
+                except Exception as e:  # malformed review → denied, not a crash
+                    log.exception("webhook handler failed")
+                    body = json.dumps(
+                        {
+                            "apiVersion": "admission.k8s.io/v1",
+                            "kind": "AdmissionReview",
+                            "response": {
+                                "uid": "",
+                                "allowed": False,
+                                "status": {"message": f"webhook error: {e}"},
+                            },
+                        }
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                # health endpoint (reference serves :8444 healthz, nri:231)
+                if self.path in ("/healthz", "/readyz"):
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        if self._certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._certfile, self._keyfile)
+            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="admission-webhook"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()

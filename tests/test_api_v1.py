@@ -1,0 +1,137 @@
+"""api/v1 schema + webhook tests (counterpart of reference
+api/v1/dpuoperatorconfig_webhook_test.go + webhook_suite_test.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.api import AdmissionWebhook, v1
+from dpu_operator_tpu.api.webhook import (
+    validate_dpu_operator_config,
+    validate_service_function_chain,
+)
+
+
+def test_constructors_produce_wire_format():
+    cfg = v1.new_dpu_operator_config()
+    assert cfg["apiVersion"] == "config.tpu.io/v1"
+    assert cfg["metadata"]["name"] == v.DPU_OPERATOR_CONFIG_NAME
+    dpu = v1.new_data_processing_unit("tpu-w0-dpu", "TPU v5e", True, "node-a")
+    assert dpu["spec"] == {
+        "dpuProductName": "TPU v5e",
+        "isDpuSide": True,
+        "nodeName": "node-a",
+    }
+
+
+def test_singleton_name_enforced():
+    bad = v1.new_dpu_operator_config(name="something-else")
+    with pytest.raises(v1.ValidationError, match="must be named"):
+        v1.validate_dpu_operator_config_spec(bad)
+    v1.validate_dpu_operator_config_spec(v1.new_dpu_operator_config())
+
+
+def test_mode_and_loglevel_validation():
+    cfg = v1.new_dpu_operator_config()
+    cfg["spec"]["mode"] = "sideways"
+    with pytest.raises(v1.ValidationError, match="mode"):
+        v1.validate_dpu_operator_config_spec(cfg)
+    cfg = v1.new_dpu_operator_config(log_level=7)
+    with pytest.raises(v1.ValidationError, match="logLevel"):
+        v1.validate_dpu_operator_config_spec(cfg)
+
+
+def test_sfc_validation():
+    sfc = v1.new_service_function_chain(
+        "chain", network_functions=[{"name": "fw", "image": "img"}]
+    )
+    v1.validate_service_function_chain_spec(sfc)
+    sfc["spec"]["networkFunctions"].append({"name": "fw", "image": "img2"})
+    with pytest.raises(v1.ValidationError, match="duplicate"):
+        v1.validate_service_function_chain_spec(sfc)
+    with pytest.raises(v1.ValidationError, match="name and image"):
+        v1.validate_service_function_chain_spec(
+            v1.new_service_function_chain("c2", network_functions=[{"name": "x"}])
+        )
+
+
+def _post_review(port, path, obj):
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "test-uid", "object": obj},
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())["response"]
+
+
+def test_webhook_server_round_trip():
+    wh = AdmissionWebhook()
+    wh.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
+    wh.register("/validate-sfc", validate_service_function_chain)
+    wh.start()
+    try:
+        ok = _post_review(
+            wh.port, "/validate-dpuoperatorconfig", v1.new_dpu_operator_config()
+        )
+        assert ok["allowed"] is True and ok["uid"] == "test-uid"
+
+        denied = _post_review(
+            wh.port,
+            "/validate-dpuoperatorconfig",
+            v1.new_dpu_operator_config(name="wrong"),
+        )
+        assert denied["allowed"] is False
+        assert "must be named" in denied["status"]["message"]
+
+        # malformed body → denied, not a 500
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wh.port}/validate-dpuoperatorconfig",
+            data=b"not json",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())["response"]
+        assert out["allowed"] is False
+    finally:
+        wh.stop()
+
+
+def test_webhook_health_endpoint():
+    wh = AdmissionWebhook()
+    wh.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{wh.port}/healthz") as resp:
+            assert resp.read() == b"ok"
+    finally:
+        wh.stop()
+
+
+def test_crd_manifests_parse():
+    import glob
+    import os
+
+    import yaml
+
+    crd_dir = os.path.join(os.path.dirname(__file__), "..", "config", "crd")
+    files = sorted(glob.glob(os.path.join(crd_dir, "*.yaml")))
+    assert len(files) == 4
+    kinds = set()
+    for f in files:
+        crd = yaml.safe_load(open(f))
+        assert crd["kind"] == "CustomResourceDefinition"
+        assert crd["spec"]["group"] == "config.tpu.io"
+        kinds.add(crd["spec"]["names"]["kind"])
+    assert kinds == {
+        "DpuOperatorConfig",
+        "DataProcessingUnit",
+        "ServiceFunctionChain",
+        "DataProcessingUnitConfig",
+    }
