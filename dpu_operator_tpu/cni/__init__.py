@@ -1,0 +1,5 @@
+from .types import CniError, CniRequest, CniResult
+from .server import CniServer
+from .shim import do_cni
+
+__all__ = ["CniRequest", "CniResult", "CniError", "CniServer", "do_cni"]

@@ -1,0 +1,4 @@
+from .fabric import FabricDataplane
+from .networkfn import NetworkFnDataplane
+
+__all__ = ["FabricDataplane", "NetworkFnDataplane"]

@@ -1,0 +1,141 @@
+"""Host-side fabric dataplane — pod interface plumbing.
+
+The role the SR-IOV manager plays in the reference (dpu-cni/pkgs/sriov/
+sriov.go:51-59 Manager): give the pod a secondary interface backed by a
+fabric endpoint. On SR-IOV hardware that means moving a VF into the pod
+netns; the TPU ICI fabric has no VFs, so the endpoint is realised as a
+veth pair whose host end is attached to the fabric bridge/queue by the
+VSP (the Marvell VSP does exactly this shape with veth + OVS,
+vendor-specific-plugins/marvell/main.go:280-317). The veth realisation
+is also the zero-hardware debug dataplane (SURVEY §7 hard part (a)).
+
+ADD: create veth, move container end into pod netns with temp-rename
+protocol, set deterministic MAC, IPAM address, bring up, persist state.
+DEL: tear down host end, release lease; returns whether the endpoint was
+actually released to gate the DPU-side bridge-port delete (the reference
+returns the same vfReleased gate, sriov.go:507-593)."""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Optional, Tuple
+
+from .. import netlink as nl
+from ..ipam import HostLocalIpam
+from ..statestore import StateStore
+from ..types import CniError, CniRequest, CniResult
+
+log = logging.getLogger(__name__)
+
+
+def _host_ifname(container_id: str, ifname: str) -> str:
+    h = hashlib.sha1(f"{container_id}/{ifname}".encode()).hexdigest()[:11]
+    return f"vep{h}"  # 14 chars, under IFNAMSIZ
+
+
+def _stable_mac(container_id: str, ifname: str) -> str:
+    h = hashlib.sha1(f"mac/{container_id}/{ifname}".encode()).digest()
+    # Locally administered, unicast.
+    return ":".join(
+        f"{b:02x}" for b in bytes([(h[0] & 0xFE) | 0x02]) + h[1:6]
+    )
+
+
+class FabricDataplane:
+    def __init__(self, state_store: StateStore, ipam: HostLocalIpam):
+        self._store = state_store
+        self._ipam = ipam
+
+    def cmd_add(self, req: CniRequest) -> CniResult:
+        if not req.netns:
+            raise CniError("ADD requires CNI_NETNS", code=4)
+        netns_was_path = "/" in req.netns
+        netns = nl.ensure_named_netns(req.netns)
+        host_if = _host_ifname(req.container_id, req.ifname)
+        tmp_if = "t" + host_if[1:]
+        mac = req.config.get("mac") or _stable_mac(req.container_id, req.ifname)
+        owner = f"{req.container_id}/{req.ifname}"
+
+        # Idempotent re-ADD: kubelet retries after timeouts.
+        if nl.link_exists(req.ifname, netns) and nl.link_exists(host_if):
+            state = self._store.load(req.container_id, req.ifname)
+            if state:
+                return self._result_from_state(state)
+
+        try:
+            nl.create_veth(host_if, tmp_if)
+            nl.set_mac(tmp_if, mac)
+            mtu = req.config.get("mtu")
+            if mtu:
+                nl.set_mtu(host_if, int(mtu))
+                nl.set_mtu(tmp_if, int(mtu))
+            nl.move_link_to_netns(tmp_if, netns)
+            nl.rename_link(tmp_if, req.ifname, netns)
+            cidr, gateway = self._ipam.allocate(owner)
+            nl.add_addr(req.ifname, cidr, netns)
+            nl.set_up(req.ifname, netns)
+            nl.set_up(host_if)
+            if gateway:
+                try:
+                    nl.add_route("default", gateway, req.ifname, netns)
+                except nl.NetlinkError:
+                    log.debug("default route exists in %s", netns)
+        except (nl.NetlinkError, OSError) as e:
+            # Full rollback — never leave a half-plumbed pod (the reference
+            # guarantees the same on its move protocol, networkfn.go:36-149).
+            self._rollback(host_if, tmp_if, req.ifname, netns, owner)
+            nl.release_named_netns(netns, netns_was_path)
+            raise CniError(f"fabric ADD failed: {e}") from e
+
+        state = {
+            "containerId": req.container_id,
+            "ifname": req.ifname,
+            "hostIf": host_if,
+            "mac": mac,
+            "address": cidr,
+            "gateway": gateway,
+            "netns": req.netns,
+            "owner": owner,
+            "sandbox": req.netns,
+        }
+        self._store.save(req.container_id, req.ifname, state)
+        nl.release_named_netns(netns, netns_was_path)
+        return self._result_from_state(state)
+
+    def cmd_del(self, req: CniRequest) -> Tuple[dict, bool]:
+        """Returns (result, released): released gates the DPU-side
+        DeleteBridgePort (reference hostsidemanager.go:209-224)."""
+        state = self._store.load(req.container_id, req.ifname)
+        if state is None:
+            # DEL must be idempotent per CNI spec.
+            return {}, False
+        host_if = state.get("hostIf", "")
+        if host_if:
+            nl.delete_link(host_if)  # deleting one veth end removes both
+        self._ipam.release(state.get("owner", f"{req.container_id}/{req.ifname}"))
+        self._store.delete(req.container_id, req.ifname)
+        return {}, True
+
+    def host_interface(self, container_id: str, ifname: str) -> Optional[str]:
+        state = self._store.load(container_id, ifname)
+        return state.get("hostIf") if state else None
+
+    # -- internals -----------------------------------------------------------
+
+    def _result_from_state(self, state: dict) -> CniResult:
+        result = CniResult()
+        idx = result.add_interface(state["ifname"], state["mac"], state["sandbox"])
+        result.add_ip(state["address"], idx, state.get("gateway"))
+        return result
+
+    def _rollback(self, host_if: str, tmp_if: str, ifname: str, netns: str, owner: str) -> None:
+        for name, ns in ((tmp_if, netns), (ifname, netns), (tmp_if, None), (host_if, None)):
+            try:
+                nl.delete_link(name, ns)
+            except nl.NetlinkError:
+                pass
+        try:
+            self._ipam.release(owner)
+        except Exception:
+            pass

@@ -1,0 +1,120 @@
+"""DPU-side network-function dataplane — whole-netdev move.
+
+Counterpart of reference dpu-cni/pkgs/networkfn/networkfn.go:36-231: a
+VSP-provided device (conf.deviceID) is moved bodily into the NF pod's
+netns using a temp-rename, alias-preserving protocol with full rollback;
+DEL reverses the move, restoring the original name in the host netns."""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Tuple
+
+from .. import netlink as nl
+from ..statestore import StateStore
+from ..types import CniError, CniRequest, CniResult
+
+log = logging.getLogger(__name__)
+
+
+class NetworkFnDataplane:
+    def __init__(self, state_store: StateStore):
+        self._store = state_store
+
+    def cmd_add(self, req: CniRequest) -> CniResult:
+        device = req.config.get("deviceID") or req.args.get("NF_DEV", "")
+        if not device:
+            raise CniError("networkfn ADD requires config.deviceID", code=7)
+        if not req.netns:
+            raise CniError("ADD requires CNI_NETNS", code=4)
+        netns_was_path = "/" in req.netns
+        netns = nl.ensure_named_netns(req.netns)
+        if not nl.link_exists(device):
+            nl.release_named_netns(netns, netns_was_path)
+            raise CniError(f"device {device} not found in host netns", code=7)
+
+        tmp = "nf" + uuid.uuid4().hex[:10]
+        orig_alias = nl.get_link(device).get("ifalias", "")
+        moved_to_ns = False
+        try:
+            nl.set_down(device)
+            # Alias records the original device name so DEL can restore it
+            # even after the link is renamed in the pod (the reference
+            # preserves the same breadcrumb, networkfn.go:60-100).
+            nl.set_alias(device, f"nf-orig:{device}")
+            nl.rename_link(device, tmp)
+            nl.move_link_to_netns(tmp, netns)
+            moved_to_ns = True
+            nl.rename_link(tmp, req.ifname, netns)
+            nl.set_up(req.ifname, netns)
+        except nl.NetlinkError as e:
+            self._rollback(device, tmp, req.ifname, netns, moved_to_ns, orig_alias)
+            nl.release_named_netns(netns, netns_was_path)
+            raise CniError(f"networkfn ADD failed: {e}") from e
+
+        mac = nl.get_mac(req.ifname, netns)
+        state = {
+            "containerId": req.container_id,
+            "ifname": req.ifname,
+            "device": device,
+            "mac": mac,
+            "netns": req.netns,
+            "sandbox": req.netns,
+        }
+        self._store.save(req.container_id, req.ifname, state)
+        nl.release_named_netns(netns, netns_was_path)
+        result = CniResult()
+        result.add_interface(req.ifname, mac, req.netns)
+        return result
+
+    def cmd_del(self, req: CniRequest) -> Tuple[dict, bool]:
+        state = self._store.load(req.container_id, req.ifname)
+        if state is None:
+            return {}, False
+        netns_was_path = "/" in state["netns"]
+        try:
+            netns = nl.ensure_named_netns(state["netns"])
+        except nl.NetlinkError:
+            # Pod netns is already gone; the kernel returned the device to
+            # the host netns under its temp/pod name or destroyed it.
+            self._store.delete(req.container_id, req.ifname)
+            return {}, True
+        device = state["device"]
+        tmp = "nf" + uuid.uuid4().hex[:10]
+        try:
+            if nl.link_exists(state["ifname"], netns):
+                nl.set_down(state["ifname"], netns)
+                nl.rename_link(state["ifname"], tmp, netns)
+                nl.move_link_to_host(tmp, netns)
+                nl.rename_link(tmp, device)
+                nl.set_alias(device, "")
+        except nl.NetlinkError as e:
+            log.warning("networkfn DEL restore failed for %s: %s", device, e)
+        finally:
+            nl.release_named_netns(netns, netns_was_path)
+        self._store.delete(req.container_id, req.ifname)
+        return {}, True
+
+    def pod_mac(self, container_id: str, ifname: str) -> str:
+        state = self._store.load(container_id, ifname)
+        return state.get("mac", "") if state else ""
+
+    # -- internals -----------------------------------------------------------
+
+    def _rollback(self, device, tmp, ifname, netns, moved_to_ns, orig_alias) -> None:
+        try:
+            if moved_to_ns:
+                for name in (tmp, ifname):
+                    if nl.link_exists(name, netns):
+                        nl.set_down(name, netns)
+                        nl.move_link_to_host(name, netns)
+                        nl.rename_link(name, device)
+                        break
+            elif nl.link_exists(tmp):
+                nl.rename_link(tmp, device)
+            if nl.link_exists(device):
+                nl.set_alias(device, orig_alias or "")
+                nl.set_up(device)
+        except nl.NetlinkError:
+            log.exception("networkfn rollback incomplete for %s", device)

@@ -1,0 +1,74 @@
+"""host-local IPAM — file-backed address allocator.
+
+The reference delegates IPAM to external plugins via env-var-passing exec
+(sriov.go:426-487), which forces its CNI server to serialize all requests
+under one mutex (cniserver.go:97-121). We implement host-local allocation
+natively instead: per-range file store with an fcntl lock, so requests
+for different pods can run concurrently — that mutex was the reference's
+pod-attach latency ceiling (SURVEY §7 hard part (c))."""
+
+from __future__ import annotations
+
+import fcntl
+import ipaddress
+import json
+import os
+from typing import Optional, Tuple
+
+
+class IpamError(RuntimeError):
+    pass
+
+
+class HostLocalIpam:
+    def __init__(self, state_dir: str, range_cidr: str, gateway: Optional[str] = None):
+        self._dir = state_dir
+        self._net = ipaddress.ip_network(range_cidr, strict=False)
+        self._gateway = gateway
+        os.makedirs(state_dir, exist_ok=True)
+        self._store = os.path.join(
+            state_dir, f"ipam-{self._net.network_address}-{self._net.prefixlen}.json"
+        )
+
+    def _load_locked(self, f) -> dict:
+        f.seek(0)
+        raw = f.read()
+        return json.loads(raw) if raw.strip() else {}
+
+    def _save_locked(self, f, data: dict) -> None:
+        f.seek(0)
+        f.truncate()
+        f.write(json.dumps(data))
+        f.flush()
+
+    def allocate(self, owner: str) -> Tuple[str, Optional[str]]:
+        """Returns (cidr, gateway). Owner is container_id/ifname — repeat
+        allocation for the same owner returns the existing lease."""
+        with open(self._store, "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            leases = self._load_locked(f)
+            for ip, who in leases.items():
+                if who == owner:
+                    return f"{ip}/{self._net.prefixlen}", self._gateway
+            used = set(leases.keys())
+            if self._gateway:
+                used.add(self._gateway)
+            for host in self._net.hosts():
+                h = str(host)
+                if h not in used:
+                    leases[h] = owner
+                    self._save_locked(f, leases)
+                    return f"{h}/{self._net.prefixlen}", self._gateway
+            raise IpamError(f"range {self._net} exhausted")
+
+    def release(self, owner: str) -> None:
+        with open(self._store, "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            leases = self._load_locked(f)
+            leases = {ip: who for ip, who in leases.items() if who != owner}
+            self._save_locked(f, leases)
+
+    def leases(self) -> dict:
+        with open(self._store, "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_SH)
+            return self._load_locked(f)

@@ -1,0 +1,171 @@
+"""Netlink operations via the iproute2 CLI.
+
+The reference uses vishvananda/netlink (Go); this image has neither
+pyroute2 nor a need for raw RTNETLINK — `ip` subprocess calls with full
+error propagation are the Python-native equivalent the rest of the CNI
+layer builds on. Every mutation has a rollback-friendly, idempotent
+wrapper."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import uuid
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class NetlinkError(RuntimeError):
+    pass
+
+
+def _run(args: List[str], netns: Optional[str] = None) -> str:
+    cmd = ["ip"]
+    if netns:
+        cmd += ["-n", netns]
+    cmd += args
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise NetlinkError(f"{' '.join(cmd)}: {r.stderr.strip()}")
+    return r.stdout
+
+
+def link_exists(name: str, netns: Optional[str] = None) -> bool:
+    try:
+        _run(["link", "show", "dev", name], netns)
+        return True
+    except NetlinkError:
+        return False
+
+
+def create_veth(name: str, peer: str) -> None:
+    _run(["link", "add", name, "type", "veth", "peer", "name", peer])
+
+
+def delete_link(name: str, netns: Optional[str] = None) -> None:
+    if link_exists(name, netns):
+        _run(["link", "del", "dev", name], netns)
+
+
+def set_up(name: str, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", name, "up"], netns)
+
+
+def set_down(name: str, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", name, "down"], netns)
+
+
+def set_mac(name: str, mac: str, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", name, "address", mac], netns)
+
+
+def set_mtu(name: str, mtu: int, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", name, "mtu", str(mtu)], netns)
+
+
+def rename_link(old: str, new: str, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", old, "name", new], netns)
+
+
+def set_alias(name: str, alias: str, netns: Optional[str] = None) -> None:
+    _run(["link", "set", "dev", name, "alias", alias], netns)
+
+
+def get_link(name: str, netns: Optional[str] = None) -> dict:
+    out = _run(["-j", "link", "show", "dev", name], netns)
+    data = json.loads(out)
+    if not data:
+        raise NetlinkError(f"link {name} not found")
+    return data[0]
+
+
+def get_mac(name: str, netns: Optional[str] = None) -> str:
+    return get_link(name, netns).get("address", "")
+
+
+def move_link_to_netns(name: str, netns: str) -> None:
+    _run(["link", "set", "dev", name, "netns", netns])
+
+
+def move_link_to_host(name: str, netns: str) -> None:
+    """Move a link out of `netns` back into the init (host) namespace."""
+    _run(["link", "set", "dev", name, "netns", "1"], netns)
+
+
+def add_addr(name: str, cidr: str, netns: Optional[str] = None) -> None:
+    _run(["addr", "add", cidr, "dev", name], netns)
+
+
+def get_addrs(name: str, netns: Optional[str] = None) -> List[str]:
+    out = _run(["-j", "addr", "show", "dev", name], netns)
+    data = json.loads(out)
+    addrs = []
+    for entry in data:
+        for a in entry.get("addr_info", []):
+            addrs.append(f"{a['local']}/{a['prefixlen']}")
+    return addrs
+
+
+def add_route(dst: str, via: Optional[str], dev: str, netns: Optional[str] = None) -> None:
+    args = ["route", "add", dst]
+    if via:
+        args += ["via", via]
+    args += ["dev", dev]
+    _run(args, netns)
+
+
+# -- netns management --------------------------------------------------------
+
+NETNS_RUN_DIR = "/var/run/netns"
+
+
+def create_netns(name: str) -> None:
+    subprocess.run(["ip", "netns", "add", name], check=True, capture_output=True)
+
+
+def delete_netns(name: str) -> None:
+    subprocess.run(["ip", "netns", "del", name], capture_output=True)
+
+
+def netns_exists(name: str) -> bool:
+    return os.path.exists(os.path.join(NETNS_RUN_DIR, name))
+
+
+def ensure_named_netns(netns_ref: str) -> str:
+    """Return an iproute2-usable netns name for either a name or a path.
+
+    The kubelet hands CNI a path like /proc/<pid>/ns/net or
+    /var/run/netns/<name>; iproute2 only addresses registered names, so
+    foreign paths are bind-mounted into /var/run/netns (the same trick
+    the reference's netns helpers rely on via the ns package)."""
+    if "/" not in netns_ref:
+        return netns_ref
+    if netns_ref.startswith(NETNS_RUN_DIR + "/"):
+        return os.path.basename(netns_ref)
+    name = "cni-" + uuid.uuid4().hex[:12]
+    os.makedirs(NETNS_RUN_DIR, exist_ok=True)
+    target = os.path.join(NETNS_RUN_DIR, name)
+    with open(target, "w"):
+        pass
+    r = subprocess.run(
+        ["mount", "--bind", netns_ref, target], capture_output=True, text=True
+    )
+    if r.returncode != 0:
+        os.unlink(target)
+        raise NetlinkError(f"bind-mount {netns_ref} -> {target}: {r.stderr.strip()}")
+    return name
+
+
+def release_named_netns(name: str, was_path: bool) -> None:
+    """Undo ensure_named_netns for bind-mounted (path-derived) names."""
+    if not was_path:
+        return
+    target = os.path.join(NETNS_RUN_DIR, name)
+    subprocess.run(["umount", target], capture_output=True)
+    try:
+        os.unlink(target)
+    except OSError:
+        pass

@@ -1,0 +1,154 @@
+"""CNI server — HTTP over a root-only unix socket inside the daemon.
+
+Counterpart of reference dpu-cni/pkgs/cniserver/cniserver.go: the on-disk
+shim POSTs the serialized CNI invocation to /cni; the server dispatches
+to the side manager's registered add/del handlers.
+
+Design change vs reference: the reference serializes ALL requests under a
+global mutex because its delegated IPAM reads process-wide env vars
+(cniserver.go:97-121,231-235). Our IPAM is native and file-locked, so
+requests serialize per-(container,ifname) only — concurrent pod attaches
+proceed in parallel, removing the reference's pod-attach latency ceiling.
+Per-request timeout matches kubelet CRI's 2 minutes (cniserver.go:208)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Optional, Tuple
+
+from ..utils import PathManager
+from .types import CniError, CniRequest
+
+log = logging.getLogger(__name__)
+
+# handler(CniRequest) -> dict (CNI result json) ; raises CniError on failure
+CniHandler = Callable[[CniRequest], dict]
+
+REQUEST_TIMEOUT = 120.0
+
+
+class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.TCPServer):
+    address_family = socket.AF_UNIX
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def server_bind(self):
+        os.makedirs(os.path.dirname(self.server_address), exist_ok=True)
+        try:
+            os.unlink(self.server_address)
+        except FileNotFoundError:
+            pass
+        self.socket.bind(self.server_address)
+        os.chmod(self.server_address, 0o600)
+
+    # BaseHTTPRequestHandler expects a (host, port) client address.
+    def get_request(self):
+        request, _ = self.socket.accept()
+        return request, ("unix", 0)
+
+
+class _KeyedLocks:
+    """Per-key mutexes so one slow attach doesn't serialize the node."""
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks = {}
+
+    def get(self, key: str) -> threading.Lock:
+        with self._guard:
+            if key not in self._locks:
+                self._locks[key] = threading.Lock()
+            return self._locks[key]
+
+
+class CniServer:
+    def __init__(self, path_manager: Optional[PathManager] = None,
+                 socket_path: Optional[str] = None):
+        pm = path_manager or PathManager()
+        self._socket_path = socket_path or pm.cni_server_socket()
+        self._pm = pm
+        self._add_handler: Optional[CniHandler] = None
+        self._del_handler: Optional[CniHandler] = None
+        self._locks = _KeyedLocks()
+        self._server: Optional[_UnixHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def set_handlers(self, add: CniHandler, delete: CniHandler) -> None:
+        self._add_handler = add
+        self._del_handler = delete
+
+    @property
+    def socket_path(self) -> str:
+        return self._socket_path
+
+    def handle(self, req: CniRequest) -> Tuple[int, dict]:
+        handler = {"ADD": self._add_handler, "DEL": self._del_handler}.get(req.command)
+        if handler is None:
+            if req.command in ("CHECK", "VERSION"):
+                return 200, {}
+            raise CniError(f"unsupported CNI command {req.command!r}", code=4)
+        lock = self._locks.get(f"{req.container_id}/{req.ifname}")
+        with lock:
+            result = handler(req)
+        return 200, result
+
+    def start(self) -> None:
+        self._pm.ensure_socket_dir(self._socket_path)
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            timeout = REQUEST_TIMEOUT
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("cniserver: " + fmt, *args)
+
+            def do_POST(self):
+                if self.path != "/cni":
+                    self._reply(404, {"msg": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    req = CniRequest.from_json(body)
+                    log.info(
+                        "CNI %s container=%s ifname=%s netns=%s",
+                        req.command, req.container_id[:13], req.ifname, req.netns,
+                    )
+                    code, result = server_ref.handle(req)
+                    self._reply(code, result)
+                except CniError as e:
+                    self._reply(400, e.to_json())
+                except Exception as e:
+                    log.exception("CNI request failed")
+                    self._reply(500, CniError(str(e)).to_json())
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = _UnixHTTPServer(self._socket_path, Handler, bind_and_activate=True)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="cni-server"
+        )
+        self._thread.start()
+        log.info("CNI server on %s", self._socket_path)
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            try:
+                os.unlink(self._socket_path)
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,75 @@
+"""CNI shim — the thin client the kubelet executes.
+
+Counterpart of reference dpu-cni/dpu-cni.go + pkgs/cni/cnishim.go:31-135:
+marshal the CNI env + stdin NetConf into JSON, POST it to the daemon's
+unix socket, print the daemon's answer on stdout with the right exit
+status. A native C++ implementation of the same wire protocol lives in
+native/cni-shim (the binary actually installed to the CNI bin dir);
+this module is the reference implementation and the library used by
+tests and the daemon itself."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+from typing import Optional
+
+from .types import CniError, CniRequest
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 125.0):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(self.timeout)
+        self.sock.connect(self._socket_path)
+
+
+def do_cni(socket_path: str, req: CniRequest, timeout: float = 125.0) -> dict:
+    """POST one CNI request; returns the result dict or raises CniError
+    (reference cnishim.go:59-89 doCNI)."""
+    conn = _UnixHTTPConnection(socket_path, timeout=timeout)
+    try:
+        body = json.dumps(req.to_json())
+        conn.request(
+            "POST", "/cni", body=body, headers={"Content-Type": "application/json"}
+        )
+        resp = conn.getresponse()
+        payload = json.loads(resp.read() or b"{}")
+        if resp.status != 200:
+            raise CniError(
+                payload.get("msg", f"CNI server returned {resp.status}"),
+                code=payload.get("code", 999),
+            )
+        return payload
+    except (OSError, http.client.HTTPException) as e:
+        raise CniError(f"cannot reach CNI server at {socket_path}: {e}", code=11) from e
+    finally:
+        conn.close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    """CLI entrypoint with CNI plugin semantics: env in, JSON out, exit
+    code signalling success (reference dpu-cni.go:17-30)."""
+    socket_path = os.environ.get(
+        "DPU_CNI_SOCKET", "/var/run/dpu-daemon/dpu-cni/dpu-cni-server.sock"
+    )
+    try:
+        stdin_data = sys.stdin.read()
+        req = CniRequest.from_env(dict(os.environ), stdin_data)
+        result = do_cni(socket_path, req)
+        sys.stdout.write(json.dumps(result))
+        return 0
+    except CniError as e:
+        sys.stdout.write(json.dumps(e.to_json()))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

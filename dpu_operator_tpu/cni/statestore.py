@@ -1,0 +1,49 @@
+"""On-disk CNI state cache.
+
+Counterpart of the reference's NetConf disk cache + PCI allocator
+(sriov.go:492-503, pci_allocator.go:25-61): every successful ADD persists
+what DEL needs, so deletes survive daemon restarts."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..utils import fileutils
+
+
+class StateStore:
+    def __init__(self, state_dir: str):
+        self._dir = os.path.join(state_dir, "attachments")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, container_id: str, ifname: str) -> str:
+        return os.path.join(self._dir, f"{container_id}-{ifname}.json")
+
+    def save(self, container_id: str, ifname: str, state: dict) -> None:
+        fileutils.atomic_write(self._path(container_id, ifname), json.dumps(state))
+
+    def load(self, container_id: str, ifname: str) -> Optional[dict]:
+        try:
+            with open(self._path(container_id, ifname)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def delete(self, container_id: str, ifname: str) -> None:
+        try:
+            os.unlink(self._path(container_id, ifname))
+        except FileNotFoundError:
+            pass
+
+    def list_all(self) -> list:
+        out = []
+        for name in sorted(os.listdir(self._dir)):
+            if name.endswith(".json"):
+                try:
+                    with open(os.path.join(self._dir, name)) as f:
+                        out.append(json.load(f))
+                except (OSError, json.JSONDecodeError):
+                    continue
+        return out

@@ -1,0 +1,105 @@
+"""CNI request/response types.
+
+Counterpart of reference dpu-cni/pkgs/cnitypes/cnitypes.go:19-136. The
+shim serialises the kubelet's CNI invocation (env + stdin NetConf) into a
+CniRequest JSON; the server answers a CNI result or error JSON."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+CNI_VERSION = "1.0.0"
+
+
+class CniError(Exception):
+    def __init__(self, msg: str, code: int = 999):
+        super().__init__(msg)
+        self.code = code
+
+    def to_json(self) -> dict:
+        return {"cniVersion": CNI_VERSION, "code": self.code, "msg": str(self)}
+
+
+@dataclass
+class CniRequest:
+    command: str  # ADD | DEL | CHECK
+    container_id: str
+    netns: str
+    ifname: str
+    args: Dict[str, str] = field(default_factory=dict)  # CNI_ARGS key=val
+    path: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)  # parsed stdin NetConf
+
+    def to_json(self) -> dict:
+        return {
+            "command": self.command,
+            "containerId": self.container_id,
+            "netns": self.netns,
+            "ifname": self.ifname,
+            "args": self.args,
+            "path": self.path,
+            "config": self.config,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "CniRequest":
+        for req_field in ("command", "containerId", "ifname"):
+            if not data.get(req_field):
+                raise CniError(f"missing required field {req_field}", code=4)
+        return cls(
+            command=data["command"],
+            container_id=data["containerId"],
+            netns=data.get("netns", ""),
+            ifname=data["ifname"],
+            args=data.get("args", {}),
+            path=data.get("path", ""),
+            config=data.get("config", {}),
+        )
+
+    @classmethod
+    def from_env(cls, env: Dict[str, str], stdin_data: str) -> "CniRequest":
+        """Build from the kubelet's CNI environment (the shim's job,
+        reference cnishim.go:31-57)."""
+        args = {}
+        for kv in (env.get("CNI_ARGS") or "").split(";"):
+            if "=" in kv:
+                k, _, val = kv.partition("=")
+                args[k] = val
+        return cls(
+            command=env.get("CNI_COMMAND", ""),
+            container_id=env.get("CNI_CONTAINERID", ""),
+            netns=env.get("CNI_NETNS", ""),
+            ifname=env.get("CNI_IFNAME", ""),
+            args=args,
+            path=env.get("CNI_PATH", ""),
+            config=json.loads(stdin_data) if stdin_data.strip() else {},
+        )
+
+
+@dataclass
+class CniResult:
+    """CNI spec result (success)."""
+
+    interfaces: list = field(default_factory=list)
+    ips: list = field(default_factory=list)
+    routes: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "cniVersion": CNI_VERSION,
+            "interfaces": self.interfaces,
+            "ips": self.ips,
+            "routes": self.routes,
+        }
+
+    def add_interface(self, name: str, mac: str, sandbox: str) -> int:
+        self.interfaces.append({"name": name, "mac": mac, "sandbox": sandbox})
+        return len(self.interfaces) - 1
+
+    def add_ip(self, address: str, interface_index: int, gateway: Optional[str] = None) -> None:
+        entry: Dict[str, Any] = {"address": address, "interface": interface_index}
+        if gateway:
+            entry["gateway"] = gateway
+        self.ips.append(entry)

@@ -1,0 +1,4 @@
+from .plugin import GrpcPlugin, VendorPlugin
+from .daemon import Daemon, SideManager
+
+__all__ = ["GrpcPlugin", "VendorPlugin", "Daemon", "SideManager"]

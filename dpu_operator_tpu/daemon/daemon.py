@@ -1,0 +1,269 @@
+"""Daemon — the per-node control loop.
+
+Counterpart of reference internal/daemon/daemon.go: a ticker loop that
+detects accelerators (DetectAll), manages a ManagedDpu{cr, plugin,
+side-manager} per detection (daemon.go:41-45), spawns side managers in
+threads (runSideManager, :449-472), derives the Ready condition from VSP
+init + heartbeat (:173-204), syncs DataProcessingUnit CRs including
+orphan deletion (:265-306), maintains the node's dpuside label
+(:476-526), and installs the CNI shim binary (:433-447). More than one
+detected DPU is an error, matching the reference (:135-143)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .. import vars as v
+from ..api import v1
+from ..k8s import Client, set_condition
+from ..k8s.store import NotFound
+from ..platform import DetectedDpu, DpuDetectorManager, Platform, TpuDetector
+from ..utils import PathManager, fileutils
+from .dpu_side import DpuSideManager
+from .host_side import HostSideManager
+from .plugin import GrpcPlugin, VendorPlugin
+
+log = logging.getLogger(__name__)
+
+TICK_INTERVAL = 1.0
+
+
+class SideManager:
+    """The role interface (reference daemon.go:32-38)."""
+
+    def start_vsp(self) -> None: ...
+    def setup_devices(self, num_endpoints: int = 8) -> None: ...
+    def listen(self) -> None: ...
+    def serve(self) -> None: ...
+    def check_ping(self) -> bool: ...
+    def stop(self) -> None: ...
+
+
+@dataclass
+class ManagedDpu:
+    detection: DetectedDpu
+    plugin: VendorPlugin
+    manager: SideManager
+    thread: Optional[threading.Thread] = None
+    serve_error: Optional[str] = None
+
+
+class Daemon:
+    def __init__(
+        self,
+        client: Client,
+        platform: Platform,
+        path_manager: Optional[PathManager] = None,
+        detectors: Optional[list] = None,
+        namespace: str = v.NAMESPACE,
+        tick_interval: float = TICK_INTERVAL,
+        register_device_plugin: bool = True,
+        side_manager_factory: Optional[Callable[[DetectedDpu, VendorPlugin], SideManager]] = None,
+        cni_shim_source: Optional[str] = None,
+        mode_override: str = "auto",
+    ):
+        self._client = client
+        self._platform = platform
+        self._pm = path_manager or PathManager()
+        self._detector = DpuDetectorManager(platform, detectors or [TpuDetector()])
+        self._namespace = namespace
+        self._tick = tick_interval
+        self._register_dp = register_device_plugin
+        self._factory = side_manager_factory or self._default_factory
+        self._cni_shim_source = cni_shim_source
+        self._mode_override = mode_override
+
+        self._managed: Dict[str, ManagedDpu] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def prepare(self) -> None:
+        """Install the CNI shim into the host CNI bin dir
+        (reference daemon.go:433-447 copies /dpu-cni)."""
+        if not self._cni_shim_source:
+            return
+        from ..utils.cluster_environment import ClusterEnvironment
+        from ..utils.filesystem_mode import FilesystemModeDetector
+
+        flavour = ClusterEnvironment(self._client).flavour()
+        fs_mode = FilesystemModeDetector(self._pm.root).detect()
+        dst = f"{self._pm.cni_host_dir(flavour, fs_mode)}/dpu-cni"
+        fileutils.copy_file(self._cni_shim_source, dst)
+        fileutils.make_executable(dst)
+        log.info("installed CNI shim at %s", dst)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.serve, daemon=True, name="daemon")
+        self._thread.start()
+
+    def serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                log.exception("daemon tick failed")
+            self._stop.wait(self._tick)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for md in self._managed.values():
+            try:
+                md.plugin.close()
+                md.manager.stop()
+            except Exception:
+                log.exception("side manager stop failed")
+        # Deleting our CRs on clean shutdown mirrors the reference's
+        # teardown path (daemon.go:219-247).
+        for md in list(self._managed.values()):
+            self._delete_cr(md.detection.cr_name())
+        self._managed.clear()
+
+    # -- the tick ------------------------------------------------------------
+
+    def tick(self) -> None:
+        detections = self._apply_mode_override(self._detector.detect_all())
+        if len(detections) > 1:
+            raise RuntimeError(
+                f"{len(detections)} DPUs detected on one node; only one is supported"
+            )
+        by_id = {d.identifier: d for d in detections}
+
+        for ident, det in by_id.items():
+            if ident not in self._managed:
+                self._managed[ident] = self._start_managed(det)
+
+        for ident in list(self._managed.keys()):
+            if ident not in by_id:
+                log.info("DPU %s no longer detected; tearing down", ident)
+                md = self._managed.pop(ident)
+                md.plugin.close()
+                md.manager.stop()
+                self._delete_cr(md.detection.cr_name())
+
+        self._sync_crs()
+        self._update_node_labels()
+
+    # -- managed DPU lifecycle ----------------------------------------------
+
+    def _default_factory(self, det: DetectedDpu, plugin: VendorPlugin) -> SideManager:
+        # reference createSideManager (daemon.go:249-263)
+        kwargs = dict(
+            path_manager=self._pm,
+            client=self._client,
+            namespace=self._namespace,
+            node_name=det.node_name,
+            register_device_plugin=self._register_dp,
+        )
+        if det.is_dpu_side:
+            return DpuSideManager(plugin, det.identifier, **kwargs)
+        return HostSideManager(plugin, det.identifier, **kwargs)
+
+    def _start_managed(self, det: DetectedDpu) -> ManagedDpu:
+        plugin = GrpcPlugin(self._pm.vendor_plugin_socket())
+        manager = self._factory(det, plugin)
+        md = ManagedDpu(detection=det, plugin=plugin, manager=manager)
+
+        def run():  # reference runSideManager (daemon.go:449-472)
+            try:
+                manager.start_vsp()
+                manager.setup_devices()
+                manager.listen()
+                manager.serve()
+            except Exception as e:
+                log.exception("side manager for %s failed", det.identifier)
+                md.serve_error = str(e)
+
+        md.thread = threading.Thread(
+            target=run, daemon=True, name=f"side-{det.identifier}"
+        )
+        md.thread.start()
+        return md
+
+    def _apply_mode_override(self, detections: List[DetectedDpu]) -> List[DetectedDpu]:
+        if self._mode_override == "auto":
+            return detections
+        forced = self._mode_override == "dpu"
+        return [
+            DetectedDpu(
+                identifier=d.identifier,
+                product_name=d.product_name,
+                is_dpu_side=forced,
+                vendor=d.vendor,
+                node_name=d.node_name,
+                topology=d.topology,
+            )
+            for d in detections
+        ]
+
+    # -- CR sync -------------------------------------------------------------
+
+    def _sync_crs(self) -> None:
+        node = self._platform.node_name()
+        wanted = {}
+        for md in self._managed.values():
+            cr = md.detection.to_cr(self._namespace)
+            ready = md.plugin.is_initialized() and md.manager.check_ping()
+            wanted[cr["metadata"]["name"]] = (cr, ready, md.serve_error)
+
+        existing = {
+            o["metadata"]["name"]: o
+            for o in self._client.list(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, self._namespace
+            )
+            if o.get("spec", {}).get("nodeName") == node
+        }
+
+        for name, (cr, ready, err) in wanted.items():
+            cur = existing.get(name)
+            if cur is None:
+                cur = self._client.create(cr)
+            changed = set_condition(
+                cur,
+                v1.COND_READY,
+                "True" if ready else "False",
+                reason="Ready" if ready else (
+                    "SideManagerError" if err else "AwaitingVspInit"
+                ),
+                message=err or "",
+            )
+            if changed:
+                self._client.update_status(cur)
+
+        # Orphans: CRs for this node whose DPU vanished (daemon.go:265-306).
+        for name in existing:
+            if name not in wanted:
+                self._delete_cr(name)
+
+    def _delete_cr(self, name: str) -> None:
+        try:
+            self._client.delete(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, self._namespace, name
+            )
+        except NotFound:
+            pass
+        except Exception:
+            log.exception("deleting DataProcessingUnit %s failed", name)
+
+    # -- node labels ---------------------------------------------------------
+
+    def _update_node_labels(self) -> None:
+        node_name = self._platform.node_name()
+        node = self._client.get_or_none("v1", "Node", None, node_name)
+        if node is None:
+            return
+        want: Optional[str] = None
+        for md in self._managed.values():
+            want = v.DPU_SIDE_DPU if md.detection.is_dpu_side else v.DPU_SIDE_HOST
+        labels = node["metadata"].setdefault("labels", {})
+        if want is None:
+            if v.DPU_SIDE_LABEL in labels:
+                del labels[v.DPU_SIDE_LABEL]
+                self._client.update(node)
+        elif labels.get(v.DPU_SIDE_LABEL) != want:
+            labels[v.DPU_SIDE_LABEL] = want
+            self._client.update(node)

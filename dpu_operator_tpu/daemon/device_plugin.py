@@ -1,0 +1,171 @@
+"""Device plugin — advertises fabric endpoints to the kubelet.
+
+Counterpart of reference internal/daemon/device-plugin/deviceplugin.go:
+serves the kubelet device-plugin v1beta1 API for the extended resource
+(ours: tpu.dpu.io/endpoint, reference: openshift.io/dpu), polls the VSP's
+GetDevices every POLL_INTERVAL and streams on change (deviceplugin.go:
+92-111), and Allocate validates cached health + passes NF-DEV=<ids> to
+the container (deviceplugin.go:114-142).
+
+Registration: the plugin serves on its own socket under the kubelet
+plugin dir, then dials the kubelet's Registration service. The reference
+needs a self-connection workaround for kubelet's blocking dial
+(deviceplugin.go:164-204); grpc-python's channel_ready_future gives us
+the same "serving before registering" guarantee."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+import grpc
+
+from .. import vars as v
+from ..dpu_api import services
+from ..dpu_api.gen import dpu_api_pb2 as pb
+from ..dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+from ..utils import PathManager
+
+log = logging.getLogger(__name__)
+
+API_VERSION = "v1beta1"
+
+
+class DevicePlugin(services.DevicePluginServicer):
+    POLL_INTERVAL = 5.0
+
+    def __init__(
+        self,
+        vendor_plugin,
+        path_manager: Optional[PathManager] = None,
+        resource_name: str = v.DPU_RESOURCE_NAME,
+        require_pci_ids: bool = False,
+        poll_interval: Optional[float] = None,
+    ):
+        self._vsp = vendor_plugin
+        self._pm = path_manager or PathManager()
+        self.resource_name = resource_name
+        # Host side enforces PCI-address device IDs; DPU side allows
+        # abstract ids (reference dpudevicehandler.go:58-73).
+        self._require_pci_ids = require_pci_ids
+        if poll_interval is not None:
+            self.POLL_INTERVAL = poll_interval
+        self._server: Optional[grpc.Server] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._healthy: Dict[str, bool] = {}
+
+    # -- device translation --------------------------------------------------
+
+    def _fetch_devices(self) -> Dict[str, kdp.Device]:
+        """Translate VSP devices into kubelet Device entries
+        (reference dpudevicehandler.go:48-73)."""
+        out: Dict[str, kdp.Device] = {}
+        for dev_id, dev in self._vsp.get_devices().items():
+            if self._require_pci_ids and not _is_pci_address(dev_id):
+                log.warning("host-side device id %r is not a PCI address; skipping", dev_id)
+                continue
+            kd = kdp.Device(
+                ID=dev_id,
+                health="Healthy" if dev.health == pb.HEALTHY else "Unhealthy",
+            )
+            if dev.topology:
+                kd.topology.nodes.add(ID=dev.topology.numa_node)
+            out[dev_id] = kd
+        return out
+
+    # -- kubelet DevicePlugin service ---------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return kdp.DevicePluginOptions()
+
+    def ListAndWatch(self, request, context):
+        """Stream the device list; re-send only on change
+        (reference deviceplugin.go:92-111)."""
+        last: Optional[Dict[str, str]] = None
+        while not self._stop.is_set() and context.is_active():
+            try:
+                devices = self._fetch_devices()
+            except Exception:
+                log.exception("GetDevices failed; reporting empty inventory")
+                devices = {}
+            snapshot = {i: d.health for i, d in devices.items()}
+            if snapshot != last:
+                last = snapshot
+                with self._lock:
+                    self._healthy = {i: h == "Healthy" for i, h in snapshot.items()}
+                yield kdp.ListAndWatchResponse(devices=list(devices.values()))
+            self._stop.wait(self.POLL_INTERVAL)
+
+    def Allocate(self, request, context):
+        """Health-check from cache and pass NF-DEV env
+        (reference deviceplugin.go:114-142)."""
+        resp = kdp.AllocateResponse()
+        with self._lock:
+            healthy = dict(self._healthy)
+        for creq in request.container_requests:
+            for dev_id in creq.devices_ids:
+                if not healthy.get(dev_id, False):
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"device {dev_id} is not healthy or unknown",
+                    )
+            cresp = resp.container_responses.add()
+            cresp.envs["NF-DEV"] = ",".join(creq.devices_ids)
+        return resp
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup_devices(self, num_endpoints: int = 8) -> None:
+        """Partition the fabric (reference dpudevicehandler.go:84-106 calls
+        SetNumVfs(8); failures tolerated on the DPU side)."""
+        self._vsp.set_num_endpoints(num_endpoints)
+
+    def start(self) -> None:
+        sock = self._pm.device_plugin_socket()
+        self._pm.ensure_socket_dir(sock)
+        self._pm.remove_stale_socket(sock)
+        self._server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=4))
+        services.add_device_plugin(self, self._server)
+        self._server.add_insecure_port(f"unix://{sock}")
+        self._server.start()
+        log.info("device plugin serving on %s", sock)
+
+    def register_with_kubelet(self, timeout: float = 10.0) -> None:
+        """Dial kubelet's Registration service and announce our socket
+        (reference deviceplugin.go:240-262)."""
+        import os
+
+        kubelet_sock = self._pm.kubelet_registry_socket()
+        channel = grpc.insecure_channel(f"unix://{kubelet_sock}")
+        grpc.channel_ready_future(channel).result(timeout=timeout)
+        stub = services.KubeletRegistrationStub(channel)
+        stub.Register(
+            kdp.RegisterRequest(
+                version=API_VERSION,
+                endpoint=os.path.basename(self._pm.device_plugin_socket()),
+                resource_name=self.resource_name,
+            ),
+            timeout=timeout,
+        )
+        channel.close()
+        log.info("registered %s with kubelet", self.resource_name)
+
+    def serve(self, register: bool = True) -> None:
+        self.start()
+        if register:
+            self.register_with_kubelet()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(0.5)
+
+
+def _is_pci_address(dev_id: str) -> bool:
+    import re
+
+    return bool(re.fullmatch(r"[0-9a-fA-F]{4}:[0-9a-fA-F]{2}:[0-9a-fA-F]{2}\.[0-7]", dev_id))

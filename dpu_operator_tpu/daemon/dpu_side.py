@@ -1,0 +1,184 @@
+"""DpuSideManager — the daemon role on the accelerator-side runtime.
+
+Counterpart of reference internal/daemon/dpusidemanager.go: serves the
+OPI BridgePortService + HeartbeatService on the tcp addr:port the VSP's
+Init returned (dpusidemanager.go:182-209), runs the CNI server with
+networkfn handlers and the device plugin, and pairs the two NF
+interfaces per pod netns — calling CreateNetworkFunction(mac0, mac1) on
+the second CNI ADD (dpusidemanager.go:145-180). Ping freshness window is
+60 s (dpusidemanager.go:90-101)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+from google.protobuf import empty_pb2
+
+from ..cni import CniServer
+from ..cni.dataplane import NetworkFnDataplane
+from ..cni.statestore import StateStore
+from ..dpu_api import services
+from ..dpu_api.gen import bridge_port_pb2 as bp
+from ..dpu_api.gen import dpu_api_pb2 as pb
+from ..utils import PathManager
+from .device_plugin import DevicePlugin
+from .plugin import VendorPlugin
+
+log = logging.getLogger(__name__)
+
+PING_WINDOW = 60.0
+
+
+class _OpiService(services.BridgePortServicer, services.HeartbeatServicer):
+    """The DPU-side daemon's public gRPC face: forwards bridge-port ops to
+    the VSP and records heartbeats (dpusidemanager.go:54-88)."""
+
+    def __init__(self, manager: "DpuSideManager"):
+        self._mgr = manager
+
+    def CreateBridgePort(self, request, context):
+        try:
+            self._mgr.plugin.create_bridge_port(request)
+        except grpc.RpcError as e:
+            context.abort(e.code(), f"VSP CreateBridgePort failed: {e.details()}")
+        return bp.BridgePort(name=request.bridge_port.name)
+
+    def DeleteBridgePort(self, request, context):
+        try:
+            self._mgr.plugin.delete_bridge_port(request.name)
+        except grpc.RpcError as e:
+            context.abort(e.code(), f"VSP DeleteBridgePort failed: {e.details()}")
+        return empty_pb2.Empty()
+
+    def Ping(self, request, context):
+        self._mgr.record_ping()
+        return pb.PingResponse(healthy=True)
+
+
+class DpuSideManager:
+    def __init__(
+        self,
+        vendor_plugin: VendorPlugin,
+        identifier: str,
+        path_manager: Optional[PathManager] = None,
+        client=None,
+        namespace: Optional[str] = None,
+        node_name: str = "",
+        register_device_plugin: bool = True,
+    ):
+        self.plugin = vendor_plugin
+        self.identifier = identifier
+        self._pm = path_manager or PathManager()
+        self._client = client
+        self._namespace = namespace
+        self._node_name = node_name
+        self._register_dp = register_device_plugin
+
+        state = StateStore(self._pm.cni_state_dir())
+        self.dataplane = NetworkFnDataplane(state)
+        self.cni_server = CniServer(self._pm)
+        self.cni_server.set_handlers(self._cni_nf_add, self._cni_nf_del)
+        self.device_plugin = DevicePlugin(vendor_plugin, self._pm, require_pci_ids=False)
+
+        self._opi_server: Optional[grpc.Server] = None
+        self._opi_addr: Tuple[str, int] = ("", 0)
+        self._last_ping = 0.0
+        self._ping_lock = threading.Lock()
+        # netns → [mac...] pairing store (reference macStore, :145-180)
+        self._mac_store: Dict[str, List[str]] = {}
+        self._mac_lock = threading.Lock()
+
+    # -- SideManager interface ----------------------------------------------
+
+    def start_vsp(self) -> None:
+        ip, port = self.plugin.start(dpu_mode=True, identifier=self.identifier)
+        self._opi_addr = (ip, port)
+        log.info("dpu side: VSP initialised, OPI server will bind %s:%s", ip, port)
+
+    def setup_devices(self, num_endpoints: int = 8) -> None:
+        # Errors tolerated in DPU mode (reference dpudevicehandler.go:84-106).
+        try:
+            self.device_plugin.setup_devices(num_endpoints)
+        except grpc.RpcError:
+            log.warning("SetNumEndpoints failed on DPU side (tolerated)")
+
+    def listen(self) -> None:
+        ip, port = self._opi_addr
+        self._opi_server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        )
+        svc = _OpiService(self)
+        services.add_bridge_port(svc, self._opi_server)
+        services.add_heartbeat(svc, self._opi_server)
+        bound = self._opi_server.add_insecure_port(f"{ip}:{port}")
+        if port != 0 and bound != port:
+            raise RuntimeError(f"OPI server could not bind {ip}:{port}")
+        self._opi_addr = (ip, bound)
+        self.cni_server.start()
+        self.device_plugin.start()
+
+    def serve(self) -> None:
+        assert self._opi_server is not None, "listen must run first"
+        self._opi_server.start()
+        if self._register_dp:
+            try:
+                self.device_plugin.register_with_kubelet()
+            except Exception:
+                log.exception("kubelet registration failed; device plugin unserved")
+
+    def check_ping(self) -> bool:
+        with self._ping_lock:
+            return (time.monotonic() - self._last_ping) < PING_WINDOW
+
+    def record_ping(self) -> None:
+        with self._ping_lock:
+            self._last_ping = time.monotonic()
+
+    def stop(self) -> None:
+        if self._opi_server is not None:
+            self._opi_server.stop(0.5)
+        self.cni_server.stop()
+        self.device_plugin.stop()
+
+    @property
+    def opi_addr(self) -> Tuple[str, int]:
+        return self._opi_addr
+
+    # -- CNI NF handlers -----------------------------------------------------
+
+    def _cni_nf_add(self, req) -> dict:
+        result = self.dataplane.cmd_add(req)
+        mac = result.interfaces[0]["mac"]
+        with self._mac_lock:
+            macs = self._mac_store.setdefault(req.netns, [])
+            macs.append(mac)
+            pair = list(macs) if len(macs) == 2 else None
+        if pair:
+            # Second interface of the NF pod: wire the chain through the VSP
+            # (reference dpusidemanager.go:152-157).
+            self.plugin.create_network_function(pair[0], pair[1])
+        return result.to_json()
+
+    def _cni_nf_del(self, req) -> dict:
+        mac = self.dataplane.pod_mac(req.container_id, req.ifname)
+        result, released = self.dataplane.cmd_del(req)
+        if released and mac:
+            with self._mac_lock:
+                macs = self._mac_store.get(req.netns, [])
+                was_complete = len(macs) == 2
+                pair = list(macs)
+                if mac in macs:
+                    macs.remove(mac)
+                if not macs:
+                    self._mac_store.pop(req.netns, None)
+            if was_complete:
+                try:
+                    self.plugin.delete_network_function(pair[0], pair[1])
+                except grpc.RpcError:
+                    log.warning("DeleteNetworkFunction failed (continuing)")
+        return result

@@ -1,0 +1,197 @@
+"""HostSideManager — the daemon role on nodes that host an accelerator.
+
+Counterpart of reference internal/daemon/hostsidemanager.go: runs the CNI
+server (fabric dataplane), the device plugin, and a 1 s heartbeat ping
+client to the DPU-side daemon; a CNI ADD plumbs the pod interface and
+then calls CreateBridgePort on the DPU-side OPI server with retry backoff
+(hostsidemanager.go:163-207); CheckPing enforces a 5 s freshness window
+(hostsidemanager.go:287-298)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+import grpc
+
+from ..cni import CniServer
+from ..cni.dataplane import FabricDataplane
+from ..cni.ipam import HostLocalIpam
+from ..cni.statestore import StateStore
+from ..cni.types import CniError, CniRequest
+from ..dpu_api import services
+from ..dpu_api.gen import bridge_port_pb2 as bp
+from ..dpu_api.gen import dpu_api_pb2 as pb
+from ..utils import PathManager
+from .device_plugin import DevicePlugin
+from .plugin import VendorPlugin
+
+log = logging.getLogger(__name__)
+
+PING_INTERVAL = 1.0
+PING_WINDOW = 5.0
+OPI_DIAL_RETRIES = 40  # reference dials with 40-attempt backoff (:163-175)
+
+
+class HostSideManager:
+    def __init__(
+        self,
+        vendor_plugin: VendorPlugin,
+        identifier: str,
+        path_manager: Optional[PathManager] = None,
+        pod_cidr: str = "10.56.0.0/24",
+        client=None,
+        namespace: Optional[str] = None,
+        node_name: str = "",
+        register_device_plugin: bool = True,
+    ):
+        self.plugin = vendor_plugin
+        self.identifier = identifier
+        self._pm = path_manager or PathManager()
+        self._client = client
+        self._namespace = namespace
+        self._node_name = node_name
+        self._register_dp = register_device_plugin
+
+        state = StateStore(self._pm.cni_state_dir())
+        ipam = HostLocalIpam(self._pm.cni_state_dir(), pod_cidr)
+        self.dataplane = FabricDataplane(state, ipam)
+        self.cni_server = CniServer(self._pm)
+        self.cni_server.set_handlers(self._cni_add, self._cni_del)
+        self.device_plugin = DevicePlugin(
+            vendor_plugin, self._pm, require_pci_ids=False
+        )
+
+        self._opi_addr: Optional[Tuple[str, int]] = None
+        self._opi_channel: Optional[grpc.Channel] = None
+        self._last_pong = 0.0
+        self._ping_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- SideManager interface ----------------------------------------------
+
+    def start_vsp(self) -> None:
+        ip, port = self.plugin.start(dpu_mode=False, identifier=self.identifier)
+        self._opi_addr = (ip, port)
+        log.info("host side: VSP initialised, DPU-side OPI at %s:%s", ip, port)
+
+    def setup_devices(self, num_endpoints: int = 8) -> None:
+        self.device_plugin.setup_devices(num_endpoints)
+
+    def listen(self) -> None:
+        self.cni_server.start()
+        self.device_plugin.start()
+
+    def serve(self) -> None:
+        if self._register_dp:
+            try:
+                self.device_plugin.register_with_kubelet()
+            except Exception:
+                log.exception("kubelet registration failed; device plugin unserved")
+        t = threading.Thread(target=self._ping_loop, daemon=True, name="host-ping")
+        t.start()
+        self._threads.append(t)
+
+    def check_ping(self) -> bool:
+        with self._ping_lock:
+            return (time.monotonic() - self._last_pong) < PING_WINDOW
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.cni_server.stop()
+        self.device_plugin.stop()
+        if self._opi_channel is not None:
+            self._opi_channel.close()
+
+    # -- CNI handlers --------------------------------------------------------
+
+    def _cni_add(self, req: CniRequest) -> dict:
+        result = self.dataplane.cmd_add(req)
+        mac = result.interfaces[0]["mac"]
+        port_name = _bridge_port_name(req)
+        try:
+            self._create_bridge_port(port_name, mac)
+        except grpc.RpcError as e:
+            # Unplumb on dataplane-attach failure: a pod interface without
+            # fabric attachment is worse than a failed ADD.
+            self.dataplane.cmd_del(req)
+            raise CniError(f"CreateBridgePort({port_name}) failed: {e.code()}") from e
+        return result.to_json()
+
+    def _cni_del(self, req: CniRequest) -> dict:
+        result, released = self.dataplane.cmd_del(req)
+        if released:
+            try:
+                self._delete_bridge_port(_bridge_port_name(req))
+            except grpc.RpcError as e:
+                log.warning("DeleteBridgePort failed (continuing): %s", e.code())
+        return result
+
+    # -- OPI client ----------------------------------------------------------
+
+    def _opi_stub(self) -> services.BridgePortStub:
+        if self._opi_channel is None:
+            assert self._opi_addr is not None, "start_vsp must run first"
+            ip, port = self._opi_addr
+            self._opi_channel = grpc.insecure_channel(f"{ip}:{port}")
+        return services.BridgePortStub(self._opi_channel)
+
+    def _create_bridge_port(self, name: str, mac: str) -> None:
+        req = bp.CreateBridgePortRequest(
+            bridge_port=bp.BridgePort(
+                name=name,
+                spec=bp.BridgePortSpec(
+                    ptype=bp.ACCESS,
+                    mac_address=bytes.fromhex(mac.replace(":", "")),
+                    logical_bridges=["br-fabric"],
+                ),
+            )
+        )
+        delay = 0.05
+        for attempt in range(OPI_DIAL_RETRIES):
+            try:
+                self._opi_stub().CreateBridgePort(req, timeout=5.0)
+                return
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.UNAVAILABLE or attempt == OPI_DIAL_RETRIES - 1:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 16.0)
+
+    def _delete_bridge_port(self, name: str) -> None:
+        self._opi_stub().DeleteBridgePort(
+            bp.DeleteBridgePortRequest(name=name), timeout=5.0
+        )
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def _ping_loop(self) -> None:
+        stub: Optional[services.HeartbeatStub] = None
+        while not self._stop.is_set():
+            try:
+                if stub is None:
+                    assert self._opi_addr is not None
+                    ip, port = self._opi_addr
+                    chan = grpc.insecure_channel(f"{ip}:{port}")
+                    stub = services.HeartbeatStub(chan)
+                resp = stub.Ping(
+                    pb.PingRequest(
+                        timestamp_ns=time.monotonic_ns(), sender_id=self.identifier
+                    ),
+                    timeout=PING_WINDOW,
+                )
+                if resp.healthy:
+                    with self._ping_lock:
+                        self._last_pong = time.monotonic()
+            except grpc.RpcError:
+                log.debug("heartbeat ping failed")
+            self._stop.wait(PING_INTERVAL)
+
+
+def _bridge_port_name(req: CniRequest) -> str:
+    """Structured port name the DPU-side VSP parses
+    (reference: "host<pf>-<vf>"; ours keys on the attachment identity)."""
+    return f"port-{req.container_id[:13]}-{req.ifname}"

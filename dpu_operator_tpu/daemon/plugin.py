@@ -1,0 +1,157 @@
+"""VendorPlugin — the daemon's client half of the VSP contract.
+
+Counterpart of reference internal/daemon/plugin/vendorplugin.go: dials the
+vendor unix socket lazily (vendorplugin.go:129-153), Start() retries Init
+every 100 ms until the VSP answers — tolerating "already initialized" from
+a restarted daemon (vendorplugin.go:51-94) — and tracks `initialized` so
+the daemon can surface the Ready condition (vendorplugin.go:214-225)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import grpc
+from google.protobuf import empty_pb2
+
+from ..dpu_api import services
+from ..dpu_api.gen import dpu_api_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+READY_CONDITION_TYPE = "Ready"
+
+
+class VendorPlugin:
+    """Interface the side managers program against
+    (reference vendorplugin.go:25-34)."""
+
+    def start(self, dpu_mode: bool, identifier: str) -> Tuple[str, int]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def is_initialized(self) -> bool:
+        raise NotImplementedError
+
+    def get_devices(self) -> Dict[str, pb.Device]:
+        raise NotImplementedError
+
+    def set_num_endpoints(self, count: int) -> int:
+        raise NotImplementedError
+
+    def create_network_function(self, input_mac: str, output_mac: str) -> None:
+        raise NotImplementedError
+
+    def delete_network_function(self, input_mac: str, output_mac: str) -> None:
+        raise NotImplementedError
+
+    def create_bridge_port(self, request) -> None:
+        raise NotImplementedError
+
+    def delete_bridge_port(self, name: str) -> None:
+        raise NotImplementedError
+
+
+class GrpcPlugin(VendorPlugin):
+    INIT_RETRY_INTERVAL = 0.1
+    RPC_TIMEOUT = 5.0
+
+    def __init__(self, socket_path: str):
+        self._socket_path = socket_path
+        self._lock = threading.Lock()
+        self._channel: Optional[grpc.Channel] = None
+        self._initialized = False
+        self._stop = threading.Event()
+
+    # -- connection management ----------------------------------------------
+
+    def _ensure_channel(self) -> grpc.Channel:
+        with self._lock:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(f"unix://{self._socket_path}")
+            return self._channel
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop.set()
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+            self._initialized = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, dpu_mode: bool, identifier: str) -> Tuple[str, int]:
+        """Block until the VSP's Init succeeds; returns the OPI ip:port the
+        VSP wants the DPU-side daemon to use."""
+        stub = services.LifeCycleStub(self._ensure_channel())
+        req = pb.InitRequest(
+            dpu_mode=pb.DPU_MODE_DPU if dpu_mode else pb.DPU_MODE_HOST,
+            dpu_identifier=identifier,
+        )
+        while not self._stop.is_set():
+            try:
+                resp = stub.Init(req, timeout=self.RPC_TIMEOUT)
+                with self._lock:
+                    self._initialized = True
+                return resp.ip, resp.port
+            except grpc.RpcError as e:
+                code = e.code()
+                # A VSP that was already initialised by a previous daemon
+                # incarnation answers ALREADY_EXISTS; treat as success with
+                # the address in the details (reference vendorplugin.go:74-78
+                # handles the same restart race).
+                if code == grpc.StatusCode.ALREADY_EXISTS:
+                    with self._lock:
+                        self._initialized = True
+                    return "", 0
+                log.debug("VSP Init not ready (%s); retrying", code)
+                time.sleep(self.INIT_RETRY_INTERVAL)
+        raise RuntimeError("plugin stopped before Init completed")
+
+    def is_initialized(self) -> bool:
+        with self._lock:
+            return self._initialized
+
+    # -- device service ------------------------------------------------------
+
+    def get_devices(self) -> Dict[str, pb.Device]:
+        stub = services.DeviceStub(self._ensure_channel())
+        resp = stub.GetDevices(empty_pb2.Empty(), timeout=self.RPC_TIMEOUT)
+        return dict(resp.devices)
+
+    def set_num_endpoints(self, count: int) -> int:
+        stub = services.DeviceStub(self._ensure_channel())
+        return stub.SetNumEndpoints(
+            pb.EndpointCount(count=count), timeout=self.RPC_TIMEOUT
+        ).count
+
+    # -- network functions ---------------------------------------------------
+
+    def create_network_function(self, input_mac: str, output_mac: str) -> None:
+        stub = services.NetworkFunctionStub(self._ensure_channel())
+        stub.CreateNetworkFunction(
+            pb.NFRequest(input=input_mac, output=output_mac), timeout=self.RPC_TIMEOUT
+        )
+
+    def delete_network_function(self, input_mac: str, output_mac: str) -> None:
+        stub = services.NetworkFunctionStub(self._ensure_channel())
+        stub.DeleteNetworkFunction(
+            pb.NFRequest(input=input_mac, output=output_mac), timeout=self.RPC_TIMEOUT
+        )
+
+    # -- bridge ports (forwarded by the DPU-side daemon to its VSP) ---------
+
+    def create_bridge_port(self, request) -> None:
+        stub = services.BridgePortStub(self._ensure_channel())
+        stub.CreateBridgePort(request, timeout=self.RPC_TIMEOUT)
+
+    def delete_bridge_port(self, name: str) -> None:
+        from ..dpu_api.gen import bridge_port_pb2 as bp
+
+        stub = services.BridgePortStub(self._ensure_channel())
+        stub.DeleteBridgePort(bp.DeleteBridgePortRequest(name=name), timeout=self.RPC_TIMEOUT)

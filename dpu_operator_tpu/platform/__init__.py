@@ -1,0 +1,16 @@
+from .platform import FakePlatform, HardwarePlatform, PciDevice, Platform
+from .detector import DetectedDpu, DpuDetectorManager, VendorDetector
+from .tpu import TpuDetector
+from .fake_detector import FakeTpuDetector
+
+__all__ = [
+    "Platform",
+    "HardwarePlatform",
+    "FakePlatform",
+    "PciDevice",
+    "VendorDetector",
+    "DetectedDpu",
+    "DpuDetectorManager",
+    "TpuDetector",
+    "FakeTpuDetector",
+]

@@ -1,0 +1,98 @@
+"""Vendor detector framework.
+
+Counterpart of reference internal/platform/vendordetector.go:23-238: a
+registry of VendorDetectors; DpuDetectorManager.detect_all() asks each
+detector both "am I running *on* this vendor's DPU platform?" (DMI/env
+match → dpu side) and "does this node *host* one?" (PCI scan → host
+side), builds a DataProcessingUnit CR per detection with the -dpu/-host
+name postfix, and dedups multi-port cards by serial-derived identifier
+(vendordetector.go:199-203)."""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..api import v1
+from .platform import PciDevice, Platform
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class DetectedDpu:
+    """One detection result (reference DetectedDpuWithPlugin,
+    vendordetector.go:131)."""
+
+    identifier: str  # stable id, e.g. "tpu-v5e-<serial>"
+    product_name: str
+    is_dpu_side: bool
+    vendor: str  # vendor key, e.g. "tpu", selects the VSP image/dir
+    node_name: str
+    topology: Optional[dict] = None
+
+    def cr_name(self) -> str:
+        """CR name with side postfix (reference vendordetector.go:92-100)."""
+        side = "dpu" if self.is_dpu_side else "host"
+        base = re.sub(r"[^a-z0-9.-]", "-", self.identifier.lower()).strip("-")
+        return f"{base}-{side}"
+
+    def to_cr(self, namespace: str) -> dict:
+        cr = v1.new_data_processing_unit(
+            self.cr_name(),
+            self.product_name,
+            self.is_dpu_side,
+            self.node_name,
+            namespace=namespace,
+        )
+        cr["metadata"].setdefault("labels", {})["dpu.tpu.io/vendor"] = self.vendor
+        return cr
+
+
+class VendorDetector:
+    """Per-vendor detection hooks (reference vendordetector.go:23-55)."""
+
+    name = "unknown"
+
+    def is_dpu_platform(self, platform: Platform) -> Optional[DetectedDpu]:
+        """Detect that this node IS the vendor's accelerator-side runtime."""
+        return None
+
+    def is_dpu(self, platform: Platform, dev: PciDevice) -> Optional[DetectedDpu]:
+        """Detect that this PCI device is a hosted accelerator."""
+        return None
+
+
+class DpuDetectorManager:
+    def __init__(self, platform: Platform, detectors: List[VendorDetector]):
+        self._platform = platform
+        self._detectors = list(detectors)
+
+    def detect_all(self) -> List[DetectedDpu]:
+        detected: List[DetectedDpu] = []
+        seen_ids: set = set()
+        for det in self._detectors:
+            try:
+                plat_hit = det.is_dpu_platform(self._platform)
+            except Exception:
+                log.exception("detector %s platform check failed", det.name)
+                plat_hit = None
+            if plat_hit is not None:
+                if plat_hit.identifier not in seen_ids:
+                    seen_ids.add(plat_hit.identifier)
+                    detected.append(plat_hit)
+                continue  # a DPU platform node does not also host DPUs
+            for dev in self._platform.pci_devices():
+                try:
+                    hit = det.is_dpu(self._platform, dev)
+                except Exception:
+                    log.exception("detector %s device check failed", det.name)
+                    hit = None
+                # Serial-based dedup collapses multi-port cards into one
+                # detection (reference vendordetector.go:199-203).
+                if hit is not None and hit.identifier not in seen_ids:
+                    seen_ids.add(hit.identifier)
+                    detected.append(hit)
+        return detected

@@ -1,0 +1,28 @@
+"""FakeTpuDetector — a fully injectable detector for multi-vendor daemon
+tests (the role the mock detector + FakePlatform combination plays in the
+reference's daemon_test.go:86-100)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .detector import DetectedDpu, VendorDetector
+from .platform import PciDevice, Platform
+
+
+class FakeTpuDetector(VendorDetector):
+    def __init__(self, name: str = "fake", results: Optional[List[DetectedDpu]] = None):
+        self.name = name
+        self.results = list(results or [])
+
+    def is_dpu_platform(self, platform: Platform) -> Optional[DetectedDpu]:
+        for r in self.results:
+            if r.is_dpu_side:
+                return r
+        return None
+
+    def is_dpu(self, platform: Platform, dev: PciDevice) -> Optional[DetectedDpu]:
+        for r in self.results:
+            if not r.is_dpu_side:
+                return r
+        return None

@@ -1,0 +1,205 @@
+"""Platform abstraction — hardware inventory access.
+
+TPU-native counterpart of reference internal/platform/platform.go:15-23.
+The reference reads PCI via jaypipes/ghw and DMI product strings; on a
+TPU-VM the equivalents are sysfs PCI scan, DMI product name, the GCE
+metadata-provided environment, and the accelerator device nodes
+(/dev/accel* or /dev/vfio for newer runtimes).
+
+FakePlatform (reference platform.go:141-209) is first-class: the whole
+daemon test tier runs against it with injected devices.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class PciDevice:
+    address: str  # "0000:00:05.0"
+    vendor_id: str  # "1ae0" (Google)
+    device_id: str
+    class_name: str = ""
+    vendor_name: str = ""
+    product_name: str = ""
+    is_vf: bool = False
+    numa_node: int = 0
+    serial: str = ""
+
+
+def sanitize_pci_address(addr: str) -> str:
+    """Normalise a PCI address to 0000:00:00.0 form
+    (reference platform.go:137 SanitizePCIAddress)."""
+    addr = addr.strip().lower()
+    if len(addr.split(":")) == 2:
+        addr = "0000:" + addr
+    return addr
+
+
+class Platform:
+    """What the detectors ask of the node (reference platform.go:15-23)."""
+
+    def pci_devices(self) -> List[PciDevice]:
+        raise NotImplementedError
+
+    def product_name(self) -> str:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        raise NotImplementedError
+
+    def accel_device_paths(self) -> List[str]:
+        raise NotImplementedError
+
+    def environ(self) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def read_device_serial(self, pci_address: str) -> Optional[str]:
+        raise NotImplementedError
+
+
+class HardwarePlatform(Platform):
+    """Real sysfs/DMI-backed platform."""
+
+    def __init__(self, root: str = "/"):
+        self._root = root
+
+    def pci_devices(self) -> List[PciDevice]:
+        out = []
+        base = os.path.join(self._root, "sys/bus/pci/devices")
+        if not os.path.isdir(base):
+            return out
+        for dev in sorted(os.listdir(base)):
+            p = os.path.join(base, dev)
+            out.append(
+                PciDevice(
+                    address=dev,
+                    vendor_id=self._read(p, "vendor").replace("0x", ""),
+                    device_id=self._read(p, "device").replace("0x", ""),
+                    class_name=self._read(p, "class"),
+                    is_vf=os.path.exists(os.path.join(p, "physfn")),
+                    numa_node=int(self._read(p, "numa_node") or 0),
+                )
+            )
+        return out
+
+    def product_name(self) -> str:
+        return self._read(
+            os.path.join(self._root, "sys/class/dmi/id"), "product_name"
+        )
+
+    def node_name(self) -> str:
+        return os.environ.get("NODE_NAME") or os.uname().nodename
+
+    def accel_device_paths(self) -> List[str]:
+        pats = ["dev/accel*", "dev/vfio/*"]
+        out: List[str] = []
+        for pat in pats:
+            out.extend(sorted(glob.glob(os.path.join(self._root, pat))))
+        return out
+
+    def environ(self) -> Dict[str, str]:
+        return dict(os.environ)
+
+    def read_device_serial(self, pci_address: str) -> Optional[str]:
+        """PCIe DSN capability read. The reference reads config space at
+        the DSN offset (platform.go:101-132); sysfs exposes the config
+        file — the DSN extended capability (id 0x0003) is walked here."""
+        cfg = os.path.join(
+            self._root, "sys/bus/pci/devices", sanitize_pci_address(pci_address), "config"
+        )
+        try:
+            with open(cfg, "rb") as f:
+                data = f.read(4096)
+        except OSError:
+            return None
+        if len(data) <= 256:
+            return None  # extended config space not readable
+        off = 0x100
+        while off and off < len(data) - 4:
+            cap_id = int.from_bytes(data[off : off + 2], "little")
+            nxt = int.from_bytes(data[off + 2 : off + 4], "little") >> 4
+            if cap_id == 0x0003 and off + 12 <= len(data):
+                serial = int.from_bytes(data[off + 4 : off + 12], "little")
+                return f"{serial:016x}"
+            if nxt <= off:
+                break
+            off = nxt
+        return None
+
+    def _read(self, d: str, name: str) -> str:
+        try:
+            with open(os.path.join(d, name)) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+
+class FakePlatform(Platform):
+    """Injectable platform for tests (reference platform.go:141-209)."""
+
+    def __init__(
+        self,
+        product: str = "",
+        node: str = "fake-node",
+        devices: Optional[List[PciDevice]] = None,
+        accel_paths: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self._lock = threading.Lock()
+        self._product = product
+        self._node = node
+        self._devices = list(devices or [])
+        self._accel = list(accel_paths or [])
+        self._env = dict(env or {})
+        self._serials: Dict[str, str] = {}
+
+    def set_product(self, product: str) -> None:
+        with self._lock:
+            self._product = product
+
+    def set_env(self, env: Dict[str, str]) -> None:
+        with self._lock:
+            self._env = dict(env)
+
+    def set_accel_paths(self, paths: List[str]) -> None:
+        with self._lock:
+            self._accel = list(paths)
+
+    def add_device(self, dev: PciDevice, serial: str = "") -> None:
+        with self._lock:
+            self._devices.append(dev)
+            if serial:
+                self._serials[dev.address] = serial
+
+    def remove_device(self, address: str) -> None:
+        with self._lock:
+            self._devices = [d for d in self._devices if d.address != address]
+
+    def pci_devices(self) -> List[PciDevice]:
+        with self._lock:
+            return list(self._devices)
+
+    def product_name(self) -> str:
+        with self._lock:
+            return self._product
+
+    def node_name(self) -> str:
+        return self._node
+
+    def accel_device_paths(self) -> List[str]:
+        with self._lock:
+            return list(self._accel)
+
+    def environ(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._env)
+
+    def read_device_serial(self, pci_address: str) -> Optional[str]:
+        with self._lock:
+            return self._serials.get(pci_address)

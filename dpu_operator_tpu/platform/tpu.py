@@ -1,0 +1,98 @@
+"""TPU detector — Google TPU as a first-class DPU vendor.
+
+This is the new vendor the whole build exists for (BASELINE.json north
+star). Detection mirrors the structure of the reference's Intel detector
+(internal/platform/ipu.go:43-89) but keys on TPU-VM platform signals:
+
+dpu side ("this node IS the accelerator runtime" — a TPU-VM worker):
+  * DMI/product string contains "TPU", or
+  * TPU runtime env markers (TPU_ACCELERATOR_TYPE / TPU_WORKER_ID, set by
+    the TPU-VM runtime / GKE device injector), or
+  * accelerator device nodes (/dev/accel*, /dev/vfio/*) present
+
+host side ("this node hosts TPU PCI functions without the runtime"):
+  * PCI vendor 0x1ae0 (Google) accelerator-class devices
+
+Identifier: "tpu-<type>-w<worker>" when the runtime env names the slice,
+else "tpu-<serial|pci>" — stable across daemon restarts so the CR name
+and the VSP socket wiring survive (reference ipu.go:84-89)."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from .detector import DetectedDpu, VendorDetector
+from .platform import PciDevice, Platform
+
+GOOGLE_PCI_VENDOR = "1ae0"
+# PCI class for processing accelerators (sysfs "class" = 0x120000).
+ACCEL_CLASS_PREFIX = "0x1200"
+
+VENDOR_KEY = "tpu"
+
+
+class TpuDetector(VendorDetector):
+    name = VENDOR_KEY
+
+    def is_dpu_platform(self, platform: Platform) -> Optional[DetectedDpu]:
+        env = platform.environ()
+        accel_type = env.get("TPU_ACCELERATOR_TYPE", "")
+        worker = env.get("TPU_WORKER_ID", "")
+        product = platform.product_name()
+        has_runtime = bool(accel_type) or bool(platform.accel_device_paths())
+        if "TPU" not in product.upper() and not has_runtime:
+            return None
+        ident = self._identifier(accel_type, worker, platform)
+        product_name = product or f"Google Cloud TPU {accel_type or ''}".strip()
+        return DetectedDpu(
+            identifier=ident,
+            product_name=product_name,
+            is_dpu_side=True,
+            vendor=VENDOR_KEY,
+            node_name=platform.node_name(),
+            topology=self._topology(env),
+        )
+
+    def is_dpu(self, platform: Platform, dev: PciDevice) -> Optional[DetectedDpu]:
+        if dev.vendor_id.lower() != GOOGLE_PCI_VENDOR or dev.is_vf:
+            return None
+        if dev.class_name and not dev.class_name.startswith(ACCEL_CLASS_PREFIX):
+            return None
+        serial = platform.read_device_serial(dev.address) or dev.address
+        return DetectedDpu(
+            identifier=f"tpu-{serial}",
+            product_name=dev.product_name or "Google TPU accelerator",
+            is_dpu_side=False,
+            vendor=VENDOR_KEY,
+            node_name=platform.node_name(),
+        )
+
+    # -- helpers -------------------------------------------------------------
+
+    def _identifier(self, accel_type: str, worker: str, platform: Platform) -> str:
+        if accel_type:
+            t = re.sub(r"[^a-z0-9-]", "-", accel_type.lower())
+            w = worker or "0"
+            return f"tpu-{t}-w{w}"
+        # Fall back to first Google PCI function's serial/address.
+        for dev in platform.pci_devices():
+            if dev.vendor_id.lower() == GOOGLE_PCI_VENDOR:
+                serial = platform.read_device_serial(dev.address) or dev.address
+                return f"tpu-{serial}"
+        return f"tpu-{platform.node_name()}"
+
+    def _topology(self, env) -> dict:
+        """Slice topology from the TPU runtime env (the ICI mesh bounds the
+        fabric layer shards endpoints over)."""
+        topo = {}
+        for key, out in (
+            ("TPU_CHIPS_PER_HOST_BOUNDS", "chipsPerHostBounds"),
+            ("TPU_HOST_BOUNDS", "hostBounds"),
+            ("TPU_ACCELERATOR_TYPE", "acceleratorType"),
+            ("TPU_WORKER_ID", "workerId"),
+            ("TPU_WORKER_HOSTNAMES", "workerHostnames"),
+        ):
+            if env.get(key):
+                topo[out] = env[key]
+        return topo

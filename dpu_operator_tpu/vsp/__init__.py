@@ -1,0 +1,4 @@
+from .server import VspServer
+from .mock_vsp import MockVsp
+
+__all__ = ["VspServer", "MockVsp"]

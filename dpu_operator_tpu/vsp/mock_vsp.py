@@ -1,0 +1,96 @@
+"""Mock VSP — the in-process fake powering the integration-test tier.
+
+Counterpart of reference internal/daemon/vendor-specific-plugins/mock-vsp/
+mockvsp.go: Init returns a loopback OPI address (mockvsp.go:31-37),
+GetDevices returns four fake fabric endpoints (mockvsp.go:39-50), and the
+bridge/NF operations are recorded no-ops so tests can assert the call
+sequence (mockvsp.go:52-70)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Tuple
+
+from google.protobuf import empty_pb2
+
+from ..dpu_api import services
+from ..dpu_api.gen import bridge_port_pb2 as bp
+from ..dpu_api.gen import dpu_api_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+
+class MockVsp(
+    services.LifeCycleServicer,
+    services.NetworkFunctionServicer,
+    services.DeviceServicer,
+    services.HeartbeatServicer,
+    services.BridgePortServicer,
+):
+    def __init__(self, opi_ip: str = "127.0.0.1", opi_port: int = 50151, num_devices: int = 4):
+        self._opi = (opi_ip, opi_port)
+        self._lock = threading.Lock()
+        self._num_endpoints = num_devices
+        self.init_calls: List[Tuple[int, str]] = []
+        self.bridge_ports: List[str] = []
+        self.network_functions: List[Tuple[str, str]] = []
+
+    # LifeCycle
+    def Init(self, request, context):
+        with self._lock:
+            self.init_calls.append((request.dpu_mode, request.dpu_identifier))
+        log.info("mock vsp Init(mode=%s, id=%s)", request.dpu_mode, request.dpu_identifier)
+        return pb.IpPort(ip=self._opi[0], port=self._opi[1])
+
+    # Devices
+    def GetDevices(self, request, context):
+        resp = pb.DeviceListResponse()
+        with self._lock:
+            n = self._num_endpoints
+        for i in range(n):
+            dev_id = f"mock-ep{i}"
+            d = resp.devices[dev_id]
+            d.id = dev_id
+            d.health = pb.HEALTHY
+            d.topology.coords = f"{i},0,0"
+            d.topology.numa_node = 0
+            d.backing = f"mockdev{i}"
+        return resp
+
+    def SetNumEndpoints(self, request, context):
+        with self._lock:
+            self._num_endpoints = request.count
+        return pb.EndpointCount(count=request.count)
+
+    # Heartbeat
+    def Ping(self, request, context):
+        return pb.PingResponse(healthy=True)
+
+    # NetworkFunction
+    def CreateNetworkFunction(self, request, context):
+        with self._lock:
+            self.network_functions.append((request.input, request.output))
+        return empty_pb2.Empty()
+
+    def DeleteNetworkFunction(self, request, context):
+        with self._lock:
+            try:
+                self.network_functions.remove((request.input, request.output))
+            except ValueError:
+                pass
+        return empty_pb2.Empty()
+
+    # BridgePort
+    def CreateBridgePort(self, request, context):
+        with self._lock:
+            self.bridge_ports.append(request.bridge_port.name)
+        return bp.BridgePort(name=request.bridge_port.name)
+
+    def DeleteBridgePort(self, request, context):
+        with self._lock:
+            try:
+                self.bridge_ports.remove(request.name)
+            except ValueError:
+                pass
+        return empty_pb2.Empty()

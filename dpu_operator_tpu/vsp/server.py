@@ -1,0 +1,59 @@
+"""VspServer — common harness every vendor plugin runs in.
+
+Binds a vendor implementation (LifeCycle/NetworkFunction/Device/Heartbeat
++ optional BridgePort) to the daemon's vendor-plugin unix socket, the
+process seam the reference crosses at
+internal/daemon/plugin/vendorplugin.go:129-153."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+from typing import Optional
+
+import grpc
+
+from ..dpu_api import services
+from ..utils import PathManager
+
+log = logging.getLogger(__name__)
+
+
+class VspServer:
+    def __init__(
+        self,
+        vsp,
+        path_manager: Optional[PathManager] = None,
+        socket_path: Optional[str] = None,
+        max_workers: int = 8,
+    ):
+        pm = path_manager or PathManager()
+        self._socket = socket_path or pm.vendor_plugin_socket()
+        self._pm = pm
+        self._vsp = vsp
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        services.add_lifecycle(vsp, self._server)
+        services.add_network_function(vsp, self._server)
+        services.add_device(vsp, self._server)
+        services.add_heartbeat(vsp, self._server)
+        if isinstance(vsp, services.BridgePortServicer):
+            services.add_bridge_port(vsp, self._server)
+
+    @property
+    def socket_path(self) -> str:
+        return self._socket
+
+    def start(self) -> None:
+        self._pm.ensure_socket_dir(self._socket)
+        self._pm.remove_stale_socket(self._socket)
+        self._server.add_insecure_port(f"unix://{self._socket}")
+        self._server.start()
+        log.info("VSP serving on unix://%s", self._socket)
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()

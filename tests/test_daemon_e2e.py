@@ -1,0 +1,249 @@
+"""Daemon integration tests — the counterpart of the reference's Kind tier
+(internal/daemon/daemon_test.go, dpusidemanager_test.go,
+hostsidemanager_test.go): real gRPC process boundaries (unix sockets +
+TCP OPI), FakePlatform detection, mock VSP, and — where the environment
+allows netns — the full CNI ADD/DEL path with a real pod namespace."""
+
+import socket
+import subprocess
+import time
+import uuid
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.api import v1
+from dpu_operator_tpu.daemon import Daemon, GrpcPlugin
+from dpu_operator_tpu.daemon.dpu_side import DpuSideManager
+from dpu_operator_tpu.daemon.host_side import HostSideManager
+from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster, get_condition
+from dpu_operator_tpu.platform import FakePlatform
+from dpu_operator_tpu.utils import PathManager
+from dpu_operator_tpu.vsp import MockVsp, VspServer
+
+TPU_ENV = {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0"}
+
+
+def wait_for(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cluster_client():
+    client = InMemoryClient(InMemoryCluster())
+    client.create(
+        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpu-node-0"}}
+    )
+    return client
+
+
+def test_daemon_detects_tpu_and_syncs_cr(cluster_client, tmp_root):
+    """FakePlatform advertises a TPU-VM → DataProcessingUnit CR appears
+    with isDpuSide and is removed when the platform stops matching
+    (reference daemon_test.go:112-120 + EventuallyNoDpuCR :34-47)."""
+    platform = FakePlatform(
+        product="Google Cloud TPU", node="tpu-node-0", env=TPU_ENV
+    )
+    vsp = MockVsp(opi_port=free_port())
+    vsp_server = VspServer(vsp, tmp_root)
+    vsp_server.start()
+    daemon = Daemon(
+        cluster_client,
+        platform,
+        path_manager=tmp_root,
+        tick_interval=0.05,
+        register_device_plugin=False,
+    )
+    daemon.start()
+    try:
+        cr_name = "tpu-v5litepod-8-w0-dpu"
+        assert wait_for(
+            lambda: cluster_client.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, cr_name
+            )
+            is not None
+        ), "DataProcessingUnit CR never appeared"
+        cr = cluster_client.get(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, cr_name
+        )
+        assert cr["spec"]["isDpuSide"] is True
+        assert cr["spec"]["nodeName"] == "tpu-node-0"
+        assert "TPU" in cr["spec"]["dpuProductName"]
+
+        # VSP got Init with DPU mode + our identifier.
+        assert wait_for(lambda: len(vsp.init_calls) > 0)
+        mode, ident = vsp.init_calls[0]
+        assert ident == "tpu-v5litepod-8-w0"
+
+        # Node label was derived.
+        node = cluster_client.get("v1", "Node", None, "tpu-node-0")
+        assert wait_for(
+            lambda: cluster_client.get("v1", "Node", None, "tpu-node-0")["metadata"][
+                "labels"
+            ].get(v.DPU_SIDE_LABEL)
+            == v.DPU_SIDE_DPU
+        )
+
+        # Platform stops matching → CR cleaned up (orphan path).
+        platform.set_product("")
+        platform.set_env({})
+        assert wait_for(
+            lambda: cluster_client.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, cr_name
+            )
+            is None
+        ), "orphaned CR was not deleted"
+    finally:
+        daemon.stop()
+        vsp_server.stop()
+
+
+def test_daemon_rejects_multiple_dpus(cluster_client, tmp_root):
+    """More than one detected DPU is an error (reference daemon.go:135-143)."""
+    from dpu_operator_tpu.platform import DetectedDpu, FakeTpuDetector
+
+    platform = FakePlatform(node="tpu-node-0")
+    two = [
+        DetectedDpu("a", "prod-a", True, "fake", "tpu-node-0"),
+        DetectedDpu("b", "prod-b", True, "fake", "tpu-node-0"),
+    ]
+    daemon = Daemon(
+        cluster_client,
+        platform,
+        path_manager=tmp_root,
+        detectors=[
+            FakeTpuDetector("d1", [two[0]]),
+            FakeTpuDetector("d2", [two[1]]),
+        ],
+        register_device_plugin=False,
+    )
+    with pytest.raises(RuntimeError, match="only one"):
+        daemon.tick()
+
+
+class TwoSideHarness:
+    """Both daemon roles in one process, separate PathManager roots, real
+    gRPC boundaries — the shape of the reference's host/dpu manager tests."""
+
+    def __init__(self, host_pm: PathManager, dpu_pm: PathManager):
+        port = free_port()
+        self.dpu_vsp = MockVsp(opi_port=port)
+        self.dpu_vsp_server = VspServer(self.dpu_vsp, dpu_pm)
+        self.dpu_vsp_server.start()
+        self.host_vsp = MockVsp(opi_port=port)
+        self.host_vsp_server = VspServer(self.host_vsp, host_pm)
+        self.host_vsp_server.start()
+
+        self.dpu = DpuSideManager(
+            GrpcPlugin(dpu_pm.vendor_plugin_socket()),
+            "tpu-v5litepod-8-w0",
+            path_manager=dpu_pm,
+            register_device_plugin=False,
+        )
+        self.host = HostSideManager(
+            GrpcPlugin(host_pm.vendor_plugin_socket()),
+            "tpu-host-0",
+            path_manager=host_pm,
+            register_device_plugin=False,
+        )
+
+    def start(self):
+        self.dpu.start_vsp()
+        self.dpu.setup_devices()
+        self.dpu.listen()
+        self.dpu.serve()
+        self.host.start_vsp()
+        self.host.setup_devices()
+        self.host.listen()
+        self.host.serve()
+
+    def stop(self):
+        self.host.stop()
+        self.dpu.stop()
+        self.host_vsp_server.stop()
+        self.dpu_vsp_server.stop()
+
+
+@pytest.fixture
+def two_sides(tmp_root):
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="dpu-")
+    harness = TwoSideHarness(host_pm=tmp_root, dpu_pm=PathManager(root=d))
+    harness.start()
+    try:
+        yield harness
+    finally:
+        harness.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_heartbeat_host_to_dpu(two_sides):
+    """Host pings the DPU-side OPI server every second; both sides report
+    fresh pings (reference §3.5 health loop)."""
+    assert wait_for(two_sides.host.check_ping, timeout=10), "host never got a pong"
+    assert two_sides.dpu.check_ping(), "dpu never recorded a ping"
+
+
+def test_cni_add_del_full_path(two_sides, netns):
+    """The 'forward pass' (SURVEY §3.3): CNI ADD through the shim protocol
+    → host CNI server → veth fabric dataplane into a REAL pod netns →
+    CreateBridgePort over TCP to the DPU-side daemon → DPU VSP. Then DEL
+    tears it all down."""
+    from dpu_operator_tpu.cni import CniRequest, do_cni
+
+    ns = "tstpod-" + uuid.uuid4().hex[:6]
+    subprocess.run(["ip", "netns", "add", ns], check=True)
+    try:
+        container_id = "cont" + uuid.uuid4().hex[:12]
+        req = CniRequest(
+            command="ADD",
+            container_id=container_id,
+            netns=ns,
+            ifname="net1",
+            config={"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"},
+        )
+        sock = two_sides.host.cni_server.socket_path
+        result = do_cni(sock, req)
+        assert result["interfaces"][0]["name"] == "net1"
+        assert result["ips"], "no IP allocated"
+
+        # Interface really exists in the pod netns with the allocated IP.
+        out = subprocess.run(
+            ["ip", "-n", ns, "-j", "addr", "show", "dev", "net1"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        assert result["ips"][0]["address"].split("/")[0] in out
+
+        # The DPU-side VSP saw the bridge port (host→OPI→VSP chain).
+        assert wait_for(lambda: len(two_sides.dpu_vsp.bridge_ports) == 1)
+
+        # DEL is clean and releases the bridge port.
+        req_del = CniRequest(
+            command="DEL", container_id=container_id, netns=ns, ifname="net1",
+            config=req.config,
+        )
+        do_cni(sock, req_del)
+        assert wait_for(lambda: len(two_sides.dpu_vsp.bridge_ports) == 0)
+        out = subprocess.run(
+            ["ip", "-n", ns, "link", "show", "dev", "net1"],
+            capture_output=True, text=True,
+        )
+        assert out.returncode != 0, "pod interface survived DEL"
+
+        # DEL is idempotent (CNI spec).
+        do_cni(sock, req_del)
+    finally:
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)

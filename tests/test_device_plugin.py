@@ -1,0 +1,136 @@
+"""Device-plugin protocol tests with a fake kubelet — the counterpart of
+the reference's Kind device-plugin assertions (dpusidemanager_test.go
+waitAllNodesDpuAllocatable) without needing a real kubelet: we run both
+ends of the v1beta1 protocol over real unix sockets."""
+
+import concurrent.futures
+import threading
+import time
+
+import grpc
+import pytest
+
+from dpu_operator_tpu.dpu_api import services
+from dpu_operator_tpu.dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+from dpu_operator_tpu.daemon.device_plugin import DevicePlugin
+from dpu_operator_tpu.daemon.plugin import GrpcPlugin
+from dpu_operator_tpu.vsp import MockVsp, VspServer
+
+
+class FakeKubelet(services.KubeletRegistrationServicer):
+    """Serves the Registration endpoint like kubelet does, then (like
+    kubelet) dials back the plugin's socket and consumes ListAndWatch."""
+
+    def __init__(self, plugin_dir_pm):
+        self._pm = plugin_dir_pm
+        self.registered = threading.Event()
+        self.resource_name = None
+        self.devices = {}
+        self._lock = threading.Lock()
+        self._server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=2))
+        services.add_kubelet_registration(self, self._server)
+
+    def start(self):
+        sock = self._pm.kubelet_registry_socket()
+        self._pm.ensure_socket_dir(sock)
+        self._pm.remove_stale_socket(sock)
+        self._server.add_insecure_port(f"unix://{sock}")
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(0)
+
+    def Register(self, request, context):
+        self.resource_name = request.resource_name
+        endpoint = request.endpoint
+        self.registered.set()
+        t = threading.Thread(
+            target=self._consume, args=(endpoint,), daemon=True, name="kubelet-law"
+        )
+        t.start()
+        return kdp.Empty()
+
+    def _consume(self, endpoint):
+        import os
+
+        sock = os.path.join(self._pm.kubelet_plugin_dir(), endpoint)
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        stub = services.DevicePluginStub(channel)
+        try:
+            for resp in stub.ListAndWatch(kdp.Empty()):
+                with self._lock:
+                    self.devices = {d.ID: d.health for d in resp.devices}
+        except grpc.RpcError:
+            pass
+
+    def allocatable(self):
+        with self._lock:
+            return dict(self.devices)
+
+
+@pytest.fixture
+def vsp_and_plugin(tmp_root):
+    vsp = MockVsp()
+    server = VspServer(vsp, tmp_root)
+    server.start()
+    plugin = GrpcPlugin(tmp_root.vendor_plugin_socket())
+    yield vsp, plugin
+    plugin.close()
+    server.stop()
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+def test_register_and_list_and_watch(vsp_and_plugin, tmp_root):
+    vsp, plugin = vsp_and_plugin
+    kubelet = FakeKubelet(tmp_root)
+    kubelet.start()
+    dp = DevicePlugin(plugin, tmp_root, poll_interval=0.1)
+    try:
+        dp.serve(register=True)
+        assert kubelet.registered.wait(timeout=5)
+        assert kubelet.resource_name == "tpu.dpu.io/endpoint"
+        assert wait_for(lambda: len(kubelet.allocatable()) == 4)
+        assert all(h == "Healthy" for h in kubelet.allocatable().values())
+
+        # Inventory change propagates through the stream.
+        plugin.set_num_endpoints(2)
+        assert wait_for(lambda: len(kubelet.allocatable()) == 2)
+    finally:
+        dp.stop()
+        kubelet.stop()
+
+
+def test_allocate_healthy_and_unknown(vsp_and_plugin, tmp_root):
+    vsp, plugin = vsp_and_plugin
+    dp = DevicePlugin(plugin, tmp_root, poll_interval=0.1)
+    try:
+        dp.start()
+        channel = grpc.insecure_channel(f"unix://{tmp_root.device_plugin_socket()}")
+        stub = services.DevicePluginStub(channel)
+        # Prime the health cache by consuming one ListAndWatch frame.
+        stream = stub.ListAndWatch(kdp.Empty())
+        first = next(iter(stream))
+        assert len(first.devices) == 4
+
+        req = kdp.AllocateRequest()
+        creq = req.container_requests.add()
+        creq.devices_ids.extend(["mock-ep0", "mock-ep1"])
+        resp = stub.Allocate(req)
+        assert resp.container_responses[0].envs["NF-DEV"] == "mock-ep0,mock-ep1"
+
+        bad = kdp.AllocateRequest()
+        bad.container_requests.add().devices_ids.append("nope")
+        with pytest.raises(grpc.RpcError) as e:
+            stub.Allocate(bad)
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        channel.close()
+    finally:
+        dp.stop()
