@@ -1,0 +1,11 @@
+from .dpuoperatorconfig_controller import DpuOperatorConfigReconciler
+from .dataprocessingunit_controller import DataProcessingUnitReconciler
+from .sfc_controller import ServiceFunctionChainClusterReconciler
+from .dpuconfig_controller import DataProcessingUnitConfigReconciler
+
+__all__ = [
+    "DpuOperatorConfigReconciler",
+    "DataProcessingUnitReconciler",
+    "ServiceFunctionChainClusterReconciler",
+    "DataProcessingUnitConfigReconciler",
+]

@@ -1,0 +1,77 @@
+"""DataProcessingUnit reconciler — launches a vendor VSP pod per DPU.
+
+Counterpart of reference internal/controller/dataprocessingunit_controller.go:
+renders the shared VSP RBAC plus the vendor-specific VSP pod pinned to
+the DPU's node (:131-187), picks the image/directory from the DPU's
+vendor (:189-205), and tracks a per-DPU ResourceRenderer so a vanished
+DPU's resources are cleaned in reverse order."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict
+
+from .. import vars as v
+from ..api import v1
+from ..images import ImageManager
+from ..k8s import Client, Reconciler, Request, Result
+from ..k8s.store import NotFound
+from ..render import ResourceRenderer
+
+log = logging.getLogger(__name__)
+
+BINDATA = os.path.join(os.path.dirname(__file__), "bindata")
+
+# vendor label value → (bindata dir, image key); the TPU row is the point
+# of this build (reference getVendorDirectory/getVSPImageForDPU :189-205).
+VENDOR_TABLE = {
+    "tpu": ("tpu", "tpu_vsp"),
+    "mock": ("mock", "mock_vsp"),
+}
+
+
+class DataProcessingUnitReconciler(Reconciler):
+    def __init__(
+        self,
+        client: Client,
+        image_manager: ImageManager,
+        namespace: str = v.NAMESPACE,
+        image_pull_policy: str = "IfNotPresent",
+    ):
+        self._client = client
+        self._images = image_manager
+        self._namespace = namespace
+        self._pull_policy = image_pull_policy
+        self._renderers: Dict[str, ResourceRenderer] = {}
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            dpu = self._client.get(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, req.namespace, req.name
+            )
+        except NotFound:
+            renderer = self._renderers.pop(req.name, None)
+            if renderer is not None:
+                renderer.cleanup_reverse_order()
+            return Result()
+
+        vendor = dpu["metadata"].get("labels", {}).get("dpu.tpu.io/vendor", "")
+        entry = VENDOR_TABLE.get(vendor)
+        if entry is None:
+            log.warning("DPU %s has unknown vendor %r; no VSP launched", req.name, vendor)
+            return Result()
+        vendor_dir, image_key = entry
+
+        renderer = self._renderers.setdefault(req.name, ResourceRenderer(self._client))
+        variables = {
+            "Namespace": self._namespace,
+            "ImagePullPolicy": self._pull_policy,
+            "NodeName": dpu["spec"]["nodeName"],
+            "VspImage": self._images.get_image(image_key),
+        }
+        renderer.apply_dir(os.path.join(BINDATA, "vsp", "shared"), variables, owner=dpu)
+        renderer.apply_dir(
+            os.path.join(BINDATA, "vsp", vendor_dir), variables, owner=dpu
+        )
+        return Result()

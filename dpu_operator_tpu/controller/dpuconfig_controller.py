@@ -1,0 +1,53 @@
+"""DataProcessingUnitConfig reconciler.
+
+The reference ships this reconciler as a stub with a placeholder spec
+(internal/controller/dataprocessingunitconfig_controller.go:49-55). Ours
+implements the obvious real behavior for the field we gave the CR:
+propagate spec.numEndpoints to matching DataProcessingUnits via an
+annotation the node daemon consumes for SetNumEndpoints."""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import v1
+from ..k8s import Client, Reconciler, Request, Result
+from ..k8s.objects import matches_selector
+from ..k8s.store import Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+NUM_ENDPOINTS_ANNOTATION = "config.tpu.io/num-endpoints"
+
+
+class DataProcessingUnitConfigReconciler(Reconciler):
+    def __init__(self, client: Client):
+        self._client = client
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            cfg = self._client.get(
+                v1.GROUP_VERSION,
+                v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+                req.namespace,
+                req.name,
+            )
+        except NotFound:
+            return Result()
+        num = cfg.get("spec", {}).get("numEndpoints")
+        if num is None:
+            return Result()
+        selector = cfg.get("spec", {}).get("dpuSelector") or None
+        for dpu in self._client.list(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, req.namespace
+        ):
+            if not matches_selector(dpu, selector):
+                continue
+            annotations = dpu["metadata"].setdefault("annotations", {})
+            if annotations.get(NUM_ENDPOINTS_ANNOTATION) != str(num):
+                annotations[NUM_ENDPOINTS_ANNOTATION] = str(num)
+                try:
+                    self._client.update(dpu)
+                except Conflict:
+                    return Result(requeue_after=0.2)
+        return Result()

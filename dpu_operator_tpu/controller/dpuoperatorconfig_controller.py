@@ -1,0 +1,116 @@
+"""DpuOperatorConfig reconciler — the operator's main loop.
+
+Counterpart of reference internal/controller/dpuoperatorconfig_controller.go:
+finalizer add/remove with reverse-order cleanup (:129-141,184-217), render
+the daemon DaemonSet (:312-320), NF NADs (:327-348) and the NRI (:322-326)
+from bindata, choose the CNI dir from cluster flavour × filesystem mode
+(:270-305 yamlVars), and surface a Ready status condition (:244-268)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from .. import vars as v
+from ..api import v1
+from ..images import ImageManager, merge_vars_with_images
+from ..images import (
+    DPU_DAEMON_IMAGE,
+    NRI_IMAGE,
+    VSP_IMAGE_MOCK,
+    VSP_IMAGE_TPU,
+)
+from ..k8s import Client, Reconciler, Request, Result
+from ..k8s.objects import (
+    add_finalizer,
+    has_finalizer,
+    remove_finalizer,
+    set_condition,
+)
+from ..k8s.store import NotFound
+from ..render import ResourceRenderer
+from ..utils.cluster_environment import ClusterEnvironment
+from ..utils.filesystem_mode import FilesystemModeDetector
+from ..utils.path_manager import PathManager
+
+log = logging.getLogger(__name__)
+
+FINALIZER = "config.tpu.io/dpu-operator-config"
+BINDATA = os.path.join(os.path.dirname(__file__), "bindata")
+
+
+class DpuOperatorConfigReconciler(Reconciler):
+    def __init__(
+        self,
+        client: Client,
+        image_manager: ImageManager,
+        namespace: str = v.NAMESPACE,
+        image_pull_policy: str = "IfNotPresent",
+        path_manager: Optional[PathManager] = None,
+    ):
+        self._client = client
+        self._images = image_manager
+        self._namespace = namespace
+        self._pull_policy = image_pull_policy
+        self._pm = path_manager or PathManager()
+        self._renderer = ResourceRenderer(client)
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        if req.name != v.DPU_OPERATOR_CONFIG_NAME:
+            return Result()
+        try:
+            cfg = self._client.get(
+                v1.GROUP_VERSION, v1.KIND_DPU_OPERATOR_CONFIG, req.namespace, req.name
+            )
+        except NotFound:
+            return Result()
+
+        if cfg["metadata"].get("deletionTimestamp"):
+            self._renderer.cleanup_reverse_order()
+            if remove_finalizer(cfg, FINALIZER):
+                self._client.update(cfg)
+            return Result()
+
+        if add_finalizer(cfg, FINALIZER):
+            cfg = self._client.update(cfg)
+
+        variables = self._yaml_vars(cfg)
+        self._ensure_daemon_set(cfg, variables)
+        self._ensure_networkfn_nads(cfg, variables)
+        self._ensure_nri(cfg, variables)
+
+        if set_condition(cfg, v1.COND_READY, "True", "ReconcileSuccess", ""):
+            self._client.update_status(cfg)
+        return Result()
+
+    # -- pieces --------------------------------------------------------------
+
+    def _yaml_vars(self, cfg: dict) -> Dict[str, str]:
+        flavour = ClusterEnvironment(self._client).flavour()
+        fs_mode = FilesystemModeDetector(self._pm.root).detect()
+        variables = {
+            "Namespace": self._namespace,
+            "ImagePullPolicy": self._pull_policy,
+            "LogLevel": str(cfg.get("spec", {}).get("logLevel", 0)),
+            "CniBinDir": self._pm.cni_host_dir(flavour, fs_mode),
+            "ResourceName": v.DPU_RESOURCE_NAME,
+            "HostNadName": v.DEFAULT_HOST_NAD_NAME,
+        }
+        return merge_vars_with_images(
+            self._images,
+            variables,
+            keys=(DPU_DAEMON_IMAGE, VSP_IMAGE_TPU, VSP_IMAGE_MOCK, NRI_IMAGE),
+        )
+
+    def _ensure_daemon_set(self, cfg: dict, variables: Dict[str, str]) -> None:
+        self._renderer.apply_dir(os.path.join(BINDATA, "daemon"), variables, owner=cfg)
+
+    def _ensure_networkfn_nads(self, cfg: dict, variables: Dict[str, str]) -> None:
+        for d in ("networkfn-nad-dpu", "networkfn-nad-host"):
+            self._renderer.apply_dir(os.path.join(BINDATA, d), variables, owner=cfg)
+
+    def _ensure_nri(self, cfg: dict, variables: Dict[str, str]) -> None:
+        self._renderer.apply_dir(os.path.join(BINDATA, "nri"), variables, owner=cfg)

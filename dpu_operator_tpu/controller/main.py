@@ -1,0 +1,89 @@
+"""Operator entrypoint — wires the manager, reconcilers, and webhook.
+
+Counterpart of reference cmd/main.go:61-161: one Manager, four
+reconcilers, the validating webhook, leader election via a k8s Lease is
+TODO (single-replica deployments don't need it; the reference enables it
+optionally)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+from .. import vars as v
+from ..api import v1
+from ..api.webhook import (
+    AdmissionWebhook,
+    validate_dpu_operator_config,
+    validate_service_function_chain,
+)
+from ..images import EnvImageManager
+from ..k8s import Manager
+from ..k8s.http_client import client_from_kubeconfig
+from . import (
+    DataProcessingUnitConfigReconciler,
+    DataProcessingUnitReconciler,
+    DpuOperatorConfigReconciler,
+    ServiceFunctionChainClusterReconciler,
+)
+
+log = logging.getLogger(__name__)
+
+
+def build_manager(client, image_manager, namespace: str = v.NAMESPACE) -> Manager:
+    """Assemble the controller set; shared by main() and the tests."""
+    mgr = Manager(client)
+    pull_policy = os.environ.get("IMAGE_PULL_POLICIES", "IfNotPresent")
+    mgr.new_controller(
+        "dpu-operator-config",
+        DpuOperatorConfigReconciler(client, image_manager, namespace, pull_policy),
+    ).watches(v1.GROUP_VERSION, v1.KIND_DPU_OPERATOR_CONFIG, namespace)
+    mgr.new_controller(
+        "data-processing-unit",
+        DataProcessingUnitReconciler(client, image_manager, namespace, pull_policy),
+    ).watches(v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, namespace)
+    mgr.new_controller(
+        "service-function-chain-cluster",
+        ServiceFunctionChainClusterReconciler(client),
+    ).watches(v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, namespace)
+    mgr.new_controller(
+        "data-processing-unit-config",
+        DataProcessingUnitConfigReconciler(client),
+    ).watches(v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG, namespace)
+    return mgr
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if os.environ.get("DPU_LOG_LEVEL", "0") != "0" else logging.INFO
+    )
+    client = client_from_kubeconfig()
+    mgr = build_manager(client, EnvImageManager())
+
+    webhook = None
+    if os.environ.get("ENABLE_WEBHOOKS", "true").lower() != "false":
+        webhook = AdmissionWebhook(
+            host="0.0.0.0",
+            port=int(os.environ.get("WEBHOOK_PORT", "9443")),
+            certfile=os.environ.get("WEBHOOK_CERT"),
+            keyfile=os.environ.get("WEBHOOK_KEY"),
+        )
+        webhook.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
+        webhook.register("/validate-sfc", validate_service_function_chain)
+        webhook.start()
+
+    mgr.start()
+    log.info("operator running (namespace=%s)", v.NAMESPACE)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    mgr.stop()
+    if webhook:
+        webhook.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Network resources injector — mutating webhook for NAD-annotated pods.
+
+Counterpart of reference cmd/nri/networkresourcesinjector.go (+ vendored
+k8snetworkplumbingwg/network-resources-injector): pods whose
+`k8s.v1.cni.cncf.io/networks` annotation references NADs that carry a
+`k8s.v1.cni.cncf.io/resourceName` annotation get that extended resource
+injected into their first container's requests/limits — one unit per
+attachment, so a pod attaching the NF NAD twice requests 2 endpoints
+(the SFC pod shape, reference sfc.go:35-76)."""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import List, Optional, Tuple
+
+from .. import vars as v
+from ..k8s import Client
+
+log = logging.getLogger(__name__)
+
+NETWORKS_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
+RESOURCE_NAME_ANNOTATION = "k8s.v1.cni.cncf.io/resourceName"
+
+
+def parse_networks(value: str, default_namespace: str) -> List[Tuple[str, str]]:
+    """Parse the networks annotation: "name", "ns/name", comma-separated.
+    Repeats are meaningful (two attachments = two resource units)."""
+    out = []
+    for item in value.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "/" in item:
+            ns, _, name = item.partition("/")
+        else:
+            ns, name = default_namespace, item
+        # Strip interface suffix form "name@ifname".
+        name = name.split("@")[0]
+        out.append((ns, name))
+    return out
+
+
+class NetworkResourcesInjector:
+    def __init__(self, client: Client, nad_namespace: str = v.NAMESPACE):
+        self._client = client
+        self._nad_namespace = nad_namespace
+
+    def _nad_resource(self, ns: str, name: str) -> Optional[str]:
+        nad = self._client.get_or_none(
+            "k8s.cni.cncf.io/v1", "NetworkAttachmentDefinition", ns, name
+        )
+        if nad is None and ns != self._nad_namespace:
+            nad = self._client.get_or_none(
+                "k8s.cni.cncf.io/v1", "NetworkAttachmentDefinition",
+                self._nad_namespace, name,
+            )
+        if nad is None:
+            return None
+        return nad["metadata"].get("annotations", {}).get(RESOURCE_NAME_ANNOTATION)
+
+    def mutate(self, request: dict) -> Tuple[bool, str, Optional[list]]:
+        """AdmissionHandler for /mutate: returns a JSONPatch injecting the
+        summed resource requests."""
+        pod = request.get("object") or {}
+        annotations = pod.get("metadata", {}).get("annotations", {}) or {}
+        networks = annotations.get(NETWORKS_ANNOTATION, "")
+        if not networks:
+            return True, "", None
+        pod_ns = (
+            pod.get("metadata", {}).get("namespace")
+            or request.get("namespace")
+            or "default"
+        )
+        wanted: Counter = Counter()
+        for ns, name in parse_networks(networks, pod_ns):
+            resource = self._nad_resource(ns, name)
+            if resource:
+                wanted[resource] += 1
+        if not wanted:
+            return True, "", None
+
+        containers = pod.get("spec", {}).get("containers", [])
+        if not containers:
+            return True, "", None
+        patch = []
+        c0 = containers[0]
+        if "resources" not in c0:
+            patch.append({"op": "add", "path": "/spec/containers/0/resources", "value": {}})
+            c0 = dict(c0, resources={})
+        for section in ("requests", "limits"):
+            existing = c0.get("resources", {}).get(section)
+            if existing is None:
+                patch.append(
+                    {
+                        "op": "add",
+                        "path": f"/spec/containers/0/resources/{section}",
+                        "value": {},
+                    }
+                )
+            for resource, count in wanted.items():
+                escaped = resource.replace("~", "~0").replace("/", "~1")
+                patch.append(
+                    {
+                        "op": "add",
+                        "path": f"/spec/containers/0/resources/{section}/{escaped}",
+                        "value": str(count),
+                    }
+                )
+        log.info("injecting %s into pod %s", dict(wanted), pod.get("metadata", {}).get("name"))
+        return True, "", patch
+
+
+def main() -> None:  # container entrypoint (bindata/nri/01.deployment.yaml)
+    import time
+
+    from ..api.webhook import AdmissionWebhook
+    from ..k8s.http_client import client_from_kubeconfig
+
+    logging.basicConfig(level=logging.INFO)
+    client = client_from_kubeconfig()
+    injector = NetworkResourcesInjector(client)
+    wh = AdmissionWebhook(host="0.0.0.0", port=8443)
+    wh.register("/mutate", injector.mutate)
+    wh.start()
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Cluster-level ServiceFunctionChain reconciler.
+
+The reference keeps this as an intentional stub — real SFC logic runs in
+the per-node reconciler inside the daemon (internal/controller/
+servicefunctionchain_controller.go:53-59). We keep the same split: this
+cluster controller only validates and surfaces status; pod creation is
+the node daemon's job (dpu_operator_tpu.daemon.sfc)."""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import v1
+from ..k8s import Client, Reconciler, Request, Result
+from ..k8s.objects import set_condition
+from ..k8s.store import NotFound
+
+log = logging.getLogger(__name__)
+
+
+class ServiceFunctionChainClusterReconciler(Reconciler):
+    def __init__(self, client: Client):
+        self._client = client
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            sfc = self._client.get(
+                v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, req.namespace, req.name
+            )
+        except NotFound:
+            return Result()
+        try:
+            v1.validate_service_function_chain_spec(sfc)
+            changed = set_condition(sfc, "Accepted", "True", "Valid", "")
+        except v1.ValidationError as e:
+            changed = set_condition(sfc, "Accepted", "False", "Invalid", str(e))
+        if changed:
+            self._client.update_status(sfc)
+        return Result()

@@ -1,0 +1,43 @@
+"""Node daemon entrypoint (counterpart of reference cmd/daemon/daemon.go:19)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+from ..k8s.http_client import client_from_kubeconfig
+from ..platform import HardwarePlatform
+from ..utils import PathManager
+from .daemon import Daemon
+
+log = logging.getLogger(__name__)
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if os.environ.get("DPU_LOG_LEVEL", "0") != "0" else logging.INFO
+    )
+    client = client_from_kubeconfig()
+    platform = HardwarePlatform()
+    shim_src = os.environ.get("DPU_CNI_SHIM", "/usr/local/bin/dpu-cni")
+    daemon = Daemon(
+        client,
+        platform,
+        path_manager=PathManager(),
+        cni_shim_source=shim_src if os.path.exists(shim_src) else None,
+        mode_override=os.environ.get("DPU_MODE", "auto"),
+    )
+    daemon.prepare()
+    daemon.start()
+    log.info("daemon running on node %s", platform.node_name())
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+"""HttpClient — the Client interface against a real kube-apiserver.
+
+Production binding of dpu_operator_tpu.k8s.client.Client (the in-memory
+binding serves tests/standalone). Pure stdlib: bearer-token or cert auth,
+JSON REST, and watch via the chunked ?watch=1 stream. In-cluster config
+comes from the service-account mount, out-of-cluster from $KUBECONFIG.
+
+The kind→resource mapping covers the kinds this operator touches; new
+kinds just add a row (we deliberately avoid a discovery client)."""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+import yaml
+
+from .client import Client
+from .objects import K8sObject, name_of, namespace_of
+from .store import AlreadyExists, Conflict, NotFound, WatchEvent
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind → (plural, api prefix). Core v1 uses /api/v1, everything else /apis/<gv>.
+_RESOURCES: Dict[str, str] = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "ConfigMap": "configmaps",
+    "Secret": "secrets",
+    "Service": "services",
+    "ServiceAccount": "serviceaccounts",
+    "Namespace": "namespaces",
+    "Deployment": "deployments",
+    "DaemonSet": "daemonsets",
+    "Role": "roles",
+    "RoleBinding": "rolebindings",
+    "ClusterRole": "clusterroles",
+    "ClusterRoleBinding": "clusterrolebindings",
+    "CustomResourceDefinition": "customresourcedefinitions",
+    "MutatingWebhookConfiguration": "mutatingwebhookconfigurations",
+    "ValidatingWebhookConfiguration": "validatingwebhookconfigurations",
+    "NetworkAttachmentDefinition": "network-attachment-definitions",
+    "DpuOperatorConfig": "dpuoperatorconfigs",
+    "DataProcessingUnit": "dataprocessingunits",
+    "ServiceFunctionChain": "servicefunctionchains",
+    "DataProcessingUnitConfig": "dataprocessingunitconfigs",
+}
+
+_CLUSTER_SCOPED = {
+    "Node",
+    "Namespace",
+    "ClusterRole",
+    "ClusterRoleBinding",
+    "CustomResourceDefinition",
+    "MutatingWebhookConfiguration",
+    "ValidatingWebhookConfiguration",
+}
+
+
+class _HttpWatcher:
+    def __init__(self):
+        self.events: "queue.Queue[WatchEvent]" = queue.Queue()
+        self.stopped = threading.Event()
+
+
+class HttpClient(Client):
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+    ):
+        self._base = base_url.rstrip("/")
+        self._token = token
+        if insecure:
+            self._ctx = ssl._create_unverified_context()
+        elif ca_file:
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = ssl.create_default_context()
+        self._watchers: List[_HttpWatcher] = []
+
+    # -- url plumbing --------------------------------------------------------
+
+    def _resource_url(
+        self, api_version: str, kind: str, namespace: Optional[str], name: Optional[str]
+    ) -> str:
+        plural = _RESOURCES.get(kind)
+        if plural is None:
+            plural = kind.lower() + "s"
+        prefix = "/api/v1" if api_version == "v1" else f"/apis/{api_version}"
+        url = self._base + prefix
+        if namespace and kind not in _CLUSTER_SCOPED:
+            url += f"/namespaces/{namespace}"
+        url += f"/{plural}"
+        if name:
+            url += f"/{name}"
+        return url
+
+    def _request(self, method: str, url: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFound(f"{method} {url}: {detail}")
+            if e.code == 409:
+                if "AlreadyExists" in detail or method == "POST":
+                    raise AlreadyExists(f"{method} {url}: {detail}")
+                raise Conflict(f"{method} {url}: {detail}")
+            raise RuntimeError(f"{method} {url}: HTTP {e.code}: {detail}")
+
+    # -- Client interface ----------------------------------------------------
+
+    def create(self, obj: K8sObject) -> K8sObject:
+        url = self._resource_url(obj["apiVersion"], obj["kind"], namespace_of(obj), None)
+        return self._request("POST", url, obj)
+
+    def get(self, api_version, kind, namespace, name) -> K8sObject:
+        return self._request(
+            "GET", self._resource_url(api_version, kind, namespace, name)
+        )
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        url = self._resource_url(api_version, kind, namespace, None)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            url += f"?labelSelector={urllib.request.quote(sel)}"
+        return self._request("GET", url).get("items", [])
+
+    def update(self, obj: K8sObject) -> K8sObject:
+        url = self._resource_url(
+            obj["apiVersion"], obj["kind"], namespace_of(obj), name_of(obj)
+        )
+        return self._request("PUT", url, obj)
+
+    def update_status(self, obj: K8sObject) -> K8sObject:
+        url = (
+            self._resource_url(
+                obj["apiVersion"], obj["kind"], namespace_of(obj), name_of(obj)
+            )
+            + "/status"
+        )
+        return self._request("PUT", url, obj)
+
+    def delete(self, api_version, kind, namespace, name) -> None:
+        self._request(
+            "DELETE", self._resource_url(api_version, kind, namespace, name)
+        )
+
+    def watch(self, api_version, kind, namespace=None):
+        w = _HttpWatcher()
+        self._watchers.append(w)
+        t = threading.Thread(
+            target=self._watch_loop,
+            args=(w, api_version, kind, namespace),
+            daemon=True,
+            name=f"http-watch-{kind}",
+        )
+        t.start()
+        return w
+
+    def stop_watch(self, watcher) -> None:
+        watcher.stopped.set()
+        if watcher in self._watchers:
+            self._watchers.remove(watcher)
+
+    # -- watch internals -----------------------------------------------------
+
+    def _watch_loop(self, w: _HttpWatcher, api_version, kind, namespace) -> None:
+        import time
+
+        while not w.stopped.is_set():
+            try:
+                listing = self._request(
+                    "GET", self._resource_url(api_version, kind, namespace, None)
+                )
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                for item in listing.get("items", []):
+                    item.setdefault("apiVersion", api_version)
+                    item.setdefault("kind", kind)
+                    w.events.put(WatchEvent("ADDED", item))
+                url = (
+                    self._resource_url(api_version, kind, namespace, None)
+                    + f"?watch=1&resourceVersion={rv}&allowWatchBookmarks=false"
+                )
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self._token:
+                    req.add_header("Authorization", f"Bearer {self._token}")
+                with urllib.request.urlopen(req, context=self._ctx) as resp:
+                    for line in resp:
+                        if w.stopped.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        ev = json.loads(line)
+                        obj = ev.get("object", {})
+                        obj.setdefault("apiVersion", api_version)
+                        obj.setdefault("kind", kind)
+                        w.events.put(WatchEvent(ev.get("type", "MODIFIED"), obj))
+            except Exception:
+                if w.stopped.is_set():
+                    return
+                time.sleep(2.0)  # relist + rewatch
+
+
+def in_cluster_client() -> HttpClient:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    with open(os.path.join(SA_DIR, "token")) as f:
+        token = f.read().strip()
+    return HttpClient(
+        f"https://{host}:{port}", token=token, ca_file=os.path.join(SA_DIR, "ca.crt")
+    )
+
+
+def client_from_kubeconfig(path: Optional[str] = None) -> HttpClient:
+    """In-cluster when the SA mount exists, else $KUBECONFIG/~/.kube/config
+    (current-context, token or insecure)."""
+    if os.path.exists(os.path.join(SA_DIR, "token")):
+        return in_cluster_client()
+    path = path or os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    ctx_name = cfg.get("current-context")
+    ctx = next(c["context"] for c in cfg["contexts"] if c["name"] == ctx_name)
+    cluster = next(
+        c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"]
+    )
+    user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+    token = user.get("token")
+    insecure = bool(cluster.get("insecure-skip-tls-verify"))
+    ca = cluster.get("certificate-authority")
+    return HttpClient(cluster["server"], token=token, ca_file=ca, insecure=insecure)

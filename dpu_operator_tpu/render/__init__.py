@@ -1,0 +1,3 @@
+from .render import ResourceRenderer, render_dir, render_template
+
+__all__ = ["ResourceRenderer", "render_dir", "render_template"]

@@ -1,0 +1,19 @@
+"""Mock VSP container entrypoint (bindata/vsp/mock/99.vsp-pod.yaml)."""
+
+from __future__ import annotations
+
+import logging
+
+from .mock_vsp import MockVsp
+from .server import VspServer
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    server = VspServer(MockVsp())
+    server.start()
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()

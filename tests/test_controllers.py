@@ -1,0 +1,217 @@
+"""Operator control-plane tests — the real reconcilers run in-process
+against the in-memory cluster (the shape of reference
+internal/controller/dpuoperatorconfig_controller_test.go:45-80 with
+DummyImageManager)."""
+
+import time
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.api import v1
+from dpu_operator_tpu.controller.main import build_manager
+from dpu_operator_tpu.controller.nri import NetworkResourcesInjector
+from dpu_operator_tpu.images import DummyImageManager
+from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster, get_condition
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+@pytest.fixture
+def mgr_and_client():
+    client = InMemoryClient(InMemoryCluster())
+    mgr = build_manager(client, DummyImageManager())
+    mgr.start()
+    yield mgr, client
+    mgr.stop()
+
+
+def test_config_reconcile_renders_operands(mgr_and_client):
+    mgr, client = mgr_and_client
+    client.create(v1.new_dpu_operator_config())
+
+    assert wait_for(
+        lambda: client.get_or_none("apps/v1", "DaemonSet", v.NAMESPACE, "dpu-daemon")
+        is not None
+    ), "daemon DaemonSet not rendered"
+    ds = client.get("apps/v1", "DaemonSet", v.NAMESPACE, "dpu-daemon")
+    tmpl = ds["spec"]["template"]["spec"]
+    assert tmpl["nodeSelector"] == {"dpu": "true"}
+    assert tmpl["containers"][0]["image"] == "dpu_daemon-mock-image"
+
+    # Both NF NADs (reference ensureNetworkFunctioNAD :327-348).
+    for nad_name in ("dpunfcni-conf", v.DEFAULT_HOST_NAD_NAME):
+        assert wait_for(
+            lambda n=nad_name: client.get_or_none(
+                "k8s.cni.cncf.io/v1", "NetworkAttachmentDefinition", v.NAMESPACE, n
+            )
+            is not None
+        ), f"NAD {nad_name} not rendered"
+    nad = client.get(
+        "k8s.cni.cncf.io/v1", "NetworkAttachmentDefinition", v.NAMESPACE, "dpunfcni-conf"
+    )
+    assert (
+        nad["metadata"]["annotations"]["k8s.v1.cni.cncf.io/resourceName"]
+        == v.DPU_RESOURCE_NAME
+    )
+
+    # NRI deployment + webhook config.
+    assert wait_for(
+        lambda: client.get_or_none(
+            "apps/v1", "Deployment", v.NAMESPACE, "network-resources-injector"
+        )
+        is not None
+    )
+
+    # Ready condition on the config CR.
+    assert wait_for(
+        lambda: (
+            get_condition(
+                client.get(
+                    v1.GROUP_VERSION, v1.KIND_DPU_OPERATOR_CONFIG,
+                    v.NAMESPACE, v.DPU_OPERATOR_CONFIG_NAME,
+                ),
+                "Ready",
+            )
+            or {}
+        ).get("status")
+        == "True"
+    )
+
+
+def test_config_deletion_cleans_up(mgr_and_client):
+    mgr, client = mgr_and_client
+    client.create(v1.new_dpu_operator_config())
+    assert wait_for(
+        lambda: client.get_or_none("apps/v1", "DaemonSet", v.NAMESPACE, "dpu-daemon")
+        is not None
+    )
+    client.delete(
+        v1.GROUP_VERSION, v1.KIND_DPU_OPERATOR_CONFIG, v.NAMESPACE,
+        v.DPU_OPERATOR_CONFIG_NAME,
+    )
+    # Finalizer runs → operands removed → CR gone.
+    assert wait_for(
+        lambda: client.get_or_none("apps/v1", "DaemonSet", v.NAMESPACE, "dpu-daemon")
+        is None
+    ), "DaemonSet survived config deletion"
+    assert wait_for(
+        lambda: client.get_or_none(
+            v1.GROUP_VERSION, v1.KIND_DPU_OPERATOR_CONFIG, v.NAMESPACE,
+            v.DPU_OPERATOR_CONFIG_NAME,
+        )
+        is None
+    ), "config CR not released by finalizer"
+
+
+def test_dpu_reconciler_launches_and_cleans_vsp_pod(mgr_and_client):
+    mgr, client = mgr_and_client
+    dpu = v1.new_data_processing_unit("tpu-v5e-w0-dpu", "TPU v5e", True, "node-a")
+    dpu["metadata"]["labels"] = {"dpu.tpu.io/vendor": "tpu"}
+    client.create(dpu)
+    pod_name = "vsp-tpu-node-a"
+    assert wait_for(
+        lambda: client.get_or_none("v1", "Pod", v.NAMESPACE, pod_name) is not None
+    ), "VSP pod not created"
+    pod = client.get("v1", "Pod", v.NAMESPACE, pod_name)
+    assert pod["spec"]["nodeName"] == "node-a"
+    assert pod["spec"]["containers"][0]["image"] == "tpu_vsp-mock-image"
+
+    client.delete(v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
+                  "tpu-v5e-w0-dpu")
+    assert wait_for(
+        lambda: client.get_or_none("v1", "Pod", v.NAMESPACE, pod_name) is None
+    ), "VSP pod not cleaned up after DPU removal"
+
+
+def test_dpuconfig_propagates_num_endpoints(mgr_and_client):
+    mgr, client = mgr_and_client
+    dpu = v1.new_data_processing_unit("tpu-x-dpu", "TPU v5e", True, "node-a")
+    dpu["metadata"]["labels"] = {"dpu.tpu.io/vendor": "tpu"}
+    client.create(dpu)
+    client.create(
+        v1.new_data_processing_unit_config("tune", num_endpoints=16)
+    )
+    assert wait_for(
+        lambda: client.get(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, "tpu-x-dpu"
+        )["metadata"]
+        .get("annotations", {})
+        .get("config.tpu.io/num-endpoints")
+        == "16"
+    )
+
+
+def test_sfc_cluster_reconciler_sets_accepted(mgr_and_client):
+    mgr, client = mgr_and_client
+    sfc = v1.new_service_function_chain(
+        "chain-a", network_functions=[{"name": "fw", "image": "img"}]
+    )
+    client.create(sfc)
+    assert wait_for(
+        lambda: (
+            get_condition(
+                client.get(
+                    v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, v.NAMESPACE,
+                    "chain-a",
+                ),
+                "Accepted",
+            )
+            or {}
+        ).get("status")
+        == "True"
+    )
+
+
+# -- NRI ---------------------------------------------------------------------
+
+
+def _nad(name, resource=None, namespace=v.NAMESPACE):
+    obj = {
+        "apiVersion": "k8s.cni.cncf.io/v1",
+        "kind": "NetworkAttachmentDefinition",
+        "metadata": {"name": name, "namespace": namespace},
+    }
+    if resource:
+        obj["metadata"]["annotations"] = {"k8s.v1.cni.cncf.io/resourceName": resource}
+    return obj
+
+
+def test_nri_injects_resources_for_double_attachment():
+    client = InMemoryClient(InMemoryCluster())
+    client.create(_nad("dpunfcni-conf", v.DPU_RESOURCE_NAME))
+    injector = NetworkResourcesInjector(client)
+    pod = {
+        "metadata": {
+            "name": "nf-pod",
+            "namespace": "default",
+            "annotations": {
+                "k8s.v1.cni.cncf.io/networks": "dpunfcni-conf, dpunfcni-conf"
+            },
+        },
+        "spec": {"containers": [{"name": "nf", "resources": {}}]},
+    }
+    allowed, _, patch = injector.mutate({"object": pod, "namespace": "default"})
+    assert allowed and patch
+    values = {
+        (p["path"], p["value"]) for p in patch if "endpoint" in p["path"]
+    }
+    escaped = v.DPU_RESOURCE_NAME.replace("/", "~1")
+    assert (f"/spec/containers/0/resources/requests/{escaped}", "2") in values
+    assert (f"/spec/containers/0/resources/limits/{escaped}", "2") in values
+
+
+def test_nri_passes_through_unannotated_pods():
+    client = InMemoryClient(InMemoryCluster())
+    injector = NetworkResourcesInjector(client)
+    allowed, _, patch = injector.mutate(
+        {"object": {"metadata": {"name": "p"}, "spec": {"containers": [{}]}}}
+    )
+    assert allowed and patch is None
