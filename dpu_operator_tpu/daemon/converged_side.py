@@ -1,0 +1,73 @@
+"""ConvergedSideManager — both daemon roles on a single TPU-VM node.
+
+The reference's topology splits host CPU and DPU ARM cores into two
+nodes, each running one side manager. A TPU-VM has no second CPU
+complex: the chips hang off the same VM that runs the pods. The roles
+therefore converge — this manager runs the host-side CNI/fabric path
+AND serves the DPU-side OPI BridgePort/Heartbeat endpoint locally,
+preserving the exact wire contract (host half still talks gRPC to the
+OPI server the VSP's Init named) so 2-node deployments keep working
+unchanged. This is the TPU-first design decision SURVEY §7 calls the
+main risk — resolved by keeping both halves intact on one node."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+from typing import Optional
+
+import grpc
+
+from ..dpu_api import services
+from ..utils import PathManager
+from .dpu_side import _OpiService
+from .host_side import HostSideManager
+from .plugin import VendorPlugin
+
+log = logging.getLogger(__name__)
+
+
+class ConvergedSideManager(HostSideManager):
+    def __init__(
+        self,
+        vendor_plugin: VendorPlugin,
+        identifier: str,
+        path_manager: Optional[PathManager] = None,
+        **kwargs,
+    ):
+        super().__init__(vendor_plugin, identifier, path_manager, **kwargs)
+        self._opi_server: Optional[grpc.Server] = None
+        self._last_local_ping = 0.0
+
+    # Reuse the DPU side's OPI service shape: it needs .plugin and
+    # .record_ping, both of which this class provides.
+    def record_ping(self) -> None:
+        # The host half's pong tracking already covers freshness; this
+        # hook exists for the shared _OpiService.
+        pass
+
+    def start_vsp(self) -> None:
+        # The node IS the accelerator platform: init the VSP in DPU mode.
+        ip, port = self.plugin.start(dpu_mode=True, identifier=self.identifier)
+        self._opi_addr = (ip, port)
+        log.info("converged side: VSP initialised, OPI binds %s:%s", ip, port)
+
+    def listen(self) -> None:
+        ip, port = self._opi_addr  # type: ignore[misc]
+        self._opi_server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        )
+        svc = _OpiService(self)
+        services.add_bridge_port(svc, self._opi_server)
+        services.add_heartbeat(svc, self._opi_server)
+        bound = self._opi_server.add_insecure_port(f"{ip}:{port}")
+        if port != 0 and bound != port:
+            raise RuntimeError(f"OPI server could not bind {ip}:{port}")
+        self._opi_addr = (ip, bound)
+        self._opi_server.start()
+        super().listen()
+
+    def stop(self) -> None:
+        if self._opi_server is not None:
+            self._opi_server.stop(0.5)
+        super().stop()

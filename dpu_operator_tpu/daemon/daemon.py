@@ -151,7 +151,9 @@ class Daemon:
     # -- managed DPU lifecycle ----------------------------------------------
 
     def _default_factory(self, det: DetectedDpu, plugin: VendorPlugin) -> SideManager:
-        # reference createSideManager (daemon.go:249-263)
+        # reference createSideManager (daemon.go:249-263), plus the
+        # TPU-specific converged role: a TPU-VM is host and accelerator at
+        # once, so it runs both halves (converged_side.py).
         kwargs = dict(
             path_manager=self._pm,
             client=self._client,
@@ -159,6 +161,10 @@ class Daemon:
             node_name=det.node_name,
             register_device_plugin=self._register_dp,
         )
+        if det.is_dpu_side and det.vendor == "tpu":
+            from .converged_side import ConvergedSideManager
+
+            return ConvergedSideManager(plugin, det.identifier, **kwargs)
         if det.is_dpu_side:
             return DpuSideManager(plugin, det.identifier, **kwargs)
         return HostSideManager(plugin, det.identifier, **kwargs)

@@ -192,6 +192,10 @@ class HostSideManager:
 
 
 def _bridge_port_name(req: CniRequest) -> str:
-    """Structured port name the DPU-side VSP parses
-    (reference: "host<pf>-<vf>"; ours keys on the attachment identity)."""
-    return f"port-{req.container_id[:13]}-{req.ifname}"
+    """Port name the DPU-side VSP resolves to a node netdev. The reference
+    encodes PF/VF math in "host<pf>-<vf>" (marvell main.go:331-449); we
+    use the deterministic host-side veth name both sides can derive from
+    the attachment identity, so the VSP needs no extra lookup channel."""
+    from ..cni.dataplane.fabric import _host_ifname
+
+    return _host_ifname(req.container_id, req.ifname)

@@ -1,0 +1,9 @@
+"""parallel — TPU slice topology and device-mesh utilities.
+
+topology: pure-Python ICI slice model (used by the tpuvsp — no jax
+import). mesh/collectives: JAX device-mesh construction and the
+collective benchmark engine (lazy jax import)."""
+
+from .topology import Chip, SliceTopology
+
+__all__ = ["Chip", "SliceTopology"]

@@ -1,0 +1,71 @@
+"""Client for the native control-plane agent (native/cp-agent).
+
+The C++ agent is the TPU analogue of Marvell's octep_cp_agent (C, VFIO
+mailbox): a node-local process that owns chip-health/topology reading and
+answers heartbeats. Wire protocol: 4-byte big-endian length prefix +
+JSON, over a unix socket — the same local plugin-server pattern as
+octep_plugin_server.c."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict
+
+
+class CpAgentError(RuntimeError):
+    pass
+
+
+class CpAgentClient:
+    def __init__(self, socket_path: str, timeout: float = 2.0):
+        self._path = socket_path
+        self._timeout = timeout
+
+    def _call(self, request: dict) -> dict:
+        payload = json.dumps(request).encode()
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(self._timeout)
+            try:
+                s.connect(self._path)
+                s.sendall(struct.pack(">I", len(payload)) + payload)
+                header = self._recv_exact(s, 4)
+                (length,) = struct.unpack(">I", header)
+                if length > 1 << 20:
+                    raise CpAgentError(f"oversized response ({length} bytes)")
+                body = self._recv_exact(s, length)
+            except (OSError, struct.error) as e:
+                raise CpAgentError(f"cp-agent at {self._path}: {e}") from e
+        resp = json.loads(body)
+        if "error" in resp:
+            raise CpAgentError(resp["error"])
+        return resp
+
+    @staticmethod
+    def _recv_exact(s: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise CpAgentError("connection closed mid-frame")
+            buf += chunk
+        return buf
+
+    # -- API -----------------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self._call({"op": "ping"})
+
+    def healthy(self) -> bool:
+        return bool(self.ping().get("healthy"))
+
+    def topology(self) -> dict:
+        return self._call({"op": "topology"})
+
+    def chip_health(self) -> Dict[int, bool]:
+        resp = self._call({"op": "chip_health"})
+        return {int(k): bool(v) for k, v in resp.get("chips", {}).items()}
+
+    def stats(self) -> dict:
+        return self._call({"op": "stats"})

@@ -1,0 +1,128 @@
+"""TPU fabric dataplane — bridge + NF wiring for the tpuvsp.
+
+The role OVS plays for the Marvell/NetSec VSPs (marvell/ovs-dp/ovsdp.go,
+intel-netsec initOvSDataPlane): a node dataplane that pod interfaces are
+attached to, with an uplink toward the fabric. On a TPU-VM the uplink is
+the VM's fabric-facing netdev (gVNIC toward ICI-connected peers; env
+DPU_FABRIC_UPLINK); without hardware the DebugDataplane no-ops and
+records, exactly like Marvell's debug-dp (debug-dp/debugdp.go) — keeping
+the zero-hardware test tier first-class (SURVEY §7 hard part (a)).
+
+Linux-bridge based: no OVS dependency in the image. NF chaining uses
+hairpin mode + static fdb pinning of the chained MACs, the linux-bridge
+equivalent of the reference's OVS NF flow rules (marvell main.go:515-588)."""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+BRIDGE_NAME = "br-fabric"
+
+
+class DataplaneError(RuntimeError):
+    pass
+
+
+def _run(args: List[str]) -> str:
+    r = subprocess.run(args, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise DataplaneError(f"{' '.join(args)}: {r.stderr.strip()}")
+    return r.stdout
+
+
+class TpuFabricDataplane:
+    """Mutating dataplane over a real linux bridge."""
+
+    def __init__(self, bridge: str = BRIDGE_NAME, uplink: Optional[str] = None):
+        self.bridge = bridge
+        self.uplink = uplink
+        self.ports: Dict[str, str] = {}  # port name -> mac
+        self.nf_pairs: List[Tuple[str, str]] = []
+
+    def ensure_bridge(self) -> None:
+        try:
+            _run(["ip", "link", "show", "dev", self.bridge])
+        except DataplaneError:
+            _run(["ip", "link", "add", self.bridge, "type", "bridge"])
+        _run(["ip", "link", "set", "dev", self.bridge, "up"])
+        if self.uplink:
+            _run(["ip", "link", "set", "dev", self.uplink, "master", self.bridge])
+            _run(["ip", "link", "set", "dev", self.uplink, "up"])
+
+    def attach_port(self, netdev: str, mac: str) -> None:
+        _run(["ip", "link", "set", "dev", netdev, "master", self.bridge])
+        _run(["ip", "link", "set", "dev", netdev, "up"])
+        self.ports[netdev] = mac
+
+    def detach_port(self, netdev: str) -> None:
+        try:
+            _run(["ip", "link", "set", "dev", netdev, "nomaster"])
+        except DataplaneError as e:
+            log.debug("detach %s: %s", netdev, e)
+        self.ports.pop(netdev, None)
+
+    def wire_network_function(self, mac_in: str, mac_out: str) -> None:
+        """Chain two NF ports: hairpin on both (traffic may re-enter the
+        port it arrived on) + static fdb entries pinning the MACs."""
+        for mac in (mac_in, mac_out):
+            port = self._port_by_mac(mac)
+            if port is None:
+                continue
+            _run(["bridge", "link", "set", "dev", port, "hairpin", "on"])
+            _run(
+                ["bridge", "fdb", "replace", mac, "dev", port, "master", "static"]
+            )
+        self.nf_pairs.append((mac_in, mac_out))
+
+    def unwire_network_function(self, mac_in: str, mac_out: str) -> None:
+        for mac in (mac_in, mac_out):
+            port = self._port_by_mac(mac)
+            if port is None:
+                continue
+            try:
+                _run(["bridge", "fdb", "del", mac, "dev", port, "master"])
+                _run(["bridge", "link", "set", "dev", port, "hairpin", "off"])
+            except DataplaneError as e:
+                log.debug("unwire %s: %s", mac, e)
+        try:
+            self.nf_pairs.remove((mac_in, mac_out))
+        except ValueError:
+            pass
+
+    def _port_by_mac(self, mac: str) -> Optional[str]:
+        for port, m in self.ports.items():
+            if m.lower() == mac.lower():
+                return port
+        return None
+
+
+class DebugDataplane:
+    """Recording no-op dataplane (reference marvell/debug-dp/debugdp.go)."""
+
+    def __init__(self, bridge: str = BRIDGE_NAME, uplink: Optional[str] = None):
+        self.bridge = bridge
+        self.uplink = uplink
+        self.ports: Dict[str, str] = {}
+        self.nf_pairs: List[Tuple[str, str]] = []
+
+    def ensure_bridge(self) -> None:
+        log.info("debug-dp: ensure_bridge(%s)", self.bridge)
+
+    def attach_port(self, netdev: str, mac: str) -> None:
+        self.ports[netdev] = mac
+
+    def detach_port(self, netdev: str) -> None:
+        self.ports.pop(netdev, None)
+
+    def wire_network_function(self, mac_in: str, mac_out: str) -> None:
+        self.nf_pairs.append((mac_in, mac_out))
+
+    def unwire_network_function(self, mac_in: str, mac_out: str) -> None:
+        try:
+            self.nf_pairs.remove((mac_in, mac_out))
+        except ValueError:
+            pass

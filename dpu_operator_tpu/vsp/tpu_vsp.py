@@ -1,0 +1,208 @@
+"""tpuvsp — the Google TPU vendor-specific plugin.
+
+The centerpiece of this build (BASELINE.json north star): the VSP that
+makes TPU chips and ICI fabric endpoints first-class DPU-operator
+devices. Plays the role the Intel/Marvell VSPs play in the reference
+(SURVEY §2.4) with TPU semantics:
+
+  Init             fabric bridge bring-up (+ optional uplink enslave),
+                   slice topology discovery, returns the OPI bind addr
+                   (reference: marvell main.go:280-317 OVS+SDP bring-up)
+  GetDevices       ICI endpoint slices per local chip, each carrying the
+                   chip's coordinates and ICI link inventory
+  SetNumEndpoints  repartitions endpoints across local chips
+                   (reference SetNumVfs → VF creation)
+  CreateBridgePort attach the pod's host-side veth to the fabric bridge,
+                   resolved by deterministic port name
+                   (reference: OPI name → VF netdev math, main.go:331-449)
+  Create/DeleteNetworkFunction
+                   hairpin+fdb chain wiring (reference: OVS NF flows)
+  Ping             heartbeat, optionally proxied to the native cp-agent
+                   for real chip-health (octep_cp_agent heartbeat analogue)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from ..dpu_api import services
+from ..dpu_api.gen import bridge_port_pb2 as bp
+from ..dpu_api.gen import dpu_api_pb2 as pb
+from ..parallel.topology import SliceTopology
+
+log = logging.getLogger(__name__)
+
+DEFAULT_NUM_ENDPOINTS = 8
+DEFAULT_OPI_PORT = 50151
+
+
+class TpuVsp(
+    services.LifeCycleServicer,
+    services.NetworkFunctionServicer,
+    services.DeviceServicer,
+    services.HeartbeatServicer,
+    services.BridgePortServicer,
+):
+    def __init__(
+        self,
+        topology: Optional[SliceTopology] = None,
+        dataplane=None,
+        opi_ip: str = "127.0.0.1",
+        opi_port: Optional[int] = None,
+        cp_agent_client=None,
+        num_endpoints: int = DEFAULT_NUM_ENDPOINTS,
+    ):
+        self._topology = topology
+        self._dataplane = dataplane
+        self._opi = (opi_ip, opi_port or int(os.environ.get("DPU_OPI_PORT", DEFAULT_OPI_PORT)))
+        self._cp_agent = cp_agent_client
+        self._lock = threading.Lock()
+        self._num_endpoints = num_endpoints
+        self._initialized = False
+
+    # -- LifeCycle -----------------------------------------------------------
+
+    def Init(self, request, context):
+        with self._lock:
+            if self._topology is None:
+                self._topology = SliceTopology.from_env()
+                if not self._topology.chips:
+                    self._topology = SliceTopology.single_chip()
+            if self._dataplane is None:
+                from .tpu_dataplane import DebugDataplane, TpuFabricDataplane
+
+                uplink = os.environ.get("DPU_FABRIC_UPLINK")
+                if os.environ.get("DPU_DATAPLANE", "bridge") == "debug":
+                    self._dataplane = DebugDataplane(uplink=uplink)
+                else:
+                    self._dataplane = TpuFabricDataplane(uplink=uplink)
+            try:
+                self._dataplane.ensure_bridge()
+            except Exception as e:
+                log.warning("bridge bring-up failed (%s); debug dataplane", e)
+                from .tpu_dataplane import DebugDataplane
+
+                self._dataplane = DebugDataplane()
+                self._dataplane.ensure_bridge()
+            self._initialized = True
+        log.info(
+            "tpuvsp Init(id=%s): slice=%s chips=%d, OPI at %s:%d",
+            request.dpu_identifier,
+            self._topology.accelerator_type or "single",
+            self._topology.num_chips,
+            *self._opi,
+        )
+        return pb.IpPort(ip=self._opi[0], port=self._opi[1])
+
+    # -- Devices -------------------------------------------------------------
+
+    def GetDevices(self, request, context):
+        resp = pb.DeviceListResponse()
+        with self._lock:
+            topo = self._topology or SliceTopology.single_chip()
+            total = self._num_endpoints
+        local = topo.local_chips() or topo.chips
+        healthy = self._chip_health(len(local))
+        for i in range(total):
+            chip = local[i % len(local)]
+            dev_id = f"tpu{chip.index}-ep{i // len(local)}"
+            d = resp.devices[dev_id]
+            d.id = dev_id
+            d.health = pb.HEALTHY if healthy.get(chip.index, True) else pb.UNHEALTHY
+            d.backing = f"/dev/accel{chip.index}"
+            d.topology.coords = chip.coords_str
+            d.topology.numa_node = chip.numa_node
+            for n in topo.neighbors(chip):
+                d.topology.links.add(neighbor=n.coords_str, gbps=400)
+        return resp
+
+    def SetNumEndpoints(self, request, context):
+        with self._lock:
+            self._num_endpoints = request.count
+        log.info("tpuvsp: fabric partitioned into %d endpoints", request.count)
+        return pb.EndpointCount(count=request.count)
+
+    # -- Heartbeat -----------------------------------------------------------
+
+    def Ping(self, request, context):
+        healthy = True
+        if self._cp_agent is not None:
+            try:
+                healthy = self._cp_agent.healthy()
+            except Exception:
+                log.warning("cp-agent unreachable; reporting unhealthy")
+                healthy = False
+        return pb.PingResponse(healthy=healthy)
+
+    def _chip_health(self, n_local: int) -> Dict[int, bool]:
+        if self._cp_agent is None:
+            return {}
+        try:
+            return self._cp_agent.chip_health()
+        except Exception:
+            return {}
+
+    # -- BridgePort ----------------------------------------------------------
+
+    def CreateBridgePort(self, request, context):
+        name = request.bridge_port.name
+        mac = request.bridge_port.spec.mac_address
+        mac_str = ":".join(f"{b:02x}" for b in mac) if mac else ""
+        with self._lock:
+            dp = self._dataplane
+        if dp is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "tpuvsp not initialised")
+        try:
+            dp.attach_port(name, mac_str)
+        except Exception as e:
+            log.warning("attach_port(%s) failed: %s", name, e)
+            context.abort(grpc.StatusCode.INTERNAL, f"attach failed: {e}")
+        return bp.BridgePort(name=name)
+
+    def DeleteBridgePort(self, request, context):
+        with self._lock:
+            dp = self._dataplane
+        if dp is not None:
+            dp.detach_port(request.name)
+        return empty_pb2.Empty()
+
+    # -- NetworkFunction -----------------------------------------------------
+
+    def CreateNetworkFunction(self, request, context):
+        with self._lock:
+            dp = self._dataplane
+        if dp is not None:
+            dp.wire_network_function(request.input, request.output)
+        return empty_pb2.Empty()
+
+    def DeleteNetworkFunction(self, request, context):
+        with self._lock:
+            dp = self._dataplane
+        if dp is not None:
+            dp.unwire_network_function(request.input, request.output)
+        return empty_pb2.Empty()
+
+
+def main() -> None:  # container entrypoint (bindata/vsp/tpu/99.vsp-pod.yaml)
+    from .server import VspServer
+
+    logging.basicConfig(level=logging.INFO)
+    cp_agent = None
+    agent_sock = os.environ.get("DPU_CP_AGENT_SOCKET")
+    if agent_sock:
+        from .cp_agent_client import CpAgentClient
+
+        cp_agent = CpAgentClient(agent_sock)
+    server = VspServer(TpuVsp(cp_agent_client=cp_agent))
+    server.start()
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()

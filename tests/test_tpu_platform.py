@@ -1,0 +1,150 @@
+"""TPU platform tests: slice topology model, tpuvsp contract behavior,
+and the converged-node attach path with the real bridge dataplane."""
+
+import subprocess
+import uuid
+
+import pytest
+from google.protobuf import empty_pb2
+
+from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
+from dpu_operator_tpu.parallel.topology import SliceTopology
+from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
+from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+V5E8_ENV = {
+    "TPU_ACCELERATOR_TYPE": "v5litepod-8",
+    "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+    "TPU_WORKER_ID": "0",
+}
+
+
+class _Ctx:
+    """Minimal grpc context stand-in for direct servicer calls."""
+
+    def abort(self, code, details):
+        raise RuntimeError(f"{code}: {details}")
+
+    def is_active(self):
+        return True
+
+
+def test_topology_v5e8_grid_and_links():
+    topo = SliceTopology.from_env(V5E8_ENV)
+    assert topo.num_chips == 8
+    assert topo.grid == (2, 4, 1)
+    assert len(topo.local_chips()) == 4  # one host's chips
+    # Interior chip has neighbours along both active dims.
+    chip = topo.chips[0]
+    neigh = topo.neighbors(chip)
+    assert 2 <= len(neigh) <= 4
+    assert topo.bisection_gbps() > 0
+
+
+def test_topology_single_chip_fallback():
+    topo = SliceTopology.from_env({})
+    assert topo.num_chips >= 1
+    assert topo.grid[0] >= 1
+
+
+def test_tpuvsp_contract_init_devices_endpoints():
+    vsp = TpuVsp(
+        topology=SliceTopology.from_env(V5E8_ENV),
+        dataplane=DebugDataplane(),
+        opi_port=50199,
+    )
+    ctx = _Ctx()
+    ipport = vsp.Init(
+        pb.InitRequest(dpu_mode=pb.DPU_MODE_DPU, dpu_identifier="tpu-v5litepod-8-w0"),
+        ctx,
+    )
+    assert (ipport.ip, ipport.port) == ("127.0.0.1", 50199)
+
+    devices = vsp.GetDevices(empty_pb2.Empty(), ctx).devices
+    assert len(devices) == 8
+    sample = next(iter(devices.values()))
+    assert sample.topology.coords
+    assert sample.topology.links[0].gbps == 400
+    assert sample.backing.startswith("/dev/accel")
+
+    assert vsp.SetNumEndpoints(pb.EndpointCount(count=16), ctx).count == 16
+    assert len(vsp.GetDevices(empty_pb2.Empty(), ctx).devices) == 16
+
+
+def test_tpuvsp_nf_wiring_records():
+    dp = DebugDataplane()
+    vsp = TpuVsp(topology=SliceTopology.single_chip(), dataplane=dp)
+    ctx = _Ctx()
+    vsp.Init(pb.InitRequest(dpu_mode=pb.DPU_MODE_DPU, dpu_identifier="x"), ctx)
+    vsp.CreateNetworkFunction(pb.NFRequest(input="aa:bb", output="cc:dd"), ctx)
+    assert dp.nf_pairs == [("aa:bb", "cc:dd")]
+    vsp.DeleteNetworkFunction(pb.NFRequest(input="aa:bb", output="cc:dd"), ctx)
+    assert dp.nf_pairs == []
+
+
+def test_converged_tpu_node_full_attach(netns, tmp_root):
+    """The flagship single-node TPU-VM path: daemon-shaped converged
+    manager + real tpuvsp + real linux bridge. CNI ADD plumbs a veth into
+    a pod netns AND the veth host end lands on br-fabric via the local
+    OPI chain."""
+    import socket as pysock
+
+    from dpu_operator_tpu.cni import CniRequest, do_cni
+    from dpu_operator_tpu.daemon.converged_side import ConvergedSideManager
+    from dpu_operator_tpu.daemon.plugin import GrpcPlugin
+    from dpu_operator_tpu.vsp import VspServer
+    from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+    with pysock.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    bridge = "brtst" + uuid.uuid4().hex[:6]
+    dp = TpuFabricDataplane(bridge=bridge)
+    vsp = TpuVsp(
+        topology=SliceTopology.from_env(V5E8_ENV), dataplane=dp, opi_port=port
+    )
+    vsp_server = VspServer(vsp, tmp_root)
+    vsp_server.start()
+    mgr = ConvergedSideManager(
+        GrpcPlugin(tmp_root.vendor_plugin_socket()),
+        "tpu-v5litepod-8-w0",
+        path_manager=tmp_root,
+        register_device_plugin=False,
+    )
+    ns = "tstconv-" + uuid.uuid4().hex[:6]
+    subprocess.run(["ip", "netns", "add", ns], check=True)
+    try:
+        mgr.start_vsp()
+        mgr.setup_devices()
+        mgr.listen()
+        mgr.serve()
+
+        container_id = "conv" + uuid.uuid4().hex[:12]
+        req = CniRequest(
+            command="ADD", container_id=container_id, netns=ns, ifname="net1",
+            config={"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"},
+        )
+        result = do_cni(mgr.cni_server.socket_path, req)
+        assert result["ips"]
+
+        # Host veth end is enslaved to the fabric bridge.
+        from dpu_operator_tpu.cni.dataplane.fabric import _host_ifname
+
+        host_if = _host_ifname(container_id, "net1")
+        out = subprocess.run(
+            ["ip", "-d", "link", "show", "dev", host_if],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        assert bridge in out, f"{host_if} not enslaved to {bridge}: {out}"
+
+        do_cni(mgr.cni_server.socket_path, CniRequest(
+            command="DEL", container_id=container_id, netns=ns, ifname="net1",
+            config=req.config,
+        ))
+        assert dp.ports == {}
+    finally:
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+        mgr.stop()
+        vsp_server.stop()
