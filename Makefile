@@ -1,0 +1,25 @@
+# Build/test entry points (counterpart of the reference's Makefile +
+# taskfile.yaml task system).
+
+.PHONY: all native proto test fast-test bench clean
+
+all: native
+
+native:
+	cmake -S native -B native/build -G Ninja
+	cmake --build native/build
+
+proto:
+	./scripts/genproto.sh
+
+test: native
+	python -m pytest tests/ -q
+
+fast-test:
+	python -m pytest tests/ -q -x
+
+bench: native
+	python bench.py
+
+clean:
+	rm -rf native/build

@@ -1,0 +1,163 @@
+// dpu-cni — the native CNI shim binary installed into the CNI bin dir.
+//
+// Counterpart of the reference's Go shim (dpu-cni/dpu-cni.go +
+// pkgs/cni/cnishim.go:31-135): read the CNI_* environment and stdin
+// NetConf, POST the serialized request as HTTP/1.1 over the daemon's
+// unix socket, relay the daemon's JSON answer on stdout, exit 0/1 per
+// the CNI plugin convention. Kept dependency-free (raw sockets, no
+// libcurl) so the binary copies cleanly onto any host.
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+namespace {
+
+constexpr const char* kDefaultSocket =
+    "/var/run/dpu-daemon/dpu-cni/dpu-cni-server.sock";
+
+std::string env_or_empty(const char* name) {
+  const char* v = std::getenv(name);
+  return v ? std::string(v) : std::string();
+}
+
+std::string json_escape(const std::string& s) {
+  std::ostringstream o;
+  for (char c : s) {
+    switch (c) {
+      case '"': o << "\\\""; break;
+      case '\\': o << "\\\\"; break;
+      case '\n': o << "\\n"; break;
+      case '\r': o << "\\r"; break;
+      case '\t': o << "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          o << buf;
+        } else {
+          o << c;
+        }
+    }
+  }
+  return o.str();
+}
+
+// CNI_ARGS ("K=V;K2=V2") -> {"K":"V","K2":"V2"}
+std::string args_to_json(const std::string& cni_args) {
+  std::ostringstream o;
+  o << "{";
+  bool first = true;
+  std::istringstream in(cni_args);
+  std::string item;
+  while (std::getline(in, item, ';')) {
+    auto eq = item.find('=');
+    if (eq == std::string::npos) continue;
+    if (!first) o << ",";
+    first = false;
+    o << '"' << json_escape(item.substr(0, eq)) << "\":\""
+      << json_escape(item.substr(eq + 1)) << '"';
+  }
+  o << "}";
+  return o.str();
+}
+
+std::string build_request_json(const std::string& stdin_conf) {
+  std::ostringstream o;
+  o << "{"
+    << "\"command\":\"" << json_escape(env_or_empty("CNI_COMMAND")) << "\","
+    << "\"containerId\":\"" << json_escape(env_or_empty("CNI_CONTAINERID"))
+    << "\","
+    << "\"netns\":\"" << json_escape(env_or_empty("CNI_NETNS")) << "\","
+    << "\"ifname\":\"" << json_escape(env_or_empty("CNI_IFNAME")) << "\","
+    << "\"path\":\"" << json_escape(env_or_empty("CNI_PATH")) << "\","
+    << "\"args\":" << args_to_json(env_or_empty("CNI_ARGS")) << ","
+    << "\"config\":" << (stdin_conf.empty() ? "{}" : stdin_conf) << "}";
+  return o.str();
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Returns HTTP status, fills body. -1 on transport error.
+int http_post_unix(const std::string& socket_path, const std::string& body_in,
+                   std::string* body_out) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) {
+    close(fd);
+    return -1;
+  }
+  std::strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  std::ostringstream req;
+  req << "POST /cni HTTP/1.1\r\n"
+      << "Host: dpu-daemon\r\n"
+      << "Content-Type: application/json\r\n"
+      << "Content-Length: " << body_in.size() << "\r\n"
+      << "Connection: close\r\n\r\n"
+      << body_in;
+  if (!send_all(fd, req.str())) {
+    close(fd);
+    return -1;
+  }
+  std::string raw;
+  char buf[4096];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, n);
+  close(fd);
+
+  auto header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) return -1;
+  int status = -1;
+  if (sscanf(raw.c_str(), "HTTP/%*s %d", &status) != 1) return -1;
+  *body_out = raw.substr(header_end + 4);
+  // Tolerate chunked encoding from HTTP/1.1 servers: our server sends
+  // Content-Length, so the body is plain; strip trailing whitespace only.
+  while (!body_out->empty() && isspace(static_cast<unsigned char>(body_out->back()))) {
+    body_out->pop_back();
+  }
+  return status;
+}
+
+}  // namespace
+
+int main() {
+  std::string socket_path = env_or_empty("DPU_CNI_SOCKET");
+  if (socket_path.empty()) socket_path = kDefaultSocket;
+
+  std::string stdin_conf((std::istreambuf_iterator<char>(std::cin)),
+                         std::istreambuf_iterator<char>());
+
+  const std::string request = build_request_json(stdin_conf);
+  std::string body;
+  int status = http_post_unix(socket_path, request, &body);
+  if (status < 0) {
+    std::printf(
+        "{\"cniVersion\":\"1.0.0\",\"code\":11,"
+        "\"msg\":\"cannot reach CNI server at %s\"}",
+        json_escape(socket_path).c_str());
+    return 1;
+  }
+  std::fputs(body.empty() ? "{}" : body.c_str(), stdout);
+  return status == 200 ? 0 : 1;
+}

@@ -1,0 +1,104 @@
+// Minimal JSON emit + request-field extraction for the cp-agent protocol.
+//
+// The agent's wire format is framed JSON (4-byte BE length + payload);
+// requests are flat objects like {"op":"ping"}. We need full JSON *output*
+// but only single-string-field *input*, so this stays dependency-free
+// instead of vendoring a JSON library.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace cpagent {
+
+inline std::string json_escape(const std::string& s) {
+  std::ostringstream o;
+  for (char c : s) {
+    switch (c) {
+      case '"': o << "\\\""; break;
+      case '\\': o << "\\\\"; break;
+      case '\n': o << "\\n"; break;
+      case '\r': o << "\\r"; break;
+      case '\t': o << "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          o << buf;
+        } else {
+          o << c;
+        }
+    }
+  }
+  return o.str();
+}
+
+// Incremental JSON object writer: Json o; o.str("op","pong"); o.done();
+class Json {
+ public:
+  Json() { out_ << "{"; }
+
+  Json& raw(const std::string& key, const std::string& value) {
+    sep();
+    out_ << '"' << json_escape(key) << "\":" << value;
+    return *this;
+  }
+
+  Json& str(const std::string& key, const std::string& value) {
+    return raw(key, "\"" + json_escape(value) + "\"");
+  }
+
+  Json& num(const std::string& key, int64_t value) {
+    return raw(key, std::to_string(value));
+  }
+
+  Json& num(const std::string& key, double value) {
+    std::ostringstream v;
+    v << value;
+    return raw(key, v.str());
+  }
+
+  Json& boolean(const std::string& key, bool value) {
+    return raw(key, value ? "true" : "false");
+  }
+
+  std::string done() {
+    out_ << "}";
+    return out_.str();
+  }
+
+ private:
+  void sep() {
+    if (!first_) out_ << ",";
+    first_ = false;
+  }
+  std::ostringstream out_;
+  bool first_ = true;
+};
+
+// Extract a string field from a flat JSON object ({"op":"ping", ...}).
+// Tolerates whitespace; returns "" when absent. Sufficient for the
+// request side of the protocol, which the Python client controls.
+inline std::string extract_string_field(const std::string& json,
+                                        const std::string& field) {
+  const std::string needle = "\"" + field + "\"";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return "";
+  pos = json.find(':', pos + needle.size());
+  if (pos == std::string::npos) return "";
+  ++pos;
+  while (pos < json.size() && isspace(static_cast<unsigned char>(json[pos]))) ++pos;
+  if (pos >= json.size() || json[pos] != '"') return "";
+  ++pos;
+  std::string out;
+  while (pos < json.size() && json[pos] != '"') {
+    if (json[pos] == '\\' && pos + 1 < json.size()) ++pos;
+    out += json[pos++];
+  }
+  return out;
+}
+
+}  // namespace cpagent

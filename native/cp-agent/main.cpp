@@ -1,0 +1,152 @@
+// cp-agent — the native node control-plane agent for TPU DPUs.
+//
+// TPU-native counterpart of the reference's Marvell octep_cp_agent
+// (pcie_ep_octeon_target/apps/octep_cp_agent: mailbox poll loop,
+// heartbeat timer, PERST handling). On TPU there is no PCIe-EP mailbox;
+// the agent instead owns:
+//   * chip topology/health reading (device nodes + runtime env),
+//     re-probed on every request so a vanished /dev/accel* flips health
+//     (the PERST-event analogue: main.c:45-62 in the reference handles
+//     function-level resets; we surface device-node loss the same way)
+//   * heartbeat answering for the tpuvsp over a local framed-JSON socket
+//     (the octep_plugin_server.c pattern)
+//   * uptime/request statistics for observability
+//
+// Usage: cp-agent --socket /var/run/dpu-daemon/cp-agent/cp-agent.sock
+//                 [--root /] [--oneshot op]
+
+#include <getopt.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <time.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "json.hpp"
+#include "server.hpp"
+#include "topology.hpp"
+
+namespace {
+
+cpagent::Server* g_server = nullptr;
+std::atomic<uint64_t> g_requests{0};
+time_t g_start = 0;
+std::string g_root = "/";
+
+void handle_signal(int) {
+  if (g_server != nullptr) g_server->stop();
+}
+
+std::string chips_json(const cpagent::Topology& topo) {
+  std::string out = "{";
+  bool first = true;
+  for (const auto& chip : topo.chips) {
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + std::to_string(chip.index) + "\":";
+    out += (chip.present && chip.openable) ? "true" : "false";
+  }
+  out += "}";
+  return out;
+}
+
+std::string handle(const std::string& op, const std::string&) {
+  ++g_requests;
+  if (op == "ping") {
+    auto topo = cpagent::read_topology(g_root);
+    bool all_healthy = true;
+    for (const auto& chip : topo.chips) {
+      if (!chip.present || !chip.openable) all_healthy = false;
+    }
+    return cpagent::Json()
+        .boolean("healthy", all_healthy)
+        .num("uptime_s", static_cast<int64_t>(time(nullptr) - g_start))
+        .done();
+  }
+  if (op == "chip_health") {
+    auto topo = cpagent::read_topology(g_root);
+    return cpagent::Json().raw("chips", chips_json(topo)).done();
+  }
+  if (op == "topology") {
+    auto topo = cpagent::read_topology(g_root);
+    return cpagent::Json()
+        .str("acceleratorType", topo.accelerator_type)
+        .num("workerId", static_cast<int64_t>(topo.worker_id))
+        .str("chipsPerHostBounds", topo.chips_per_host_bounds)
+        .str("hostBounds", topo.host_bounds)
+        .num("numChips", static_cast<int64_t>(topo.chips.size()))
+        .raw("chips", chips_json(topo))
+        .done();
+  }
+  if (op == "stats") {
+    return cpagent::Json()
+        .num("requests", static_cast<int64_t>(g_requests.load()))
+        .num("uptime_s", static_cast<int64_t>(time(nullptr) - g_start))
+        .done();
+  }
+  return cpagent::Json().str("error", "unknown op: " + op).done();
+}
+
+void ensure_parent_dir(const std::string& path) {
+  auto slash = path.rfind('/');
+  if (slash == std::string::npos) return;
+  std::string dir = path.substr(0, slash);
+  std::string partial;
+  for (size_t i = 0; i < dir.size(); ++i) {
+    partial += dir[i];
+    if (dir[i] == '/' || i + 1 == dir.size()) {
+      if (partial != "/") mkdir(partial.c_str(), 0700);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = "/var/run/dpu-daemon/cp-agent/cp-agent.sock";
+  std::string oneshot;
+
+  static option long_opts[] = {
+      {"socket", required_argument, nullptr, 's'},
+      {"root", required_argument, nullptr, 'r'},
+      {"oneshot", required_argument, nullptr, 'o'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int c;
+  while ((c = getopt_long(argc, argv, "s:r:o:", long_opts, nullptr)) != -1) {
+    switch (c) {
+      case 's': socket_path = optarg; break;
+      case 'r': g_root = optarg; break;
+      case 'o': oneshot = optarg; break;
+      default:
+        fprintf(stderr,
+                "usage: %s [--socket PATH] [--root DIR] [--oneshot OP]\n",
+                argv[0]);
+        return 2;
+    }
+  }
+
+  g_start = time(nullptr);
+
+  if (!oneshot.empty()) {  // debug/CI mode: answer one op on stdout
+    printf("%s\n", handle(oneshot, "{}").c_str());
+    return 0;
+  }
+
+  ensure_parent_dir(socket_path);
+  cpagent::Server server(socket_path, handle);
+  g_server = &server;
+  signal(SIGTERM, handle_signal);
+  signal(SIGINT, handle_signal);
+  if (!server.start()) {
+    fprintf(stderr, "cp-agent: cannot listen on %s: %s\n", socket_path.c_str(),
+            strerror(errno));
+    return 1;
+  }
+  fprintf(stderr, "cp-agent: serving on %s\n", socket_path.c_str());
+  server.run();
+  return 0;
+}

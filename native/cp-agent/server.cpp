@@ -1,0 +1,116 @@
+#include "server.hpp"
+
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+
+namespace cpagent {
+
+namespace {
+
+bool recv_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+constexpr uint32_t kMaxFrame = 1 << 20;
+
+}  // namespace
+
+Server::Server(std::string socket_path, Handler handler)
+    : socket_path_(std::move(socket_path)), handler_(std::move(handler)) {}
+
+Server::~Server() { stop(); }
+
+bool Server::start() {
+  listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (socket_path_.size() >= sizeof(addr.sun_path)) {
+    errno = ENAMETOOLONG;
+    return false;
+  }
+  std::strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+  unlink(socket_path_.c_str());
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    return false;
+  }
+  chmod(socket_path_.c_str(), 0600);
+  return listen(listen_fd_, 16) == 0;
+}
+
+void Server::run() {
+  while (!stopping_) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping_ || errno == EBADF || errno == EINVAL) return;
+      continue;
+    }
+    std::thread(&Server::serve_connection, this, fd).detach();
+  }
+}
+
+void Server::serve_connection(int fd) {
+  while (!stopping_) {
+    uint32_t be_len = 0;
+    if (!recv_exact(fd, &be_len, sizeof(be_len))) break;
+    uint32_t len = ntohl(be_len);
+    if (len == 0 || len > kMaxFrame) break;
+    std::vector<char> body(len);
+    if (!recv_exact(fd, body.data(), len)) break;
+    std::string request(body.begin(), body.end());
+    std::string op = extract_string_field(request, "op");
+    std::string response;
+    if (op.empty()) {
+      response = Json().str("error", "missing op field").done();
+    } else {
+      response = handler_(op, request);
+    }
+    uint32_t out_len = htonl(static_cast<uint32_t>(response.size()));
+    if (!send_all(fd, &out_len, sizeof(out_len)) ||
+        !send_all(fd, response.data(), response.size())) {
+      break;
+    }
+  }
+  close(fd);
+}
+
+void Server::stop() {
+  stopping_ = true;
+  if (listen_fd_ >= 0) {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    listen_fd_ = -1;
+    unlink(socket_path_.c_str());
+  }
+}
+
+}  // namespace cpagent

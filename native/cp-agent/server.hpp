@@ -1,0 +1,38 @@
+// Framed-JSON unix-socket server for the control-plane agent.
+//
+// Protocol (shared with dpu_operator_tpu/vsp/cp_agent_client.py and the
+// same local plugin-server pattern as the reference's
+// octep_plugin_server.c): 4-byte big-endian length + JSON payload, one
+// request/response per frame, connection may carry multiple frames.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <string>
+
+namespace cpagent {
+
+using Handler = std::function<std::string(const std::string& op,
+                                          const std::string& request_json)>;
+
+class Server {
+ public:
+  Server(std::string socket_path, Handler handler);
+  ~Server();
+
+  // Bind + listen; returns false on failure (errno preserved).
+  bool start();
+  // Accept loop; returns when stop() is called.
+  void run();
+  void stop();
+
+ private:
+  void serve_connection(int fd);
+
+  std::string socket_path_;
+  Handler handler_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+};
+
+}  // namespace cpagent

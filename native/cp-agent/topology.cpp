@@ -1,0 +1,107 @@
+#include "topology.hpp"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+namespace cpagent {
+
+namespace {
+
+std::string getenv_str(const char* name) {
+  const char* v = std::getenv(name);
+  return v ? std::string(v) : std::string();
+}
+
+// List /dev entries matching prefix "accel" (accel0, accel1, ...) or the
+// contents of /dev/vfio (newer TPU runtimes).
+std::vector<std::string> accel_device_nodes(const std::string& root) {
+  std::vector<std::string> out;
+  std::string devdir = root + "/dev";
+  DIR* d = opendir(devdir.c_str());
+  if (d != nullptr) {
+    while (dirent* e = readdir(d)) {
+      if (std::strncmp(e->d_name, "accel", 5) == 0) {
+        out.push_back(devdir + "/" + e->d_name);
+      }
+    }
+    closedir(d);
+  }
+  std::string vfiodir = devdir + "/vfio";
+  d = opendir(vfiodir.c_str());
+  if (d != nullptr) {
+    while (dirent* e = readdir(d)) {
+      if (e->d_name[0] != '.' && std::strcmp(e->d_name, "vfio") != 0) {
+        out.push_back(vfiodir + "/" + e->d_name);
+      }
+    }
+    closedir(d);
+  }
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+bool probe_openable(const std::string& path) {
+  int fd = open(path.c_str(), O_RDONLY | O_NONBLOCK);
+  if (fd < 0) return false;
+  close(fd);
+  return true;
+}
+
+// Chip count implied by TPU_CHIPS_PER_HOST_BOUNDS ("2,2,1" -> 4).
+int env_chip_count(const std::string& bounds) {
+  if (bounds.empty()) return 0;
+  int product = 1, value = 0;
+  bool any = false;
+  for (char c : bounds + ",") {
+    if (c >= '0' && c <= '9') {
+      value = value * 10 + (c - '0');
+      any = true;
+    } else if (any) {
+      product *= value;
+      value = 0;
+      any = false;
+    }
+  }
+  return product;
+}
+
+}  // namespace
+
+Topology read_topology(const std::string& root) {
+  Topology t;
+  t.accelerator_type = getenv_str("TPU_ACCELERATOR_TYPE");
+  t.chips_per_host_bounds = getenv_str("TPU_CHIPS_PER_HOST_BOUNDS");
+  t.host_bounds = getenv_str("TPU_HOST_BOUNDS");
+  const std::string worker = getenv_str("TPU_WORKER_ID");
+  t.worker_id = worker.empty() ? 0 : std::atoi(worker.c_str());
+
+  auto nodes = accel_device_nodes(root);
+  int idx = 0;
+  for (const auto& path : nodes) {
+    ChipInfo c;
+    c.index = idx++;
+    c.dev_path = path;
+    c.present = true;
+    c.openable = probe_openable(path);
+    t.chips.push_back(c);
+  }
+  // Env declares more chips than device nodes (e.g. runtime owns them or
+  // test env): synthesize the remainder as env-declared, health unknown
+  // but presumed present — the VSP treats them as healthy-by-default.
+  int declared = env_chip_count(t.chips_per_host_bounds);
+  for (int i = idx; i < declared; ++i) {
+    ChipInfo c;
+    c.index = i;
+    c.present = true;
+    c.openable = true;
+    t.chips.push_back(c);
+  }
+  return t;
+}
+
+}  // namespace cpagent

@@ -1,0 +1,33 @@
+// TPU node topology/health reading for the control-plane agent.
+//
+// The role the OCTEON soc/vfio mailbox readers play in the reference's
+// octep_cp_lib (pcie_ep_octeon_target/libs/.../soc): discover the local
+// accelerator complement and report per-chip health. On a TPU-VM the
+// sources are the runtime env (TPU_*), accelerator device nodes
+// (/dev/accel*, /dev/vfio/*), and their sysfs entries.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace cpagent {
+
+struct ChipInfo {
+  int index = 0;
+  std::string dev_path;   // e.g. /dev/accel0 ("" if env-declared only)
+  bool present = false;   // device node exists
+  bool openable = false;  // open(O_RDONLY|O_NONBLOCK) succeeded
+};
+
+struct Topology {
+  std::string accelerator_type;  // $TPU_ACCELERATOR_TYPE
+  int worker_id = 0;
+  std::string chips_per_host_bounds;
+  std::string host_bounds;
+  std::vector<ChipInfo> chips;
+};
+
+// root: filesystem prefix for tests (agent --root), "/" in production.
+Topology read_topology(const std::string& root);
+
+}  // namespace cpagent

@@ -1,0 +1,158 @@
+"""Native-component tests: drive the REAL C++ binaries — cp-agent over
+its framed-JSON socket (via the Python client the tpuvsp uses) and the
+dpu-cni shim binary end-to-end against a live CNI server."""
+
+import json
+import os
+import subprocess
+import time
+import uuid
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+
+
+@pytest.fixture(scope="session")
+def native_binaries():
+    """Build native/ if binaries are missing (cached across runs)."""
+    cp_agent = os.path.join(BUILD, "cp-agent")
+    shim = os.path.join(BUILD, "dpu-cni")
+    if not (os.path.exists(cp_agent) and os.path.exists(shim)):
+        subprocess.run(
+            ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, "-G", "Ninja"],
+            check=True, capture_output=True,
+        )
+        subprocess.run(
+            ["cmake", "--build", BUILD], check=True, capture_output=True
+        )
+    return {"cp_agent": cp_agent, "shim": shim}
+
+
+@pytest.fixture
+def cp_agent(native_binaries, tmp_root):
+    sock = tmp_root.cp_agent_socket()
+    env = dict(
+        os.environ,
+        TPU_ACCELERATOR_TYPE="v5litepod-8",
+        TPU_CHIPS_PER_HOST_BOUNDS="2,2,1",
+        TPU_WORKER_ID="1",
+    )
+    proc = subprocess.Popen(
+        [native_binaries["cp_agent"], "--socket", sock, "--root", tmp_root.root],
+        env=env, stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + 5
+    while not os.path.exists(sock) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert os.path.exists(sock), "cp-agent socket never appeared"
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_cp_agent_ping_topology_health(cp_agent):
+    from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+    client = CpAgentClient(cp_agent)
+    pong = client.ping()
+    assert pong["healthy"] is True
+    assert "uptime_s" in pong
+
+    topo = client.topology()
+    assert topo["acceleratorType"] == "v5litepod-8"
+    assert topo["workerId"] == 1
+    # 4 chips declared by bounds env (no /dev/accel* under the temp root).
+    assert topo["numChips"] == 4
+
+    health = client.chip_health()
+    assert health == {0: True, 1: True, 2: True, 3: True}
+
+    stats = client.stats()
+    assert stats["requests"] >= 3
+
+
+def test_cp_agent_unknown_op(cp_agent):
+    from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient, CpAgentError
+
+    with pytest.raises(CpAgentError, match="unknown op"):
+        CpAgentClient(cp_agent)._call({"op": "explode"})
+
+
+def test_cp_agent_detects_unhealthy_chip(native_binaries, tmp_root):
+    """PERST-analogue: an unopenable device node flips chip health."""
+    os.makedirs(os.path.join(tmp_root.root, "dev"), exist_ok=True)
+    # accel0: a plain file (openable). accel1: dangling symlink (present in
+    # listing but unopenable → unhealthy).
+    open(os.path.join(tmp_root.root, "dev", "accel0"), "w").close()
+    os.symlink("/nonexistent", os.path.join(tmp_root.root, "dev", "accel1"))
+    out = subprocess.run(
+        [native_binaries["cp_agent"], "--root", tmp_root.root, "--oneshot", "chip_health"],
+        capture_output=True, text=True, env={"PATH": os.environ["PATH"]},
+    )
+    chips = json.loads(out.stdout)["chips"]
+    assert chips == {"0": True, "1": False}
+
+
+def test_cni_shim_binary_against_live_server(native_binaries, tmp_root, netns):
+    """The on-disk binary round-trips a real ADD: env + stdin → unix-socket
+    HTTP → CNI server → veth in a real netns → JSON result on stdout."""
+    from dpu_operator_tpu.cni import CniServer
+    from dpu_operator_tpu.cni.dataplane import FabricDataplane
+    from dpu_operator_tpu.cni.ipam import HostLocalIpam
+    from dpu_operator_tpu.cni.statestore import StateStore
+
+    store = StateStore(tmp_root.cni_state_dir())
+    ipam = HostLocalIpam(tmp_root.cni_state_dir(), "10.77.0.0/24")
+    dataplane = FabricDataplane(store, ipam)
+    server = CniServer(tmp_root)
+    server.set_handlers(
+        lambda req: dataplane.cmd_add(req).to_json(),
+        lambda req: dataplane.cmd_del(req)[0],
+    )
+    server.start()
+    ns = "tstshim-" + uuid.uuid4().hex[:6]
+    subprocess.run(["ip", "netns", "add", ns], check=True)
+    container_id = "shim" + uuid.uuid4().hex[:12]
+    try:
+        env = {
+            "PATH": os.environ["PATH"],
+            "DPU_CNI_SOCKET": server.socket_path,
+            "CNI_COMMAND": "ADD",
+            "CNI_CONTAINERID": container_id,
+            "CNI_NETNS": ns,
+            "CNI_IFNAME": "net1",
+            "CNI_PATH": "/opt/cni/bin",
+            "CNI_ARGS": "K8S_POD_NAME=testpod;K8S_POD_NAMESPACE=default",
+        }
+        conf = json.dumps(
+            {"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"}
+        )
+        r = subprocess.run(
+            [native_binaries["shim"]], input=conf, env=env,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        result = json.loads(r.stdout)
+        assert result["interfaces"][0]["name"] == "net1"
+        assert result["ips"][0]["address"].startswith("10.77.0.")
+
+        env["CNI_COMMAND"] = "DEL"
+        r = subprocess.run(
+            [native_binaries["shim"]], input=conf, env=env,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        # Error path: server down → code 11 JSON + exit 1.
+        server.stop()
+        r = subprocess.run(
+            [native_binaries["shim"]], input=conf, env=env,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 1
+        assert json.loads(r.stdout)["code"] == 11
+    finally:
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+        server.stop()
