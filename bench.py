@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Benchmark: pod-attach p50 latency through the full CNI control path.
+
+The headline metric from BASELINE.md: time from CNI ADD (the JSON POST the
+kubelet-invoked shim makes) to interface-plumbed-and-fabric-attached — the
+"forward pass" of this system (SURVEY.md §3.3). The measured path crosses
+every process boundary the reference crosses:
+
+    shim HTTP client → unix-socket CNI server → request parse/serialize
+    → host fabric dataplane (real veth+netns when run as root, recording
+    stand-in otherwise) → CreateBridgePort gRPC over TCP to the DPU-side
+    daemon → VSP bridge-port programming → response back through the stack
+
+then a CNI DEL tears it down so each sample is a full attach/detach cycle.
+
+vs_baseline: the reference publishes no latency numbers (BASELINE.md); the
+only per-request bound it encodes is the 2-minute CNI request budget
+matching the kubelet CRI timeout (reference dpu-cni/pkgs/cniserver/
+cniserver.go:208), within which it serializes all requests under a global
+mutex. vs_baseline = 120000 ms / p50 ms — how many times under the
+reference's per-request budget one full attach completes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dpu_operator_tpu.cni import CniRequest, do_cni  # noqa: E402
+from dpu_operator_tpu.cni.types import CniResult  # noqa: E402
+from dpu_operator_tpu.daemon import GrpcPlugin  # noqa: E402
+from dpu_operator_tpu.daemon.dpu_side import DpuSideManager  # noqa: E402
+from dpu_operator_tpu.daemon.host_side import HostSideManager  # noqa: E402
+from dpu_operator_tpu.utils import PathManager  # noqa: E402
+from dpu_operator_tpu.vsp import MockVsp, VspServer  # noqa: E402
+
+WARMUP = 20
+SAMPLES = 200
+REFERENCE_REQUEST_BUDGET_MS = 120_000.0  # kubelet CRI timeout, cniserver.go:208
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _can_use_netns() -> bool:
+    if os.geteuid() != 0:
+        return False
+    probe = "bp" + uuid.uuid4().hex[:8]
+    r = subprocess.run(
+        ["ip", "link", "add", probe + "a", "type", "veth", "peer", "name", probe + "b"],
+        capture_output=True,
+    )
+    if r.returncode != 0:
+        return False
+    subprocess.run(["ip", "link", "del", probe + "a"], capture_output=True)
+    return True
+
+
+class RecordingDataplane:
+    """Stand-in for the veth dataplane in unprivileged environments; keeps
+    every other boundary (HTTP shim protocol, unix-socket server, OPI gRPC
+    hop, VSP) real. Mirrors the reference's SriovManagerStub test seam
+    (internal/daemon/hostsidemanager_test.go:74-100)."""
+
+    def cmd_add(self, req: CniRequest) -> CniResult:
+        res = CniResult()
+        idx = res.add_interface(req.ifname, "02:00:00:00:00:01", req.netns)
+        res.add_ip("10.56.0.2/24", idx)
+        return res
+
+    def cmd_del(self, req: CniRequest):
+        return {}, True
+
+
+class Harness:
+    """Both daemon roles, separate socket roots, real gRPC boundaries."""
+
+    def __init__(self, host_root: str, dpu_root: str, real_dataplane: bool):
+        host_pm, dpu_pm = PathManager(root=host_root), PathManager(root=dpu_root)
+        port = _free_port()
+        self.dpu_vsp = MockVsp(opi_port=port)
+        self.dpu_vsp_server = VspServer(self.dpu_vsp, dpu_pm)
+        self.dpu_vsp_server.start()
+        self.host_vsp = MockVsp(opi_port=port)
+        self.host_vsp_server = VspServer(self.host_vsp, host_pm)
+        self.host_vsp_server.start()
+        self.dpu = DpuSideManager(
+            GrpcPlugin(dpu_pm.vendor_plugin_socket()),
+            "tpu-v5litepod-8-w0",
+            path_manager=dpu_pm,
+            register_device_plugin=False,
+        )
+        self.host = HostSideManager(
+            GrpcPlugin(host_pm.vendor_plugin_socket()),
+            "tpu-host-0",
+            path_manager=host_pm,
+            register_device_plugin=False,
+        )
+        if not real_dataplane:
+            self.host.dataplane = RecordingDataplane()
+
+    def start(self):
+        for side in (self.dpu, self.host):
+            side.start_vsp()
+            side.setup_devices()
+            side.listen()
+            side.serve()
+
+    def stop(self):
+        self.host.stop()
+        self.dpu.stop()
+        self.host_vsp_server.stop()
+        self.dpu_vsp_server.stop()
+
+
+def one_attach(sock: str, netns: str, i: int) -> float:
+    container_id = f"bench{i:06d}" + uuid.uuid4().hex[:8]
+    config = {"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"}
+    add = CniRequest(
+        command="ADD", container_id=container_id, netns=netns, ifname="net1",
+        config=config,
+    )
+    start = time.perf_counter()
+    do_cni(sock, add)
+    elapsed_ms = (time.perf_counter() - start) * 1000.0
+    do_cni(
+        sock,
+        CniRequest(
+            command="DEL", container_id=container_id, netns=netns, ifname="net1",
+            config=config,
+        ),
+    )
+    return elapsed_ms
+
+
+def main() -> int:
+    real = _can_use_netns()
+    netns = "/proc/self/ns/net"  # placeholder sandbox id for the stand-in
+    host_root = dpu_root = None
+    harness = None
+    try:
+        host_root = tempfile.mkdtemp(prefix="dpu-bh-")
+        dpu_root = tempfile.mkdtemp(prefix="dpu-bd-")
+        if real:
+            netns = "bench-" + uuid.uuid4().hex[:8]
+            subprocess.run(["ip", "netns", "add", netns], check=True)
+        harness = Harness(host_root, dpu_root, real_dataplane=real)
+        harness.start()
+        sock = harness.host.cni_server.socket_path
+        for i in range(WARMUP):
+            one_attach(sock, netns, i)
+        samples = [one_attach(sock, netns, WARMUP + i) for i in range(SAMPLES)]
+        p50 = statistics.median(samples)
+        p99 = sorted(samples)[int(len(samples) * 0.99) - 1]
+        print(
+            f"pod-attach over {SAMPLES} cycles ({'real veth/netns' if real else 'recording'}"
+            f" dataplane): p50={p50:.3f} ms p99={p99:.3f} ms",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "pod_attach_p50",
+                    "value": round(p50, 3),
+                    "unit": "ms",
+                    "vs_baseline": round(REFERENCE_REQUEST_BUDGET_MS / p50, 1),
+                }
+            )
+        )
+        return 0
+    finally:
+        if harness is not None:
+            harness.stop()
+        if real and netns.startswith("bench-"):
+            subprocess.run(["ip", "netns", "del", netns], capture_output=True)
+        for d in (host_root, dpu_root):
+            if d:
+                shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,4 +6,17 @@ collective benchmark engine (lazy jax import)."""
 
 from .topology import Chip, SliceTopology
 
-__all__ = ["Chip", "SliceTopology"]
+
+def build_mesh(*args, **kwargs):
+    from .mesh import build_mesh as f
+
+    return f(*args, **kwargs)
+
+
+def run_probe(*args, **kwargs):
+    from .fabric_probe import run_probe as f
+
+    return f(*args, **kwargs)
+
+
+__all__ = ["Chip", "SliceTopology", "build_mesh", "run_probe"]

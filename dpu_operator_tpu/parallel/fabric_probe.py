@@ -1,0 +1,172 @@
+"""Fabric-probe workloads — the compute the operator runs ON the TPUs.
+
+Two tiers, both jit-compiled:
+
+* `burn_step` — single-chip MXU health burn: a bf16 matmul chain sized
+  for the 128×128 systolic array. The tpuvsp runs this before marking a
+  chip HEALTHY in GetDevices (the TPU-native analogue of the OCTEON
+  agent's mailbox heartbeat proving the datapath is alive,
+  reference marvell/vendor/pcie_ep_octeon_target/apps/octep_cp_agent).
+
+* `probe_train_step` — the full multi-chip fabric validation step: a
+  probe model trained under `shard_map` over a (dp, sp, tp) mesh so that
+  every ICI dimension carries a distinct collective pattern:
+    - tp: column-parallel matmul with `psum` reduction (all-reduce),
+    - sp: ring `ppermute` accumulation over sequence blocks
+      (the ring-attention communication shape on the sp axis),
+    - dp: gradient `pmean` (data-parallel all-reduce).
+  A link that drops or corrupts traffic shows up as a non-finite or
+  drifting probe loss; the driver's multi-chip dry-run jits exactly this
+  step (see __graft_entry__.dryrun_multichip).
+
+Everything here is static-shaped, bf16 on the matmul path, f32 on the
+accumulators — MXU-friendly and fully fusible by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Probe-model dimensions. Per-shard block sizes are fixed; the global
+# batch/seq dims scale with the mesh (see probe_shapes) so any (dp, sp)
+# factoring divides evenly — a 6- or 64-chip slice probes as cleanly as 8.
+BLOCK_BATCH = 4
+BLOCK_SEQ = 8
+DIM = 128
+HIDDEN = 256
+BURN_DIM = 1024
+LR = 1e-2
+
+# One spec shared by device_put placement and shard_map in/out_specs —
+# these MUST agree or traffic silently reshards at the jit boundary.
+PARAM_SPEC = {"w1": P(None, "tp"), "w2": P("tp", None)}
+
+
+def probe_shapes(mesh) -> Tuple[int, int]:
+    """Global (batch, seq) for `mesh`: per-shard block × axis size."""
+    return (
+        BLOCK_BATCH * mesh.shape["dp"],
+        BLOCK_SEQ * mesh.shape["sp"],
+    )
+
+
+# -- single-chip burn ---------------------------------------------------------
+
+
+@jax.jit
+def burn_step(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Eight chained bf16 matmuls + nonlinearity; returns an f32 scalar
+    health signature (finite ⇔ datapath healthy)."""
+
+    def body(h, _):
+        h = jnp.tanh(h @ w).astype(jnp.bfloat16)
+        return h, ()
+
+    h, _ = jax.lax.scan(body, x.astype(jnp.bfloat16), None, length=8)
+    return jnp.sum(h.astype(jnp.float32) ** 2)
+
+
+def burn_example_args() -> Tuple[jax.Array, jax.Array]:
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, (BURN_DIM, BURN_DIM), dtype=jnp.bfloat16)
+    w = jax.random.normal(k2, (BURN_DIM, BURN_DIM), dtype=jnp.bfloat16) * 0.05
+    return x, w
+
+
+# -- multi-chip probe model ---------------------------------------------------
+
+
+def init_probe_params(key: jax.Array) -> dict:
+    k1, k2 = jax.random.split(key)
+    scale = 1.0 / jnp.sqrt(DIM)
+    return {
+        "w1": (jax.random.normal(k1, (DIM, HIDDEN)) * scale).astype(jnp.float32),
+        "w2": (jax.random.normal(k2, (HIDDEN, DIM)) * scale).astype(jnp.float32),
+    }
+
+
+def probe_shardings(mesh):
+    """Shardings for (params, batch): w1 column- and w2 row-sharded over
+    tp (Megatron split — one psum per layer pair), batch sharded over dp
+    on batch dim and sp on sequence dim."""
+    return (
+        {k: NamedSharding(mesh, s) for k, s in PARAM_SPEC.items()},
+        NamedSharding(mesh, P("dp", "sp", None)),
+    )
+
+
+def _probe_step_shardmapped(params, batch):
+    """Per-shard body. batch: [B/dp, S/sp, DIM] local block."""
+    sp_size = jax.lax.axis_size("sp")
+
+    def loss_fn(p):
+        h = jnp.einsum(
+            "bsd,dh->bsh",
+            batch.astype(jnp.bfloat16),
+            p["w1"].astype(jnp.bfloat16),
+        )
+        h = jax.nn.relu(h)
+        y = jnp.einsum("bsh,hd->bsd", h, p["w2"].astype(jnp.bfloat16))
+        y = jax.lax.psum(y.astype(jnp.float32), "tp")  # tp all-reduce
+
+        # Ring accumulation over the sp axis: every chip's sequence block
+        # visits every sp neighbour exactly once (ring-attention shape).
+        def ring_body(i, carry):
+            acc, blk = carry
+            acc = acc + jnp.mean(blk * y)
+            blk = jax.lax.ppermute(
+                blk, "sp", [(j, (j + 1) % sp_size) for j in range(sp_size)]
+            )
+            return acc, blk
+
+        ring_acc, _ = jax.lax.fori_loop(
+            0, sp_size, ring_body, (jnp.float32(0.0), batch)
+        )
+
+        recon = jnp.mean((y - batch) ** 2)
+        loss = recon + 0.0 * ring_acc  # ring term exercises links, not grads
+        return jax.lax.pmean(loss, ("dp", "sp"))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = jax.tree_util.tree_map(
+        lambda g: jax.lax.pmean(g, ("dp", "sp")), grads
+    )
+    new_params = jax.tree_util.tree_map(lambda p, g: p - LR * g, params, grads)
+    return new_params, loss
+
+
+def make_probe_train_step(mesh):
+    """The jitted full fabric-validation step over `mesh`."""
+    from jax.experimental.shard_map import shard_map
+
+    mapped = shard_map(
+        _probe_step_shardmapped,
+        mesh=mesh,
+        in_specs=(PARAM_SPEC, P("dp", "sp", None)),
+        out_specs=(PARAM_SPEC, P()),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+def probe_example_batch(key: jax.Array, mesh) -> jax.Array:
+    batch, seq = probe_shapes(mesh)
+    return jax.random.normal(key, (batch, seq, DIM), dtype=jnp.float32)
+
+
+def run_probe(mesh, steps: int = 1) -> float:
+    """Initialise, shard, and run `steps` probe-train steps on `mesh`;
+    returns the final loss (finite ⇔ all exercised links healthy)."""
+    param_sh, batch_sh = probe_shardings(mesh)
+    params = init_probe_params(jax.random.PRNGKey(1))
+    params = {k: jax.device_put(v, param_sh[k]) for k, v in params.items()}
+    batch = jax.device_put(probe_example_batch(jax.random.PRNGKey(2), mesh), batch_sh)
+    step = make_probe_train_step(mesh)
+    loss = None
+    for _ in range(steps):
+        params, loss = step(params, batch)
+    return float(loss)

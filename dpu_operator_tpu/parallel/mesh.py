@@ -1,0 +1,73 @@
+"""Device-mesh construction over the ICI slice topology.
+
+Maps a SliceTopology grid onto a `jax.sharding.Mesh` so fabric-probe
+workloads (fabric_probe.py) exercise real ICI dimensions: mesh axes
+correspond to grid dims, so a collective over an axis rides the physical
+links along that dim. This is the operator's analogue of the scaling-book
+recipe — pick a mesh, annotate shardings, let XLA insert collectives —
+applied to fabric *validation* rather than model training.
+
+The reference has no counterpart (its fabrics are OVS/P4/SDP, §2.5);
+this is the TPU-native replacement for the vendor dataplane's own
+health/bandwidth self-tests."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .topology import SliceTopology
+
+AXES = ("dp", "sp", "tp")  # data / sequence(ring) / tensor axes
+
+
+def axis_sizes(n_devices: int) -> Tuple[int, int, int]:
+    """Factor n devices onto (dp, sp, tp), preferring to populate tp then
+    sp so collectives exercise more than one dimension whenever possible
+    (8 → 2×2×2, 4 → 1×2×2, 2 → 1×1×2, 1 → 1×1×1)."""
+    tp = 2 if n_devices % 2 == 0 else 1
+    rest = n_devices // tp
+    sp = 2 if rest % 2 == 0 and rest >= 2 else 1
+    dp = rest // sp
+    assert dp * sp * tp == n_devices
+    return dp, sp, tp
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis_names: Sequence[str] = AXES,
+):
+    """An (dp, sp, tp) Mesh over the first n available devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    shape = axis_sizes(len(devices))
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def mesh_from_topology(topology: SliceTopology, devices: Optional[Sequence] = None):
+    """Mesh laid out so mesh coordinates track ICI grid coordinates.
+
+    TPU devices expose their physical chip coords (`device.coords`); when
+    present, devices are sorted into the topology's (z, y, x) raster order
+    before factoring, which keeps each mesh axis contiguous along a
+    physical grid dim so a collective over an axis rides one ICI
+    dimension. Devices without coords (CPU virtual platform) keep their
+    enumeration order — there is no physical fabric to align with."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if all(getattr(d, "coords", None) is not None for d in devices):
+        devices = sorted(devices, key=lambda d: tuple(reversed(d.coords)))
+    n = min(len(devices), topology.num_chips) or len(devices)
+    return build_mesh(n_devices=n, devices=devices)

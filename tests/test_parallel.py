@@ -1,0 +1,71 @@
+"""parallel package — mesh factoring, topology, and the driver contracts.
+
+The jax-running checks go through a subprocess with PYTHONPATH cleared:
+this environment pre-imports jax against the live TPU tunnel via a
+sitecustomize hook, so an in-process backend switch to the virtual
+8-device CPU platform is impossible (same reason the driver runs
+dryrun_multichip in its own process)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpu_operator_tpu.parallel.mesh import axis_sizes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_axis_sizes_factorings():
+    assert axis_sizes(1) == (1, 1, 1)
+    assert axis_sizes(2) == (1, 1, 2)
+    assert axis_sizes(4) == (1, 2, 2)
+    assert axis_sizes(8) == (2, 2, 2)
+    assert axis_sizes(3) == (3, 1, 1)
+    for n in (1, 2, 3, 4, 6, 8, 16):
+        dp, sp, tp = axis_sizes(n)
+        assert dp * sp * tp == n
+
+
+def _run_graft(n: int) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), str(n)],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+@pytest.mark.slow
+def test_graft_entry_multichip_8():
+    out = _run_graft(8)
+    assert "'dp': 2, 'sp': 2, 'tp': 2" in out
+    assert "probe loss" in out
+
+
+def test_bench_json_contract():
+    """bench.py's one-line stdout contract: metric/value/unit/vs_baseline
+    (driver parses this into BENCH_r{N}.json)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = r.stdout.strip().splitlines()[-1]
+    data = json.loads(line)
+    assert set(data) == {"metric", "value", "unit", "vs_baseline"}
+    assert data["metric"] == "pod_attach_p50"
+    assert data["value"] > 0
