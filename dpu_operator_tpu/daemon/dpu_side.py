@@ -92,6 +92,7 @@ class DpuSideManager:
         # netns → [mac...] pairing store (reference macStore, :145-180)
         self._mac_store: Dict[str, List[str]] = {}
         self._mac_lock = threading.Lock()
+        self._ctrl_manager = None
 
     # -- SideManager interface ----------------------------------------------
 
@@ -130,6 +131,15 @@ class DpuSideManager:
                 self.device_plugin.register_with_kubelet()
             except Exception:
                 log.exception("kubelet registration failed; device plugin unserved")
+        if self._client is not None and self._node_name:
+            # Per-node controller manager with the SFC reconciler, same as
+            # the reference's in-daemon manager (dpusidemanager.go:300-330).
+            from ..k8s import Manager
+            from .sfc import setup_sfc_controller
+
+            self._ctrl_manager = Manager(self._client)
+            setup_sfc_controller(self._ctrl_manager, self._client, self._node_name)
+            self._ctrl_manager.start()
 
     def check_ping(self) -> bool:
         with self._ping_lock:
@@ -140,6 +150,8 @@ class DpuSideManager:
             self._last_ping = time.monotonic()
 
     def stop(self) -> None:
+        if self._ctrl_manager is not None:
+            self._ctrl_manager.stop()
         if self._opi_server is not None:
             self._opi_server.stop(0.5)
         self.cni_server.stop()

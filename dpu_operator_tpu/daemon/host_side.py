@@ -70,6 +70,7 @@ class HostSideManager:
         self._ping_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads = []
+        self._ctrl_manager = None
 
     # -- SideManager interface ----------------------------------------------
 
@@ -91,6 +92,15 @@ class HostSideManager:
                 self.device_plugin.register_with_kubelet()
             except Exception:
                 log.exception("kubelet registration failed; device plugin unserved")
+        if self._client is not None and self._node_name:
+            # Per-node controller manager with the SFC reconciler — the host
+            # side runs it too (reference hostsidemanager.go:334-410).
+            from ..k8s import Manager
+            from .sfc import setup_sfc_controller
+
+            self._ctrl_manager = Manager(self._client)
+            setup_sfc_controller(self._ctrl_manager, self._client, self._node_name)
+            self._ctrl_manager.start()
         t = threading.Thread(target=self._ping_loop, daemon=True, name="host-ping")
         t.start()
         self._threads.append(t)
@@ -101,6 +111,8 @@ class HostSideManager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._ctrl_manager is not None:
+            self._ctrl_manager.stop()
         self.cni_server.stop()
         self.device_plugin.stop()
         if self._opi_channel is not None:
