@@ -101,6 +101,10 @@ class Daemon:
         self._thread = threading.Thread(target=self.serve, daemon=True, name="daemon")
         self._thread.start()
 
+    def managed(self) -> dict:
+        """Identifier → ManagedDpu for the currently managed devices."""
+        return dict(self._managed)
+
     def serve(self) -> None:
         while not self._stop.is_set():
             try:

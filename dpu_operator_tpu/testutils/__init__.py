@@ -1,0 +1,251 @@
+"""Test utilities — the counterpart of reference internal/testutils/.
+
+The reference gets a real kubelet by bind-mounting a host dir as a Kind
+node's /var/lib/kubelet so the device plugin can register with it
+(internal/testutils/kindcluster.go:162-214). There is no kubelet in this
+environment, so KubeletSim implements the kubelet half of the device
+plugin contract in-process: the v1beta1 Registration service on
+kubelet.sock, a ListAndWatch consumer per registered plugin, node
+allocatable/capacity updates, and a minimal scheduler that binds pending
+pods against extended-resource capacity and calls Allocate — enough to
+run the reference's e2e scheduling scenarios (e2e_test.go:558-626)
+without a cluster."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+import grpc
+
+from ..dpu_api import services
+from ..dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+from ..k8s import Client
+from ..utils import PathManager
+
+log = logging.getLogger(__name__)
+
+
+class _Registration(services.KubeletRegistrationServicer):
+    def __init__(self, sim: "KubeletSim"):
+        self._sim = sim
+
+    def Register(self, request, context):
+        self._sim._on_register(request.resource_name, request.endpoint)
+        return kdp.Empty()
+
+
+class KubeletSim:
+    """One simulated kubelet == one node."""
+
+    def __init__(self, client: Client, node_name: str, path_manager: PathManager):
+        self._client = client
+        self.node_name = node_name
+        self._pm = path_manager
+        self._server: Optional[grpc.Server] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # resource name → plugin stub / healthy device ids / allocations
+        self._stubs: Dict[str, services.DevicePluginStub] = {}
+        self._channels: List[grpc.Channel] = []
+        self._devices: Dict[str, Set[str]] = {}
+        self._allocated: Dict[str, Dict[str, List[str]]] = {}  # res → pod → devs
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        sock = self._pm.kubelet_registry_socket()
+        self._pm.ensure_socket_dir(sock)
+        self._pm.remove_stale_socket(sock)
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        )
+        services.add_kubelet_registration(_Registration(self), self._server)
+        self._server.add_insecure_port(f"unix://{sock}")
+        self._server.start()
+        t = threading.Thread(target=self._scheduler_loop, daemon=True,
+                             name=f"kubelet-sim-{self.node_name}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(0.5)
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+    # -- device plugin side --------------------------------------------------
+
+    def _on_register(self, resource_name: str, endpoint: str) -> None:
+        """Dial back the plugin's socket and start consuming ListAndWatch
+        (what the kubelet does after Register)."""
+        sock = os.path.join(self._pm.kubelet_plugin_dir(), endpoint)
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        stub = services.DevicePluginStub(channel)
+        with self._lock:
+            self._stubs[resource_name] = stub
+            self._channels.append(channel)
+            self._allocated.setdefault(resource_name, {})
+        t = threading.Thread(
+            target=self._watch_devices, args=(resource_name, stub), daemon=True,
+            name=f"kubelet-sim-law-{resource_name}",
+        )
+        t.start()
+        self._threads.append(t)
+        log.info("kubelet-sim: plugin %s registered via %s", resource_name, endpoint)
+
+    def _watch_devices(self, resource_name: str, stub) -> None:
+        try:
+            for resp in stub.ListAndWatch(kdp.Empty()):
+                healthy = {d.ID for d in resp.devices if d.health == "Healthy"}
+                with self._lock:
+                    self._devices[resource_name] = healthy
+                self._patch_node_status(resource_name, len(healthy))
+                if self._stop.is_set():
+                    return
+        except grpc.RpcError:
+            if not self._stop.is_set():
+                log.warning("kubelet-sim: ListAndWatch(%s) stream broke", resource_name)
+
+    def _patch_node_status(self, resource_name: str, count: int) -> None:
+        node = self._client.get_or_none("v1", "Node", None, self.node_name)
+        if node is None:
+            return
+        status = node.setdefault("status", {})
+        for key in ("capacity", "allocatable"):
+            status.setdefault(key, {})[resource_name] = str(count)
+        self._client.update_status(node)
+
+    # -- scheduler + allocation ----------------------------------------------
+
+    def allocatable(self, resource_name: str) -> int:
+        with self._lock:
+            total = len(self._devices.get(resource_name, ()))
+            used = sum(
+                len(devs) for devs in self._allocated.get(resource_name, {}).values()
+            )
+        return total - used
+
+    def _scheduler_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._schedule_once()
+            except Exception:
+                log.exception("kubelet-sim scheduler failed")
+            self._stop.wait(0.05)
+
+    def _schedule_once(self) -> None:
+        pods = self._client.list("v1", "Pod", None)
+        live = {
+            (p["metadata"].get("namespace"), p["metadata"]["name"]) for p in pods
+        }
+        self._release_gone_pods(live)
+        for pod in pods:
+            phase = pod.get("status", {}).get("phase")
+            if phase in ("Running", "Succeeded", "Failed"):
+                continue
+            if not self._node_matches(pod):
+                continue
+            self._try_bind(pod)
+
+    def _node_matches(self, pod: dict) -> bool:
+        sel = pod.get("spec", {}).get("nodeSelector") or {}
+        pinned = pod.get("spec", {}).get("nodeName")
+        if pinned and pinned != self.node_name:
+            return False
+        if not sel:
+            return True
+        node = self._client.get_or_none("v1", "Node", None, self.node_name)
+        labels = (node or {}).get("metadata", {}).get("labels", {}) or {}
+        return all(labels.get(k) == val for k, val in sel.items())
+
+    def _extended_requests(self, pod: dict) -> Dict[str, int]:
+        wants: Dict[str, int] = {}
+        for ctr in pod.get("spec", {}).get("containers", []):
+            reqs = ctr.get("resources", {}).get("requests", {}) or {}
+            for res, qty in reqs.items():
+                if res in self._stubs:
+                    wants[res] = wants.get(res, 0) + int(qty)
+        return wants
+
+    def _try_bind(self, pod: dict) -> None:
+        key = f'{pod["metadata"].get("namespace")}/{pod["metadata"]["name"]}'
+        wants = self._extended_requests(pod)
+        picked: Dict[str, List[str]] = {}
+        with self._lock:
+            for res, count in wants.items():
+                free = [
+                    d
+                    for d in sorted(self._devices.get(res, ()))
+                    if not any(
+                        d in devs for devs in self._allocated[res].values()
+                    )
+                ]
+                if len(free) < count:
+                    self._set_phase(pod, "Pending", f"insufficient {res}")
+                    return
+                picked[res] = free[:count]
+            for res, devs in picked.items():
+                self._allocated[res][key] = devs
+        try:
+            for res, devs in picked.items():
+                self._stubs[res].Allocate(
+                    kdp.AllocateRequest(
+                        container_requests=[
+                            kdp.ContainerAllocateRequest(devices_ids=devs)
+                        ]
+                    ),
+                    timeout=5.0,
+                )
+        except grpc.RpcError as e:
+            with self._lock:
+                for res in picked:
+                    self._allocated[res].pop(key, None)
+            self._set_phase(pod, "Pending", f"Allocate failed: {e.code()}")
+            return
+        pod["spec"]["nodeName"] = self.node_name
+        if picked:
+            pod["metadata"].setdefault("annotations", {})["dpu.test/allocated"] = (
+                ",".join(d for devs in picked.values() for d in devs)
+            )
+        pod = self._client.update(pod)
+        self._set_phase(pod, "Running", "")
+
+    def _set_phase(self, pod: dict, phase: str, message: str) -> None:
+        from ..k8s.store import Conflict, NotFound
+
+        for _ in range(3):
+            cur = pod.get("status", {})
+            if cur.get("phase") == phase and cur.get("message", "") == message:
+                return
+            pod.setdefault("status", {})["phase"] = phase
+            pod["status"]["message"] = message
+            try:
+                self._client.update_status(pod)
+                return
+            except Conflict:
+                try:
+                    pod = self._client.get(
+                        "v1", "Pod", pod["metadata"].get("namespace"),
+                        pod["metadata"]["name"],
+                    )
+                except NotFound:
+                    return
+            except NotFound:
+                return
+
+    def _release_gone_pods(self, live: set) -> None:
+        with self._lock:
+            for res, allocs in self._allocated.items():
+                for key in list(allocs):
+                    ns, _, name = key.partition("/")
+                    if (ns or None, name) not in live:
+                        del allocs[key]

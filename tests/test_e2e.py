@@ -1,0 +1,419 @@
+"""End-to-end suite — the counterpart of reference e2e_test/e2e_test.go,
+run against the zero-hardware tier: in-memory apiserver, real operator
+reconcilers, real daemon with TPU FakePlatform detection, the REAL tpuvsp
+served over the vendor-plugin gRPC socket, KubeletSim standing in for the
+kubelet (registration + ListAndWatch + scheduling + Allocate), and —
+when root — real veth/netns pod interfaces bridged by the TPU fabric
+dataplane, verified with an actual ping (e2e_test.go:439-456).
+
+Covered, in the reference's order: webhook singleton validation
+(:229-359), workload pod with secondary net reaching Running (:432-438),
+pod↔pod ping over net1 (:439-456), SFC pod creation with image+resource
+assertions (:458-478), SFC deletion (:547-555), and resource-exhaustion
+scheduling (N+1 chains vs capacity, pending pod unblocking, :558-626)."""
+
+import json
+import socket
+import subprocess
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.api import v1
+from dpu_operator_tpu.api.webhook import (
+    AdmissionWebhook,
+    validate_dpu_operator_config,
+)
+from dpu_operator_tpu.cni import CniRequest, do_cni
+from dpu_operator_tpu.controller.main import build_manager
+from dpu_operator_tpu.daemon import Daemon
+from dpu_operator_tpu.images import DummyImageManager
+from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster, get_condition
+from dpu_operator_tpu.parallel import SliceTopology
+from dpu_operator_tpu.platform import FakePlatform
+from dpu_operator_tpu.testutils import KubeletSim
+from dpu_operator_tpu.vsp import VspServer
+from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+NODE = "tpu-e2e-node"
+TPU_ENV = {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0"}
+NUM_ENDPOINTS = 8  # the daemon partitions the fabric into 8 (reference SetNumVfs(8))
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _have_netns() -> bool:
+    import os
+
+    if os.geteuid() != 0:
+        return False
+    probe = "e2e" + uuid.uuid4().hex[:6]
+    r = subprocess.run(
+        ["ip", "link", "add", probe + "a", "type", "veth", "peer", "name", probe + "b"],
+        capture_output=True,
+    )
+    if r.returncode == 0:
+        subprocess.run(["ip", "link", "del", probe + "a"], capture_output=True)
+        return True
+    return False
+
+
+HAVE_NETNS = _have_netns()
+
+
+class Stack:
+    """The whole system in one process."""
+
+    def __init__(self, pm):
+        self.pm = pm
+        self.client = InMemoryClient(InMemoryCluster())
+        self.client.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": NODE, "labels": {v.NODE_OPT_IN_LABEL: v.NODE_OPT_IN_VALUE}},
+            }
+        )
+        # Operator control plane.
+        self.operator = build_manager(self.client, DummyImageManager())
+        self.operator.start()
+        self.client.create(v1.new_dpu_operator_config())
+
+        # Real tpuvsp on the vendor socket; unique bridge per run.
+        self.bridge = None
+        topology = SliceTopology.from_env(TPU_ENV)
+        if HAVE_NETNS:
+            from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+            self.bridge = "brE2E" + uuid.uuid4().hex[:6]
+            dataplane = TpuFabricDataplane(bridge=self.bridge)
+        else:
+            from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
+
+            dataplane = DebugDataplane()
+        self.vsp = TpuVsp(
+            topology=topology,
+            dataplane=dataplane,
+            opi_port=free_port(),
+            num_endpoints=NUM_ENDPOINTS,
+        )
+        self.vsp_server = VspServer(self.vsp, pm)
+        self.vsp_server.start()
+
+        # Kubelet simulator for this node.
+        self.kubelet = KubeletSim(self.client, NODE, pm)
+        self.kubelet.start()
+
+        # Node daemon with TPU platform detection.
+        self.daemon = Daemon(
+            self.client,
+            FakePlatform(product="Google Cloud TPU", node=NODE, env=TPU_ENV),
+            path_manager=pm,
+            tick_interval=0.05,
+            register_device_plugin=True,
+        )
+        self.daemon.start()
+
+    def side_manager(self):
+        for md in self.daemon.managed().values():
+            return md.manager
+        return None
+
+    def stop(self):
+        self.daemon.stop()
+        self.kubelet.stop()
+        self.vsp_server.stop()
+        self.operator.stop()
+        if self.bridge:
+            subprocess.run(["ip", "link", "del", self.bridge], capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import shutil
+    import tempfile
+
+    from dpu_operator_tpu.utils import PathManager
+
+    d = tempfile.mkdtemp(prefix="dpu-")
+    s = Stack(PathManager(root=d))
+    try:
+        assert wait_for(lambda: s.side_manager() is not None), "daemon never spawned a side manager"
+        yield s
+    finally:
+        s.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# -- 1. webhook validation (reference e2e_test.go:229-359) --------------------
+
+
+def test_webhook_rejects_wrong_singleton_name(stack):
+    ok, msg, _ = validate_dpu_operator_config(
+        {"object": v1.new_dpu_operator_config(name="not-the-singleton")}
+    )
+    assert not ok and "dpu-operator-config" in msg
+
+    # And over HTTP, the way the apiserver calls it.
+    wh = AdmissionWebhook()
+    wh.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
+    wh.start()
+    try:
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "e2e-uid",
+                "object": v1.new_dpu_operator_config(name="bad-name"),
+            },
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wh.port}/validate-dpuoperatorconfig",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req).read())
+        assert resp["response"]["allowed"] is False
+    finally:
+        wh.stop()
+
+
+# -- 2. operand rollout + device inventory ------------------------------------
+
+
+def test_daemonset_rendered_and_dpu_cr_ready(stack):
+    assert wait_for(
+        lambda: stack.client.get_or_none("apps/v1", "DaemonSet", v.NAMESPACE, "dpu-daemon")
+        is not None
+    ), "operator never rendered the daemon DaemonSet"
+    cr_name = "tpu-v5litepod-8-w0-dpu"
+    def ready():
+        cr = stack.client.get_or_none(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, cr_name
+        )
+        if cr is None:
+            return False
+        cond = get_condition(cr, "Ready")
+        return cond is not None and cond["status"] == "True"
+    assert wait_for(ready, timeout=30), "DataProcessingUnit CR never went Ready"
+
+
+def test_node_reports_allocatable_endpoints(stack):
+    """Device plugin registered with the (simulated) kubelet and the node
+    shows allocatable fabric endpoints (reference
+    dpusidemanager_test.go:22-49 waitAllNodesDpuAllocatable)."""
+    def allocatable():
+        node = stack.client.get("v1", "Node", None, NODE)
+        return int(node.get("status", {}).get("allocatable", {}).get(v.DPU_RESOURCE_NAME, "0"))
+    assert wait_for(lambda: allocatable() == NUM_ENDPOINTS, timeout=30), (
+        f"allocatable={allocatable()}, want {NUM_ENDPOINTS}"
+    )
+
+
+# -- 3. workload pod with secondary network (reference :432-456) --------------
+
+
+def _workload_pod(name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "annotations": {"k8s.v1.cni.cncf.io/networks": v.DEFAULT_HOST_NAD_NAME},
+        },
+        "spec": {
+            "nodeSelector": {v.NODE_OPT_IN_LABEL: v.NODE_OPT_IN_VALUE},
+            "containers": [
+                {
+                    "name": name,
+                    "image": "quay.io/example/workload:1",
+                    "resources": {
+                        "requests": {v.DPU_RESOURCE_NAME: "1"},
+                        "limits": {v.DPU_RESOURCE_NAME: "1"},
+                    },
+                }
+            ],
+        },
+    }
+
+
+def test_workload_pod_reaches_running(stack):
+    stack.client.create(_workload_pod("workload-a"))
+    assert wait_for(
+        lambda: (stack.client.get_or_none("v1", "Pod", "default", "workload-a") or {})
+        .get("status", {})
+        .get("phase")
+        == "Running",
+        timeout=30,
+    ), "workload pod never reached Running"
+    pod = stack.client.get("v1", "Pod", "default", "workload-a")
+    assert pod["metadata"]["annotations"].get("dpu.test/allocated"), "no device allocated"
+    stack.client.delete("v1", "Pod", "default", "workload-a")
+
+
+@pytest.mark.skipif(not HAVE_NETNS, reason="needs root + netns/veth")
+def test_pod_to_pod_ping_over_net1(stack):
+    """Two pod netns, both attached through the CNI path, REAL ping over
+    the fabric bridge (reference pingTest, e2e_test.go:439-456)."""
+    sm = stack.side_manager()
+    sock = sm.cni_server.socket_path
+    conf = {"cniVersion": "1.0.0", "name": v.DEFAULT_HOST_NAD_NAME, "type": "dpu-cni"}
+    namespaces, ips, reqs = [], [], []
+    try:
+        for i in range(2):
+            ns = f"e2epod{i}-" + uuid.uuid4().hex[:6]
+            subprocess.run(["ip", "netns", "add", ns], check=True)
+            namespaces.append(ns)
+            req = CniRequest(
+                command="ADD",
+                container_id=f"e2ec{i}" + uuid.uuid4().hex[:10],
+                netns=ns,
+                ifname="net1",
+                config=conf,
+            )
+            reqs.append(req)
+            result = do_cni(sock, req)
+            ips.append(result["ips"][0]["address"].split("/")[0])
+        # No ping binary in this image; a TCP round-trip across the two
+        # pod netns proves the same L3 path through the fabric bridge.
+        import sys
+
+        server = subprocess.Popen(
+            [
+                "ip", "netns", "exec", namespaces[1], sys.executable, "-c",
+                "import socket\n"
+                "s = socket.socket()\n"
+                f"s.bind(('{ips[1]}', 9000))\n"
+                "s.listen(1)\n"
+                "c, _ = s.accept()\n"
+                "print(c.recv(16).decode(), flush=True)\n",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            time.sleep(0.5)
+            r = subprocess.run(
+                [
+                    "ip", "netns", "exec", namespaces[0], sys.executable, "-c",
+                    "import socket\n"
+                    f"s = socket.create_connection(('{ips[1]}', 9000), timeout=5)\n"
+                    "s.send(b'e2e-traffic')\n"
+                    "s.close()\n",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=10,
+            )
+            assert r.returncode == 0, f"TCP connect failed:\n{r.stdout}\n{r.stderr}"
+            out, err = server.communicate(timeout=10)
+            assert "e2e-traffic" in out, f"server never got payload: {out!r} {err!r}"
+        finally:
+            if server.poll() is None:
+                server.kill()
+    finally:
+        for req in reqs:
+            try:
+                do_cni(sock, CniRequest(
+                    command="DEL", container_id=req.container_id, netns=req.netns,
+                    ifname="net1", config=conf,
+                ))
+            except Exception:
+                pass
+        for ns in namespaces:
+            subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+# -- 4. service function chains (reference :458-478, :547-555) ---------------
+
+
+def _sfc(i: int) -> dict:
+    return v1.new_service_function_chain(
+        f"sfc-test{i}",
+        v.NAMESPACE,
+        node_selector={v.NODE_OPT_IN_LABEL: v.NODE_OPT_IN_VALUE},
+        network_functions=[{"name": f"test-nf{i}", "image": "quay.io/example/nf:1"}],
+    )
+
+
+def test_sfc_pod_created_and_running(stack):
+    stack.client.create(_sfc(0))
+    def nf_pod():
+        return stack.client.get_or_none("v1", "Pod", v.NAMESPACE, "test-nf0")
+    assert wait_for(lambda: nf_pod() is not None, timeout=15), "NF pod never created"
+    pod = nf_pod()
+    ctr = pod["spec"]["containers"][0]
+    assert ctr["image"] == "quay.io/example/nf:1"
+    assert ctr["resources"]["requests"][v.DPU_RESOURCE_NAME] == "2"
+    assert wait_for(
+        lambda: (nf_pod() or {}).get("status", {}).get("phase") == "Running",
+        timeout=30,
+    ), "NF pod never scheduled against fabric endpoints"
+
+
+def test_sfc_deletion_removes_nf_pod(stack):
+    stack.client.delete(
+        v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, v.NAMESPACE, "sfc-test0"
+    )
+    assert wait_for(
+        lambda: stack.client.get_or_none("v1", "Pod", v.NAMESPACE, "test-nf0") is None,
+        timeout=15,
+    ), "NF pod survived SFC deletion"
+
+
+# -- 5. resource exhaustion (reference :558-626) ------------------------------
+
+
+def test_resource_exhaustion_and_unblock(stack):
+    """With 4 endpoints and 2 per NF pod, the 3rd chain must stay Pending;
+    deleting one chain unblocks it."""
+    n_fit = NUM_ENDPOINTS // 2
+    for i in range(1, n_fit + 2):
+        stack.client.create(_sfc(i))
+    for i in range(1, n_fit + 1):
+        assert wait_for(
+            lambda i=i: (stack.client.get_or_none("v1", "Pod", v.NAMESPACE, f"test-nf{i}") or {})
+            .get("status", {})
+            .get("phase")
+            == "Running",
+            timeout=30,
+        ), f"NF pod {i} never ran"
+    extra = n_fit + 1
+    time.sleep(0.5)
+    pod = stack.client.get_or_none("v1", "Pod", v.NAMESPACE, f"test-nf{extra}")
+    assert pod is not None and pod.get("status", {}).get("phase") != "Running", (
+        "over-capacity NF pod should be Pending"
+    )
+    # Delete one running chain → the pending pod gets its endpoints.
+    stack.client.delete(
+        v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, v.NAMESPACE, "sfc-test1"
+    )
+    assert wait_for(
+        lambda: (stack.client.get_or_none("v1", "Pod", v.NAMESPACE, f"test-nf{extra}") or {})
+        .get("status", {})
+        .get("phase")
+        == "Running",
+        timeout=30,
+    ), "pending NF pod never unblocked after capacity freed"
+    for i in range(2, n_fit + 2):
+        stack.client.delete_if_exists(
+            v1.GROUP_VERSION, v1.KIND_SERVICE_FUNCTION_CHAIN, v.NAMESPACE, f"sfc-test{i}"
+        )
