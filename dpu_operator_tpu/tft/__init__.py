@@ -1,0 +1,19 @@
+"""tft — traffic-flow tests for fabric-backed pod interfaces.
+
+TPU-native replacement for the reference's kubernetes-traffic-flow-tests
+submodule + hack/traffic_flow_tests.sh: same YAML config shape
+(hack/cluster-configs/ocp-tft-config.yaml — connection list with
+iperf-tcp / iperf-udp / netperf-tcp-stream / netperf-tcp-rr types,
+per-test duration, secondary-network NAD), run either against two
+existing netns (cluster mode would exec into pods; local mode execs into
+the netns the CNI attached) with the engines in engine.py."""
+
+from .tft import ConnectionSpec, TestSpec, load_config, run_connection, run_suite
+
+__all__ = [
+    "ConnectionSpec",
+    "TestSpec",
+    "load_config",
+    "run_connection",
+    "run_suite",
+]

@@ -1,0 +1,129 @@
+"""CLI: python -m dpu_operator_tpu.tft <config.yaml> [--duration D]
+       [--self-contained | --server-netns NS --client-netns NS --server-ip IP]
+
+Counterpart of hack/traffic_flow_tests.sh + tft.py in the reference's
+kubernetes-traffic-flow-tests submodule. --self-contained stands up the
+whole local slice (tpuvsp + fabric bridge + two CNI-attached netns) and
+measures through it — the mode `hack/traffic_flow_tests.sh` uses on a
+single TPU-VM node."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import subprocess
+import sys
+import uuid
+
+from .tft import load_config, print_results, run_suite
+
+
+def _self_contained_run(tests, duration):
+    import socket as socketlib
+    import tempfile
+
+    from ..cni import CniRequest, do_cni
+    from ..daemon import GrpcPlugin
+    from ..daemon.converged_side import ConvergedSideManager
+    from ..parallel import SliceTopology
+    from ..utils import PathManager
+    from ..vsp import VspServer
+    from ..vsp.tpu_dataplane import TpuFabricDataplane
+    from ..vsp.tpu_vsp import TpuVsp
+
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        opi_port = s.getsockname()[1]
+
+    root = tempfile.mkdtemp(prefix="dpu-tft-")
+    pm = PathManager(root=root)
+    bridge = "brTFT" + uuid.uuid4().hex[:6]
+    vsp = TpuVsp(
+        topology=SliceTopology.single_chip(),
+        dataplane=TpuFabricDataplane(bridge=bridge),
+        opi_port=opi_port,
+    )
+    vsp_server = VspServer(vsp, pm)
+    vsp_server.start()
+    manager = ConvergedSideManager(
+        GrpcPlugin(pm.vendor_plugin_socket()),
+        "tft-local",
+        path_manager=pm,
+        register_device_plugin=False,
+    )
+    namespaces, reqs, ips = [], [], []
+    conf = {"cniVersion": "1.0.0", "name": tests[0].secondary_network_nad, "type": "dpu-cni"}
+    try:
+        manager.start_vsp()
+        manager.setup_devices()
+        manager.listen()
+        manager.serve()
+        sock = manager.cni_server.socket_path
+        for i in range(2):
+            ns = f"tft{i}-" + uuid.uuid4().hex[:6]
+            subprocess.run(["ip", "netns", "add", ns], check=True)
+            namespaces.append(ns)
+            req = CniRequest(
+                command="ADD",
+                container_id=f"tftc{i}" + uuid.uuid4().hex[:10],
+                netns=ns,
+                ifname="net1",
+                config=conf,
+            )
+            reqs.append(req)
+            result = do_cni(sock, req)
+            ips.append(result["ips"][0]["address"].split("/")[0])
+        return run_suite(
+            tests,
+            server_netns=namespaces[1],
+            client_netns=namespaces[0],
+            server_ip=ips[1],
+            duration_override=duration,
+        )
+    finally:
+        try:
+            sock = manager.cni_server.socket_path
+            for req in reqs:
+                do_cni(sock, CniRequest(
+                    command="DEL", container_id=req.container_id,
+                    netns=req.netns, ifname="net1", config=conf,
+                ))
+        except Exception:
+            pass
+        for ns in namespaces:
+            subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+        manager.stop()
+        vsp_server.stop()
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(prog="tft")
+    ap.add_argument("config")
+    ap.add_argument("--duration", type=float, default=None)
+    ap.add_argument("--self-contained", action="store_true")
+    ap.add_argument("--server-netns")
+    ap.add_argument("--client-netns")
+    ap.add_argument("--server-ip")
+    args = ap.parse_args(argv)
+
+    tests = load_config(args.config)
+    if args.self_contained:
+        results = _self_contained_run(tests, args.duration)
+    else:
+        if not args.server_ip:
+            ap.error("--server-ip required unless --self-contained")
+        results = run_suite(
+            tests, args.server_netns, args.client_netns, args.server_ip,
+            duration_override=args.duration,
+        )
+    print_results(results)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Traffic engines — iperf3/netperf stand-ins runnable inside a pod netns.
+
+The reference delegates to iperf3/netperf via the
+kubernetes-traffic-flow-tests submodule (hack/traffic_flow_tests.sh,
+ocp-tft-config.yaml: iperf-tcp / iperf-udp / netperf-tcp-stream /
+netperf-tcp-rr). Neither tool ships in this image, so the same four test
+shapes are implemented in Python over raw sockets; each engine prints a
+single JSON result line so the harness can collect from `ip netns exec`
+subprocesses.
+
+Invocation (from tft.py, one process per endpoint):
+    python -m dpu_operator_tpu.tft.engine server <type> <bind_ip> <port> <duration>
+    python -m dpu_operator_tpu.tft.engine client <type> <server_ip> <port> <duration>
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+import time
+
+BUF = 256 * 1024  # stream write size
+UDP_PAYLOAD = 8192
+RR_PAYLOAD = 1
+
+
+def _emit(**kw) -> None:
+    print(json.dumps(kw), flush=True)
+
+
+# -- TCP stream (iperf-tcp / netperf-tcp-stream) ------------------------------
+
+
+def tcp_stream_server(bind_ip: str, port: int, duration: float) -> None:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((bind_ip, port))
+    s.listen(1)
+    s.settimeout(duration + 30)
+    conn, _ = s.accept()
+    conn.settimeout(10)
+    total = 0
+    start = None
+    try:
+        while True:
+            data = conn.recv(BUF)
+            if not data:
+                break
+            if start is None:
+                start = time.perf_counter()
+            total += len(data)
+    except socket.timeout:
+        pass
+    elapsed = (time.perf_counter() - start) if start else 0.0
+    gbps = (total * 8 / elapsed / 1e9) if elapsed else 0.0
+    _emit(type="tcp-stream", bytes=total, seconds=round(elapsed, 3), gbps=round(gbps, 3))
+
+
+def tcp_stream_client(server_ip: str, port: int, duration: float) -> None:
+    conn = _dial(server_ip, port)
+    payload = b"\x5a" * BUF
+    end = time.perf_counter() + duration
+    total = 0
+    while time.perf_counter() < end:
+        conn.sendall(payload)
+        total += len(payload)
+    conn.close()
+    _emit(type="tcp-stream-client", bytes=total)
+
+
+# -- UDP stream (iperf-udp) ---------------------------------------------------
+
+
+def udp_server(bind_ip: str, port: int, duration: float) -> None:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind((bind_ip, port))
+    s.settimeout(duration + 30)
+    total = 0
+    pkts = 0
+    start = None
+    try:
+        while True:
+            data, _ = s.recvfrom(UDP_PAYLOAD)
+            if data == b"FIN":
+                break
+            if start is None:
+                start = time.perf_counter()
+                s.settimeout(duration + 5)
+            total += len(data)
+            pkts += 1
+    except socket.timeout:
+        pass
+    elapsed = (time.perf_counter() - start) if start else 0.0
+    gbps = (total * 8 / elapsed / 1e9) if elapsed else 0.0
+    _emit(
+        type="udp", bytes=total, packets=pkts, seconds=round(elapsed, 3),
+        gbps=round(gbps, 3),
+    )
+
+
+def udp_client(server_ip: str, port: int, duration: float) -> None:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    payload = b"\x5a" * UDP_PAYLOAD
+    end = time.perf_counter() + duration
+    total = 0
+    while time.perf_counter() < end:
+        s.sendto(payload, (server_ip, port))
+        total += len(payload)
+    for _ in range(5):
+        s.sendto(b"FIN", (server_ip, port))
+    _emit(type="udp-client", bytes=total)
+
+
+# -- TCP request/response (netperf-tcp-rr) ------------------------------------
+
+
+def tcp_rr_server(bind_ip: str, port: int, duration: float) -> None:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((bind_ip, port))
+    s.listen(1)
+    s.settimeout(duration + 30)
+    conn, _ = s.accept()
+    conn.settimeout(10)
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    n = 0
+    try:
+        while True:
+            data = conn.recv(RR_PAYLOAD)
+            if not data:
+                break
+            conn.sendall(data)
+            n += 1
+    except socket.timeout:
+        pass
+    _emit(type="tcp-rr-server", transactions=n)
+
+
+def tcp_rr_client(server_ip: str, port: int, duration: float) -> None:
+    conn = _dial(server_ip, port)
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    end = time.perf_counter() + duration
+    start = time.perf_counter()
+    n = 0
+    while time.perf_counter() < end:
+        conn.sendall(b"\x5a")
+        if not conn.recv(RR_PAYLOAD):
+            break
+        n += 1
+    elapsed = time.perf_counter() - start
+    conn.close()
+    tps = n / elapsed if elapsed else 0.0
+    _emit(
+        type="tcp-rr", transactions=n, seconds=round(elapsed, 3),
+        tps=round(tps, 1), mean_rtt_us=round(elapsed / n * 1e6, 1) if n else None,
+    )
+
+
+def _dial(ip: str, port: int, timeout: float = 15.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return socket.create_connection((ip, port), timeout=5)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+ENGINES = {
+    ("server", "iperf-tcp"): tcp_stream_server,
+    ("client", "iperf-tcp"): tcp_stream_client,
+    ("server", "netperf-tcp-stream"): tcp_stream_server,
+    ("client", "netperf-tcp-stream"): tcp_stream_client,
+    ("server", "iperf-udp"): udp_server,
+    ("client", "iperf-udp"): udp_client,
+    ("server", "netperf-tcp-rr"): tcp_rr_server,
+    ("client", "netperf-tcp-rr"): tcp_rr_client,
+}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    role, typ, ip, port, duration = (
+        argv[0], argv[1], argv[2], int(argv[3]), float(argv[4]),
+    )
+    ENGINES[(role, typ)](ip, port, duration)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

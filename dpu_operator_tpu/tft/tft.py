@@ -1,0 +1,162 @@
+"""Traffic-flow test runner.
+
+Config shape follows the reference's ocp-tft-config.yaml: a `tft` list of
+tests, each with connections of the four supported types, a duration,
+and the secondary-network NAD to ride. Execution here targets two pod
+network namespaces (local mode — what the zero-hardware tier and the
+single-TPU-VM deployment use); each endpoint runs an engine subprocess
+via `ip netns exec`, mirroring how the reference execs iperf in pods."""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+SUPPORTED_TYPES = (
+    "iperf-tcp",
+    "iperf-udp",
+    "netperf-tcp-stream",
+    "netperf-tcp-rr",
+)
+BASE_PORT = 20100
+
+
+@dataclass
+class ConnectionSpec:
+    name: str
+    type: str
+    instances: int = 1
+
+    def __post_init__(self):
+        if self.type not in SUPPORTED_TYPES:
+            raise ValueError(
+                f"connection {self.name}: unsupported type {self.type}; "
+                f"supported: {', '.join(SUPPORTED_TYPES)}"
+            )
+
+
+@dataclass
+class TestSpec:
+    name: str
+    namespace: str = "default"
+    duration: float = 30.0
+    connections: List[ConnectionSpec] = field(default_factory=list)
+    secondary_network_nad: str = "default-ici-net"
+
+
+def load_config(path: str) -> List[TestSpec]:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    tests = []
+    for t in doc.get("tft", []):
+        conns = []
+        for c in t.get("connections", []):
+            conns.append(
+                ConnectionSpec(
+                    name=c.get("name", "conn"),
+                    type=c.get("type", "iperf-tcp"),
+                    instances=int(c.get("instances", 1)),
+                )
+            )
+            nad = c.get("secondary_network_nad")
+        tests.append(
+            TestSpec(
+                name=t.get("name", "test"),
+                namespace=t.get("namespace", "default"),
+                duration=float(t.get("duration", 30)),
+                connections=conns,
+                secondary_network_nad=nad or "default-ici-net",
+            )
+        )
+    return tests
+
+
+def _netns_cmd(netns: Optional[str], args: List[str]) -> List[str]:
+    return (["ip", "netns", "exec", netns] if netns else []) + args
+
+
+def run_connection(
+    conn: ConnectionSpec,
+    server_netns: Optional[str],
+    client_netns: Optional[str],
+    server_ip: str,
+    duration: float,
+    port: int = BASE_PORT,
+) -> dict:
+    """One connection: server engine in the server netns, client engine in
+    the client netns, collect the server-side result line."""
+    eng = [sys.executable, "-m", "dpu_operator_tpu.tft.engine"]
+    server = subprocess.Popen(
+        _netns_cmd(server_netns, eng + ["server", conn.type, server_ip, str(port), str(duration)]),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    time.sleep(0.3)
+    client = subprocess.Popen(
+        _netns_cmd(client_netns, eng + ["client", conn.type, server_ip, str(port), str(duration)]),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    budget = duration + 60
+    try:
+        c_out, c_err = client.communicate(timeout=budget)
+        s_out, s_err = server.communicate(timeout=budget)
+    finally:
+        for p in (client, server):
+            if p.poll() is None:
+                p.kill()
+    if server.returncode != 0:
+        raise RuntimeError(f"server engine failed: {s_err}")
+    if client.returncode != 0:
+        raise RuntimeError(f"client engine failed: {c_err}")
+    server_result = json.loads(s_out.strip().splitlines()[-1])
+    client_result = json.loads(c_out.strip().splitlines()[-1])
+    # RR results are measured client-side (transactions/sec), stream/udp
+    # server-side (goodput) — same split the reference tools use.
+    result = client_result if conn.type == "netperf-tcp-rr" else server_result
+    return {"connection": conn.name, "type": conn.type, **result}
+
+
+def run_suite(
+    tests: List[TestSpec],
+    server_netns: Optional[str],
+    client_netns: Optional[str],
+    server_ip: str,
+    duration_override: Optional[float] = None,
+) -> List[dict]:
+    results = []
+    port = BASE_PORT
+    for t in tests:
+        for conn in t.connections:
+            for i in range(conn.instances):
+                port += 1
+                d = duration_override if duration_override is not None else t.duration
+                log.info("tft: %s / %s instance %d (%.1fs)", t.name, conn.name, i, d)
+                r = run_connection(conn, server_netns, client_netns, server_ip, d, port)
+                r["test"] = t.name
+                results.append(r)
+    return results
+
+
+def print_results(results: List[dict], file=None) -> None:
+    file = file or sys.stdout
+    for r in results:
+        if "gbps" in r:
+            line = f'{r["test"]:<10} {r["connection"]:<14} {r["type"]:<20} {r["gbps"]:>9.3f} Gbps'
+        elif "tps" in r:
+            line = f'{r["test"]:<10} {r["connection"]:<14} {r["type"]:<20} {r["tps"]:>9.1f} tps'
+        else:
+            line = json.dumps(r)
+        print(line, file=file)
+    print(json.dumps({"tft_results": results}), file=file)

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Traffic-flow tests over the fabric-backed secondary network.
+# Counterpart of the reference's hack/traffic_flow_tests.sh, which picks
+# the first dpuside=dpu-host worker and drives the
+# kubernetes-traffic-flow-tests iperf/netperf matrix through the SR-IOV
+# NAD. Here the engines are built in (dpu_operator_tpu/tft) and the
+# default mode is self-contained: stand up the tpuvsp + fabric bridge +
+# two CNI-attached pod netns on this node and measure through them.
+#
+# Env:
+#   TFT_CONFIG    config yaml (default hack/cluster-configs/tft-config.yaml)
+#   TFT_DURATION  per-case duration override in seconds
+
+set -e
+cd "$(dirname "$0")/.."
+
+CONFIG="${TFT_CONFIG:-hack/cluster-configs/tft-config.yaml}"
+DURATION_ARG=""
+if [ -n "${TFT_DURATION:-}" ]; then
+  DURATION_ARG="--duration ${TFT_DURATION}"
+fi
+
+exec python3 -m dpu_operator_tpu.tft "$CONFIG" --self-contained $DURATION_ARG

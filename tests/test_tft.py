@@ -1,0 +1,84 @@
+"""Traffic-flow test harness (dpu_operator_tpu/tft) — counterpart of the
+reference's hack/traffic_flow_tests.sh + kubernetes-traffic-flow-tests
+submodule wiring (SURVEY §4 tier 4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpu_operator_tpu.tft import ConnectionSpec, load_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_load_reference_shaped_config(tmp_path):
+    cfg = tmp_path / "tft.yaml"
+    cfg.write_text(
+        """
+tft:
+  - name: "Test 1"
+    namespace: "default"
+    duration: "5"
+    connections:
+      - name: "c1"
+        type: "iperf-udp"
+        instances: 2
+        secondary_network_nad: "default-ici-net"
+      - name: "c2"
+        type: "netperf-tcp-rr"
+"""
+    )
+    tests = load_config(str(cfg))
+    assert len(tests) == 1
+    t = tests[0]
+    assert t.duration == 5.0
+    assert [c.type for c in t.connections] == ["iperf-udp", "netperf-tcp-rr"]
+    assert t.connections[0].instances == 2
+    assert t.secondary_network_nad == "default-ici-net"
+
+
+def test_unsupported_type_rejected():
+    with pytest.raises(ValueError, match="unsupported type"):
+        ConnectionSpec(name="x", type="iperf-sctp")
+
+
+def test_engine_loopback_round_trip():
+    """Engines work without netns: server+client over loopback."""
+    from dpu_operator_tpu.tft.tft import run_connection
+
+    r = run_connection(
+        ConnectionSpec(name="lo", type="iperf-tcp"),
+        server_netns=None,
+        client_netns=None,
+        server_ip="127.0.0.1",
+        duration=0.5,
+        port=20944,
+    )
+    assert r["type"] == "tcp-stream"
+    assert r["gbps"] > 0
+
+
+@pytest.mark.slow
+def test_traffic_flow_script_self_contained(netns):
+    """hack/traffic_flow_tests.sh end-to-end: real bridge, two netns, all
+    four connection types."""
+    env = dict(os.environ, TFT_DURATION="0.5")
+    r = subprocess.run(
+        [os.path.join(REPO, "hack", "traffic_flow_tests.sh")],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    last = r.stdout.strip().splitlines()[-1]
+    results = json.loads(last)["tft_results"]
+    assert len(results) == 4
+    by_type = {x["type"]: x for x in results}
+    assert by_type["udp"]["gbps"] > 0
+    assert by_type["tcp-stream"]["gbps"] > 0
+    assert by_type["tcp-rr"]["tps"] > 0
