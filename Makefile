@@ -1,7 +1,11 @@
 # Build/test entry points (counterpart of the reference's Makefile +
 # taskfile.yaml task system).
 
-.PHONY: all native proto test fast-test bench clean
+.PHONY: all native proto test fast-test e2e-test traffic-flow-tests bench \
+        build-images deploy undeploy clean
+
+IMG_REGISTRY ?= localhost
+KUSTOMIZE ?= kubectl kustomize
 
 all: native
 
@@ -16,10 +20,30 @@ test: native
 	python -m pytest tests/ -q
 
 fast-test:
-	python -m pytest tests/ -q -x
+	python -m pytest tests/ -q -x -m "not slow"
+
+e2e-test:
+	python -m pytest tests/test_e2e.py -q
+
+traffic-flow-tests:
+	./hack/traffic_flow_tests.sh
 
 bench: native
 	python bench.py
+
+# Container images (counterpart of `task build-image-all`).
+build-images:
+	docker build -f Dockerfile.manager -t $(IMG_REGISTRY)/tpu-dpu-operator:latest .
+	docker build -f Dockerfile.daemon -t $(IMG_REGISTRY)/dpu-daemon:latest .
+	docker build -f Dockerfile.tpuVSP -t $(IMG_REGISTRY)/tpu-vsp:latest .
+	docker build -f Dockerfile.cpAgent -t $(IMG_REGISTRY)/dpu-cp-agent:latest .
+	docker build -f Dockerfile.nri -t $(IMG_REGISTRY)/dpu-nri:latest .
+
+deploy:
+	$(KUSTOMIZE) config/default | kubectl apply -f -
+
+undeploy:
+	$(KUSTOMIZE) config/default | kubectl delete -f - --ignore-not-found
 
 clean:
 	rm -rf native/build
