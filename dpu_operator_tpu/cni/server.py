@@ -93,9 +93,33 @@ class CniServer:
             if req.command in ("CHECK", "VERSION"):
                 return 200, {}
             raise CniError(f"unsupported CNI command {req.command!r}", code=4)
+        import time
+
+        from ..utils.metrics import default_registry as metrics
+
         lock = self._locks.get(f"{req.container_id}/{req.ifname}")
-        with lock:
-            result = handler(req)
+        start = time.perf_counter()
+        try:
+            with lock:
+                result = handler(req)
+        except Exception:
+            metrics.counter_inc(
+                "dpu_cni_requests_total",
+                {"command": req.command, "result": "error"},
+                help="CNI requests handled by the daemon server",
+            )
+            raise
+        metrics.counter_inc(
+            "dpu_cni_requests_total",
+            {"command": req.command, "result": "ok"},
+            help="CNI requests handled by the daemon server",
+        )
+        metrics.observe(
+            "dpu_cni_request_seconds",
+            time.perf_counter() - start,
+            {"command": req.command},
+            help="CNI request handling latency",
+        )
         return 200, result
 
     def start(self) -> None:

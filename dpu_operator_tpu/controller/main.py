@@ -74,6 +74,15 @@ def main() -> None:
         webhook.register("/validate-sfc", validate_service_function_chain)
         webhook.start()
 
+    # Metrics + health endpoints (reference serves metrics on :18090 and
+    # health on :18091, cmd/main.go:82-102).
+    from ..utils.metrics import MetricsServer
+
+    metrics_server = MetricsServer(
+        host="0.0.0.0", port=int(os.environ.get("METRICS_PORT", "18090"))
+    )
+    metrics_server.start()
+
     mgr.start()
     log.info("operator running (namespace=%s)", v.NAMESPACE)
     stop = threading.Event()
@@ -81,6 +90,7 @@ def main() -> None:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     mgr.stop()
+    metrics_server.stop()
     if webhook:
         webhook.stop()
 

@@ -64,6 +64,7 @@ class Daemon:
         side_manager_factory: Optional[Callable[[DetectedDpu, VendorPlugin], SideManager]] = None,
         cni_shim_source: Optional[str] = None,
         mode_override: str = "auto",
+        drain_on_setup: bool = False,
     ):
         self._client = client
         self._platform = platform
@@ -75,6 +76,7 @@ class Daemon:
         self._factory = side_manager_factory or self._default_factory
         self._cni_shim_source = cni_shim_source
         self._mode_override = mode_override
+        self._drain_on_setup = drain_on_setup
 
         self._managed: Dict[str, ManagedDpu] = {}
         self._stop = threading.Event()
@@ -130,12 +132,20 @@ class Daemon:
     # -- the tick ------------------------------------------------------------
 
     def tick(self) -> None:
+        from ..utils.metrics import default_registry as metrics
+
+        metrics.counter_inc(
+            "dpu_daemon_ticks_total", help="Daemon detection-loop iterations"
+        )
         detections = self._apply_mode_override(self._detector.detect_all())
         if len(detections) > 1:
             raise RuntimeError(
                 f"{len(detections)} DPUs detected on one node; only one is supported"
             )
         by_id = {d.identifier: d for d in detections}
+        metrics.gauge_set(
+            "dpu_daemon_managed_dpus", len(by_id), help="Devices currently managed"
+        )
 
         for ident, det in by_id.items():
             if ident not in self._managed:
@@ -181,7 +191,18 @@ class Daemon:
         def run():  # reference runSideManager (daemon.go:449-472)
             try:
                 manager.start_vsp()
-                manager.setup_devices()
+                if self._drain_on_setup:
+                    # Fabric repartition changes the endpoint inventory under
+                    # running pods; drain first (the reference leaves this as
+                    # a TODO before SetNumVfs, dpudevicehandler.go:78-83).
+                    from ..drain import Drainer
+
+                    drainer = Drainer(self._client)
+                    drainer.drain_node(det.node_name, force=True)
+                    manager.setup_devices()
+                    drainer.complete_drain_node(det.node_name)
+                else:
+                    manager.setup_devices()
                 manager.listen()
                 manager.serve()
             except Exception as e:

@@ -29,6 +29,15 @@ def main() -> None:
         cni_shim_source=shim_src if os.path.exists(shim_src) else None,
         mode_override=os.environ.get("DPU_MODE", "auto"),
     )
+    # DPU-side manager metrics port in the reference is :18001
+    # (dpusidemanager.go:315-319); one server covers the whole daemon here.
+    from ..utils.metrics import MetricsServer
+
+    metrics_server = MetricsServer(
+        host="0.0.0.0", port=int(os.environ.get("METRICS_PORT", "18001"))
+    )
+    metrics_server.start()
+
     daemon.prepare()
     daemon.start()
     log.info("daemon running on node %s", platform.node_name())
@@ -37,6 +46,7 @@ def main() -> None:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     daemon.stop()
+    metrics_server.stop()
 
 
 if __name__ == "__main__":

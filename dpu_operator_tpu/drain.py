@@ -1,0 +1,74 @@
+"""Node drain — cordon + evict fabric-consuming pods.
+
+Counterpart of reference pkgs/drain/drain.go (a facade over the
+sriov-network-operator drainer). The reference keeps it unwired — a TODO
+notes it should run before SetNumVfs repartitions the VFs
+(internal/daemon/device-handler/dpu-device-handler/dpudevicehandler.go:78-83).
+Here the same role exists for fabric repartition: SetNumEndpoints changes
+the endpoint inventory under running pods, so callers can drain first.
+Wiring is opt-in (Daemon(drain_on_setup=True)) to match the reference's
+default behavior."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from . import vars as v
+from .k8s import Client
+
+log = logging.getLogger(__name__)
+
+
+class Drainer:
+    def __init__(self, client: Client, resource_name: str = v.DPU_RESOURCE_NAME):
+        self._client = client
+        self._resource = resource_name
+
+    def _fabric_pods_on_node(self, node_name: str) -> List[dict]:
+        out = []
+        for pod in self._client.list("v1", "Pod", None):
+            if pod.get("spec", {}).get("nodeName") != node_name:
+                continue
+            for ctr in pod.get("spec", {}).get("containers", []):
+                reqs = ctr.get("resources", {}).get("requests", {}) or {}
+                if self._resource in reqs:
+                    out.append(pod)
+                    break
+        return out
+
+    def drain_node(self, node_name: str, force: bool = False) -> bool:
+        """Cordon the node and evict pods holding fabric endpoints.
+        Returns True once the node is drained (reference DrainNode
+        semantics: callable repeatedly until it reports done)."""
+        node = self._client.get_or_none("v1", "Node", None, node_name)
+        if node is None:
+            return False
+        if not node.get("spec", {}).get("unschedulable"):
+            node.setdefault("spec", {})["unschedulable"] = True
+            self._client.update(node)
+            log.info("drain: cordoned %s", node_name)
+        pods = self._fabric_pods_on_node(node_name)
+        for pod in pods:
+            meta = pod["metadata"]
+            if not force and meta.get("annotations", {}).get(
+                "dpu.tpu.io/no-evict"
+            ) == "true":
+                log.warning("drain: %s/%s refuses eviction", meta.get("namespace"), meta["name"])
+                return False
+            self._client.delete_if_exists(
+                "v1", "Pod", meta.get("namespace"), meta["name"]
+            )
+            log.info("drain: evicted %s/%s", meta.get("namespace"), meta["name"])
+        return len(self._fabric_pods_on_node(node_name)) == 0
+
+    def complete_drain_node(self, node_name: str) -> bool:
+        """Uncordon (reference CompleteDrainNode)."""
+        node = self._client.get_or_none("v1", "Node", None, node_name)
+        if node is None:
+            return False
+        if node.get("spec", {}).get("unschedulable"):
+            node["spec"]["unschedulable"] = False
+            self._client.update(node)
+            log.info("drain: uncordoned %s", node_name)
+        return True

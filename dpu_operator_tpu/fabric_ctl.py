@@ -1,0 +1,179 @@
+"""fabric-ctl — operator CLI for the dpu-api/OPI gRPC surface.
+
+The role p4rt-ctl plays for the Intel VSP (cmd/intelvsp/p4rt-ctl: a
+Python CLI the Go code and operators shell out to for inspecting and
+programming the P4 pipeline): a debugging/ops tool speaking the same
+wire contracts as the daemon — LifeCycle/Device/Heartbeat over the
+vendor-plugin unix socket, BridgePort/NetworkFunction against either the
+VSP socket or the DPU-side daemon's OPI TCP endpoint.
+
+Usage:
+    fabric-ctl [--socket PATH | --opi HOST:PORT] <command> [args]
+
+Commands:
+    init [--dpu-mode] [--id IDENT]      VSP LifeCycle.Init
+    devices                              device inventory incl. topology
+    set-endpoints N                      repartition the fabric
+    ping [--id IDENT]                    heartbeat
+    add-port NAME MAC [BRIDGE...]        BridgePort create
+    del-port NAME                        BridgePort delete
+    add-nf MAC0 MAC1                     chain two ports
+    del-nf MAC0 MAC1                     unchain
+    topology                             slice topology from env/JAX
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import grpc
+
+from .dpu_api import services
+from .dpu_api.gen import bridge_port_pb2 as bp
+from google.protobuf import empty_pb2
+
+from .dpu_api.gen import dpu_api_pb2 as pb
+from .utils import PathManager
+
+
+def _channel(args) -> grpc.Channel:
+    if args.opi:
+        return grpc.insecure_channel(args.opi)
+    sock = args.socket or PathManager().vendor_plugin_socket()
+    return grpc.insecure_channel(f"unix://{sock}")
+
+
+def cmd_init(args, chan):
+    stub = services.LifeCycleStub(chan)
+    resp = stub.Init(
+        pb.InitRequest(
+            dpu_mode=pb.DPU_MODE_DPU if args.dpu_mode else pb.DPU_MODE_HOST,
+            dpu_identifier=args.id,
+        ),
+        timeout=30,
+    )
+    print(json.dumps({"opi_ip": resp.ip, "opi_port": resp.port}))
+
+
+def cmd_devices(args, chan):
+    stub = services.DeviceStub(chan)
+    resp = stub.GetDevices(empty_pb2.Empty(), timeout=10)
+    out = {}
+    for dev_id, d in resp.devices.items():
+        out[dev_id] = {
+            "health": pb.Health.Name(d.health),
+            "backing": d.backing,
+            "coords": d.topology.coords,
+            "numaNode": d.topology.numa_node,
+            "links": [
+                {"neighbor": l.neighbor, "gbps": l.gbps} for l in d.topology.links
+            ],
+        }
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+def cmd_set_endpoints(args, chan):
+    stub = services.DeviceStub(chan)
+    resp = stub.SetNumEndpoints(pb.EndpointCount(count=args.count), timeout=30)
+    print(json.dumps({"count": resp.count}))
+
+
+def cmd_ping(args, chan):
+    import time
+
+    stub = services.HeartbeatStub(chan)
+    resp = stub.Ping(
+        pb.PingRequest(timestamp_ns=time.monotonic_ns(), sender_id=args.id),
+        timeout=10,
+    )
+    print(json.dumps({"healthy": resp.healthy}))
+
+
+def cmd_add_port(args, chan):
+    stub = services.BridgePortStub(chan)
+    stub.CreateBridgePort(
+        bp.CreateBridgePortRequest(
+            bridge_port=bp.BridgePort(
+                name=args.name,
+                spec=bp.BridgePortSpec(
+                    ptype=bp.ACCESS,
+                    mac_address=bytes.fromhex(args.mac.replace(":", "")),
+                    logical_bridges=args.bridges or ["br-fabric"],
+                ),
+            )
+        ),
+        timeout=30,
+    )
+    print(json.dumps({"created": args.name}))
+
+
+def cmd_del_port(args, chan):
+    stub = services.BridgePortStub(chan)
+    stub.DeleteBridgePort(bp.DeleteBridgePortRequest(name=args.name), timeout=30)
+    print(json.dumps({"deleted": args.name}))
+
+
+def cmd_add_nf(args, chan):
+    stub = services.NetworkFunctionStub(chan)
+    stub.CreateNetworkFunction(
+        pb.NFRequest(input=args.mac0, output=args.mac1), timeout=30
+    )
+    print(json.dumps({"chained": [args.mac0, args.mac1]}))
+
+
+def cmd_del_nf(args, chan):
+    stub = services.NetworkFunctionStub(chan)
+    stub.DeleteNetworkFunction(
+        pb.NFRequest(input=args.mac0, output=args.mac1), timeout=30
+    )
+    print(json.dumps({"unchained": [args.mac0, args.mac1]}))
+
+
+def cmd_topology(args, chan):
+    from .parallel import SliceTopology
+
+    topo = SliceTopology.from_env()
+    if not topo.chips:
+        topo = SliceTopology.single_chip()
+    print(json.dumps(topo.to_dict(), indent=2))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fabric-ctl", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--socket", help="vendor-plugin unix socket path")
+    ap.add_argument("--opi", help="OPI server host:port (TCP)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("init"); p.add_argument("--dpu-mode", action="store_true")
+    p.add_argument("--id", default="fabric-ctl"); p.set_defaults(fn=cmd_init)
+    p = sub.add_parser("devices"); p.set_defaults(fn=cmd_devices)
+    p = sub.add_parser("set-endpoints"); p.add_argument("count", type=int)
+    p.set_defaults(fn=cmd_set_endpoints)
+    p = sub.add_parser("ping"); p.add_argument("--id", default="fabric-ctl")
+    p.set_defaults(fn=cmd_ping)
+    p = sub.add_parser("add-port"); p.add_argument("name"); p.add_argument("mac")
+    p.add_argument("bridges", nargs="*"); p.set_defaults(fn=cmd_add_port)
+    p = sub.add_parser("del-port"); p.add_argument("name"); p.set_defaults(fn=cmd_del_port)
+    p = sub.add_parser("add-nf"); p.add_argument("mac0"); p.add_argument("mac1")
+    p.set_defaults(fn=cmd_add_nf)
+    p = sub.add_parser("del-nf"); p.add_argument("mac0"); p.add_argument("mac1")
+    p.set_defaults(fn=cmd_del_nf)
+    p = sub.add_parser("topology"); p.set_defaults(fn=cmd_topology)
+
+    args = ap.parse_args(argv)
+    chan = _channel(args)
+    try:
+        args.fn(args, chan)
+    except grpc.RpcError as e:
+        print(json.dumps({"error": e.code().name, "details": e.details()}), file=sys.stderr)
+        return 1
+    finally:
+        chan.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

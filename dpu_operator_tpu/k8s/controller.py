@@ -131,13 +131,25 @@ class Controller:
                 continue
             with self._pending_lock:
                 self._pending.discard(req)
+            from ..utils.metrics import default_registry as metrics
+
             try:
                 result = self.reconciler.reconcile(req)
                 self._failures.pop(req, None)
                 if result and result.requeue_after:
                     self._requeue_later(req, result.requeue_after)
+                metrics.counter_inc(
+                    "dpu_reconcile_total",
+                    {"controller": self.name, "result": "ok"},
+                    help="Reconcile attempts per controller",
+                )
             except Exception:
                 log.exception("%s: reconcile %s failed", self.name, req)
+                metrics.counter_inc(
+                    "dpu_reconcile_total",
+                    {"controller": self.name, "result": "error"},
+                    help="Reconcile attempts per controller",
+                )
                 n = self._failures.get(req, 0) + 1
                 self._failures[req] = n
                 self._requeue_later(req, min(0.05 * (2 ** n), self._MAX_BACKOFF))

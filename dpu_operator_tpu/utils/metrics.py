@@ -1,0 +1,136 @@
+"""Prometheus-text-format metrics registry + HTTP exposition.
+
+The reference gets controller-runtime's prometheus registry for free
+(operator :18090 with authn/authz filter, cmd/main.go:82-86; DPU-side
+manager :18001, dpusidemanager.go:315-319). This is the dependency-free
+equivalent: counters/gauges/histograms rendered in the Prometheus text
+exposition format on /metrics, plus /healthz."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{val}"' for k, val in labels)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[tuple, float]] = {}
+        self._gauges: Dict[str, Dict[tuple, float]] = {}
+        self._hists: Dict[str, Dict[tuple, List[float]]] = {}
+        self._help: Dict[str, str] = {}
+
+    def counter_inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0,
+                    help: str = "") -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._help.setdefault(name, help)
+            self._counters.setdefault(name, {})
+            self._counters[name][key] = self._counters[name].get(key, 0.0) + by
+
+    def gauge_set(self, name: str, value: float, labels: Optional[dict] = None,
+                  help: str = "") -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._help.setdefault(name, help)
+            self._gauges.setdefault(name, {})[key] = value
+
+    def observe(self, name: str, value: float, labels: Optional[dict] = None,
+                help: str = "") -> None:
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._help.setdefault(name, help)
+            self._hists.setdefault(name, {}).setdefault(key, []).append(value)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                if self._help.get(name):
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} counter")
+                for key, val in sorted(series.items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {val}")
+            for name, series in sorted(self._gauges.items()):
+                if self._help.get(name):
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} gauge")
+                for key, val in sorted(series.items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {val}")
+            for name, series in sorted(self._hists.items()):
+                if self._help.get(name):
+                    lines.append(f"# HELP {name} {self._help[name]}")
+                lines.append(f"# TYPE {name} histogram")
+                for key, values in sorted(series.items()):
+                    count = len(values)
+                    total = sum(values)
+                    for b in _BUCKETS:
+                        le = sum(1 for x in values if x <= b)
+                        bl = key + (("le", str(b)),)
+                        lines.append(f"{name}_bucket{_fmt_labels(bl)} {le}")
+                    bl = key + (("le", "+Inf"),)
+                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {count}")
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {total}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {count}")
+        return "\n".join(lines) + "\n"
+
+
+# Default process-wide registry (controller-runtime has the same shape).
+default_registry = Registry()
+
+
+class MetricsServer:
+    """HTTP /metrics + /healthz on a given port (0 → ephemeral)."""
+
+    def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._registry = registry or default_registry
+        registry_ref = self._registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = registry_ref.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                elif self.path in ("/healthz", "/readyz"):
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="metrics"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

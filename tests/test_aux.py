@@ -1,0 +1,223 @@
+"""Auxiliary subsystems: drain (reference pkgs/drain), metrics exposition
+(controller-runtime prometheus equivalent), fabric-ctl CLI (p4rt-ctl
+analogue)."""
+
+import json
+import socket as socketlib
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.drain import Drainer
+from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster
+from dpu_operator_tpu.utils.metrics import MetricsServer, Registry
+
+
+def free_port() -> int:
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- drain --------------------------------------------------------------------
+
+
+@pytest.fixture
+def client():
+    c = InMemoryClient(InMemoryCluster())
+    c.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}})
+    return c
+
+
+def _pod(name, node, requests=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "nodeName": node,
+            "containers": [
+                {"name": "c", "image": "img", "resources": {"requests": requests or {}}}
+            ],
+        },
+    }
+
+
+def test_drain_cordons_and_evicts_fabric_pods(client):
+    client.create(_pod("fabric-pod", "n1", {v.DPU_RESOURCE_NAME: "2"}))
+    client.create(_pod("plain-pod", "n1"))
+    d = Drainer(client)
+    assert d.drain_node("n1") is True
+    node = client.get("v1", "Node", None, "n1")
+    assert node["spec"]["unschedulable"] is True
+    assert client.get_or_none("v1", "Pod", "default", "fabric-pod") is None
+    # Non-fabric pods stay.
+    assert client.get_or_none("v1", "Pod", "default", "plain-pod") is not None
+    assert d.complete_drain_node("n1") is True
+    assert client.get("v1", "Node", None, "n1")["spec"]["unschedulable"] is False
+
+
+def test_drain_respects_no_evict_unless_forced(client):
+    pod = _pod("precious", "n1", {v.DPU_RESOURCE_NAME: "1"})
+    pod["metadata"]["annotations"] = {"dpu.tpu.io/no-evict": "true"}
+    client.create(pod)
+    d = Drainer(client)
+    assert d.drain_node("n1") is False
+    assert client.get_or_none("v1", "Pod", "default", "precious") is not None
+    assert d.drain_node("n1", force=True) is True
+    assert client.get_or_none("v1", "Pod", "default", "precious") is None
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_registry_renders_prometheus_text():
+    r = Registry()
+    r.counter_inc("dpu_cni_requests_total", {"command": "ADD", "result": "ok"},
+                  help="reqs")
+    r.counter_inc("dpu_cni_requests_total", {"command": "ADD", "result": "ok"})
+    r.gauge_set("dpu_daemon_managed_dpus", 1)
+    r.observe("dpu_cni_request_seconds", 0.004, {"command": "ADD"})
+    text = r.render()
+    assert '# TYPE dpu_cni_requests_total counter' in text
+    assert 'dpu_cni_requests_total{command="ADD",result="ok"} 2.0' in text
+    assert "dpu_daemon_managed_dpus 1" in text
+    assert 'dpu_cni_request_seconds_bucket{command="ADD",le="0.005"} 1' in text
+    assert 'dpu_cni_request_seconds_count{command="ADD"} 1' in text
+
+
+def test_metrics_server_serves_http():
+    r = Registry()
+    r.counter_inc("x_total", help="x")
+    srv = MetricsServer(registry=r, port=0)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ).read().decode()
+        assert "x_total 1.0" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz"
+        ).read()
+        assert health == b"ok"
+    finally:
+        srv.stop()
+
+
+def test_cni_requests_counted_through_server(tmp_root):
+    """The CNI server increments dpu_cni_requests_total on handled calls."""
+    from dpu_operator_tpu.cni import CniRequest, CniServer, do_cni
+    from dpu_operator_tpu.utils.metrics import default_registry
+
+    server = CniServer(tmp_root)
+    server.set_handlers(lambda req: {"ok": True}, lambda req: {})
+    server.start()
+    try:
+        do_cni(server.socket_path, CniRequest(
+            command="ADD", container_id="m" * 12, netns="/proc/self/ns/net",
+            ifname="net1", config={"cniVersion": "1.0.0", "name": "n", "type": "dpu-cni"},
+        ))
+        text = default_registry.render()
+        assert 'dpu_cni_requests_total{command="ADD",result="ok"}' in text
+    finally:
+        server.stop()
+
+
+# -- fabric-ctl ---------------------------------------------------------------
+
+
+def test_fabric_ctl_devices_and_ping(tmp_root, capsys):
+    from dpu_operator_tpu.fabric_ctl import main as fabric_ctl
+    from dpu_operator_tpu.vsp import MockVsp, VspServer
+
+    vsp = MockVsp(opi_port=free_port())
+    server = VspServer(vsp, tmp_root)
+    server.start()
+    try:
+        sock = tmp_root.vendor_plugin_socket()
+        assert fabric_ctl(["--socket", sock, "devices"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out) == 4
+        assert all(d["health"] == "HEALTHY" for d in out.values())
+
+        assert fabric_ctl(["--socket", sock, "ping"]) == 0
+        assert json.loads(capsys.readouterr().out)["healthy"] is True
+
+        assert fabric_ctl(["--socket", sock, "set-endpoints", "6"]) == 0
+        assert json.loads(capsys.readouterr().out)["count"] == 6
+
+        assert fabric_ctl(
+            ["--socket", sock, "add-port", "p0", "02:00:00:00:00:01"]
+        ) == 0
+        assert json.loads(capsys.readouterr().out)["created"] == "p0"
+        assert vsp.bridge_ports == ["p0"]
+
+        assert fabric_ctl(["--socket", sock, "del-port", "p0"]) == 0
+        capsys.readouterr()
+        assert vsp.bridge_ports == []
+    finally:
+        server.stop()
+
+
+def test_fabric_ctl_topology(capsys, monkeypatch):
+    from dpu_operator_tpu.fabric_ctl import main as fabric_ctl
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert fabric_ctl(["topology"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["numChips"] == 8
+    assert out["bisectionGbps"] > 0
+
+
+# -- daemon drain wiring ------------------------------------------------------
+
+
+def test_daemon_drains_before_setup(client, tmp_root):
+    """drain_on_setup=True: fabric pods evicted before SetNumEndpoints,
+    node uncordoned after."""
+    import time
+
+    from dpu_operator_tpu.daemon import Daemon
+    from dpu_operator_tpu.platform import FakePlatform
+    from dpu_operator_tpu.vsp import MockVsp, VspServer
+
+    client.create(
+        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpu-node-0"}}
+    )
+    client.create(_pod("victim", "tpu-node-0", {v.DPU_RESOURCE_NAME: "1"}))
+    vsp = MockVsp(opi_port=free_port())
+    server = VspServer(vsp, tmp_root)
+    server.start()
+    daemon = Daemon(
+        client,
+        FakePlatform(
+            product="Google Cloud TPU",
+            node="tpu-node-0",
+            env={"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0"},
+        ),
+        path_manager=tmp_root,
+        tick_interval=0.05,
+        register_device_plugin=False,
+        drain_on_setup=True,
+    )
+    daemon.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.get_or_none("v1", "Pod", "default", "victim") is None:
+                break
+            time.sleep(0.05)
+        assert client.get_or_none("v1", "Pod", "default", "victim") is None
+        # Node ends uncordoned.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            node = client.get("v1", "Node", None, "tpu-node-0")
+            if not node.get("spec", {}).get("unschedulable"):
+                break
+            time.sleep(0.05)
+        assert not client.get("v1", "Node", None, "tpu-node-0")["spec"].get("unschedulable")
+    finally:
+        daemon.stop()
+        server.stop()
