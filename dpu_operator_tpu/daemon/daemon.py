@@ -195,12 +195,22 @@ class Daemon:
                     # Fabric repartition changes the endpoint inventory under
                     # running pods; drain first (the reference leaves this as
                     # a TODO before SetNumVfs, dpudevicehandler.go:78-83).
+                    import time as _time
+
                     from ..drain import Drainer
 
                     drainer = Drainer(self._client)
-                    drainer.drain_node(det.node_name, force=True)
-                    manager.setup_devices()
-                    drainer.complete_drain_node(det.node_name)
+                    try:
+                        deadline = _time.monotonic() + 60
+                        while not drainer.drain_node(det.node_name, force=True):
+                            if _time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    f"drain of {det.node_name} did not complete"
+                                )
+                            _time.sleep(0.5)
+                        manager.setup_devices()
+                    finally:
+                        drainer.complete_drain_node(det.node_name)
                 else:
                     manager.setup_devices()
                 manager.listen()

@@ -52,7 +52,8 @@ class KubeletSim:
         self._stubs: Dict[str, services.DevicePluginStub] = {}
         self._channels: List[grpc.Channel] = []
         self._devices: Dict[str, Set[str]] = {}
-        self._allocated: Dict[str, Dict[str, List[str]]] = {}  # res → pod → devs
+        # res → (namespace, name) → allocated device ids
+        self._allocated: Dict[str, Dict[tuple, List[str]]] = {}
         self._threads: List[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -177,7 +178,7 @@ class KubeletSim:
         return wants
 
     def _try_bind(self, pod: dict) -> None:
-        key = f'{pod["metadata"].get("namespace")}/{pod["metadata"]["name"]}'
+        key = (pod["metadata"].get("namespace"), pod["metadata"]["name"])
         wants = self._extended_requests(pod)
         picked: Dict[str, List[str]] = {}
         with self._lock:
@@ -246,6 +247,5 @@ class KubeletSim:
         with self._lock:
             for res, allocs in self._allocated.items():
                 for key in list(allocs):
-                    ns, _, name = key.partition("/")
-                    if (ns or None, name) not in live:
+                    if key not in live:
                         del allocs[key]

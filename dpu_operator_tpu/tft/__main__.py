@@ -52,7 +52,8 @@ def _self_contained_run(tests, duration):
         register_device_plugin=False,
     )
     namespaces, reqs, ips = [], [], []
-    conf = {"cniVersion": "1.0.0", "name": tests[0].secondary_network_nad, "type": "dpu-cni"}
+    nad = tests[0].secondary_network_nad if tests else "default-ici-net"
+    conf = {"cniVersion": "1.0.0", "name": nad, "type": "dpu-cni"}
     try:
         manager.start_vsp()
         manager.setup_devices()
@@ -92,8 +93,11 @@ def _self_contained_run(tests, duration):
             pass
         for ns in namespaces:
             subprocess.run(["ip", "netns", "del", ns], capture_output=True)
-        manager.stop()
-        vsp_server.stop()
+        for stop in (manager.stop, vsp_server.stop):
+            try:
+                stop()
+            except Exception:
+                logging.getLogger(__name__).exception("tft teardown step failed")
         subprocess.run(["ip", "link", "del", bridge], capture_output=True)
         import shutil
 

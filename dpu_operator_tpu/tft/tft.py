@@ -59,6 +59,7 @@ def load_config(path: str) -> List[TestSpec]:
     tests = []
     for t in doc.get("tft", []):
         conns = []
+        nad = None
         for c in t.get("connections", []):
             conns.append(
                 ConnectionSpec(
@@ -67,7 +68,7 @@ def load_config(path: str) -> List[TestSpec]:
                     instances=int(c.get("instances", 1)),
                 )
             )
-            nad = c.get("secondary_network_nad")
+            nad = nad or c.get("secondary_network_nad")
         tests.append(
             TestSpec(
                 name=t.get("name", "test"),

@@ -27,7 +27,7 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: Dict[str, Dict[tuple, float]] = {}
         self._gauges: Dict[str, Dict[tuple, float]] = {}
-        self._hists: Dict[str, Dict[tuple, List[float]]] = {}
+        self._hists: Dict[str, Dict[tuple, dict]] = {}
         self._help: Dict[str, str] = {}
 
     def counter_inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0,
@@ -47,10 +47,21 @@ class Registry:
 
     def observe(self, name: str, value: float, labels: Optional[dict] = None,
                 help: str = "") -> None:
+        """Cumulative bucket counts + sum + count, prometheus-style — O(1)
+        memory per series regardless of observation volume."""
         key = tuple(sorted((labels or {}).items()))
         with self._lock:
             self._help.setdefault(name, help)
-            self._hists.setdefault(name, {}).setdefault(key, []).append(value)
+            series = self._hists.setdefault(name, {})
+            state = series.get(key)
+            if state is None:
+                state = {"buckets": [0] * len(_BUCKETS), "sum": 0.0, "count": 0}
+                series[key] = state
+            for i, b in enumerate(_BUCKETS):
+                if value <= b:
+                    state["buckets"][i] += 1
+            state["sum"] += value
+            state["count"] += 1
 
     def render(self) -> str:
         lines: List[str] = []
@@ -71,17 +82,16 @@ class Registry:
                 if self._help.get(name):
                     lines.append(f"# HELP {name} {self._help[name]}")
                 lines.append(f"# TYPE {name} histogram")
-                for key, values in sorted(series.items()):
-                    count = len(values)
-                    total = sum(values)
-                    for b in _BUCKETS:
-                        le = sum(1 for x in values if x <= b)
+                for key, state in sorted(series.items()):
+                    for i, b in enumerate(_BUCKETS):
                         bl = key + (("le", str(b)),)
-                        lines.append(f"{name}_bucket{_fmt_labels(bl)} {le}")
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(bl)} {state['buckets'][i]}"
+                        )
                     bl = key + (("le", "+Inf"),)
-                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {count}")
-                    lines.append(f"{name}_sum{_fmt_labels(key)} {total}")
-                    lines.append(f"{name}_count{_fmt_labels(key)} {count}")
+                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {state['count']}")
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {state['sum']}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {state['count']}")
         return "\n".join(lines) + "\n"
 
 
