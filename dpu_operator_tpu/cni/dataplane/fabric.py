@@ -50,8 +50,7 @@ class FabricDataplane:
     def cmd_add(self, req: CniRequest) -> CniResult:
         if not req.netns:
             raise CniError("ADD requires CNI_NETNS", code=4)
-        netns_was_path = "/" in req.netns
-        netns = nl.ensure_named_netns(req.netns)
+        netns, netns_created = nl.ensure_named_netns(req.netns)
         host_if = _host_ifname(req.container_id, req.ifname)
         tmp_if = "t" + host_if[1:]
         mac = req.config.get("mac") or _stable_mac(req.container_id, req.ifname)
@@ -61,17 +60,23 @@ class FabricDataplane:
         if nl.link_exists(req.ifname, netns) and nl.link_exists(host_if):
             state = self._store.load(req.container_id, req.ifname)
             if state:
+                nl.release_named_netns(netns, netns_created)
                 return self._result_from_state(state)
 
         try:
-            nl.create_veth(host_if, tmp_if)
-            nl.set_mac(tmp_if, mac)
             mtu = req.config.get("mtu")
-            if mtu:
-                nl.set_mtu(host_if, int(mtu))
-                nl.set_mtu(tmp_if, int(mtu))
-            nl.move_link_to_netns(tmp_if, netns)
-            nl.rename_link(tmp_if, req.ifname, netns)
+            if not nl.create_veth_in_netns(
+                host_if, req.ifname, netns, mac, int(mtu) if mtu else None
+            ):
+                # Fallback: classic temp-rename move protocol (reference
+                # networkfn.go:36-149 shape).
+                nl.create_veth(host_if, tmp_if)
+                nl.set_mac(tmp_if, mac)
+                if mtu:
+                    nl.set_mtu(host_if, int(mtu))
+                    nl.set_mtu(tmp_if, int(mtu))
+                nl.move_link_to_netns(tmp_if, netns)
+                nl.rename_link(tmp_if, req.ifname, netns)
             cidr, gateway = self._ipam.allocate(owner)
             nl.add_addr(req.ifname, cidr, netns)
             nl.set_up(req.ifname, netns)
@@ -85,7 +90,7 @@ class FabricDataplane:
             # Full rollback — never leave a half-plumbed pod (the reference
             # guarantees the same on its move protocol, networkfn.go:36-149).
             self._rollback(host_if, tmp_if, req.ifname, netns, owner)
-            nl.release_named_netns(netns, netns_was_path)
+            nl.release_named_netns(netns, netns_created)
             raise CniError(f"fabric ADD failed: {e}") from e
 
         state = {
@@ -100,7 +105,7 @@ class FabricDataplane:
             "sandbox": req.netns,
         }
         self._store.save(req.container_id, req.ifname, state)
-        nl.release_named_netns(netns, netns_was_path)
+        nl.release_named_netns(netns, netns_created)
         return self._result_from_state(state)
 
     def cmd_del(self, req: CniRequest) -> Tuple[dict, bool]:

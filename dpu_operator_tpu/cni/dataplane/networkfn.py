@@ -28,10 +28,9 @@ class NetworkFnDataplane:
             raise CniError("networkfn ADD requires config.deviceID", code=7)
         if not req.netns:
             raise CniError("ADD requires CNI_NETNS", code=4)
-        netns_was_path = "/" in req.netns
-        netns = nl.ensure_named_netns(req.netns)
+        netns, netns_created = nl.ensure_named_netns(req.netns)
         if not nl.link_exists(device):
-            nl.release_named_netns(netns, netns_was_path)
+            nl.release_named_netns(netns, netns_created)
             raise CniError(f"device {device} not found in host netns", code=7)
 
         tmp = "nf" + uuid.uuid4().hex[:10]
@@ -50,7 +49,7 @@ class NetworkFnDataplane:
             nl.set_up(req.ifname, netns)
         except nl.NetlinkError as e:
             self._rollback(device, tmp, req.ifname, netns, moved_to_ns, orig_alias)
-            nl.release_named_netns(netns, netns_was_path)
+            nl.release_named_netns(netns, netns_created)
             raise CniError(f"networkfn ADD failed: {e}") from e
 
         mac = nl.get_mac(req.ifname, netns)
@@ -63,7 +62,7 @@ class NetworkFnDataplane:
             "sandbox": req.netns,
         }
         self._store.save(req.container_id, req.ifname, state)
-        nl.release_named_netns(netns, netns_was_path)
+        nl.release_named_netns(netns, netns_created)
         result = CniResult()
         result.add_interface(req.ifname, mac, req.netns)
         return result
@@ -72,9 +71,9 @@ class NetworkFnDataplane:
         state = self._store.load(req.container_id, req.ifname)
         if state is None:
             return {}, False
-        netns_was_path = "/" in state["netns"]
+        
         try:
-            netns = nl.ensure_named_netns(state["netns"])
+            netns, netns_created = nl.ensure_named_netns(state["netns"])
         except nl.NetlinkError:
             # Pod netns is already gone; the kernel returned the device to
             # the host netns under its temp/pod name or destroyed it.
@@ -92,7 +91,7 @@ class NetworkFnDataplane:
         except nl.NetlinkError as e:
             log.warning("networkfn DEL restore failed for %s: %s", device, e)
         finally:
-            nl.release_named_netns(netns, netns_was_path)
+            nl.release_named_netns(netns, netns_created)
         self._store.delete(req.container_id, req.ifname)
         return {}, True
 

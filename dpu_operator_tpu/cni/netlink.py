@@ -1,10 +1,10 @@
-"""Netlink operations via the iproute2 CLI.
+"""Netlink operations: raw RTNETLINK fast path + iproute2 CLI fallback.
 
-The reference uses vishvananda/netlink (Go); this image has neither
-pyroute2 nor a need for raw RTNETLINK — `ip` subprocess calls with full
-error propagation are the Python-native equivalent the rest of the CNI
-layer builds on. Every mutation has a rollback-friendly, idempotent
-wrapper."""
+The reference uses vishvananda/netlink (Go, direct AF_NETLINK). The hot
+pod-attach operations go through rtnetlink.py (~100 µs/op); anything the
+fast path can't do here (no CAP_NET_ADMIN, unregistered netns path)
+falls back to `ip` subprocess calls with full error propagation. Every
+mutation has a rollback-friendly, idempotent wrapper."""
 
 from __future__ import annotations
 
@@ -15,11 +15,30 @@ import subprocess
 import uuid
 from typing import List, Optional
 
+from . import rtnetlink as _fast
+
 log = logging.getLogger(__name__)
+
+_FAST = _fast.available()
 
 
 class NetlinkError(RuntimeError):
     pass
+
+
+def _fastpath(fn, *args, **kwargs):
+    """Run an rtnetlink op; RtnlError is a real kernel error (raise as
+    NetlinkError), RtnlUnavailable means retry via the CLI (return
+    False so the caller falls through)."""
+    if not _FAST:
+        return False
+    try:
+        fn(*args, **kwargs)
+        return True
+    except _fast.RtnlError as e:
+        raise NetlinkError(f"{fn.__name__}{args}: {e}") from e
+    except _fast.RtnlUnavailable:
+        return False
 
 
 def _run(args: List[str], netns: Optional[str] = None) -> str:
@@ -34,6 +53,11 @@ def _run(args: List[str], netns: Optional[str] = None) -> str:
 
 
 def link_exists(name: str, netns: Optional[str] = None) -> bool:
+    if _FAST:
+        try:
+            return _fast.link_exists(name, netns)
+        except _fast.RtnlUnavailable:
+            pass
     try:
         _run(["link", "show", "dev", name], netns)
         return True
@@ -42,35 +66,69 @@ def link_exists(name: str, netns: Optional[str] = None) -> bool:
 
 
 def create_veth(name: str, peer: str) -> None:
+    if _fastpath(_fast.create_veth, name, peer):
+        return
     _run(["link", "add", name, "type", "veth", "peer", "name", peer])
 
 
+def create_veth_in_netns(
+    name: str,
+    peer: str,
+    peer_netns: str,
+    peer_mac: Optional[str] = None,
+    mtu: Optional[int] = None,
+) -> bool:
+    """One-transaction veth create with the peer born in `peer_netns`
+    (named + MAC'd); returns False when only the CLI is available so the
+    caller can run the classic move protocol instead."""
+    return bool(
+        _fastpath(
+            _fast.create_veth_peer_in_netns, name, peer, peer_netns, peer_mac, mtu
+        )
+    )
+
+
 def delete_link(name: str, netns: Optional[str] = None) -> None:
-    if link_exists(name, netns):
-        _run(["link", "del", "dev", name], netns)
+    if not link_exists(name, netns):
+        return
+    if _fastpath(_fast.delete_link, name, netns):
+        return
+    _run(["link", "del", "dev", name], netns)
 
 
 def set_up(name: str, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.set_up, name, netns):
+        return
     _run(["link", "set", "dev", name, "up"], netns)
 
 
 def set_down(name: str, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.set_down, name, netns):
+        return
     _run(["link", "set", "dev", name, "down"], netns)
 
 
 def set_mac(name: str, mac: str, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.set_mac, name, mac, netns):
+        return
     _run(["link", "set", "dev", name, "address", mac], netns)
 
 
 def set_mtu(name: str, mtu: int, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.set_mtu, name, mtu, netns):
+        return
     _run(["link", "set", "dev", name, "mtu", str(mtu)], netns)
 
 
 def rename_link(old: str, new: str, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.rename_link, old, new, netns):
+        return
     _run(["link", "set", "dev", old, "name", new], netns)
 
 
 def set_alias(name: str, alias: str, netns: Optional[str] = None) -> None:
+    if _fastpath(_fast.set_alias, name, alias, netns):
+        return
     _run(["link", "set", "dev", name, "alias", alias], netns)
 
 
@@ -87,15 +145,21 @@ def get_mac(name: str, netns: Optional[str] = None) -> str:
 
 
 def move_link_to_netns(name: str, netns: str) -> None:
+    if _fastpath(_fast.move_link_to_netns, name, netns):
+        return
     _run(["link", "set", "dev", name, "netns", netns])
 
 
 def move_link_to_host(name: str, netns: str) -> None:
     """Move a link out of `netns` back into the init (host) namespace."""
+    if _fastpath(_fast.move_link_to_host, name, netns):
+        return
     _run(["link", "set", "dev", name, "netns", "1"], netns)
 
 
 def add_addr(name: str, cidr: str, netns: Optional[str] = None) -> None:
+    if "/" in cidr and ":" not in cidr and _fastpath(_fast.add_addr, name, cidr, netns):
+        return
     _run(["addr", "add", cidr, "dev", name], netns)
 
 
@@ -110,6 +174,8 @@ def get_addrs(name: str, netns: Optional[str] = None) -> List[str]:
 
 
 def add_route(dst: str, via: Optional[str], dev: str, netns: Optional[str] = None) -> None:
+    if ":" not in dst and _fastpath(_fast.add_route, dst, via, dev, netns):
+        return
     args = ["route", "add", dst]
     if via:
         args += ["via", via]
@@ -117,9 +183,21 @@ def add_route(dst: str, via: Optional[str], dev: str, netns: Optional[str] = Non
     _run(args, netns)
 
 
+def set_master(name: str, master: Optional[str], netns: Optional[str] = None) -> None:
+    """Attach `name` to bridge `master` (None detaches)."""
+    if _fastpath(_fast.set_master, name, master, netns):
+        return
+    if master:
+        _run(["link", "set", "dev", name, "master", master], netns)
+    else:
+        _run(["link", "set", "dev", name, "nomaster"], netns)
+
+
 # -- netns management --------------------------------------------------------
 
-NETNS_RUN_DIR = "/var/run/netns"
+# Single source of truth shared with the fast path — both layers MUST
+# address the same netns registration directory.
+NETNS_RUN_DIR = _fast.NETNS_RUN_DIR
 
 
 def create_netns(name: str) -> None:
@@ -134,17 +212,21 @@ def netns_exists(name: str) -> bool:
     return os.path.exists(os.path.join(NETNS_RUN_DIR, name))
 
 
-def ensure_named_netns(netns_ref: str) -> str:
-    """Return an iproute2-usable netns name for either a name or a path.
+def ensure_named_netns(netns_ref: str) -> tuple:
+    """Return (name, created): an iproute2-usable netns name for either a
+    name or a path, and whether WE created a bind mount for it (only then
+    may release_named_netns undo it — a /var/run/netns path is a
+    runtime-owned registration we must never unmount).
 
     The kubelet hands CNI a path like /proc/<pid>/ns/net or
-    /var/run/netns/<name>; iproute2 only addresses registered names, so
-    foreign paths are bind-mounted into /var/run/netns (the same trick
-    the reference's netns helpers rely on via the ns package)."""
+    /var/run/netns/<name>; netlink/iproute2 only address registered
+    names, so foreign paths are bind-mounted into /var/run/netns (the
+    same trick the reference's netns helpers rely on via the ns
+    package)."""
     if "/" not in netns_ref:
-        return netns_ref
+        return netns_ref, False
     if netns_ref.startswith(NETNS_RUN_DIR + "/"):
-        return os.path.basename(netns_ref)
+        return os.path.basename(netns_ref), False
     name = "cni-" + uuid.uuid4().hex[:12]
     os.makedirs(NETNS_RUN_DIR, exist_ok=True)
     target = os.path.join(NETNS_RUN_DIR, name)
@@ -156,12 +238,12 @@ def ensure_named_netns(netns_ref: str) -> str:
     if r.returncode != 0:
         os.unlink(target)
         raise NetlinkError(f"bind-mount {netns_ref} -> {target}: {r.stderr.strip()}")
-    return name
+    return name, True
 
 
-def release_named_netns(name: str, was_path: bool) -> None:
-    """Undo ensure_named_netns for bind-mounted (path-derived) names."""
-    if not was_path:
+def release_named_netns(name: str, created: bool) -> None:
+    """Undo ensure_named_netns for registrations this plugin created."""
+    if not created:
         return
     target = os.path.join(NETNS_RUN_DIR, name)
     subprocess.run(["umount", target], capture_output=True)

@@ -54,14 +54,23 @@ class TpuFabricDataplane:
             _run(["ip", "link", "set", "dev", self.uplink, "up"])
 
     def attach_port(self, netdev: str, mac: str) -> None:
-        _run(["ip", "link", "set", "dev", netdev, "master", self.bridge])
-        _run(["ip", "link", "set", "dev", netdev, "up"])
+        # Hot path: direct RTNETLINK via the shared netlink layer (falls
+        # back to the CLI when the fast path is unavailable).
+        from ..cni import netlink as nl
+
+        try:
+            nl.set_master(netdev, self.bridge)
+            nl.set_up(netdev)
+        except nl.NetlinkError as e:
+            raise DataplaneError(str(e)) from e
         self.ports[netdev] = mac
 
     def detach_port(self, netdev: str) -> None:
+        from ..cni import netlink as nl
+
         try:
-            _run(["ip", "link", "set", "dev", netdev, "nomaster"])
-        except DataplaneError as e:
+            nl.set_master(netdev, None)
+        except nl.NetlinkError as e:
             log.debug("detach %s: %s", netdev, e)
         self.ports.pop(netdev, None)
 
