@@ -1,0 +1,75 @@
+"""Leveled CNI file logger with per-request context.
+
+Counterpart of reference dpu-cni/pkgs/cnilogging (a wrapper over
+k8snetworkplumbingwg/cni-log adding containerID/netns/ifname context,
+cnilogging.go:26-86). The CNI shim runs as a short-lived kubelet-exec'd
+process whose stdout is the CNI result channel — diagnostics must go to
+a file. The daemon-side CNI server uses it too, so one `tail -f` shows
+the full request path."""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import threading
+from typing import Optional
+
+DEFAULT_LOG_FILE = "/var/log/dpu-cni/dpu-cni.log"
+MAX_BYTES = 10 * 1024 * 1024
+BACKUPS = 3
+
+_lock = threading.Lock()
+_configured = False
+
+
+def setup(log_file: Optional[str] = None, level: str = "info") -> logging.Logger:
+    """Idempotently attach a rotating file handler to the 'dpu-cni'
+    logger; falls back to stderr when the log dir isn't writable
+    (unprivileged tests)."""
+    global _configured
+    logger = logging.getLogger("dpu-cni")
+    with _lock:
+        if _configured:
+            return logger
+        path = log_file or os.environ.get("DPU_CNI_LOG_FILE", DEFAULT_LOG_FILE)
+        logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            handler: logging.Handler = logging.handlers.RotatingFileHandler(
+                path, maxBytes=MAX_BYTES, backupCount=BACKUPS
+            )
+        except OSError:
+            handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.propagate = False
+        _configured = True
+    return logger
+
+
+class RequestLogger(logging.LoggerAdapter):
+    """Prefixes every line with the CNI request identity
+    (reference cnilogging.go context fields)."""
+
+    def process(self, msg, kwargs):
+        ctx = self.extra or {}
+        prefix = " ".join(
+            f"{k}={ctx[k]}" for k in ("containerID", "netns", "ifname") if ctx.get(k)
+        )
+        return (f"[{prefix}] {msg}" if prefix else msg), kwargs
+
+
+def for_request(container_id: str, netns: str, ifname: str) -> RequestLogger:
+    return RequestLogger(
+        setup(),
+        {
+            "containerID": (container_id or "")[:13],
+            "netns": netns,
+            "ifname": ifname,
+        },
+    )

@@ -141,11 +141,16 @@ class CniServer:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length))
                     req = CniRequest.from_json(body)
+                    from .cnilogging import for_request
+
+                    rlog = for_request(req.container_id, req.netns, req.ifname)
+                    rlog.info("%s dispatched", req.command)
                     log.info(
                         "CNI %s container=%s ifname=%s netns=%s",
                         req.command, req.container_id[:13], req.ifname, req.netns,
                     )
                     code, result = server_ref.handle(req)
+                    rlog.info("%s done (%d)", req.command, code)
                     self._reply(code, result)
                 except CniError as e:
                     self._reply(400, e.to_json())

@@ -63,6 +63,10 @@ def main(argv: Optional[list] = None) -> int:
     try:
         stdin_data = sys.stdin.read()
         req = CniRequest.from_env(dict(os.environ), stdin_data)
+        from .cnilogging import for_request
+
+        rlog = for_request(req.container_id, req.netns, req.ifname)
+        rlog.info("shim %s -> %s", req.command, socket_path)
         result = do_cni(socket_path, req)
         sys.stdout.write(json.dumps(result))
         return 0

@@ -221,3 +221,24 @@ def test_daemon_drains_before_setup(client, tmp_root):
     finally:
         daemon.stop()
         server.stop()
+
+
+# -- cni file logger ----------------------------------------------------------
+
+
+def test_cnilogging_request_context(tmp_path, monkeypatch):
+    """Per-request context prefix + file output (reference
+    dpu-cni/pkgs/cnilogging/cnilogging.go:26-86)."""
+    import importlib
+
+    from dpu_operator_tpu.cni import cnilogging
+
+    log_file = str(tmp_path / "cni.log")
+    monkeypatch.setenv("DPU_CNI_LOG_FILE", log_file)
+    importlib.reload(cnilogging)
+    rlog = cnilogging.for_request("abcdef0123456789", "/ns/x", "net1")
+    rlog.info("hello %s", "world")
+    content = open(log_file).read()
+    assert "containerID=abcdef0123456" in content
+    assert "ifname=net1" in content
+    assert "hello world" in content
