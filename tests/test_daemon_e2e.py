@@ -247,3 +247,126 @@ def test_cni_add_del_full_path(two_sides, netns):
         do_cni(sock, req_del)
     finally:
         subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_two_cluster_topology(tmp_root):
+    """The reference's 2-cluster deployment shape (README.md:38-44): the
+    host cluster node PCI-detects the accelerator (is_dpu_side=False →
+    HostSideManager), the accelerator-side cluster runs the TPU-VM
+    runtime (converged manager serving OPI); each cluster keeps its own
+    DataProcessingUnit CR and side label, and the host's CNI ADD crosses
+    the cluster boundary over OPI TCP to program the DPU-side VSP."""
+    import shutil
+    import tempfile
+
+    from dpu_operator_tpu.cni import CniRequest, do_cni
+    from dpu_operator_tpu.platform import PciDevice
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+    host_cluster = InMemoryClient(InMemoryCluster())
+    dpu_cluster = InMemoryClient(InMemoryCluster())
+    host_cluster.create(
+        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "host-0"}}
+    )
+    dpu_cluster.create(
+        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpuvm-0"}}
+    )
+
+    opi_port = free_port()
+    dpu_root = tempfile.mkdtemp(prefix="dpu-")
+    dpu_pm = PathManager(root=dpu_root)
+
+    # DPU-side cluster: converged daemon with the real tpuvsp (debug
+    # dataplane — no root needed), OPI bound on opi_port.
+    from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
+
+    dpu_vsp = TpuVsp(dataplane=DebugDataplane(), opi_port=opi_port)
+    dpu_vsp_server = VspServer(dpu_vsp, dpu_pm)
+    dpu_vsp_server.start()
+    dpu_daemon = Daemon(
+        dpu_cluster,
+        FakePlatform(product="Google Cloud TPU", node="tpuvm-0", env=TPU_ENV),
+        path_manager=dpu_pm,
+        tick_interval=0.05,
+        register_device_plugin=False,
+    )
+    dpu_daemon.start()
+
+    # Host cluster: PCI detection of the accelerator function.
+    host_platform = FakePlatform(node="host-0")
+    host_platform.add_device(
+        PciDevice(
+            address="0000:00:05.0",
+            vendor_id="1ae0",
+            device_id="0063",
+            class_name="0x120000",
+            product_name="Google TPU accelerator",
+        ),
+        serial="serA1",
+    )
+    host_vsp = MockVsp(opi_port=opi_port)  # Init → points at the DPU-side OPI
+    host_vsp_server = VspServer(host_vsp, tmp_root)
+    host_vsp_server.start()
+    host_daemon = Daemon(
+        host_cluster,
+        host_platform,
+        path_manager=tmp_root,
+        tick_interval=0.05,
+        register_device_plugin=False,
+    )
+    host_daemon.start()
+    try:
+        # Each cluster gets its own CR with the right side.
+        assert wait_for(
+            lambda: dpu_cluster.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
+                "tpu-v5litepod-8-w0-dpu",
+            ) is not None
+        ), "DPU-side CR never appeared"
+        assert wait_for(
+            lambda: host_cluster.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
+                "tpu-sera1-host",
+            ) is not None
+        ), "host-side CR never appeared"
+        assert host_cluster.get(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, "tpu-sera1-host"
+        )["spec"]["isDpuSide"] is False
+
+        # Side labels derived per cluster (reference daemon.go:476-526).
+        assert wait_for(
+            lambda: dpu_cluster.get("v1", "Node", None, "tpuvm-0")["metadata"]
+            .get("labels", {}).get(v.DPU_SIDE_LABEL) == v.DPU_SIDE_DPU
+        )
+        assert wait_for(
+            lambda: host_cluster.get("v1", "Node", None, "host-0")["metadata"]
+            .get("labels", {}).get(v.DPU_SIDE_LABEL) == v.DPU_SIDE_HOST
+        )
+
+        # Cross-cluster heartbeat: host manager pings DPU-side OPI over TCP.
+        host_mgr = None
+        assert wait_for(lambda: len(host_daemon.managed()) == 1)
+        host_mgr = list(host_daemon.managed().values())[0].manager
+        assert wait_for(host_mgr.check_ping, timeout=15), "cross-cluster ping failed"
+
+        # Host CNI ADD → CreateBridgePort lands in the DPU-side tpuvsp.
+        from bench import RecordingDataplane
+
+        host_mgr.dataplane = RecordingDataplane()
+        req = CniRequest(
+            command="ADD",
+            container_id="xcluster" + uuid.uuid4().hex[:8],
+            netns="/proc/self/ns/net",
+            ifname="net1",
+            config={"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"},
+        )
+        do_cni(host_mgr.cni_server.socket_path, req)
+        assert wait_for(lambda: len(dpu_vsp._dataplane.ports) == 1), (
+            "bridge port never reached the DPU-side VSP"
+        )
+    finally:
+        host_daemon.stop()
+        dpu_daemon.stop()
+        host_vsp_server.stop()
+        dpu_vsp_server.stop()
+        shutil.rmtree(dpu_root, ignore_errors=True)
