@@ -86,6 +86,11 @@ class FabricDataplane:
                     nl.add_route("default", gateway, req.ifname, netns)
                 except nl.NetlinkError:
                     log.debug("default route exists in %s", netns)
+            # Announce the new MAC/IP so bridge FDBs and peers learn it
+            # immediately (reference GARP after IPAM, sriov.go:466-480).
+            from .. import arp
+
+            arp.announce(req.ifname, mac, cidr, netns, blocking=False)
         except (nl.NetlinkError, OSError) as e:
             # Full rollback — never leave a half-plumbed pod (the reference
             # guarantees the same on its move protocol, networkfn.go:36-149).

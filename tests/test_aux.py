@@ -242,3 +242,56 @@ def test_cnilogging_request_context(tmp_path, monkeypatch):
     assert "containerID=abcdef0123456" in content
     assert "ifname=net1" in content
     assert "hello world" in content
+
+
+# -- gratuitous ARP -----------------------------------------------------------
+
+
+def test_garp_frame_shape():
+    from dpu_operator_tpu.cni.arp import _build_garp
+
+    frame = _build_garp(bytes.fromhex("020000000001"), bytes([10, 56, 0, 2]))
+    assert len(frame) == 14 + 28
+    assert frame[:6] == b"\xff" * 6  # broadcast dst
+    assert frame[12:14] == b"\x08\x06"  # ethertype ARP
+    # opcode 1 (request), sender == target IP (gratuitous).
+    assert frame[20:22] == b"\x00\x01"
+    assert frame[28:32] == frame[38:42] == bytes([10, 56, 0, 2])
+
+
+def test_garp_announce_over_real_veth(netns):
+    """Send a real GARP from a veth end and capture it on the peer."""
+    import socket as s_mod
+    import struct
+    import subprocess
+    import threading
+    import uuid
+
+    from dpu_operator_tpu.cni.arp import ETH_P_ARP, announce
+
+    a = "ga" + uuid.uuid4().hex[:6]
+    b = "gb" + uuid.uuid4().hex[:6]
+    subprocess.run(["ip", "link", "add", a, "type", "veth", "peer", "name", b], check=True)
+    try:
+        for dev in (a, b):
+            subprocess.run(["ip", "link", "set", dev, "up"], check=True)
+        cap = s_mod.socket(s_mod.AF_PACKET, s_mod.SOCK_RAW, s_mod.htons(ETH_P_ARP))
+        cap.bind((b, 0))
+        cap.settimeout(5)
+        got = []
+
+        def rx():
+            try:
+                got.append(cap.recv(100))
+            except OSError:
+                pass
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        assert announce(a, "02:00:00:00:00:07", "10.99.0.5/24") is True
+        t.join(timeout=6)
+        cap.close()
+        assert got, "no GARP captured on peer"
+        assert got[0][12:14] == struct.pack("!H", ETH_P_ARP)
+    finally:
+        subprocess.run(["ip", "link", "del", a], capture_output=True)
