@@ -67,6 +67,36 @@ class ConvergedSideManager(HostSideManager):
         self._opi_server.start()
         super().listen()
 
+    def _ping_loop(self) -> None:
+        """Converged liveness: heartbeat the VSP itself over the vendor
+        socket (the host-side loop pings the remote OPI endpoint, which
+        here is our own server — it would mask a dead VSP). A VSP that
+        dies flips Ready via plugin.is_initialized; one that comes back
+        is re-adopted with a single-shot Init (fresh-process semantics)."""
+        import time as _time
+
+        was_down = False
+        while not self._stop.is_set():
+            ok = self.plugin.ping()
+            if ok and was_down:
+                # VSP restarted: re-run Init so it redoes hardware setup.
+                addr = self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
+                if addr is None:
+                    ok = False
+                else:
+                    log.info("converged side: re-adopted restarted VSP")
+            if ok:
+                was_down = False
+                with self._ping_lock:
+                    self._last_pong = _time.monotonic()
+            else:
+                if not was_down:
+                    log.warning("converged side: VSP heartbeat lost")
+                was_down = True
+                # Nudge a dead channel so grpc redials promptly.
+                self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
+            self._stop.wait(1.0)
+
     def stop(self) -> None:
         if self._opi_server is not None:
             self._opi_server.stop(0.5)

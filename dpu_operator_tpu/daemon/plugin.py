@@ -117,6 +117,45 @@ class GrpcPlugin(VendorPlugin):
         with self._lock:
             return self._initialized
 
+    def ping(self, timeout: float = 2.0) -> bool:
+        """One VSP heartbeat over the vendor channel. A dead VSP marks
+        the plugin uninitialised so the daemon's Ready condition flips
+        (converged-node liveness path)."""
+        try:
+            stub = services.HeartbeatStub(self._ensure_channel())
+            resp = stub.Ping(
+                pb.PingRequest(timestamp_ns=time.monotonic_ns(), sender_id="daemon"),
+                timeout=timeout,
+            )
+            return bool(resp.healthy)
+        except grpc.RpcError:
+            with self._lock:
+                self._initialized = False
+            return False
+
+    def try_init(self, dpu_mode: bool, identifier: str) -> Optional[Tuple[str, int]]:
+        """Single non-blocking Init attempt — used to re-adopt a VSP that
+        restarted under a running daemon. Returns the OPI addr on success,
+        None while the VSP is still down."""
+        try:
+            stub = services.LifeCycleStub(self._ensure_channel())
+            resp = stub.Init(
+                pb.InitRequest(
+                    dpu_mode=pb.DPU_MODE_DPU if dpu_mode else pb.DPU_MODE_HOST,
+                    dpu_identifier=identifier,
+                ),
+                timeout=self.RPC_TIMEOUT,
+            )
+            with self._lock:
+                self._initialized = True
+            return resp.ip, resp.port
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.ALREADY_EXISTS:
+                with self._lock:
+                    self._initialized = True
+                return "", 0
+            return None
+
     # -- device service ------------------------------------------------------
 
     def get_devices(self) -> Dict[str, pb.Device]:
