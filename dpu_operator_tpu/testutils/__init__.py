@@ -193,7 +193,7 @@ class KubeletSim:
                 if len(free) < count:
                     self._set_phase(pod, "Pending", f"insufficient {res}")
                     return
-                picked[res] = free[:count]
+                picked[res] = self._preferred(res, free, count)
             for res, devs in picked.items():
                 self._allocated[res][key] = devs
         try:
@@ -219,6 +219,27 @@ class KubeletSim:
             )
         pod = self._client.update(pod)
         self._set_phase(pod, "Running", "")
+
+    def _preferred(self, res: str, free: List[str], count: int) -> List[str]:
+        """Ask the plugin's GetPreferredAllocation like a real kubelet
+        does when the plugin advertises the option."""
+        try:
+            resp = self._stubs[res].GetPreferredAllocation(
+                kdp.PreferredAllocationRequest(
+                    container_requests=[
+                        kdp.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=free, allocation_size=count
+                        )
+                    ]
+                ),
+                timeout=5.0,
+            )
+            chosen = list(resp.container_responses[0].deviceIDs)
+            if len(chosen) == count and set(chosen) <= set(free):
+                return chosen
+        except (grpc.RpcError, IndexError):
+            pass
+        return free[:count]
 
     def _set_phase(self, pod: dict, phase: str, message: str) -> None:
         from ..k8s.store import Conflict, NotFound

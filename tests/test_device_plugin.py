@@ -134,3 +134,58 @@ def test_allocate_healthy_and_unknown(vsp_and_plugin, tmp_root):
         channel.close()
     finally:
         dp.stop()
+
+
+def test_preferred_allocation_prefers_ici_adjacent(tmp_root):
+    """GetPreferredAllocation picks ICI-adjacent chips' endpoints (a
+    TPU-first capability the reference leaves unimplemented)."""
+    from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
+    from dpu_operator_tpu.dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+
+    class TopoVsp:
+        def get_devices(self):
+            devs = {}
+            for dev_id, coords in {
+                "ep-a": "0,0,0",
+                "ep-b": "3,3,0",
+                "ep-c": "0,1,0",
+                "ep-d": "3,2,0",
+            }.items():
+                d = pb.Device(id=dev_id, health=pb.HEALTHY)
+                d.topology.coords = coords
+                devs[dev_id] = d
+            return devs
+
+        def set_num_endpoints(self, n):
+            return n
+
+    from dpu_operator_tpu.daemon.device_plugin import DevicePlugin
+
+    dp = DevicePlugin(TopoVsp(), tmp_root)
+    opts = dp.GetDevicePluginOptions(kdp.Empty(), None)
+    assert opts.get_preferred_allocation_available is True
+
+    req = kdp.PreferredAllocationRequest(
+        container_requests=[
+            kdp.ContainerPreferredAllocationRequest(
+                available_deviceIDs=["ep-a", "ep-b", "ep-c", "ep-d"],
+                must_include_deviceIDs=["ep-a"],
+                allocation_size=2,
+            )
+        ]
+    )
+    resp = dp.GetPreferredAllocation(req, None)
+    # ep-c at (0,1,0) is the ICI neighbour of ep-a at (0,0,0).
+    assert list(resp.container_responses[0].deviceIDs) == ["ep-a", "ep-c"]
+
+    # Without must_include: picks a tight pair deterministically.
+    req2 = kdp.PreferredAllocationRequest(
+        container_requests=[
+            kdp.ContainerPreferredAllocationRequest(
+                available_deviceIDs=["ep-b", "ep-d"],
+                allocation_size=2,
+            )
+        ]
+    )
+    resp2 = dp.GetPreferredAllocation(req2, None)
+    assert set(resp2.container_responses[0].deviceIDs) == {"ep-b", "ep-d"}
