@@ -49,6 +49,7 @@ class ManagedDpu:
     manager: SideManager
     thread: Optional[threading.Thread] = None
     serve_error: Optional[str] = None
+    applied_endpoints: Optional[int] = None
 
 
 class Daemon:
@@ -160,6 +161,7 @@ class Daemon:
                 self._delete_cr(md.detection.cr_name())
 
         self._sync_crs()
+        self._apply_dpu_configs()
         self._update_node_labels()
 
     # -- managed DPU lifecycle ----------------------------------------------
@@ -279,6 +281,44 @@ class Daemon:
         for name in existing:
             if name not in wanted:
                 self._delete_cr(name)
+
+    def _apply_dpu_configs(self) -> None:
+        """DataProcessingUnitConfig CRs: dpuSelector matches the labels of
+        this node's DataProcessingUnit CR → apply spec.numEndpoints via
+        the VSP. The reference ships this CRD as a placeholder
+        (dataprocessingunitconfig_types.go:251-254); here it carries the
+        obvious real knob, fabric endpoint partitioning. Last-applied is
+        tracked per device so the VSP only sees changes."""
+        try:
+            configs = self._client.list(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG, self._namespace
+            )
+        except Exception:
+            return
+        if not configs:
+            return
+        for md in self._managed.values():
+            cr = md.detection.to_cr(self._namespace)
+            labels = cr["metadata"].get("labels", {})
+            for cfg in configs:
+                spec = cfg.get("spec", {})
+                selector = spec.get("dpuSelector", {}) or {}
+                count = spec.get("numEndpoints")
+                if count is None:
+                    continue
+                if not all(labels.get(k) == val for k, val in selector.items()):
+                    continue
+                if md.applied_endpoints == count:
+                    continue
+                try:
+                    md.plugin.set_num_endpoints(int(count))
+                    md.applied_endpoints = int(count)
+                    log.info(
+                        "applied DataProcessingUnitConfig %s: %d endpoints on %s",
+                        cfg["metadata"]["name"], count, md.detection.identifier,
+                    )
+                except Exception:
+                    log.exception("SetNumEndpoints from DPUConfig failed")
 
     def _delete_cr(self, name: str) -> None:
         try:
