@@ -22,7 +22,7 @@ import logging
 from typing import Optional, Tuple
 
 from .. import netlink as nl
-from ..ipam import HostLocalIpam
+from ..ipam import HostLocalIpam, IpamError
 from ..statestore import StateStore
 from ..types import CniError, CniRequest, CniResult
 
@@ -91,9 +91,11 @@ class FabricDataplane:
             from .. import arp
 
             arp.announce(req.ifname, mac, cidr, netns, blocking=False)
-        except (nl.NetlinkError, OSError) as e:
+        except (nl.NetlinkError, OSError, IpamError) as e:
             # Full rollback — never leave a half-plumbed pod (the reference
             # guarantees the same on its move protocol, networkfn.go:36-149).
+            # IpamError included: the veth already exists in the pod netns
+            # when range exhaustion hits.
             self._rollback(host_if, tmp_if, req.ifname, netns, owner)
             nl.release_named_netns(netns, netns_created)
             raise CniError(f"fabric ADD failed: {e}") from e

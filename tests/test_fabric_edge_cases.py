@@ -1,0 +1,127 @@
+"""Fabric dataplane edge cases: idempotent re-ADD (kubelet retries),
+rollback on mid-ADD failure, IPAM exhaustion, DEL idempotency — the
+behaviors the reference guards in sriov.go (NetConf cache, vfReleased
+gate) and networkfn.go (rollback protocol)."""
+
+import subprocess
+import uuid
+
+import pytest
+
+from dpu_operator_tpu.cni.dataplane.fabric import FabricDataplane
+from dpu_operator_tpu.cni.ipam import HostLocalIpam, IpamError
+from dpu_operator_tpu.cni.statestore import StateStore
+from dpu_operator_tpu.cni.types import CniError, CniRequest
+
+
+@pytest.fixture
+def pod_ns(netns):
+    ns = "fe-" + uuid.uuid4().hex[:8]
+    subprocess.run(["ip", "netns", "add", ns], check=True)
+    yield ns
+    subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+@pytest.fixture
+def dataplane(tmp_path):
+    return FabricDataplane(
+        StateStore(str(tmp_path / "state")),
+        HostLocalIpam(str(tmp_path / "ipam"), "10.77.0.0/29"),  # 6 usable hosts (no gw)
+    )
+
+
+def _req(ns, cid=None, command="ADD"):
+    return CniRequest(
+        command=command,
+        container_id=cid or ("fec" + uuid.uuid4().hex[:12]),
+        netns=ns,
+        ifname="net1",
+        config={"cniVersion": "1.0.0", "name": "t", "type": "dpu-cni"},
+    )
+
+
+def test_re_add_is_idempotent(dataplane, pod_ns):
+    """kubelet retries ADD after a timeout; the second ADD must return
+    the SAME result (ip/mac) without double-allocating
+    (reference NetConf disk cache, sriov.go:492-503)."""
+    req = _req(pod_ns)
+    first = dataplane.cmd_add(req)
+    second = dataplane.cmd_add(req)
+    assert first.to_json() == second.to_json()
+    # Only one lease consumed.
+    out = subprocess.run(
+        ["ip", "-n", pod_ns, "-j", "addr", "show", "dev", "net1"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert first.ips[0]["address"].split("/")[0] in out
+    dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
+
+
+def test_add_rolls_back_on_ifname_conflict(dataplane, pod_ns):
+    """If the pod netns already has an interface with the requested name
+    (and no recorded state), the ADD fails and leaves no host-side veth
+    or lease behind."""
+    subprocess.run(
+        ["ip", "-n", pod_ns, "link", "add", "net1", "type", "veth",
+         "peer", "name", "net1p"],
+        check=True,
+    )
+    req = _req(pod_ns)
+    with pytest.raises(CniError):
+        dataplane.cmd_add(req)
+    # No stranded host interface.
+    from dpu_operator_tpu.cni.dataplane.fabric import _host_ifname
+
+    host_if = _host_ifname(req.container_id, "net1")
+    r = subprocess.run(["ip", "link", "show", "dev", host_if], capture_output=True)
+    assert r.returncode != 0, "host veth leaked after rollback"
+    # Lease released: all 6 of the /29's usable leases must still be
+    # allocatable afterwards.
+    for i in range(6):
+        dataplane._ipam.allocate(f"probe{i}")
+
+
+def test_ipam_exhaustion_fails_cleanly(dataplane, netns):
+    """Range exhaustion surfaces as a CNI error and releases nothing it
+    shouldn't (reference ipam delegation failure path, sriov.go:426-487)."""
+    namespaces = []
+    reqs = []
+    try:
+        for i in range(6):  # /29 with no gateway = 6 usable leases
+            ns = "fx%d-" % i + uuid.uuid4().hex[:6]
+            subprocess.run(["ip", "netns", "add", ns], check=True)
+            namespaces.append(ns)
+            req = _req(ns)
+            reqs.append(req)
+            dataplane.cmd_add(req)
+        ns = "fxover-" + uuid.uuid4().hex[:6]
+        subprocess.run(["ip", "netns", "add", ns], check=True)
+        namespaces.append(ns)
+        over = _req(ns)
+        with pytest.raises(CniError, match="exhausted|ADD failed"):
+            dataplane.cmd_add(over)
+        # A DEL frees a lease and the ADD then succeeds.
+        dataplane.cmd_del(_req(reqs[0].netns, reqs[0].container_id, "DEL"))
+        result = dataplane.cmd_add(over)
+        assert result.ips
+        dataplane.cmd_del(_req(ns, over.container_id, "DEL"))
+        for req in reqs[1:]:
+            dataplane.cmd_del(_req(req.netns, req.container_id, "DEL"))
+    finally:
+        for ns in namespaces:
+            subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_del_without_state_is_idempotent(dataplane, pod_ns):
+    result, released = dataplane.cmd_del(_req(pod_ns, command="DEL"))
+    assert released is False  # gates DeleteBridgePort (sriov.go:507-593)
+
+
+def test_del_releases_and_gates(dataplane, pod_ns):
+    req = _req(pod_ns)
+    dataplane.cmd_add(req)
+    _, released = dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
+    assert released is True
+    # Second DEL: idempotent, no release signal.
+    _, released2 = dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
+    assert released2 is False
