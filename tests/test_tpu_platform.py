@@ -148,3 +148,36 @@ def test_converged_tpu_node_full_attach(netns, tmp_root):
         subprocess.run(["ip", "link", "del", bridge], capture_output=True)
         mgr.stop()
         vsp_server.stop()
+
+
+def test_fabric_bridge_enslaves_uplink(netns):
+    """DPU_FABRIC_UPLINK semantics: ensure_bridge attaches the VM's
+    fabric-facing netdev to the bridge so pod traffic rides the ICI
+    uplink (the role of the Marvell SDP/OVS uplink wiring)."""
+    import subprocess
+    import uuid
+
+    from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+    bridge = "brUP" + uuid.uuid4().hex[:6]
+    up_a = "up" + uuid.uuid4().hex[:6]
+    up_b = "ub" + uuid.uuid4().hex[:6]
+    subprocess.run(
+        ["ip", "link", "add", up_a, "type", "veth", "peer", "name", up_b],
+        check=True,
+    )
+    try:
+        dp = TpuFabricDataplane(bridge=bridge, uplink=up_a)
+        dp.ensure_bridge()
+        out = subprocess.run(
+            ["ip", "-j", "link", "show", "dev", up_a],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        import json
+
+        assert json.loads(out)[0].get("master") == bridge, "uplink not enslaved"
+        # Idempotent re-run.
+        dp.ensure_bridge()
+    finally:
+        subprocess.run(["ip", "link", "del", up_a], capture_output=True)
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
