@@ -22,6 +22,12 @@ log = logging.getLogger(__name__)
 NETWORKS_ANNOTATION = "k8s.v1.cni.cncf.io/networks"
 RESOURCE_NAME_ANNOTATION = "k8s.v1.cni.cncf.io/resourceName"
 
+# Control-switches ConfigMap (reference polls it every 30 s,
+# networkresourcesinjector.go:231-245): lets an operator turn resource
+# injection off at runtime without tearing down the webhook.
+CONTROL_SWITCHES_CONFIGMAP = "nri-control-switches"
+CONTROL_SWITCHES_TTL = 30.0
+
 
 def parse_networks(value: str, default_namespace: str) -> List[Tuple[str, str]]:
     """Parse the networks annotation: "name", "ns/name", comma-separated.
@@ -45,6 +51,28 @@ class NetworkResourcesInjector:
     def __init__(self, client: Client, nad_namespace: str = v.NAMESPACE):
         self._client = client
         self._nad_namespace = nad_namespace
+        self._switch_cache: Optional[bool] = None
+        self._switch_checked = 0.0
+
+    def _injection_enabled(self) -> bool:
+        import time
+
+        now = time.monotonic()
+        if self._switch_cache is not None and now - self._switch_checked < CONTROL_SWITCHES_TTL:
+            return self._switch_cache
+        enabled = True
+        try:
+            cm = self._client.get_or_none(
+                "v1", "ConfigMap", self._nad_namespace, CONTROL_SWITCHES_CONFIGMAP
+            )
+            if cm is not None:
+                value = (cm.get("data", {}) or {}).get("resourceInjection", "true")
+                enabled = str(value).lower() != "false"
+        except Exception:
+            log.debug("control-switches lookup failed; injection stays on")
+        self._switch_cache = enabled
+        self._switch_checked = now
+        return enabled
 
     def _nad_resource(self, ns: str, name: str) -> Optional[str]:
         nad = self._client.get_or_none(
@@ -62,6 +90,8 @@ class NetworkResourcesInjector:
     def mutate(self, request: dict) -> Tuple[bool, str, Optional[list]]:
         """AdmissionHandler for /mutate: returns a JSONPatch injecting the
         summed resource requests."""
+        if not self._injection_enabled():
+            return True, "", None
         pod = request.get("object") or {}
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
         networks = annotations.get(NETWORKS_ANNOTATION, "")

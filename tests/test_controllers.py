@@ -215,3 +215,42 @@ def test_nri_passes_through_unannotated_pods():
         {"object": {"metadata": {"name": "p"}, "spec": {"containers": [{}]}}}
     )
     assert allowed and patch is None
+
+
+def test_nri_control_switches_disable_injection():
+    """The nri-control-switches ConfigMap turns injection off at runtime
+    (reference networkresourcesinjector.go:231-245)."""
+    from dpu_operator_tpu.controller.nri import (
+        CONTROL_SWITCHES_CONFIGMAP,
+        NetworkResourcesInjector,
+    )
+
+    client = InMemoryClient(InMemoryCluster())
+    client.create({
+        "apiVersion": "k8s.cni.cncf.io/v1",
+        "kind": "NetworkAttachmentDefinition",
+        "metadata": {
+            "name": "dpunfcni-conf",
+            "namespace": v.NAMESPACE,
+            "annotations": {"k8s.v1.cni.cncf.io/resourceName": v.DPU_RESOURCE_NAME},
+        },
+    })
+    pod = {
+        "metadata": {
+            "name": "p", "namespace": "default",
+            "annotations": {"k8s.v1.cni.cncf.io/networks": "dpunfcni-conf"},
+        },
+        "spec": {"containers": [{"name": "c", "image": "i"}]},
+    }
+    injector = NetworkResourcesInjector(client)
+    ok, _, patch = injector.mutate({"object": pod})
+    assert ok and patch, "baseline injection should produce a patch"
+
+    client.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": CONTROL_SWITCHES_CONFIGMAP, "namespace": v.NAMESPACE},
+        "data": {"resourceInjection": "false"},
+    })
+    injector2 = NetworkResourcesInjector(client)  # fresh cache
+    ok, _, patch = injector2.mutate({"object": pod})
+    assert ok and patch is None, "injection should be switched off"
