@@ -1,0 +1,87 @@
+"""Pallas MXU burn kernel — the hot op of the chip-health probe.
+
+The jnp version in fabric_probe.burn_step leaves scheduling to XLA; this
+kernel pins the shape the hardware wants: 128×128 output tiles (one MXU
+systolic pass each), bf16 operands resident in VMEM, f32 accumulation,
+VPU tanh on the accumulator before writeback. The health probe's goal is
+to saturate the MXU and touch every VMEM lane deterministically, so a
+hand-tiled kernel is the honest tool (pallas_guide.md: Grid/BlockSpec +
+dot patterns).
+
+Falls back to interpret mode off-TPU (CPU tests) and composes with the
+same lax.scan chain as the jnp path."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable everywhere but only usable on TPU backends
+    from jax.experimental.pallas import tpu as pltpu
+
+    _MEMSPACE = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _MEMSPACE = None
+
+TILE = 128
+
+
+def _burn_kernel(x_ref, w_ref, o_ref):
+    acc = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    o_ref[:] = jnp.tanh(acc).astype(o_ref.dtype)
+
+
+def _block_specs(k: int):
+    kwargs = {"memory_space": _MEMSPACE} if _MEMSPACE is not None else {}
+    return (
+        [
+            pl.BlockSpec((TILE, k), lambda i, j: (i, 0), **kwargs),
+            pl.BlockSpec((k, TILE), lambda i, j: (0, j), **kwargs),
+        ],
+        pl.BlockSpec((TILE, TILE), lambda i, j: (i, j), **kwargs),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def burn_step_pallas(x: jax.Array, w: jax.Array, interpret: bool = False) -> jax.Array:
+    """Eight chained tiled matmul+tanh passes; same contract as
+    fabric_probe.burn_step (f32 scalar health signature)."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2 and m % TILE == 0 and n % TILE == 0, "tile-aligned shapes only"
+    in_specs, out_spec = _block_specs(k)
+    matmul = pl.pallas_call(
+        _burn_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+        grid=(m // TILE, n // TILE),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        interpret=interpret,
+    )
+
+    def body(h, _):
+        return matmul(h, w), ()
+
+    h, _ = jax.lax.scan(body, x.astype(jnp.bfloat16), None, length=8)
+    return jnp.sum(h.astype(jnp.float32) ** 2)
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def best_burn_step():
+    """The burn implementation for this backend: the pallas kernel on
+    TPU, the XLA-scheduled jnp version elsewhere."""
+    if on_tpu():
+        return functools.partial(burn_step_pallas, interpret=False)
+    from .fabric_probe import burn_step
+
+    return burn_step

@@ -69,3 +69,29 @@ def test_bench_json_contract():
     assert set(data) == {"metric", "value", "unit", "vs_baseline"}
     assert data["metric"] == "pod_attach_p50"
     assert data["value"] > 0
+
+
+def test_pallas_burn_matches_jnp_in_interpret_mode():
+    """The pallas MXU burn kernel agrees with the XLA-scheduled version
+    (run via the interpreter on CPU, pallas_guide.md interpret mode)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax.numpy as jnp, jax\n"
+            "from dpu_operator_tpu.parallel.pallas_burn import burn_step_pallas\n"
+            "from dpu_operator_tpu.parallel.fabric_probe import burn_step\n"
+            "k1, k2 = jax.random.split(jax.random.PRNGKey(3))\n"
+            "x = jax.random.normal(k1, (256, 256), dtype=jnp.bfloat16)\n"
+            "w = jax.random.normal(k2, (256, 256), dtype=jnp.bfloat16) * 0.05\n"
+            "a = float(burn_step_pallas(x, w, interpret=True))\n"
+            "b = float(burn_step(x, w))\n"
+            "assert abs(a - b) / max(abs(b), 1e-6) < 0.05, (a, b)\n"
+            "print('ok', a, b)\n"
+        ) % REPO],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
