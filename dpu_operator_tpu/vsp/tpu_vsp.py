@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Dict, Optional
 
 import grpc
@@ -49,6 +50,8 @@ class TpuVsp(
     services.HeartbeatServicer,
     services.BridgePortServicer,
 ):
+    DEEP_HEALTH_TTL = 60.0
+
     def __init__(
         self,
         topology: Optional[SliceTopology] = None,
@@ -65,6 +68,8 @@ class TpuVsp(
         self._lock = threading.Lock()
         self._num_endpoints = num_endpoints
         self._initialized = False
+        self._deep_health_cache = None
+        self._deep_health_at = 0.0
 
     # -- LifeCycle -----------------------------------------------------------
 
@@ -141,6 +146,52 @@ class TpuVsp(
         return pb.PingResponse(healthy=healthy)
 
     def _chip_health(self, n_local: int) -> Dict[int, bool]:
+        deep = self._deep_health()
+        agent = self._agent_health()
+        if deep is None:
+            return agent
+        return {i: agent.get(i, True) and deep.get(i, True) for i in
+                set(agent) | set(deep)} or deep
+
+    def _deep_health(self) -> Optional[Dict[int, bool]]:
+        """Opt-in (DPU_DEEP_HEALTH=1): run the MXU burn probe on the local
+        backend and gate health on a finite signature — the compute-path
+        equivalent of the OCTEON agent's mailbox liveness, cached for
+        DEEP_HEALTH_TTL so GetDevices polling stays cheap."""
+        if os.environ.get("DPU_DEEP_HEALTH") != "1":
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if self._deep_health_cache is not None and (
+                now - self._deep_health_at < self.DEEP_HEALTH_TTL
+            ):
+                return self._deep_health_cache
+        result: Dict[int, bool] = {}
+        try:
+            import math
+
+            from ..parallel.fabric_probe import burn_example_args
+            from ..parallel.pallas_burn import best_burn_step
+
+            import jax
+
+            fn = best_burn_step()
+            args = burn_example_args()
+            for i, dev in enumerate(jax.local_devices()):
+                try:
+                    sig = float(jax.device_put(fn(*[jax.device_put(a, dev) for a in args])))
+                    result[i] = math.isfinite(sig)
+                except Exception:
+                    result[i] = False
+        except Exception:
+            log.debug("deep health probe unavailable; skipping")
+            result = {}
+        with self._lock:
+            self._deep_health_cache = result
+            self._deep_health_at = now
+        return result
+
+    def _agent_health(self) -> Dict[int, bool]:
         if self._cp_agent is None:
             return {}
         try:
