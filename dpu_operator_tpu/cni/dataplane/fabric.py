@@ -158,6 +158,26 @@ class FabricDataplane:
         state = self._store.load(container_id, ifname)
         return state.get("hostIf") if state else None
 
+    def cmd_check(self, req: CniRequest) -> dict:
+        """CNI CHECK: verify the attachment still matches recorded state
+        (interface present in the pod netns, host end present). The spec
+        requires an error when the container's resources are gone."""
+        state = self._store.load(req.container_id, req.ifname)
+        if state is None:
+            raise CniError(
+                f"no recorded attachment for {req.container_id}/{req.ifname}", code=4
+            )
+        netns, netns_created = nl.ensure_named_netns(req.netns or state["netns"])
+        try:
+            if not nl.link_exists(req.ifname, netns):
+                raise CniError(f"{req.ifname} missing from pod netns", code=7)
+            host_if = state.get("hostIf", "")
+            if host_if and not nl.link_exists(host_if):
+                raise CniError(f"host interface {host_if} missing", code=7)
+        finally:
+            nl.release_named_netns(netns, netns_created)
+        return {}
+
     # -- internals -----------------------------------------------------------
 
     def _result_from_state(self, state: dict) -> CniResult:

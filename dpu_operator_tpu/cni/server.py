@@ -75,20 +75,31 @@ class CniServer:
         self._pm = pm
         self._add_handler: Optional[CniHandler] = None
         self._del_handler: Optional[CniHandler] = None
+        self._check_handler: Optional[CniHandler] = None
         self._locks = _KeyedLocks()
         self._server: Optional[_UnixHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    def set_handlers(self, add: CniHandler, delete: CniHandler) -> None:
+    def set_handlers(
+        self,
+        add: CniHandler,
+        delete: CniHandler,
+        check: Optional[CniHandler] = None,
+    ) -> None:
         self._add_handler = add
         self._del_handler = delete
+        self._check_handler = check
 
     @property
     def socket_path(self) -> str:
         return self._socket_path
 
     def handle(self, req: CniRequest) -> Tuple[int, dict]:
-        handler = {"ADD": self._add_handler, "DEL": self._del_handler}.get(req.command)
+        handler = {
+            "ADD": self._add_handler,
+            "DEL": self._del_handler,
+            "CHECK": self._check_handler,
+        }.get(req.command)
         if handler is None:
             if req.command in ("CHECK", "VERSION"):
                 return 200, {}

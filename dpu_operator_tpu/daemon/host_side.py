@@ -59,7 +59,9 @@ class HostSideManager:
         ipam = HostLocalIpam(self._pm.cni_state_dir(), pod_cidr)
         self.dataplane = FabricDataplane(state, ipam)
         self.cni_server = CniServer(self._pm)
-        self.cni_server.set_handlers(self._cni_add, self._cni_del)
+        self.cni_server.set_handlers(
+            self._cni_add, self._cni_del, check=self._cni_check
+        )
         self.device_plugin = DevicePlugin(
             vendor_plugin, self._pm, require_pci_ids=False
         )
@@ -132,6 +134,9 @@ class HostSideManager:
             self.dataplane.cmd_del(req)
             raise CniError(f"CreateBridgePort({port_name}) failed: {e.code()}") from e
         return result.to_json()
+
+    def _cni_check(self, req: CniRequest) -> dict:
+        return self.dataplane.cmd_check(req)
 
     def _cni_del(self, req: CniRequest) -> dict:
         result, released = self.dataplane.cmd_del(req)

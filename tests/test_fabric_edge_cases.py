@@ -125,3 +125,19 @@ def test_del_releases_and_gates(dataplane, pod_ns):
     # Second DEL: idempotent, no release signal.
     _, released2 = dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
     assert released2 is False
+
+
+def test_cni_check_semantics(dataplane, pod_ns):
+    """CHECK passes on an intact attachment, errors after teardown or for
+    unknown containers (CNI spec; reference forwards CHECK as no-op —
+    this is the stronger implementation)."""
+    req = _req(pod_ns)
+    dataplane.cmd_add(req)
+    assert dataplane.cmd_check(_req(pod_ns, req.container_id, "CHECK")) == {}
+    # Break the attachment: remove the pod interface.
+    subprocess.run(["ip", "-n", pod_ns, "link", "del", "net1"], check=True)
+    with pytest.raises(CniError, match="missing"):
+        dataplane.cmd_check(_req(pod_ns, req.container_id, "CHECK"))
+    dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
+    with pytest.raises(CniError, match="no recorded attachment"):
+        dataplane.cmd_check(_req(pod_ns, req.container_id, "CHECK"))
