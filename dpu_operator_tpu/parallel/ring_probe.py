@@ -1,0 +1,147 @@
+"""ICI ring-bandwidth probe — pallas remote-DMA all-gather.
+
+The sp-axis counterpart of the MXU burn: moves real bytes over each ICI
+ring hop so link bandwidth (and link death) is observable per hop. On a
+multi-chip TPU backend the transfer is a pallas kernel driving
+`make_async_remote_copy` around the logical ring (pallas_guide.md
+"Patterns: Ring Collectives" — double-buffered comm slots, send/recv
+semaphore pairs, neighbour barrier); everywhere else (CPU tests, the
+driver's virtual mesh, single-chip) it falls back to XLA's all_gather,
+which has identical semantics.
+
+`measure_ring_bandwidth` returns per-round wall time and an effective
+GB/s figure the traffic-flow harness can sanity-check against the
+topology's `bisection_gbps`."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+def _ring_kernel(my_id_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem):
+    """Per-device ring all-gather body (guide pattern): each step RDMAs
+    our current slot to the right neighbour while recording the chunk
+    that arrived from the left."""
+    num_devices = out_ref.shape[0] // local_ref.shape[0]
+    chunk = local_ref.shape[0]
+    my_id = my_id_ref[0]
+
+    out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
+    comm_buf[0] = local_ref[:]
+
+    def step_body(step, _):
+        send_slot = jax.lax.rem(step, 2)
+        recv_slot = jax.lax.rem(step + 1, 2)
+        dst = jax.lax.rem(my_id + 1, num_devices)
+        src = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[send_slot],
+            dst_ref=comm_buf.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        out_ref[pl.ds(src * chunk, chunk)] = comm_buf[recv_slot]
+        return ()
+
+    jax.lax.fori_loop(0, num_devices - 1, step_body, ())
+
+
+def _pallas_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
+    chunk, width = x_shard.shape
+    my_id = jax.lax.axis_index(axis).reshape((1,)).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, width), x_shard.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _ring_kernel,
+        out_shape=jax.ShapeDtypeStruct((axis_size * chunk, width), x_shard.dtype),
+        grid_spec=grid_spec,
+    )(my_id, x_shard)
+
+
+def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
+    return jax.lax.all_gather(x_shard, axis, tiled=True)
+
+
+def make_ring_all_gather(mesh, axis: str = "sp", use_pallas: Optional[bool] = None):
+    """jitted fn: sharded [N, W] over `axis` → fully gathered [N, W] on
+    every shard. Chooses the pallas RDMA ring on multi-chip TPU meshes,
+    XLA all_gather otherwise (or per `use_pallas`)."""
+    from jax.experimental.shard_map import shard_map
+
+    axis_size = mesh.shape[axis]
+    if use_pallas is None:
+        use_pallas = (
+            pltpu is not None
+            and axis_size > 1
+            and all(d.platform == "tpu" for d in mesh.devices.flat)
+        )
+    inner = _pallas_all_gather if use_pallas else _xla_all_gather
+
+    spec_axes = tuple(axis if i == 0 else None for i in range(2))
+    mapped = shard_map(
+        functools.partial(inner, axis=axis, axis_size=axis_size),
+        mesh=mesh,
+        in_specs=P(*spec_axes),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+def measure_ring_bandwidth(
+    mesh,
+    axis: str = "sp",
+    mbytes: int = 16,
+    rounds: int = 4,
+    use_pallas: Optional[bool] = None,
+) -> dict:
+    """Time repeated ring all-gathers of an `mbytes` payload; returns
+    {"seconds_per_round", "effective_gbps", "axis_size"}. On a slice the
+    bytes cross every ring hop, so a slow/dead link shows up directly."""
+    import time
+
+    axis_size = mesh.shape[axis]
+    width = 512
+    rows = max(axis_size, (mbytes * 1024 * 1024) // (4 * width))
+    rows -= rows % axis_size or 0
+    rows = max(rows, axis_size)
+    x = jnp.ones((rows, width), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+    fn = make_ring_all_gather(mesh, axis, use_pallas=use_pallas)
+    fn(x).block_until_ready()  # compile
+    start = time.perf_counter()
+    for _ in range(rounds):
+        out = fn(x)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / rounds
+    moved_bytes = x.nbytes * (axis_size - 1) / max(axis_size, 1)
+    return {
+        "seconds_per_round": elapsed,
+        "effective_gbps": (moved_bytes * 8 / elapsed / 1e9) if elapsed else 0.0,
+        "axis_size": axis_size,
+    }

@@ -1,0 +1,92 @@
+"""ICI ring-bandwidth probe (parallel/ring_probe.py): XLA fallback
+correctness on the virtual 8-device mesh, pallas kernel execution on the
+live TPU backend, and a pure-python simulation of the ring schedule for
+the multi-chip step logic that needs hardware this environment lacks."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_schedule_covers_all_chunks():
+    """Simulate the kernel's step arithmetic for rings of 2..8 devices:
+    after num_devices-1 steps every device has every chunk exactly once
+    in the right slot."""
+    for n in range(2, 9):
+        # comm[d] mirrors each device's double-buffered slot contents;
+        # out[d] the output chunks.
+        out = {d: {d} for d in range(n)}
+        slot = {d: d for d in range(n)}  # payload currently in the live slot
+        for step in range(n - 1):
+            # All devices send concurrently: dst receives src's live slot.
+            incoming = {}
+            for d in range(n):
+                dst = (d + 1) % n
+                incoming[dst] = slot[d]
+            for d in range(n):
+                src_expected = (d - step - 1) % n
+                assert incoming[d] == src_expected, (
+                    f"n={n} step={step}: device {d} got chunk {incoming[d]}, "
+                    f"kernel records it as {src_expected}"
+                )
+                out[d].add(incoming[d])
+            slot = incoming
+        for d in range(n):
+            assert out[d] == set(range(n)), f"device {d} missing chunks"
+
+
+def test_xla_fallback_all_gather_correct():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "from dpu_operator_tpu.parallel.mesh import build_mesh\n"
+            "from dpu_operator_tpu.parallel.ring_probe import "
+            "make_ring_all_gather, measure_ring_bandwidth\n"
+            "mesh = build_mesh(n_devices=8)\n"
+            "x = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)\n"
+            "xs = jax.device_put(x, NamedSharding(mesh, P('sp', None)))\n"
+            "out = make_ring_all_gather(mesh, 'sp')(xs)\n"
+            "np.testing.assert_array_equal(np.asarray(out), np.asarray(x))\n"
+            "r = measure_ring_bandwidth(mesh, mbytes=1, rounds=2)\n"
+            "assert r['effective_gbps'] > 0 and r['axis_size'] == 2\n"
+            "print('ok')\n"
+        ) % REPO],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_pallas_ring_kernel_runs_on_tpu_backend():
+    """The pallas RDMA kernel compiles and executes on the live TPU
+    backend (ring of size 1 on a single chip; multi-chip rings exercise
+    the same code with real remote copies)."""
+    try:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            pytest.skip("no TPU backend")
+    except Exception:
+        pytest.skip("jax unavailable")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.mesh import build_mesh
+    from dpu_operator_tpu.parallel.ring_probe import make_ring_all_gather
+
+    mesh = build_mesh(n_devices=1)
+    fn = make_ring_all_gather(mesh, "sp", use_pallas=True)
+    x = jnp.arange(8 * 512, dtype=jnp.float32).reshape(8, 512)
+    xs = jax.device_put(x, NamedSharding(mesh, P("sp", None)))
+    np.testing.assert_array_equal(np.asarray(fn(xs)), np.asarray(x))
