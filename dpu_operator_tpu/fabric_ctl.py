@@ -140,6 +140,30 @@ def cmd_topology(args, chan):
     print(json.dumps(topo.to_dict(), indent=2))
 
 
+def cmd_probe(args, chan):
+    """Run the compute + ring probes on the local backend (the deep
+    health checks the tpuvsp runs, on demand)."""
+    import math
+
+    from .parallel.fabric_probe import burn_example_args
+    from .parallel.mesh import build_mesh
+    from .parallel.pallas_burn import best_burn_step
+    from .parallel.ring_probe import measure_ring_bandwidth
+
+    import jax
+
+    fn = best_burn_step()
+    sig = float(fn(*burn_example_args()))
+    mesh = build_mesh()
+    ring = measure_ring_bandwidth(mesh, mbytes=args.mbytes, rounds=args.rounds)
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "burn_signature_finite": math.isfinite(sig),
+        "ring": ring,
+    }))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fabric-ctl", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -162,6 +186,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("del-nf"); p.add_argument("mac0"); p.add_argument("mac1")
     p.set_defaults(fn=cmd_del_nf)
     p = sub.add_parser("topology"); p.set_defaults(fn=cmd_topology)
+    p = sub.add_parser("probe"); p.add_argument("--mbytes", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=4); p.set_defaults(fn=cmd_probe)
 
     args = ap.parse_args(argv)
     chan = _channel(args)
