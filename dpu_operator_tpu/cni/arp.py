@@ -44,18 +44,12 @@ def announce(ifname: str, mac: str, cidr: str, netns: Optional[str] = None,
     """Send `count` gratuitous ARPs for `cidr`'s address out of `ifname`
     (inside `netns` when given). Returns False on any failure.
 
-    With blocking=False the send runs on a background thread: an
-    AF_PACKET socket teardown costs 4-8 ms of RCU synchronisation in the
-    kernel, and the announce is best-effort — no reason to hold the CNI
-    ADD response for it."""
-    if not blocking:
-        import threading
-
-        threading.Thread(
-            target=announce, args=(ifname, mac, cidr, netns, count, True),
-            daemon=True, name=f"garp-{ifname}",
-        ).start()
-        return True
+    The send itself is always synchronous — it costs microseconds and the
+    caller may unmount the netns bind right after we return, so a
+    deferred send would race the teardown and silently no-op. What
+    blocking=False defers is only the AF_PACKET socket *close* (4-8 ms of
+    RCU synchronisation in the kernel): the frames are already on the
+    wire by then, so the latency win is kept without the race."""
     try:
         mac_raw = bytes.fromhex(mac.replace(":", ""))
         ip_raw = socket.inet_aton(cidr.split("/")[0])
@@ -67,7 +61,14 @@ def announce(ifname: str, mac: str, cidr: str, netns: Optional[str] = None,
                 for _ in range(count):
                     s.send(frame)
             finally:
-                s.close()
+                if blocking:
+                    s.close()
+                else:
+                    import threading
+
+                    threading.Thread(
+                        target=s.close, daemon=True, name=f"garp-close-{ifname}"
+                    ).start()
         return True
     except Exception as e:
         log.debug("GARP on %s failed (non-fatal): %s", ifname, e)

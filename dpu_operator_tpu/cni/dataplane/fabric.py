@@ -154,6 +154,35 @@ class FabricDataplane:
         except nl.NetlinkError:
             log.warning("deferred delete of %s failed", name)
 
+    @staticmethod
+    def sweep_doomed() -> int:
+        """Delete leftover doomed-rename links ('d' + 12 hex) from a prior
+        daemon that exited before its deferred destroys ran; otherwise the
+        veth pairs leak permanently. Called on dataplane startup."""
+        swept = 0
+        try:
+            links = nl.list_links()
+        except (nl.NetlinkError, OSError) as e:
+            # OSError: `ip` binary absent (rtnetlink-fastpath-only images) —
+            # the sweep is best-effort, never block daemon startup on it.
+            log.debug("doomed sweep skipped: %s", e)
+            return 0
+        for link in links:
+            name = link.get("ifname", "")
+            if (
+                len(name) == 13
+                and name[0] == "d"
+                and all(c in "0123456789abcdef" for c in name[1:])
+            ):
+                try:
+                    nl.delete_link(name)
+                    swept += 1
+                except nl.NetlinkError:
+                    pass
+        if swept:
+            log.info("swept %d leftover doomed link(s) from a prior run", swept)
+        return swept
+
     def host_interface(self, container_id: str, ifname: str) -> Optional[str]:
         state = self._store.load(container_id, ifname)
         return state.get("hostIf") if state else None

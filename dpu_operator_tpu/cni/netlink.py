@@ -144,6 +144,12 @@ def get_mac(name: str, netns: Optional[str] = None) -> str:
     return get_link(name, netns).get("address", "")
 
 
+def list_links(netns: Optional[str] = None) -> List[dict]:
+    """All links in the (current or named) netns, `ip -j link show` shape.
+    CLI-only — used by startup sweeps, never on the attach hot path."""
+    return json.loads(_run(["-j", "link", "show"], netns))
+
+
 def move_link_to_netns(name: str, netns: str) -> None:
     if _fastpath(_fast.move_link_to_netns, name, netns):
         return

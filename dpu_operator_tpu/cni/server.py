@@ -37,6 +37,10 @@ class _UnixHTTPServer(socketserver.ThreadingMixIn, socketserver.TCPServer):
     address_family = socket.AF_UNIX
     allow_reuse_address = True
     daemon_threads = True
+    # TCPServer's default backlog of 5 overflows under a burst of
+    # concurrent shim connections (kubelet parallel pod sandbox setup)
+    # and refused clients see EAGAIN on a unix socket.
+    request_queue_size = 128
 
     def server_bind(self):
         os.makedirs(os.path.dirname(self.server_address), exist_ok=True)

@@ -10,11 +10,13 @@ tests and the daemon itself."""
 
 from __future__ import annotations
 
+import errno
 import http.client
 import json
 import os
 import socket
 import sys
+import time
 from typing import Optional
 
 from .types import CniError, CniRequest
@@ -26,9 +28,26 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
         self._socket_path = socket_path
 
     def connect(self):
-        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self.sock.settimeout(self.timeout)
-        self.sock.connect(self._socket_path)
+        # A short retry absorbs transient accept-backlog overflow
+        # (EAGAIN/ECONNREFUSED) during daemon restart or attach bursts;
+        # kubelet's own CNI budget is 2 min, so 2 s of patience is free.
+        deadline = time.monotonic() + 2.0
+        delay = 0.02
+        while True:
+            self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self.sock.settimeout(self.timeout)
+            try:
+                self.sock.connect(self._socket_path)
+                return
+            except OSError as e:
+                self.sock.close()
+                if (
+                    e.errno not in (errno.EAGAIN, errno.ECONNREFUSED, errno.ENOENT)
+                    or time.monotonic() > deadline
+                ):
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 0.25)
 
 
 def do_cni(socket_path: str, req: CniRequest, timeout: float = 125.0) -> dict:

@@ -203,12 +203,25 @@ class Daemon:
 
                     drainer = Drainer(self._client)
                     try:
+                        # Honor dpu.tpu.io/no-evict for the full drain
+                        # budget; escalate to force only once the deadline
+                        # passes, loudly — a silent force=True would make
+                        # the safety annotation dead code.
                         deadline = _time.monotonic() + 60
-                        while not drainer.drain_node(det.node_name, force=True):
+                        force = False
+                        while not drainer.drain_node(det.node_name, force=force):
                             if _time.monotonic() > deadline:
-                                raise RuntimeError(
-                                    f"drain of {det.node_name} did not complete"
+                                if force:
+                                    raise RuntimeError(
+                                        f"drain of {det.node_name} did not complete"
+                                    )
+                                log.warning(
+                                    "drain of %s blocked past deadline "
+                                    "(no-evict pods?); escalating to force",
+                                    det.node_name,
                                 )
+                                force = True
+                                deadline = _time.monotonic() + 30
                             _time.sleep(0.5)
                         manager.setup_devices()
                     finally:

@@ -58,6 +58,9 @@ class HostSideManager:
         state = StateStore(self._pm.cni_state_dir())
         ipam = HostLocalIpam(self._pm.cni_state_dir(), pod_cidr)
         self.dataplane = FabricDataplane(state, ipam)
+        # A prior daemon may have died between the fast-DEL rename and the
+        # deferred destroy; reclaim those links before serving CNI.
+        FabricDataplane.sweep_doomed()
         self.cni_server = CniServer(self._pm)
         self.cni_server.set_handlers(
             self._cni_add, self._cni_del, check=self._cni_check

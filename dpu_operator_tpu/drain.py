@@ -49,18 +49,22 @@ class Drainer:
             self._client.update(node)
             log.info("drain: cordoned %s", node_name)
         pods = self._fabric_pods_on_node(node_name)
+        blocked = False
         for pod in pods:
             meta = pod["metadata"]
             if not force and meta.get("annotations", {}).get(
                 "dpu.tpu.io/no-evict"
             ) == "true":
+                # Skip, don't bail: the other evictable pods should drain
+                # during the polite window instead of queueing behind this one.
                 log.warning("drain: %s/%s refuses eviction", meta.get("namespace"), meta["name"])
-                return False
+                blocked = True
+                continue
             self._client.delete_if_exists(
                 "v1", "Pod", meta.get("namespace"), meta["name"]
             )
             log.info("drain: evicted %s/%s", meta.get("namespace"), meta["name"])
-        return len(self._fabric_pods_on_node(node_name)) == 0
+        return not blocked and len(self._fabric_pods_on_node(node_name)) == 0
 
     def complete_drain_node(self, node_name: str) -> bool:
         """Uncordon (reference CompleteDrainNode)."""

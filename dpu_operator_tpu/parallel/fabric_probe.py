@@ -141,14 +141,14 @@ def _probe_step_shardmapped(params, batch):
 
 def make_probe_train_step(mesh):
     """The jitted full fabric-validation step over `mesh`."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mapped = shard_map(
         _probe_step_shardmapped,
         mesh=mesh,
         in_specs=(PARAM_SPEC, P("dp", "sp", None)),
         out_specs=(PARAM_SPEC, P()),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(mapped)
 

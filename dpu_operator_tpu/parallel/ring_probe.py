@@ -30,13 +30,34 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def _ring_kernel(my_id_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem):
+def _ring_kernel(
+    n_axes, my_id_ref, right_ref, left_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem
+):
     """Per-device ring all-gather body (guide pattern): each step RDMAs
     our current slot to the right neighbour while recording the chunk
-    that arrived from the left."""
+    that arrived from the left.
+
+    Neighbours are addressed with `DeviceIdType.MESH` coordinates spanning
+    every mesh axis (only the ring axis differs from our own coords), so
+    the ring stays on the sp axis even when the mesh also has dp/tp axes —
+    LOGICAL ids would index the full flattened mesh and target the wrong
+    chip on any multi-axis mesh."""
     num_devices = out_ref.shape[0] // local_ref.shape[0]
     chunk = local_ref.shape[0]
     my_id = my_id_ref[0]
+    right = tuple(right_ref[i] for i in range(n_axes))
+    left = tuple(left_ref[i] for i in range(n_axes))
+
+    # Neighbour barrier: both ring neighbours must have entered the kernel
+    # (comm slots live) before any RDMA is allowed to land in them.
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_wait(barrier, 2)
 
     out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
     comm_buf[0] = local_ref[:]
@@ -44,15 +65,14 @@ def _ring_kernel(my_id_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem):
     def step_body(step, _):
         send_slot = jax.lax.rem(step, 2)
         recv_slot = jax.lax.rem(step + 1, 2)
-        dst = jax.lax.rem(my_id + 1, num_devices)
         src = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[send_slot],
             dst_ref=comm_buf.at[recv_slot],
             send_sem=send_sem.at[send_slot],
             recv_sem=recv_sem.at[recv_slot],
-            device_id=dst,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
         rdma.wait()
@@ -62,11 +82,19 @@ def _ring_kernel(my_id_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem):
     jax.lax.fori_loop(0, num_devices - 1, step_body, ())
 
 
-def _pallas_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
+def _pallas_all_gather(
+    x_shard: jax.Array, axis: str, axis_size: int, axis_names: tuple
+) -> jax.Array:
     chunk, width = x_shard.shape
-    my_id = jax.lax.axis_index(axis).reshape((1,)).astype(jnp.int32)
+    ring_pos = axis_names.index(axis)
+    my_id = jax.lax.axis_index(axis)
+    coords = [jax.lax.axis_index(n) for n in axis_names]
+    right = list(coords)
+    right[ring_pos] = jax.lax.rem(my_id + 1, axis_size)
+    left = list(coords)
+    left[ring_pos] = jax.lax.rem(my_id - 1 + axis_size, axis_size)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=3,
         grid=(1,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -77,10 +105,16 @@ def _pallas_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Arr
         ],
     )
     return pl.pallas_call(
-        _ring_kernel,
+        functools.partial(_ring_kernel, len(axis_names)),
         out_shape=jax.ShapeDtypeStruct((axis_size * chunk, width), x_shard.dtype),
         grid_spec=grid_spec,
-    )(my_id, x_shard)
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        x_shard,
+    )
 
 
 def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
@@ -91,7 +125,7 @@ def make_ring_all_gather(mesh, axis: str = "sp", use_pallas: Optional[bool] = No
     """jitted fn: sharded [N, W] over `axis` → fully gathered [N, W] on
     every shard. Chooses the pallas RDMA ring on multi-chip TPU meshes,
     XLA all_gather otherwise (or per `use_pallas`)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     axis_size = mesh.shape[axis]
     if use_pallas is None:
@@ -100,15 +134,23 @@ def make_ring_all_gather(mesh, axis: str = "sp", use_pallas: Optional[bool] = No
             and axis_size > 1
             and all(d.platform == "tpu" for d in mesh.devices.flat)
         )
-    inner = _pallas_all_gather if use_pallas else _xla_all_gather
+    if use_pallas:
+        inner = functools.partial(
+            _pallas_all_gather,
+            axis=axis,
+            axis_size=axis_size,
+            axis_names=tuple(mesh.axis_names),
+        )
+    else:
+        inner = functools.partial(_xla_all_gather, axis=axis, axis_size=axis_size)
 
     spec_axes = tuple(axis if i == 0 else None for i in range(2))
     mapped = shard_map(
-        functools.partial(inner, axis=axis, axis_size=axis_size),
+        inner,
         mesh=mesh,
         in_specs=P(*spec_axes),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(mapped)
 

@@ -7,10 +7,12 @@
 // the CNI plugin convention. Kept dependency-free (raw sockets, no
 // libcurl) so the binary copies cleanly onto any host.
 
+#include <cerrno>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -105,9 +107,22 @@ int http_post_unix(const std::string& socket_path, const std::string& body_in,
     return -1;
   }
   std::strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
-  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+  // Retry transient connect failures (accept-backlog overflow during an
+  // attach burst, daemon restart) for ~2 s; kubelet's CNI budget is 2 min.
+  int delay_ms = 20;
+  for (int elapsed_ms = 0;;) {
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) break;
+    if ((errno != EAGAIN && errno != ECONNREFUSED && errno != ENOENT) ||
+        elapsed_ms >= 2000) {
+      close(fd);
+      return -1;
+    }
+    usleep(static_cast<useconds_t>(delay_ms) * 1000);
+    elapsed_ms += delay_ms;
+    delay_ms = std::min(delay_ms * 2, 250);
     close(fd);
-    return -1;
+    fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
   }
   std::ostringstream req;
   req << "POST /cni HTTP/1.1\r\n"
