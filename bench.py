@@ -1,10 +1,10 @@
 #!/usr/bin/env python3
-"""Benchmark: pod-attach p50 latency through the full CNI control path.
+"""Benchmark suite: control-path latency + on-chip TPU compute numbers.
 
-The headline metric from BASELINE.md: time from CNI ADD (the JSON POST the
-kubelet-invoked shim makes) to interface-plumbed-and-fabric-attached — the
-"forward pass" of this system (SURVEY.md §3.3). The measured path crosses
-every process boundary the reference crosses:
+Metric 1 (headline) — pod-attach p50: time from CNI ADD (the JSON POST
+the kubelet-invoked shim makes) to interface-plumbed-and-fabric-attached,
+the "forward pass" of this system (SURVEY.md §3.3). The measured path
+crosses every process boundary the reference crosses:
 
     shim HTTP client → unix-socket CNI server → request parse/serialize
     → host fabric dataplane (real veth+netns when run as root, recording
@@ -13,14 +13,22 @@ every process boundary the reference crosses:
 
 then a CNI DEL tears it down so each sample is a full attach/detach cycle.
 
-vs_baseline: the reference publishes no latency numbers (BASELINE.md); the
-only per-request bound it encodes is the 2-minute CNI request budget
-matching the kubelet CRI timeout (reference dpu-cni/pkgs/cniserver/
-cniserver.go:208), within which it serializes all requests under a global
-mutex. vs_baseline = 120000 ms / p50 ms — how many times under the
-reference's per-request budget one full attach completes.
+Metrics 2+ — the chip the operator manages (parallel/bench_tpu.py, run in
+a timeout-guarded subprocess): sustained MXU bf16 TFLOP/s for the pallas
+K-blocked matmul vs the XLA-scheduled jnp matmul (+ % of v5e peak), HBM
+stream bandwidth, and — when >1 device — the ICI ring-probe figure. Plus
+the sp-ring all-gather on the 8-device virtual CPU mesh as a functional
+cross-check. The reference publishes no numbers for any of these
+(BASELINE.md) — harness only — so every value here is self-measured.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline on the headline: the only per-request bound the reference
+encodes is the 2-minute CNI request budget matching the kubelet CRI
+timeout (reference dpu-cni/pkgs/cniserver/cniserver.go:208), within which
+it serializes all requests under a global mutex. vs_baseline =
+120000 ms / p50 ms.
+
+Prints one JSON line per metric; the FINAL line is the headline metric
+with all other metrics under "extra".
 """
 
 from __future__ import annotations
@@ -148,7 +156,7 @@ def one_attach(sock: str, netns: str, i: int) -> float:
     return elapsed_ms
 
 
-def main() -> int:
+def bench_pod_attach() -> dict:
     real = _can_use_netns()
     netns = "/proc/self/ns/net"  # placeholder sandbox id for the stand-in
     host_root = dpu_root = None
@@ -172,17 +180,7 @@ def main() -> int:
             f" dataplane): p50={p50:.3f} ms p99={p99:.3f} ms",
             file=sys.stderr,
         )
-        print(
-            json.dumps(
-                {
-                    "metric": "pod_attach_p50",
-                    "value": round(p50, 3),
-                    "unit": "ms",
-                    "vs_baseline": round(REFERENCE_REQUEST_BUDGET_MS / p50, 1),
-                }
-            )
-        )
-        return 0
+        return {"pod_attach_p50_ms": round(p50, 3), "pod_attach_p99_ms": round(p99, 3)}
     finally:
         if harness is not None:
             harness.stop()
@@ -191,6 +189,122 @@ def main() -> int:
         for d in (host_root, dpu_root):
             if d:
                 shutil.rmtree(d, ignore_errors=True)
+
+
+def _tunnel_alive() -> bool:
+    """The axon TPU tunnel serves 127.0.0.1:{8082..8117}; when it is down,
+    jax device discovery blocks forever in a claim-retry loop, so probe
+    cheaply before committing a subprocess to it."""
+    for port in (8082, 8092, 8102, 8112):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def bench_tpu() -> dict:
+    """MXU/HBM/ICI numbers, in a subprocess with a hard timeout (a wedged
+    tunnel must not hang the whole bench)."""
+    if os.environ.get("DPU_BENCH_SKIP_TPU") == "1":
+        return {"tpu_skipped": "env"}
+    if not _tunnel_alive():
+        print("tpu bench skipped: axon tunnel not reachable", file=sys.stderr)
+        return {"tpu_skipped": "tunnel_down"}
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "dpu_operator_tpu.parallel.bench_tpu"],
+            capture_output=True,
+            text=True,
+            timeout=1500,  # first pallas/XLA compiles through the tunnel are slow
+            cwd=repo,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu bench skipped: timed out", file=sys.stderr)
+        return {"tpu_skipped": "timeout"}
+    if r.returncode != 0:
+        print(f"tpu bench failed: {r.stderr[-400:]}", file=sys.stderr)
+        return {"tpu_skipped": f"rc={r.returncode}"}
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"tpu_skipped": "unparseable"}
+
+
+def bench_virtual_ring() -> dict:
+    """sp-ring all-gather bandwidth on the 8-device virtual CPU mesh — a
+    functional figure (XLA collective correctness + shape), not an ICI
+    number; recorded so the ring path is exercised every bench run."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": "",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    code = (
+        "import json, sys; sys.path.insert(0, %r)\n"
+        "from dpu_operator_tpu.parallel.mesh import build_mesh\n"
+        "from dpu_operator_tpu.parallel.ring_probe import measure_ring_bandwidth\n"
+        "m = build_mesh()\n"
+        "r = measure_ring_bandwidth(m, axis='sp')\n"
+        "print(json.dumps({'virtual_ring_gbps': round(r['effective_gbps'], 2),"
+        " 'virtual_ring_axis_size': r['axis_size']}))\n" % repo
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=repo,
+        )
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"virtual ring skipped: {e}", file=sys.stderr)
+        return {}
+
+
+def main() -> int:
+    metrics: dict = {}
+    metrics.update(bench_pod_attach())
+    metrics.update(bench_virtual_ring())
+    metrics.update(bench_tpu())
+
+    # One JSON line per secondary metric (driver tail keeps them visible).
+    units = {
+        "pod_attach_p99_ms": "ms",
+        "mxu_jnp_tflops": "TFLOP/s",
+        "mxu_pallas_tflops": "TFLOP/s",
+        "mxu_tflops": "TFLOP/s",
+        "mxu_utilization": "frac_v5e_peak",
+        "hbm_gbps": "GB/s",
+        "hbm_utilization": "frac_v5e_peak",
+        "ici_ring_gbps": "Gb/s",
+        "virtual_ring_gbps": "Gb/s",
+    }
+    for key, unit in units.items():
+        if key in metrics:
+            print(json.dumps({"metric": key, "value": metrics[key], "unit": unit}))
+
+    p50 = metrics.get("pod_attach_p50_ms")
+    print(
+        json.dumps(
+            {
+                "metric": "pod_attach_p50",
+                "value": p50,
+                "unit": "ms",
+                "vs_baseline": round(REFERENCE_REQUEST_BUDGET_MS / p50, 1) if p50 else 0,
+                "extra": metrics,
+            }
+        )
+    )
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,82 @@
+"""On-chip benchmark runner — executed by bench.py in a subprocess.
+
+Prints ONE JSON dict to stdout with the TPU compute/bandwidth numbers
+(SURVEY §6: the baseline must be self-measured; the reference publishes
+none). Run as `python -m dpu_operator_tpu.parallel.bench_tpu`.
+
+Kept in its own process so the orchestrating bench can enforce a hard
+timeout: when the axon tunnel is down, `jax.devices()` blocks forever in
+a claim-retry loop and no in-process guard can recover."""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+
+
+def main() -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    out: dict = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "n_devices": jax.device_count(),
+    }
+    if dev.platform != "tpu":
+        print(json.dumps(out))
+        return 0
+
+    from . import mxu_bench
+
+    jnp_res = mxu_bench.measure_matmul_tflops(
+        lambda x, w: x @ w, reps=2
+    )
+    out["mxu_jnp_tflops"] = round(jnp_res["tflops"], 1)
+
+    try:
+        cfg, pallas_res = mxu_bench.best_pallas_config(reps=1)
+        best = functools.partial(
+            mxu_bench.pallas_matmul, bm=cfg[0], bn=cfg[1], bk=cfg[2]
+        )
+        pallas_res = mxu_bench.measure_matmul_tflops(best, reps=2)
+        out["mxu_pallas_tflops"] = round(pallas_res["tflops"], 1)
+        out["mxu_pallas_config"] = list(cfg)
+    except Exception as e:  # pallas regression must not hide the jnp number
+        out["mxu_pallas_error"] = str(e)[:200]
+
+    best_tflops = max(
+        out.get("mxu_pallas_tflops", 0.0), out.get("mxu_jnp_tflops", 0.0)
+    )
+    out["mxu_tflops"] = best_tflops
+    out["mxu_utilization"] = round(
+        best_tflops / mxu_bench.V5E_PEAK_BF16_TFLOPS, 3
+    )
+
+    try:
+        hbm = mxu_bench.measure_hbm_gbps(reps=2)
+        out["hbm_gbps"] = round(hbm["gbps"], 1)
+        out["hbm_utilization"] = round(hbm["utilization_vs_v5e_peak"], 3)
+    except Exception as e:  # never discard the MXU numbers already taken
+        out["hbm_error"] = str(e)[:200]
+
+    if jax.device_count() >= 2:
+        try:
+            from .mesh import build_mesh
+            from .ring_probe import measure_ring_bandwidth
+
+            mesh = build_mesh()
+            axis = max(mesh.shape, key=lambda a: mesh.shape[a])
+            ring = measure_ring_bandwidth(mesh, axis=axis)
+            out["ici_ring_gbps"] = round(ring["effective_gbps"], 2)
+            out["ici_ring_axis_size"] = ring["axis_size"]
+        except Exception as e:
+            out["ici_ring_error"] = str(e)[:200]
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""MXU / HBM microbenchmarks — the "is it actually fast" numbers.
+
+The reference ships a traffic-flow harness but publishes no compute
+numbers (BASELINE.md); for a TPU fabric operator the MFU-equivalent is
+sustained MXU throughput and HBM bandwidth on the chip the operator
+manages, so the health/bench story must record them (SURVEY §6).
+
+Two implementations of the hot op are raced:
+  * `pallas`: a K-blocked tiled matmul (grid over M/N/K, f32 VMEM
+    accumulator, `pl.when`-gated zero/writeback — pallas_guide.md
+    Grid/BlockSpec + accumulate patterns), the hand-scheduled shape the
+    MXU wants;
+  * `jnp`: `h @ w` left entirely to XLA.
+
+Timing is robust to the axon tunnel (where `block_until_ready` returns
+before execution finishes and only a host readback truly syncs): each
+measurement jits a `lax.scan` chain of L dependent matmuls ending in a
+scalar readback, and the per-matmul time is the slope between two chain
+lengths — the tunnel round-trip cancels in the difference.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+V5E_PEAK_BF16_TFLOPS = 197.0  # per-chip bf16 peak, TPU v5e datasheet
+V5E_PEAK_HBM_GBPS = 819.0  # per-chip HBM bandwidth, TPU v5e datasheet
+
+
+# -- K-blocked pallas matmul --------------------------------------------------
+
+
+def _mm_kernel(x_ref, w_ref, o_ref, acc_ref, *, n_k: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _write():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def pallas_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    bm: int = 512,
+    bn: int = 512,
+    bk: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """bf16 x @ w -> bf16, f32 accumulation, hand-tiled for the MXU."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2 and m % bm == 0 and n % bn == 0 and k % bk == 0
+    n_k = k // bk
+    kwargs = {"memory_space": pltpu.VMEM} if pltpu is not None else {}
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        grid=(m // bm, n // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk), **kwargs),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j), **kwargs),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j), **kwargs),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)] if pltpu else [],
+        compiler_params=(
+            pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            )
+            if pltpu and not interpret
+            else None
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n + m * n) * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x, w)
+
+
+# -- RTT-cancelling timing ----------------------------------------------------
+
+
+def _chained(matmul: Callable, L: int):
+    @jax.jit
+    def run(x, w):
+        def body(h, _):
+            return matmul(h, w).astype(h.dtype), ()
+
+        h, _ = jax.lax.scan(body, x, None, length=L)
+        return jnp.sum(h.astype(jnp.float32))
+
+    return run
+
+
+def _timed(fn, *args, reps: int) -> float:
+    float(fn(*args))  # warm / compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(*args))  # host readback = true sync through the tunnel
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_matmul_tflops(
+    matmul: Callable,
+    n: int = 4096,
+    l_short: int = 8,
+    l_long: int = 40,
+    reps: int = 3,
+    seed: int = 0,
+) -> dict:
+    """Per-matmul sustained TFLOP/s for `matmul` on n×n bf16 operands.
+    Slope between two chain lengths cancels dispatch + tunnel RTT."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(seed))
+    x = jax.random.normal(kx, (n, n)).astype(jnp.bfloat16)
+    # Scale so repeated h@w neither overflows nor denormals out in bf16.
+    w = (jax.random.normal(kw, (n, n)) / jnp.sqrt(n)).astype(jnp.bfloat16)
+    t_short = _timed(_chained(matmul, l_short), x, w, reps=reps)
+    t_long = _timed(_chained(matmul, l_long), x, w, reps=reps)
+    per_mm = max((t_long - t_short) / (l_long - l_short), 1e-9)
+    tflops = 2 * n * n * n / per_mm / 1e12
+    return {
+        "n": n,
+        "seconds_per_matmul": per_mm,
+        "tflops": tflops,
+        "utilization_vs_v5e_peak": tflops / V5E_PEAK_BF16_TFLOPS,
+    }
+
+
+def measure_hbm_gbps(
+    mbytes: int = 256, l_short: int = 4, l_long: int = 20, reps: int = 3
+) -> dict:
+    """Sustained HBM read+write bandwidth via a chained elementwise pass
+    (each scan step streams the array once in and once out)."""
+    n = mbytes * 1024 * 1024 // 4
+    x = jnp.ones((n,), jnp.float32)
+
+    def run_l(x, L):
+        # Not itself jitted: the outer jax.jit(partial(..., L=L)) bakes L
+        # in as the static scan length.
+        def body(h, _):
+            return h * 1.0000001 + 1e-7, ()
+
+        h, _ = jax.lax.scan(body, x, None, length=L)
+        return jnp.sum(h[:8])
+
+    runs = {L: jax.jit(functools.partial(run_l, L=L)) for L in (l_short, l_long)}
+    t_short = _timed(runs[l_short], x, reps=reps)
+    t_long = _timed(runs[l_long], x, reps=reps)
+    per_pass = max((t_long - t_short) / (l_long - l_short), 1e-9)
+    gbps = 2 * x.nbytes / per_pass / 1e9  # read + write per step
+    return {
+        "mbytes": mbytes,
+        "seconds_per_pass": per_pass,
+        "gbps": gbps,
+        "utilization_vs_v5e_peak": gbps / V5E_PEAK_HBM_GBPS,
+    }
+
+
+def best_pallas_config(
+    n: int = 4096, configs=((512, 512, 1024), (256, 256, 2048), (512, 1024, 512)),
+    reps: int = 1,
+) -> tuple:
+    """Quick sweep over block shapes; returns (config, result) of the
+    fastest. Kept small — each config costs two compiles."""
+    best = None
+    for cfg in configs:
+        bm, bn, bk = cfg
+        mm = functools.partial(pallas_matmul, bm=bm, bn=bn, bk=bk)
+        try:
+            r = measure_matmul_tflops(mm, n=n, reps=reps)
+        except Exception:
+            continue
+        if best is None or r["tflops"] > best[1]["tflops"]:
+            best = (cfg, r)
+    if best is None:
+        raise RuntimeError("no pallas matmul config compiled")
+    return best
