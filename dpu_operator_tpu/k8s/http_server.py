@@ -1,0 +1,320 @@
+"""ApiServer — InMemoryCluster behind real kube-apiserver REST semantics.
+
+The HTTP tier of the test harness: the counterpart of the reference's
+envtest/Kind clusters (internal/testutils/kindcluster.go:47-64,162-214),
+which exist precisely so the *production* client bindings get exercised.
+Serving the in-memory store over genuine HTTP lets the whole e2e stack
+run through HttpClient (http_client.py) — chunked `?watch=1` streaming,
+409 conflicts, the /status subresource, finalizer-gated deletion — so a
+mistake in the production wire path fails a test instead of a cluster.
+
+Speaks exactly the subset HttpClient emits:
+  GET/POST           /api/v1|/apis/<gv> [/namespaces/<ns>] /<plural>
+  GET/PUT/DELETE     .../<plural>/<name> [/status]
+  GET                .../<plural>?watch=1&resourceVersion=N  (chunked)
+plus `?labelSelector=k=v,...` on lists and k8s Status error bodies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from .http_client import _CLUSTER_SCOPED, _RESOURCES
+from .store import AlreadyExists, Conflict, Expired, InMemoryCluster, NotFound
+
+_PLURAL_TO_KIND: Dict[str, str] = {v: k for k, v in _RESOURCES.items()}
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps(
+        {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "reason": reason,
+            "message": message,
+            "code": code,
+        }
+    ).encode()
+
+
+class _Route:
+    __slots__ = ("api_version", "kind", "namespace", "name", "subresource")
+
+    def __init__(self, api_version, kind, namespace, name, subresource):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def _parse_path(path: str) -> Optional[_Route]:
+    """/api/v1/... or /apis/<group>/<version>/... →  route or None."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api" and len(parts) >= 2 and parts[1] == "v1":
+        api_version = "v1"
+        rest = parts[2:]
+    elif parts[0] == "apis" and len(parts) >= 3:
+        api_version = f"{parts[1]}/{parts[2]}"
+        rest = parts[3:]
+    else:
+        return None
+    namespace = None
+    # "namespaces/<ns>" is a scope prefix only when a resource follows;
+    # "/api/v1/namespaces/<name>" with nothing after is the Namespace
+    # object itself (GET/PUT/DELETE by name must not 404).
+    if len(rest) >= 3 and rest[0] == "namespaces":
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest:
+        return None
+    plural = rest[0]
+    kind = _PLURAL_TO_KIND.get(plural)
+    if kind is None:
+        # Mirror the client's fallback: plural = kind.lower() + "s".
+        kind = plural[:-1].capitalize() if plural.endswith("s") else plural.capitalize()
+    name = rest[1] if len(rest) >= 2 else None
+    subresource = rest[2] if len(rest) >= 3 else None
+    return _Route(api_version, kind, namespace, name, subresource)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "ApiServer"
+
+    # -- plumbing -------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet; the tests assert, not read logs
+        pass
+
+    def _deny_unless_authorized(self) -> bool:
+        token = self.server.token
+        if not token:
+            return False
+        got = self.headers.get("Authorization", "")
+        if got == f"Bearer {token}":
+            return False
+        self._send(401, _status_body(401, "Unauthorized", "bad or missing token"))
+        return True
+
+    def _send(self, code: int, body: bytes, content_type="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_obj(self, code: int, obj: dict):
+        self._send(code, json.dumps(obj).encode())
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length)) if length else {}
+
+    def _route(self) -> Tuple[Optional[_Route], dict]:
+        parsed = urllib.parse.urlsplit(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        return _parse_path(parsed.path), query
+
+    # -- verbs ----------------------------------------------------------------
+
+    def do_GET(self):
+        if self._deny_unless_authorized():
+            return
+        route, query = self._route()
+        if route is None:
+            return self._send(404, _status_body(404, "NotFound", self.path))
+        cluster = self.server.cluster
+        try:
+            if route.name:
+                obj = cluster.get(
+                    route.api_version, route.kind, route.namespace, route.name
+                )
+                return self._send_obj(200, obj)
+            if query.get("watch") in ("1", "true"):
+                return self._serve_watch(route, query)
+            selector = None
+            if "labelSelector" in query:
+                selector = dict(
+                    kv.split("=", 1) for kv in query["labelSelector"].split(",") if "=" in kv
+                )
+            # Items and rv under one lock hold: an rv taken separately could
+            # postdate the snapshot and make watch resume skip the gap.
+            items, rv = cluster.list_with_rv(
+                route.api_version, route.kind, route.namespace, selector
+            )
+            return self._send_obj(
+                200,
+                {
+                    "kind": f"{route.kind}List",
+                    "apiVersion": route.api_version,
+                    "metadata": {"resourceVersion": rv},
+                    "items": items,
+                },
+            )
+        except NotFound as e:
+            return self._send(404, _status_body(404, "NotFound", str(e)))
+
+    def do_POST(self):
+        if self._deny_unless_authorized():
+            return
+        route, _ = self._route()
+        if route is None:
+            return self._send(404, _status_body(404, "NotFound", self.path))
+        obj = self._read_body()
+        obj.setdefault("apiVersion", route.api_version)
+        obj.setdefault("kind", route.kind)
+        if route.namespace and route.kind not in _CLUSTER_SCOPED:
+            obj.setdefault("metadata", {}).setdefault("namespace", route.namespace)
+        try:
+            created = self.server.cluster.create(obj)
+            return self._send_obj(201, created)
+        except AlreadyExists as e:
+            return self._send(409, _status_body(409, "AlreadyExists", str(e)))
+
+    def do_PUT(self):
+        if self._deny_unless_authorized():
+            return
+        route, _ = self._route()
+        if route is None or route.name is None:
+            return self._send(404, _status_body(404, "NotFound", self.path))
+        obj = self._read_body()
+        obj.setdefault("apiVersion", route.api_version)
+        obj.setdefault("kind", route.kind)
+        try:
+            if route.subresource == "status":
+                updated = self.server.cluster.update_status(obj)
+            elif route.subresource is None:
+                updated = self.server.cluster.update(obj)
+            else:
+                return self._send(
+                    404, _status_body(404, "NotFound", f"subresource {route.subresource}")
+                )
+            return self._send_obj(200, updated)
+        except NotFound as e:
+            return self._send(404, _status_body(404, "NotFound", str(e)))
+        except Conflict as e:
+            return self._send(409, _status_body(409, "Conflict", str(e)))
+
+    def do_DELETE(self):
+        if self._deny_unless_authorized():
+            return
+        route, _ = self._route()
+        if route is None or route.name is None:
+            return self._send(404, _status_body(404, "NotFound", self.path))
+        try:
+            self.server.cluster.delete(
+                route.api_version, route.kind, route.namespace, route.name
+            )
+            return self._send_obj(200, {"kind": "Status", "status": "Success"})
+        except NotFound as e:
+            return self._send(404, _status_body(404, "NotFound", str(e)))
+
+    # -- watch ----------------------------------------------------------------
+
+    def _serve_watch(self, route: _Route, query: dict):
+        """Chunked newline-delimited watch events, real apiserver shape.
+        Runs until the client hangs up (write fails) or the server stops."""
+        cluster = self.server.cluster
+        try:
+            watcher = cluster.watch(
+                route.api_version,
+                route.kind,
+                route.namespace,
+                since_rv=query.get("resourceVersion") or None,
+            )
+        except Expired as e:
+            # 410 Gone: the resume point fell off the history window; the
+            # client relists, exactly as against a real apiserver.
+            return self._send(410, _status_body(410, "Expired", str(e)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.server.stopping.is_set():
+                try:
+                    ev = watcher.events.get(timeout=0.25)
+                except Exception:
+                    continue
+                line = json.dumps({"type": ev.type, "object": ev.object}).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            cluster.stop_watch(watcher)
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            self.close_connection = True
+
+
+class ApiServer:
+    """Serve `cluster` on 127.0.0.1:<port> (0 = ephemeral). With `token`,
+    every request must carry the matching Bearer token (the reference
+    protects its endpoints the same way, cmd/main.go:82-86)."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        port: int = 0,
+        token: Optional[str] = None,
+    ):
+        self.cluster = cluster
+        self.token = token
+        self.stopping = threading.Event()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        # Hand the handler its back-references via the server object.
+        self._httpd.cluster = cluster  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self._httpd.stopping = self.stopping  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="apiserver"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def write_kubeconfig(self, path: str) -> str:
+        """A kubeconfig pointing at this server — lets tests exercise
+        client_from_kubeconfig end-to-end."""
+        cfg = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "inmem",
+            "contexts": [{"name": "inmem", "context": {"cluster": "inmem", "user": "u"}}],
+            "clusters": [{"name": "inmem", "cluster": {"server": self.url}}],
+            "users": [{"name": "u", "user": ({"token": self.token} if self.token else {})}],
+        }
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        return path

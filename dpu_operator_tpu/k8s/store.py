@@ -20,6 +20,7 @@ import copy
 import queue
 import threading
 import uuid
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -36,6 +37,11 @@ class AlreadyExists(Exception):
 
 class Conflict(Exception):
     pass
+
+
+class Expired(Exception):
+    """Watch resume point fell off the event history (HTTP 410 Gone);
+    the client must relist, exactly as against a real apiserver."""
 
 
 @dataclass
@@ -63,11 +69,16 @@ class _Watcher:
 
 
 class InMemoryCluster:
+    HISTORY = 4096  # retained watch events; older resume points get Expired
+
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: Dict[Key, K8sObject] = {}
         self._rv = 0
         self._watchers: List[_Watcher] = []
+        # (rv, WatchEvent) ring so watches can resume from a resourceVersion
+        # with DELETED/MODIFIED fidelity, like a real apiserver's etcd window.
+        self._history: "deque[Tuple[int, WatchEvent]]" = deque(maxlen=self.HISTORY)
 
     # -- helpers -------------------------------------------------------------
 
@@ -79,6 +90,9 @@ class InMemoryCluster:
         return str(self._rv)
 
     def _emit(self, etype: str, obj: K8sObject) -> None:
+        ev = WatchEvent(etype, copy.deepcopy(obj))
+        rv = int(obj.get("metadata", {}).get("resourceVersion", self._rv) or self._rv)
+        self._history.append((rv, ev))
         for w in self._watchers:
             if w.matches(obj):
                 w.events.put(WatchEvent(etype, copy.deepcopy(obj)))
@@ -196,6 +210,9 @@ class InMemoryCluster:
         cur = self._objects.pop(key, None)
         if cur is None:
             return
+        # A real apiserver stamps the deletion event with a fresh rv; the
+        # watch-resume filter (rv > floor) depends on that.
+        cur["metadata"]["resourceVersion"] = self._next_rv()
         self._emit("DELETED", cur)
         self._gc_orphans(uid_of(cur))
 
@@ -221,15 +238,61 @@ class InMemoryCluster:
 
     # -- watches -------------------------------------------------------------
 
+    @property
+    def resource_version(self) -> str:
+        """The cluster's current (latest) resourceVersion."""
+        with self._lock:
+            return str(self._rv)
+
+    def list_with_rv(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[K8sObject], str]:
+        """Items + the list resourceVersion under ONE lock hold — the rv a
+        client may resume a watch from without losing events created
+        between a separate list() and resource_version read."""
+        with self._lock:
+            return self.list(api_version, kind, namespace, label_selector), str(self._rv)
+
     def watch(
-        self, api_version: str, kind: str, namespace: Optional[str] = None
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        since_rv: Optional[str] = None,
     ) -> _Watcher:
         """Returns a watcher primed with synthetic ADDED events for existing
-        objects (list+watch semantics collapsed, as informers present it)."""
+        objects (list+watch semantics collapsed, as informers present it).
+
+        With `since_rv` (real apiserver `?watch=1&resourceVersion=` shape,
+        used by the HTTP tier where the client already listed), priming
+        replays the recorded event history after that resourceVersion —
+        including DELETED/MODIFIED, so a deletion between the client's
+        list and the watch registration is not lost. A resume point older
+        than the retained history raises Expired (HTTP 410 Gone) to force
+        a relist, matching apiserver behavior."""
         with self._lock:
             w = _Watcher(api_version, kind, namespace)
-            for obj in self.list(api_version, kind, namespace):
-                w.events.put(WatchEvent("ADDED", obj))
+            if since_rv is not None:
+                floor = int(since_rv)
+                if floor < self._rv:
+                    oldest = self._history[0][0] if self._history else self._rv + 1
+                    if floor + 1 < oldest:
+                        raise Expired(
+                            f"resourceVersion {since_rv} is too old "
+                            f"(history starts at {oldest})"
+                        )
+                    for rv, ev in self._history:
+                        if rv > floor and w.matches(ev.object):
+                            w.events.put(
+                                WatchEvent(ev.type, copy.deepcopy(ev.object))
+                            )
+            else:
+                for obj in self.list(api_version, kind, namespace):
+                    w.events.put(WatchEvent("ADDED", obj))
             self._watchers.append(w)
             return w
 

@@ -8,12 +8,25 @@ set before jax initialises its backends, hence at conftest import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize imports jax before this file runs, pinned to the
+# tunnelled TPU; when that tunnel is down, any in-process jax.devices()
+# blocks forever in a claim-retry loop. The backend is registered but not
+# yet initialised, so a config update here still redirects the whole test
+# process onto the virtual CPU platform. The real chip stays the domain
+# of bench.py (subprocess, timeout-guarded).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

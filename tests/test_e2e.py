@@ -78,11 +78,31 @@ HAVE_NETNS = _have_netns()
 
 
 class Stack:
-    """The whole system in one process."""
+    """The whole system in one process.
 
-    def __init__(self, pm):
+    mode="inmem": components bind the store directly (fast path).
+    mode="http": the same store is served over real REST by
+    k8s.http_server.ApiServer and every component talks through the
+    production HttpClient via a kubeconfig — chunked watch, 409s, status
+    subresource and finalizer deletion all cross a real wire (the
+    reference proves its client path the same way against Kind/envtest,
+    internal/testutils/kindcluster.go:47-64,162-214)."""
+
+    def __init__(self, pm, mode: str = "inmem"):
         self.pm = pm
-        self.client = InMemoryClient(InMemoryCluster())
+        self.mode = mode
+        self.apiserver = None
+        if mode == "http":
+            from dpu_operator_tpu.k8s.http_client import HttpClient
+            from dpu_operator_tpu.k8s.http_server import ApiServer
+
+            self.apiserver = ApiServer(InMemoryCluster()).start()
+            # Direct construction, not client_from_kubeconfig: that helper
+            # prefers an in-cluster SA mount when one exists, which inside a
+            # real pod would point this stack at the production apiserver.
+            self.client = HttpClient(self.apiserver.url)
+        else:
+            self.client = InMemoryClient(InMemoryCluster())
         self.client.create(
             {
                 "apiVersion": "v1",
@@ -140,19 +160,21 @@ class Stack:
         self.kubelet.stop()
         self.vsp_server.stop()
         self.operator.stop()
+        if self.apiserver is not None:
+            self.apiserver.stop()
         if self.bridge:
             subprocess.run(["ip", "link", "del", self.bridge], capture_output=True)
 
 
-@pytest.fixture(scope="module")
-def stack(tmp_path_factory):
+@pytest.fixture(scope="module", params=["inmem", "http"])
+def stack(request, tmp_path_factory):
     import shutil
     import tempfile
 
     from dpu_operator_tpu.utils import PathManager
 
     d = tempfile.mkdtemp(prefix="dpu-")
-    s = Stack(PathManager(root=d))
+    s = Stack(PathManager(root=d), mode=request.param)
     try:
         assert wait_for(lambda: s.side_manager() is not None), "daemon never spawned a side manager"
         yield s
