@@ -69,3 +69,51 @@ class CpAgentClient:
 
     def stats(self) -> dict:
         return self._call({"op": "stats"})
+
+    def config(self) -> dict:
+        return self._call({"op": "config"})
+
+    def subscribe(self, stop=None, idle_timeout: float = 1.0):
+        """Generator of health events pushed by the agent's event loop.
+
+        Yields the baseline state first, then a dict per health change
+        (keys: event, generation, healthy, chips). `stop` is an optional
+        threading.Event that ends the stream; between events the socket
+        wakes every `idle_timeout` seconds to check it. Raises
+        CpAgentError when the agent goes away — callers reconnect."""
+        import select
+
+        payload = json.dumps({"op": "subscribe"}).encode()
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(self._timeout)
+            try:
+                s.connect(self._path)
+                s.sendall(struct.pack(">I", len(payload)) + payload)
+            except OSError as e:
+                raise CpAgentError(f"cp-agent at {self._path}: {e}") from e
+            while stop is None or not stop.is_set():
+                # Idle-wait with select so no bytes are consumed until a
+                # frame has started — a recv that times out mid-header
+                # would silently desynchronize the stream.
+                try:
+                    readable, _, _ = select.select([s], [], [], idle_timeout)
+                except OSError as e:
+                    raise CpAgentError(f"subscribe stream: {e}") from e
+                if not readable:
+                    continue
+                try:
+                    header = self._recv_exact(s, 4)
+                    (length,) = struct.unpack(">I", header)
+                    if length > 1 << 20:
+                        raise CpAgentError(f"oversized event ({length} bytes)")
+                    body = self._recv_exact(s, length)
+                except CpAgentError:
+                    raise
+                except OSError as e:
+                    raise CpAgentError(f"subscribe stream: {e}") from e
+                event = json.loads(body)
+                if "chips" in event:
+                    event["chips"] = {
+                        int(k): bool(v) for k, v in event["chips"].items()
+                    }
+                yield event

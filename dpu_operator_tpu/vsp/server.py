@@ -53,6 +53,9 @@ class VspServer:
         log.info("VSP serving on unix://%s", self._socket)
 
     def stop(self, grace: float = 0.5) -> None:
+        stop_watchers = getattr(self._vsp, "stop_watchers", None)
+        if stop_watchers is not None:
+            stop_watchers()
         self._server.stop(grace)
 
     def wait(self) -> None:

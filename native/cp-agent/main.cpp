@@ -1,19 +1,23 @@
 // cp-agent — the native node control-plane agent for TPU DPUs.
 //
 // TPU-native counterpart of the reference's Marvell octep_cp_agent
-// (pcie_ep_octeon_target/apps/octep_cp_agent: mailbox poll loop,
-// heartbeat timer, PERST handling). On TPU there is no PCIe-EP mailbox;
-// the agent instead owns:
-//   * chip topology/health reading (device nodes + runtime env),
-//     re-probed on every request so a vanished /dev/accel* flips health
-//     (the PERST-event analogue: main.c:45-62 in the reference handles
-//     function-level resets; we surface device-node loss the same way)
-//   * heartbeat answering for the tpuvsp over a local framed-JSON socket
-//     (the octep_plugin_server.c pattern)
-//   * uptime/request statistics for observability
+// (pcie_ep_octeon_target/apps/octep_cp_agent: mailbox poll loop in
+// main.c:45-62/loop.c, timer heartbeats, PERST handling, per-device
+// config application in app_config.c). On TPU there is no PCIe-EP
+// mailbox; the agent instead owns:
+//   * an EVENT LOOP (monitor.cpp): inotify on <root>/dev + periodic
+//     rescan + heartbeat timer, maintaining a cached topology snapshot —
+//     a vanished /dev/accel* is the PERST-event analogue and flips chip
+//     health within the inotify latency, not the next poll
+//   * PUSHED health-change events to "subscribe"d connections, so the
+//     tpuvsp reacts to chip loss without polling
+//   * per-chip CONFIG application (--config FILE, app_config.c
+//     analogue): expected chip count, health thresholds, expected
+//     accelerator type
+//   * request/latency statistics (per-op counts + latency histogram)
 //
 // Usage: cp-agent --socket /var/run/dpu-daemon/cp-agent/cp-agent.sock
-//                 [--root /] [--oneshot op]
+//                 [--root /] [--config FILE] [--oneshot op]
 
 #include <getopt.h>
 #include <signal.h>
@@ -21,20 +25,30 @@
 #include <time.h>
 
 #include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
 
 #include "json.hpp"
+#include "monitor.hpp"
 #include "server.hpp"
 #include "topology.hpp"
 
 namespace {
 
 cpagent::Server* g_server = nullptr;
-std::atomic<uint64_t> g_requests{0};
+cpagent::Monitor* g_monitor = nullptr;
 time_t g_start = 0;
-std::string g_root = "/";
+
+// Request statistics: per-op counts + latency histogram (buckets in us).
+std::mutex g_stats_mu;
+std::map<std::string, uint64_t> g_op_counts;
+constexpr int64_t kLatBounds[] = {100, 1000, 10000};  // <100us <1ms <10ms, +inf
+uint64_t g_lat_buckets[4] = {0, 0, 0, 0};
+std::atomic<uint64_t> g_requests{0};
 
 void handle_signal(int) {
   if (g_server != nullptr) g_server->stop();
@@ -53,25 +67,47 @@ std::string chips_json(const cpagent::Topology& topo) {
   return out;
 }
 
-std::string handle(const std::string& op, const std::string&) {
-  ++g_requests;
+bool all_healthy(const cpagent::Topology& topo) {
+  for (const auto& chip : topo.chips) {
+    if (!chip.present || !chip.openable) return false;
+  }
+  return true;
+}
+
+int healthy_count(const cpagent::Topology& topo) {
+  int n = 0;
+  for (const auto& chip : topo.chips) {
+    if (chip.present && chip.openable) ++n;
+  }
+  return n;
+}
+
+std::string handle_op(const std::string& op, const std::string&) {
+  const cpagent::Config& cfg = g_monitor->config();
   if (op == "ping") {
-    auto topo = cpagent::read_topology(g_root);
-    bool all_healthy = true;
-    for (const auto& chip : topo.chips) {
-      if (!chip.present || !chip.openable) all_healthy = false;
-    }
+    auto topo = g_monitor->snapshot();
+    // Health policy: all chips healthy, unless the config relaxes it to
+    // a minimum count; an accelerator-type mismatch always degrades.
+    bool healthy = cfg.min_healthy_chips > 0
+                       ? healthy_count(topo) >= cfg.min_healthy_chips
+                       : all_healthy(topo);
+    if (!g_monitor->accel_type_matches()) healthy = false;
     return cpagent::Json()
-        .boolean("healthy", all_healthy)
+        .boolean("healthy", healthy)
         .num("uptime_s", static_cast<int64_t>(time(nullptr) - g_start))
+        .num("heartbeats", static_cast<int64_t>(g_monitor->heartbeats()))
+        .num("generation", static_cast<int64_t>(g_monitor->generation()))
         .done();
   }
   if (op == "chip_health") {
-    auto topo = cpagent::read_topology(g_root);
-    return cpagent::Json().raw("chips", chips_json(topo)).done();
+    auto topo = g_monitor->snapshot();
+    return cpagent::Json()
+        .raw("chips", chips_json(topo))
+        .num("generation", static_cast<int64_t>(g_monitor->generation()))
+        .done();
   }
   if (op == "topology") {
-    auto topo = cpagent::read_topology(g_root);
+    auto topo = g_monitor->snapshot();
     return cpagent::Json()
         .str("acceleratorType", topo.accelerator_type)
         .num("workerId", static_cast<int64_t>(topo.worker_id))
@@ -81,13 +117,84 @@ std::string handle(const std::string& op, const std::string&) {
         .raw("chips", chips_json(topo))
         .done();
   }
+  if (op == "subscribe") {
+    // Never reached over the socket: the server routes "subscribe" to
+    // Monitor::add_subscriber, which sends the baseline frame atomically
+    // with the fd registration (no lost-update window). Kept for
+    // --oneshot introspection.
+    auto topo = g_monitor->snapshot();
+    return cpagent::Json()
+        .str("event", "baseline")
+        .num("generation", static_cast<int64_t>(g_monitor->generation()))
+        .boolean("healthy", all_healthy(topo))
+        .raw("chips", chips_json(topo))
+        .done();
+  }
+  if (op == "config") {
+    return cpagent::Json()
+        .str("source", cfg.source)
+        .num("expected_chips", static_cast<int64_t>(cfg.expected_chips))
+        .num("min_healthy_chips", static_cast<int64_t>(cfg.min_healthy_chips))
+        .num("rescan_ms", static_cast<int64_t>(cfg.rescan_ms))
+        .num("heartbeat_ms", static_cast<int64_t>(cfg.heartbeat_ms))
+        .str("accelerator_type", cfg.accelerator_type)
+        .done();
+  }
   if (op == "stats") {
+    std::string ops = "{";
+    std::string lat = "{";
+    {
+      std::lock_guard<std::mutex> lock(g_stats_mu);
+      bool first = true;
+      for (const auto& kv : g_op_counts) {
+        if (!first) ops += ",";
+        first = false;
+        ops += "\"" + cpagent::json_escape(kv.first) +
+               "\":" + std::to_string(kv.second);
+      }
+      const char* names[] = {"lt_100us", "lt_1ms", "lt_10ms", "ge_10ms"};
+      for (int i = 0; i < 4; ++i) {
+        if (i) lat += ",";
+        lat += std::string("\"") + names[i] + "\":" +
+               std::to_string(g_lat_buckets[i]);
+      }
+    }
+    ops += "}";
+    lat += "}";
     return cpagent::Json()
         .num("requests", static_cast<int64_t>(g_requests.load()))
         .num("uptime_s", static_cast<int64_t>(time(nullptr) - g_start))
+        .num("heartbeats", static_cast<int64_t>(g_monitor->heartbeats()))
+        .num("generation", static_cast<int64_t>(g_monitor->generation()))
+        .num("subscribers", static_cast<int64_t>(g_monitor->subscriber_count()))
+        .num("events_pushed", static_cast<int64_t>(g_monitor->events_pushed()))
+        .raw("ops", ops)
+        .raw("latency_us", lat)
         .done();
   }
   return cpagent::Json().str("error", "unknown op: " + op).done();
+}
+
+std::string handle(const std::string& op, const std::string& request) {
+  ++g_requests;
+  auto t0 = std::chrono::steady_clock::now();
+  std::string response = handle_op(op, request);
+  auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  {
+    std::lock_guard<std::mutex> lock(g_stats_mu);
+    ++g_op_counts[op];
+    int bucket = 3;
+    for (int i = 0; i < 3; ++i) {
+      if (us < kLatBounds[i]) {
+        bucket = i;
+        break;
+      }
+    }
+    ++g_lat_buckets[bucket];
+  }
+  return response;
 }
 
 void ensure_parent_dir(const std::string& path) {
@@ -107,37 +214,50 @@ void ensure_parent_dir(const std::string& path) {
 
 int main(int argc, char** argv) {
   std::string socket_path = "/var/run/dpu-daemon/cp-agent/cp-agent.sock";
+  std::string root = "/";
+  std::string config_path;
   std::string oneshot;
 
   static option long_opts[] = {
       {"socket", required_argument, nullptr, 's'},
       {"root", required_argument, nullptr, 'r'},
+      {"config", required_argument, nullptr, 'c'},
       {"oneshot", required_argument, nullptr, 'o'},
       {nullptr, 0, nullptr, 0},
   };
   int c;
-  while ((c = getopt_long(argc, argv, "s:r:o:", long_opts, nullptr)) != -1) {
+  while ((c = getopt_long(argc, argv, "s:r:c:o:", long_opts, nullptr)) != -1) {
     switch (c) {
       case 's': socket_path = optarg; break;
-      case 'r': g_root = optarg; break;
+      case 'r': root = optarg; break;
+      case 'c': config_path = optarg; break;
       case 'o': oneshot = optarg; break;
       default:
         fprintf(stderr,
-                "usage: %s [--socket PATH] [--root DIR] [--oneshot OP]\n",
+                "usage: %s [--socket PATH] [--root DIR] [--config FILE] "
+                "[--oneshot OP]\n",
                 argv[0]);
         return 2;
     }
   }
 
   g_start = time(nullptr);
+  cpagent::Monitor monitor(root, cpagent::load_config(config_path));
+  g_monitor = &monitor;
 
   if (!oneshot.empty()) {  // debug/CI mode: answer one op on stdout
+    monitor.rescan_now();
     printf("%s\n", handle(oneshot, "{}").c_str());
     return 0;
   }
 
+  monitor.start();
   ensure_parent_dir(socket_path);
   cpagent::Server server(socket_path, handle);
+  server.set_subscription(
+      "subscribe",
+      [&monitor](int fd) { monitor.add_subscriber(fd); },
+      [&monitor](int fd) { monitor.remove_subscriber(fd); });
   g_server = &server;
   signal(SIGTERM, handle_signal);
   signal(SIGINT, handle_signal);
@@ -148,5 +268,6 @@ int main(int argc, char** argv) {
   }
   fprintf(stderr, "cp-agent: serving on %s\n", socket_path.c_str());
   server.run();
+  monitor.stop();
   return 0;
 }

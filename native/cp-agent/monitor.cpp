@@ -1,0 +1,276 @@
+#include "monitor.hpp"
+
+#include <poll.h>
+#include <sys/inotify.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <arpa/inet.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "json.hpp"
+
+namespace cpagent {
+
+namespace {
+
+// Non-blocking framed send: a subscriber that stopped reading (full
+// socket buffer) gets dropped rather than wedging the monitor. Event
+// frames are far smaller than the socket buffer, so a partial write only
+// happens on an already-stalled peer — also a drop.
+bool send_frame_nonblock(int fd, const std::string& body) {
+  uint32_t be_len = htonl(static_cast<uint32_t>(body.size()));
+  std::string out(reinterpret_cast<const char*>(&be_len), sizeof(be_len));
+  out += body;
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t r = send(fd, out.data() + off, out.size() - off,
+                     MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (r <= 0) return false;
+    off += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+std::string trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+}  // namespace
+
+Config load_config(const std::string& path) {
+  Config cfg;
+  if (path.empty()) return cfg;
+  std::ifstream in(path);
+  if (!in) return cfg;
+  cfg.source = path;
+  std::string line;
+  while (std::getline(in, line)) {
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = trim(line.substr(0, eq));
+    std::string value = trim(line.substr(eq + 1));
+    if (key == "expected_chips") cfg.expected_chips = std::atoi(value.c_str());
+    else if (key == "min_healthy_chips") cfg.min_healthy_chips = std::atoi(value.c_str());
+    else if (key == "rescan_ms") cfg.rescan_ms = std::atoi(value.c_str());
+    else if (key == "heartbeat_ms") cfg.heartbeat_ms = std::atoi(value.c_str());
+    else if (key == "accelerator_type") cfg.accelerator_type = value;
+  }
+  if (cfg.rescan_ms < 50) cfg.rescan_ms = 50;
+  if (cfg.heartbeat_ms < 50) cfg.heartbeat_ms = 50;
+  return cfg;
+}
+
+Monitor::Monitor(std::string root, Config cfg)
+    : root_(std::move(root)), cfg_(std::move(cfg)) {}
+
+Monitor::~Monitor() { stop(); }
+
+void Monitor::start() {
+  rescan_now();
+  thread_ = std::thread(&Monitor::loop, this);
+}
+
+void Monitor::stop() {
+  stopping_ = true;
+  if (thread_.joinable()) thread_.join();
+}
+
+Topology Monitor::snapshot() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return snapshot_;
+}
+
+bool Monitor::accel_type_matches() const {
+  if (cfg_.accelerator_type.empty()) return true;
+  std::lock_guard<std::mutex> lock(mu_);
+  return snapshot_.accelerator_type == cfg_.accelerator_type;
+}
+
+void Monitor::add_subscriber(int fd) {
+  std::lock_guard<std::mutex> lock(mu_);
+  // Baseline is sent under the same lock hold that registers the fd, so
+  // a concurrent health change either lands in this baseline or is
+  // pushed as an event after it — never lost between the two.
+  send_frame_nonblock(fd, event_json("baseline", snapshot_, generation_.load()));
+  subscribers_.push_back(fd);
+}
+
+void Monitor::remove_subscriber(int fd) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto it = subscribers_.begin(); it != subscribers_.end(); ++it) {
+    if (*it == fd) {
+      subscribers_.erase(it);
+      return;
+    }
+  }
+}
+
+size_t Monitor::subscriber_count() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return subscribers_.size();
+}
+
+Topology Monitor::read_with_config() const {
+  Topology t = read_topology(root_);
+  // app_config.c analogue: the config declares what SHOULD be there; a
+  // chip the config expects but the node scan can't see is a failed
+  // chip, not an unknown one.
+  if (cfg_.expected_chips > 0) {
+    while (static_cast<int>(t.chips.size()) < cfg_.expected_chips) {
+      ChipInfo c;
+      c.index = static_cast<int>(t.chips.size());
+      c.present = false;
+      c.openable = false;
+      t.chips.push_back(c);
+    }
+  }
+  return t;
+}
+
+std::string Monitor::event_json(const char* kind, const Topology& t,
+                                uint64_t gen) {
+  std::string chips = "{";
+  bool first = true;
+  bool all = true;
+  for (const auto& chip : t.chips) {
+    if (!first) chips += ",";
+    first = false;
+    bool ok = chip.present && chip.openable;
+    chips += "\"" + std::to_string(chip.index) + "\":" + (ok ? "true" : "false");
+    if (!ok) all = false;
+  }
+  chips += "}";
+  return Json()
+      .str("event", kind)
+      .num("generation", static_cast<int64_t>(gen))
+      .boolean("healthy", all)
+      .raw("chips", chips)
+      .done();
+}
+
+void Monitor::rescan_now() { rescan_and_publish(); }
+
+void Monitor::rescan_and_publish() {
+  Topology t = read_with_config();
+  std::vector<bool> health;
+  health.reserve(t.chips.size());
+  for (const auto& chip : t.chips) health.push_back(chip.present && chip.openable);
+
+  std::string event;
+  std::vector<int> targets;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    bool changed = (health != last_health_);
+    snapshot_ = t;
+    if (!changed) return;
+    last_health_ = health;
+    uint64_t gen = ++generation_;
+    if (subscribers_.empty()) return;
+    event = event_json("health_change", t, gen);
+    targets = subscribers_;
+  }
+  // Sends happen OUTSIDE the lock: a stalled subscriber must not wedge
+  // snapshot()/ping for everyone else. Failed/slow fds are dropped and
+  // shut down so their server thread sees the hangup, closes, and the
+  // client reconnects (slow-consumer disconnect policy).
+  std::vector<int> dead;
+  for (int fd : targets) {
+    if (send_frame_nonblock(fd, event)) {
+      ++events_pushed_;
+    } else {
+      dead.push_back(fd);
+      shutdown(fd, SHUT_RDWR);
+    }
+  }
+  if (!dead.empty()) {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (int fd : dead) {
+      for (auto it = subscribers_.begin(); it != subscribers_.end(); ++it) {
+        if (*it == fd) {
+          subscribers_.erase(it);
+          break;
+        }
+      }
+    }
+  }
+}
+
+void Monitor::loop() {
+  int ifd = inotify_init1(IN_NONBLOCK);
+  int watch = -1;
+  if (ifd >= 0) {
+    std::string devdir = root_ + "/dev";
+    watch = inotify_add_watch(
+        ifd, devdir.c_str(),
+        IN_CREATE | IN_DELETE | IN_ATTRIB | IN_MOVED_FROM | IN_MOVED_TO);
+  }
+  auto clock_now = [] {
+    return std::chrono::steady_clock::now();
+  };
+  auto last_scan = clock_now();
+  auto last_hb = clock_now();
+  const auto rescan_iv = std::chrono::milliseconds(cfg_.rescan_ms);
+  const auto hb_iv = std::chrono::milliseconds(cfg_.heartbeat_ms);
+  // Wake at least every 100 ms so stop() stays responsive and inotify
+  // events translate to pushed events fast.
+  const int poll_ms = 100;
+
+  while (!stopping_) {
+    bool fs_event = false;
+    if (ifd >= 0) {
+      pollfd p{};
+      p.fd = ifd;
+      p.events = POLLIN;
+      int r = poll(&p, 1, poll_ms);
+      if (r > 0 && (p.revents & POLLIN)) {
+        char buf[4096];
+        ssize_t n;
+        while ((n = read(ifd, buf, sizeof(buf))) > 0) {
+          // Parse the event stream: IN_IGNORED means the kernel dropped
+          // our watch (watched dir deleted/recreated) — mark it for
+          // re-arming or chip-loss detection silently degrades to the
+          // rescan interval.
+          for (ssize_t off = 0; off < n;) {
+            auto* ev = reinterpret_cast<inotify_event*>(buf + off);
+            if (ev->mask & IN_IGNORED) watch = -1;
+            off += static_cast<ssize_t>(sizeof(inotify_event)) + ev->len;
+          }
+        }
+        fs_event = true;
+      }
+      if (watch < 0) {
+        // The watched dir may only appear after start (tmp roots) or be
+        // recreated; keep trying to arm the watch until it takes.
+        watch = inotify_add_watch(
+            ifd, (root_ + "/dev").c_str(),
+            IN_CREATE | IN_DELETE | IN_ATTRIB | IN_MOVED_FROM | IN_MOVED_TO);
+        if (watch >= 0) fs_event = true;  // missed window: rescan now
+      }
+    } else {
+      usleep(poll_ms * 1000);
+    }
+    auto now = clock_now();
+    if (now - last_hb >= hb_iv) {
+      ++heartbeats_;
+      last_hb = now;
+    }
+    if (fs_event || now - last_scan >= rescan_iv) {
+      last_scan = now;
+      rescan_and_publish();
+    }
+  }
+  if (ifd >= 0) close(ifd);
+}
+
+}  // namespace cpagent

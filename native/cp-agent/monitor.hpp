@@ -1,0 +1,88 @@
+// Monitor — the cp-agent's event loop.
+//
+// The octep_cp_agent is event-driven: a mailbox poll loop, timer-driven
+// heartbeats, and PERST/function-reset event handling pushed to it by
+// the hardware (reference apps/octep_cp_agent/main.c:45-62, loop.c). The
+// TPU analogue watches the device nodes themselves: inotify on
+// <root>/dev catches chip-node create/delete/attrib instantly, a
+// periodic rescan covers everything inotify can't see (openability
+// flips, env changes), and a heartbeat timer ticks liveness state.
+//
+// Request handlers read the cached snapshot (cheap, lock-protected);
+// health *changes* are pushed to subscribed connections as framed JSON
+// events, so consumers (the tpuvsp) see a vanished chip within the
+// inotify latency instead of their next poll.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "topology.hpp"
+
+namespace cpagent {
+
+// Per-chip config application — the app_config.c analogue. Parsed from a
+// `key = value` file (see load_config); zero values mean "unset".
+struct Config {
+  int expected_chips = 0;     // chips that MUST exist; missing => unhealthy
+  int min_healthy_chips = 0;  // ping healthy iff healthy count >= this
+  int rescan_ms = 1000;       // periodic full rescan interval
+  int heartbeat_ms = 1000;    // heartbeat timer tick
+  std::string accelerator_type;  // expected slice type; mismatch => degraded
+  std::string source;            // path the config was loaded from
+};
+
+Config load_config(const std::string& path);
+
+class Monitor {
+ public:
+  Monitor(std::string root, Config cfg);
+  ~Monitor();
+
+  void start();
+  void stop();
+
+  // Cached state — cheap reads for the request handlers.
+  Topology snapshot() const;
+  uint64_t generation() const { return generation_.load(); }
+  uint64_t heartbeats() const { return heartbeats_.load(); }
+  uint64_t events_pushed() const { return events_pushed_.load(); }
+  bool accel_type_matches() const;
+  const Config& config() const { return cfg_; }
+
+  // Event subscribers (fds owned by the server's connection threads).
+  // add_subscriber registers the fd AND writes the baseline frame under
+  // the same lock hold, so no health change can fall between the
+  // baseline snapshot and registration.
+  void add_subscriber(int fd);
+  void remove_subscriber(int fd);
+  size_t subscriber_count() const;
+
+  // Force an immediate rescan (tests; also called once at start()).
+  void rescan_now();
+
+ private:
+  void loop();
+  void rescan_and_publish();
+  Topology read_with_config() const;
+  static std::string event_json(const char* kind, const Topology& t,
+                                uint64_t gen);
+
+  std::string root_;
+  Config cfg_;
+  mutable std::mutex mu_;
+  Topology snapshot_;
+  std::vector<int> subscribers_;
+  std::vector<bool> last_health_;
+  std::atomic<uint64_t> generation_{0};
+  std::atomic<uint64_t> heartbeats_{0};
+  std::atomic<uint64_t> events_pushed_{0};
+  std::atomic<bool> stopping_{false};
+  std::thread thread_;
+};
+
+}  // namespace cpagent

@@ -78,10 +78,18 @@ void Server::run() {
   }
 }
 
+void Server::set_subscription(std::string op, FdHook on_sub, FdHook on_unsub) {
+  sub_op_ = std::move(op);
+  on_sub_ = std::move(on_sub);
+  on_unsub_ = std::move(on_unsub);
+}
+
 void Server::serve_connection(int fd) {
+  bool subscribed = false;
   while (!stopping_) {
     uint32_t be_len = 0;
     if (!recv_exact(fd, &be_len, sizeof(be_len))) break;
+    if (subscribed) continue;  // push-only: drain but ignore client frames
     uint32_t len = ntohl(be_len);
     if (len == 0 || len > kMaxFrame) break;
     std::vector<char> body(len);
@@ -94,12 +102,21 @@ void Server::serve_connection(int fd) {
     } else {
       response = handler_(op, request);
     }
+    if (!sub_op_.empty() && op == sub_op_ && on_sub_) {
+      // The event source sends the baseline itself (atomically with the
+      // registration) and owns all writes from here; this thread keeps
+      // reading only to notice the hangup.
+      subscribed = true;
+      on_sub_(fd);
+      continue;
+    }
     uint32_t out_len = htonl(static_cast<uint32_t>(response.size()));
     if (!send_all(fd, &out_len, sizeof(out_len)) ||
         !send_all(fd, response.data(), response.size())) {
       break;
     }
   }
+  if (subscribed && on_unsub_) on_unsub_(fd);
   close(fd);
 }
 

@@ -14,6 +14,7 @@ namespace cpagent {
 
 using Handler = std::function<std::string(const std::string& op,
                                           const std::string& request_json)>;
+using FdHook = std::function<void(int fd)>;
 
 class Server {
  public:
@@ -26,11 +27,20 @@ class Server {
   void run();
   void stop();
 
+  // Marks `op` as a subscription: after its response is sent the
+  // connection becomes push-only — on_sub(fd) hands the fd to the event
+  // source (which then owns all writes), the server thread keeps
+  // reading only to detect hangup, and on_unsub(fd) runs before close.
+  void set_subscription(std::string op, FdHook on_sub, FdHook on_unsub);
+
  private:
   void serve_connection(int fd);
 
   std::string socket_path_;
   Handler handler_;
+  std::string sub_op_;
+  FdHook on_sub_;
+  FdHook on_unsub_;
   int listen_fd_ = -1;
   std::atomic<bool> stopping_{false};
 };

@@ -7,6 +7,7 @@
 #include <algorithm>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 
 namespace cpagent {
 
@@ -72,6 +73,18 @@ int env_chip_count(const std::string& bounds) {
 
 }  // namespace
 
+// accelN -> N; vfio/<N> -> N; anything unparseable gets -1.
+int index_from_node(const std::string& path) {
+  auto slash = path.rfind('/');
+  std::string name = slash == std::string::npos ? path : path.substr(slash + 1);
+  if (name.rfind("accel", 0) == 0) name = name.substr(5);
+  if (name.empty()) return -1;
+  for (char c : name) {
+    if (c < '0' || c > '9') return -1;
+  }
+  return std::atoi(name.c_str());
+}
+
 Topology read_topology(const std::string& root) {
   Topology t;
   t.accelerator_type = getenv_str("TPU_ACCELERATOR_TYPE");
@@ -80,25 +93,55 @@ Topology read_topology(const std::string& root) {
   const std::string worker = getenv_str("TPU_WORKER_ID");
   t.worker_id = worker.empty() ? 0 : std::atoi(worker.c_str());
 
+  // Chip index comes from the node NAME (accel1 is chip 1 even when
+  // accel0 has vanished) — enumeration order would renumber survivors
+  // and mask exactly the failure the agent exists to surface.
   auto nodes = accel_device_nodes(root);
-  int idx = 0;
+  std::map<int, std::string> by_index;
+  std::vector<std::string> unparseable;
   for (const auto& path : nodes) {
-    ChipInfo c;
-    c.index = idx++;
-    c.dev_path = path;
-    c.present = true;
-    c.openable = probe_openable(path);
-    t.chips.push_back(c);
+    int idx = index_from_node(path);
+    if (idx < 0) {
+      unparseable.push_back(path);
+    } else if (by_index.find(idx) == by_index.end()) {
+      by_index[idx] = path;
+    }
   }
-  // Env declares more chips than device nodes (e.g. runtime owns them or
-  // test env): synthesize the remainder as env-declared, health unknown
-  // but presumed present — the VSP treats them as healthy-by-default.
+  // Nodes whose names carry no index (e.g. vfio "noiommu-0") pack into
+  // the next free slots — parking them at a large offset would fabricate
+  // a gap of absent "chips" below them.
+  int next_free = by_index.empty() ? 0 : by_index.rbegin()->first + 1;
+  for (const auto& path : unparseable) by_index[next_free++] = path;
   int declared = env_chip_count(t.chips_per_host_bounds);
-  for (int i = idx; i < declared; ++i) {
+  if (by_index.empty()) {
+    // No observable nodes at all (runtime owns them, or test env):
+    // env-declared chips are presumed present — there is nothing to
+    // check them against.
+    for (int i = 0; i < declared; ++i) {
+      ChipInfo c;
+      c.index = i;
+      c.present = true;
+      c.openable = true;
+      t.chips.push_back(c);
+    }
+    return t;
+  }
+  // Nodes are observable: every declared index WITHOUT a node is a chip
+  // that fell off the bus (the PERST-analogue event), reported unhealthy.
+  int max_seen = by_index.rbegin()->first;
+  int span = declared > max_seen + 1 ? declared : max_seen + 1;
+  for (int i = 0; i < span; ++i) {
     ChipInfo c;
     c.index = i;
-    c.present = true;
-    c.openable = true;
+    auto it = by_index.find(i);
+    if (it != by_index.end()) {
+      c.dev_path = it->second;
+      c.present = true;
+      c.openable = probe_openable(it->second);
+    } else {
+      c.present = false;
+      c.openable = false;
+    }
     t.chips.push_back(c);
   }
   return t;

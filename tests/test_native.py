@@ -95,6 +95,166 @@ def test_cp_agent_detects_unhealthy_chip(native_binaries, tmp_root):
     assert chips == {"0": True, "1": False}
 
 
+def _start_agent(native_binaries, root, sock, config=None, env_extra=None):
+    args = [native_binaries["cp_agent"], "--socket", sock, "--root", root]
+    if config:
+        args += ["--config", config]
+    env = {"PATH": os.environ["PATH"]}
+    env.update(env_extra or {})
+    proc = subprocess.Popen(args, env=env, stderr=subprocess.PIPE)
+    deadline = time.monotonic() + 5
+    while not os.path.exists(sock) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert os.path.exists(sock), "cp-agent socket never appeared"
+    return proc
+
+
+def test_cp_agent_config_application(native_binaries, tmp_root):
+    """app_config.c analogue: the config declares what SHOULD exist; a
+    chip the config expects but the scan can't see is unhealthy, and
+    min_healthy_chips relaxes the ping policy."""
+    os.makedirs(os.path.join(tmp_root.root, "dev"), exist_ok=True)
+    open(os.path.join(tmp_root.root, "dev", "accel0"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("# test config\nexpected_chips = 2\nmin_healthy_chips = 1\n"
+                "rescan_ms = 100\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+        conf = client.config()
+        assert conf["expected_chips"] == 2
+        assert conf["min_healthy_chips"] == 1
+        # accel1 is expected but absent → unhealthy.
+        assert client.chip_health() == {0: True, 1: False}
+        # min_healthy_chips=1 keeps overall ping healthy despite it.
+        assert client.ping()["healthy"] is True
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_cp_agent_pushes_health_change_events(native_binaries, tmp_root):
+    """The event loop: removing a chip node produces a pushed
+    health_change frame on a subscribed connection within 1 s — no
+    client poll involved (octep PERST-event analogue)."""
+    devdir = os.path.join(tmp_root.root, "dev")
+    os.makedirs(devdir, exist_ok=True)
+    open(os.path.join(devdir, "accel0"), "w").close()
+    open(os.path.join(devdir, "accel1"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("expected_chips = 2\nrescan_ms = 100\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+        events = client.subscribe()
+        baseline = next(events)
+        assert baseline["event"] == "baseline"
+        assert baseline["chips"] == {0: True, 1: True}
+
+        os.unlink(os.path.join(devdir, "accel1"))
+        t0 = time.monotonic()
+        ev = next(events)
+        latency = time.monotonic() - t0
+        assert ev["event"] == "health_change"
+        assert ev["chips"] == {0: True, 1: False}
+        assert ev["healthy"] is False
+        assert latency < 1.0, f"event took {latency:.2f}s"
+        events.close()
+
+        stats = client.stats()
+        assert stats["events_pushed"] >= 1
+        assert stats["generation"] >= 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_cp_agent_stats_histograms(cp_agent):
+    from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+    client = CpAgentClient(cp_agent)
+    client.ping()
+    client.topology()
+    client.ping()
+    stats = client.stats()
+    assert stats["ops"]["ping"] >= 2
+    assert stats["ops"]["topology"] >= 1
+    lat = stats["latency_us"]
+    assert set(lat) == {"lt_100us", "lt_1ms", "lt_10ms", "ge_10ms"}
+    # The in-flight stats request counts in `requests` but its own
+    # latency is recorded only after the response is built.
+    assert sum(lat.values()) == stats["requests"] - 1
+    assert stats["heartbeats"] >= 0
+
+
+def test_vsp_reacts_to_pushed_chip_loss(native_binaries, tmp_root):
+    """End-to-end VERDICT r1 #4 'done' criterion: chip-node removal flips
+    the tpuvsp's GetDevices health within 1 s WITHOUT any request-path
+    probing — the VSP's background watcher consumes pushed events."""
+    from dpu_operator_tpu.parallel.topology import SliceTopology
+    from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+    from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+    from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
+
+    devdir = os.path.join(tmp_root.root, "dev")
+    os.makedirs(devdir, exist_ok=True)
+    open(os.path.join(devdir, "accel0"), "w").close()
+    open(os.path.join(devdir, "accel1"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("expected_chips = 2\nrescan_ms = 100\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    vsp = None
+    try:
+        topo = SliceTopology.from_env(
+            {"TPU_CHIPS_PER_HOST_BOUNDS": "2,1,1", "TPU_WORKER_ID": "0"}
+        )
+        vsp = TpuVsp(
+            topology=topo,
+            dataplane=DebugDataplane(),
+            cp_agent_client=CpAgentClient(sock),
+            num_endpoints=2,
+        )
+        vsp.Init(pb.InitRequest(dpu_mode=pb.DPU_MODE_DPU, dpu_identifier="t"), None)
+
+        from google.protobuf import empty_pb2
+
+        def health_of(dev_id):
+            devs = vsp.GetDevices(empty_pb2.Empty(), None).devices
+            return devs[dev_id].health == pb.HEALTHY
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not (
+            health_of("tpu0-ep0") and health_of("tpu1-ep0")
+        ):
+            time.sleep(0.05)
+        assert health_of("tpu0-ep0") and health_of("tpu1-ep0")
+
+        os.unlink(os.path.join(devdir, "accel1"))
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 3.0 and health_of("tpu1-ep0"):
+            time.sleep(0.02)
+        flipped_in = time.monotonic() - t0
+        assert not health_of("tpu1-ep0"), "chip loss never surfaced"
+        assert health_of("tpu0-ep0"), "healthy chip must stay healthy"
+        assert flipped_in < 1.0, f"flip took {flipped_in:.2f}s (event path broken?)"
+    finally:
+        if vsp is not None:
+            vsp.stop_watchers()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_cni_shim_binary_against_live_server(native_binaries, tmp_root, netns):
     """The on-disk binary round-trips a real ADD: env + stdin → unix-socket
     HTTP → CNI server → veth in a real netns → JSON result on stdout."""
