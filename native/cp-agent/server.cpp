@@ -58,13 +58,30 @@ bool Server::start() {
     errno = ENAMETOOLONG;
     return false;
   }
-  std::strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
-  unlink(socket_path_.c_str());
+  // Bind to a temp name and rename into place only after listen() so the
+  // advertised path is connectable the instant it exists (clients poll for
+  // the file and would otherwise hit ECONNREFUSED in the bind->listen gap).
+  const std::string tmp_path = socket_path_ + ".tmp";
+  if (tmp_path.size() >= sizeof(addr.sun_path)) {
+    errno = ENAMETOOLONG;
+    return false;
+  }
+  std::strncpy(addr.sun_path, tmp_path.c_str(), sizeof(addr.sun_path) - 1);
+  unlink(tmp_path.c_str());
   if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
     return false;
   }
-  chmod(socket_path_.c_str(), 0600);
-  return listen(listen_fd_, 16) == 0;
+  chmod(tmp_path.c_str(), 0600);
+  if (listen(listen_fd_, 16) != 0) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  unlink(socket_path_.c_str());
+  if (rename(tmp_path.c_str(), socket_path_.c_str()) != 0) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  return true;
 }
 
 void Server::run() {
