@@ -6,15 +6,19 @@ on :9443). The same server class also carries the mutating /mutate
 endpoint used by the network-resources-injector (cmd/nri/
 networkresourcesinjector.go:137-146) — handlers are registered per path.
 
-Stdlib HTTP server; TLS via ssl context when cert/key provided (cert
-hot-reload is handled by re-creating the server — the reference uses
-fsnotify, nri:190-230)."""
+Stdlib HTTP server; TLS via ssl context when cert/key provided. Certs
+hot-reload without dropping the listener: a watcher thread polls the
+cert/key mtimes and re-loads the chain into the live SSLContext, so new
+handshakes serve the rotated cert while established connections are
+untouched — the same guarantee the reference gets from its fsnotify
+watcher (cmd/nri/networkresourcesinjector.go:190-230)."""
 
 from __future__ import annotations
 
 import base64
 import json
 import logging
+import os
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -56,6 +60,7 @@ class AdmissionWebhook:
         port: int = 0,
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
+        cert_reload_interval: float = 1.0,
     ):
         self._handlers: Dict[str, AdmissionHandler] = {}
         self._host = host
@@ -64,6 +69,12 @@ class AdmissionWebhook:
         self._keyfile = keyfile
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        self._reload_interval = cert_reload_interval
+        self._reload_stop = threading.Event()
+        self._reload_thread: Optional[threading.Thread] = None
+        self._cert_mtimes: Tuple[float, float] = (0.0, 0.0)
+        self.certs_reloaded = 0  # observability: bumped on each hot-reload
 
     def register(self, path: str, handler: AdmissionHandler) -> None:
         self._handlers[path] = handler
@@ -135,16 +146,59 @@ class AdmissionWebhook:
                     self.send_error(404)
 
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._reload_stop.clear()  # allow stop() → start() reuse
         if self._certfile:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(self._certfile, self._keyfile)
-            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(self._certfile, self._keyfile)
+            self._cert_mtimes = self._stat_certs()
+            self._server.socket = self._ssl_ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+            self._reload_thread = threading.Thread(
+                target=self._watch_certs, daemon=True, name="webhook-cert-watcher"
+            )
+            self._reload_thread.start()
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="admission-webhook"
         )
         self._thread.start()
 
+    def _stat_certs(self) -> Tuple[float, float]:
+        try:
+            return (
+                os.stat(self._certfile).st_mtime if self._certfile else 0.0,
+                os.stat(self._keyfile).st_mtime if self._keyfile else 0.0,
+            )
+        except OSError:
+            # Rotation in progress (file momentarily absent, e.g. atomic
+            # secret-volume symlink swap) — keep the old chain this round.
+            return self._cert_mtimes
+
+    def reload_certs(self) -> None:
+        """Load the on-disk chain into the live context; new handshakes
+        serve the new cert, the listener never closes."""
+        assert self._ssl_ctx is not None
+        self._ssl_ctx.load_cert_chain(self._certfile, self._keyfile)
+        self.certs_reloaded += 1
+        log.info("webhook: serving certificate reloaded from %s", self._certfile)
+
+    def _watch_certs(self) -> None:
+        while not self._reload_stop.wait(self._reload_interval):
+            current = self._stat_certs()
+            if current != self._cert_mtimes:
+                try:
+                    self.reload_certs()
+                    # Commit the observed mtimes only on success so a
+                    # half-written pair (cert rotated, key not yet) is
+                    # retried every tick until the chain loads.
+                    self._cert_mtimes = current
+                except (ssl.SSLError, OSError):
+                    log.warning("webhook: cert reload failed; retrying", exc_info=True)
+
     def stop(self) -> None:
+        self._reload_stop.set()
+        if self._reload_thread:
+            self._reload_thread.join(timeout=2)
         if self._server:
             self._server.shutdown()
             self._server.server_close()

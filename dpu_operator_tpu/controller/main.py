@@ -1,15 +1,18 @@
 """Operator entrypoint — wires the manager, reconcilers, and webhook.
 
 Counterpart of reference cmd/main.go:61-161: one Manager, four
-reconcilers, the validating webhook, leader election via a k8s Lease is
-TODO (single-replica deployments don't need it; the reference enables it
-optionally)."""
+reconcilers, the validating webhook, and (opt-in via LEADER_ELECT=true,
+matching the reference's --leader-elect flag) Lease-based leader
+election — reconcilers only start once the Lease is acquired, and the
+process exits if leadership is lost so k8s restarts it as a fresh
+candidate."""
 
 from __future__ import annotations
 
 import logging
 import os
 import signal
+import socket
 import threading
 
 from .. import vars as v
@@ -83,13 +86,42 @@ def main() -> None:
     )
     metrics_server.start()
 
-    mgr.start()
-    log.info("operator running (namespace=%s)", v.NAMESPACE)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    elector = None
+    if os.environ.get("LEADER_ELECT", "false").lower() == "true":
+        from ..k8s.leaderelection import LeaderElector
+
+        def _lost_leadership() -> None:
+            # Same policy as controller-runtime: losing the lease after
+            # holding it is fatal — exit and let the pod restart.
+            log.error("lost leader lease; exiting")
+            os._exit(1)
+
+        elector = LeaderElector(
+            client,
+            lease_name=f"{v.NAMESPACE}-leader",
+            namespace=v.NAMESPACE,
+            identity=os.environ.get("POD_NAME", socket.gethostname()),
+            on_started_leading=mgr.start,
+            on_stopped_leading=_lost_leadership,
+        )
+        elector.start()
+        log.info("operator waiting for leader lease (namespace=%s)", v.NAMESPACE)
+    else:
+        mgr.start()
+        log.info("operator running (namespace=%s)", v.NAMESPACE)
+
     stop.wait()
+    # Stop reconcilers BEFORE releasing the lease — releasing first lets
+    # the standby start while our in-flight reconciles still write
+    # (controller-runtime stops runnables before release for the same
+    # reason).
     mgr.stop()
+    if elector:
+        elector.stop()
     metrics_server.stop()
     if webhook:
         webhook.stop()

@@ -42,15 +42,19 @@ class DevicePlugin(services.DevicePluginServicer):
         vendor_plugin,
         path_manager: Optional[PathManager] = None,
         resource_name: str = v.DPU_RESOURCE_NAME,
-        require_pci_ids: bool = False,
+        id_policy: str = "dpu",
         poll_interval: Optional[float] = None,
     ):
         self._vsp = vendor_plugin
         self._pm = path_manager or PathManager()
         self.resource_name = resource_name
-        # Host side enforces PCI-address device IDs; DPU side allows
-        # abstract ids (reference dpudevicehandler.go:58-73).
-        self._require_pci_ids = require_pci_ids
+        # Host side only advertises *addressable* device IDs — a PCI
+        # address or a fabric endpoint (tpuN-epM) the CNI can resolve to
+        # a backing netdev; abstract ids are DPU-side-only (reference
+        # dpudevicehandler.go:58-73 enforces PCI on the host).
+        if id_policy not in ("host", "dpu"):
+            raise ValueError(f"id_policy must be 'host' or 'dpu', got {id_policy!r}")
+        self._id_policy = id_policy
         if poll_interval is not None:
             self.POLL_INTERVAL = poll_interval
         self._server: Optional[grpc.Server] = None
@@ -65,8 +69,11 @@ class DevicePlugin(services.DevicePluginServicer):
         (reference dpudevicehandler.go:48-73)."""
         out: Dict[str, kdp.Device] = {}
         for dev_id, dev in self._vsp.get_devices().items():
-            if self._require_pci_ids and not _is_pci_address(dev_id):
-                log.warning("host-side device id %r is not a PCI address; skipping", dev_id)
+            if self._id_policy == "host" and not _is_host_addressable(dev_id):
+                log.warning(
+                    "host-side device id %r is neither a PCI address nor a "
+                    "fabric endpoint id; skipping", dev_id,
+                )
                 continue
             kd = kdp.Device(
                 ID=dev_id,
@@ -221,3 +228,17 @@ def _is_pci_address(dev_id: str) -> bool:
     import re
 
     return bool(re.fullmatch(r"[0-9a-fA-F]{4}:[0-9a-fA-F]{2}:[0-9a-fA-F]{2}\.[0-7]", dev_id))
+
+
+def _is_host_addressable(dev_id: str) -> bool:
+    """Host-side IDs must resolve to something the CNI can plumb: a PCI
+    address, or a fabric endpoint id in the `<device>-ep<queue>` grammar
+    every VSP's GetDevices uses for plumb-able endpoints (TpuVsp:
+    tpu0-ep1, mock VSP: mock-ep0 — the grammar is vendor-neutral so a
+    third VSP doesn't need this file edited). Genuinely abstract ids
+    (bare netdev names, uuids) stay DPU-side-only."""
+    import re
+
+    return _is_pci_address(dev_id) or bool(
+        re.fullmatch(r"[a-z][a-z0-9]*-ep\d+", dev_id)
+    )

@@ -83,7 +83,7 @@ class DpuSideManager:
         self.dataplane = NetworkFnDataplane(state)
         self.cni_server = CniServer(self._pm)
         self.cni_server.set_handlers(self._cni_nf_add, self._cni_nf_del)
-        self.device_plugin = DevicePlugin(vendor_plugin, self._pm, require_pci_ids=False)
+        self.device_plugin = DevicePlugin(vendor_plugin, self._pm, id_policy="dpu")
 
         self._opi_server: Optional[grpc.Server] = None
         self._opi_addr: Tuple[str, int] = ("", 0)

@@ -65,9 +65,7 @@ class HostSideManager:
         self.cni_server.set_handlers(
             self._cni_add, self._cni_del, check=self._cni_check
         )
-        self.device_plugin = DevicePlugin(
-            vendor_plugin, self._pm, require_pci_ids=False
-        )
+        self.device_plugin = DevicePlugin(vendor_plugin, self._pm, id_policy="host")
 
         self._opi_addr: Optional[Tuple[str, int]] = None
         self._opi_channel: Optional[grpc.Channel] = None

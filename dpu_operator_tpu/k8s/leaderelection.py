@@ -1,0 +1,252 @@
+"""Lease-based leader election.
+
+Counterpart of the controller-runtime leader election the reference
+enables in its manager (cmd/main.go:80-102, `LeaderElection: true` with a
+coordination.k8s.io/v1 Lease). Semantics follow client-go's
+leaderelection package:
+
+  * a single Lease object is the lock; `spec.holderIdentity` names the
+    current leader, `spec.renewTime` + `spec.leaseDurationSeconds` bound
+    its validity;
+  * candidates poll every `retry_period`; a lease held by another
+    identity is only stolen after it expires;
+  * the leader renews every `retry_period` and abdicates if it cannot
+    renew within `renew_deadline` (apiserver partition) — callers must
+    treat `on_stopped_leading` as fatal, exactly as client-go does
+    (the operator process exits and lets k8s restart it);
+  * on clean `stop()` the lease is released (holder cleared, duration
+    shortened) so the next candidate takes over in ~1 retry period
+    rather than a full lease duration.
+
+Optimistic concurrency does the real work: two candidates that race an
+expired lease both try `update()` from the same resourceVersion and the
+store/apiserver rejects one with Conflict.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from .client import Client
+from .objects import K8sObject
+from .store import AlreadyExists, Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+LEASE_API_VERSION = "coordination.k8s.io/v1"
+LEASE_KIND = "Lease"
+
+# RFC3339 with microseconds, the MicroTime format Lease uses.
+_MICRO_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def _now_micro() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(_MICRO_FMT)
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: Client,
+        lease_name: str,
+        namespace: str,
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        retry_period: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        if retry_period >= renew_deadline:
+            raise ValueError("retry_period must be < renew_deadline")
+        self._client = client
+        self._lease_name = lease_name
+        self._namespace = namespace
+        self.identity = identity or f"{lease_name}-{uuid.uuid4().hex[:8]}"
+        self._lease_duration = lease_duration
+        self._renew_deadline = renew_deadline
+        self._retry_period = retry_period
+        self._on_started = on_started_leading
+        self._on_stopped = on_stopped_leading
+        self._stop = threading.Event()
+        self._voluntary_stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._is_leader = False
+        # Lease validity is judged from *locally observed* renew times,
+        # not the remote wall-clock timestamps — client-go does the same
+        # so that clock skew between nodes cannot break mutual exclusion:
+        # a lease only expires after we watched it go un-renewed for a
+        # full lease_duration on our own monotonic clock.
+        self._observed_record: Optional[tuple] = None
+        self._observed_at: float = 0.0
+
+    # -- observability --------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def leader_identity(self) -> Optional[str]:
+        """Current holder as recorded in the Lease (None if unheld)."""
+        lease = self._client.get_or_none(
+            LEASE_API_VERSION, LEASE_KIND, self._namespace, self._lease_name
+        )
+        if lease is None:
+            return None
+        return (lease.get("spec") or {}).get("holderIdentity") or None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"leader-elector-{self.identity}"
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        # Voluntary shutdown must not fire on_stopped_leading — callers
+        # wire that to "fatal, exit non-zero" (main.py), which is only
+        # correct for *losing* the lease, not releasing it.
+        self._voluntary_stop = True
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout)
+        if self._is_leader:
+            self._release()
+            self._is_leader = False
+            log.info("leader election: %s released leadership", self.identity)
+
+    # -- internals ------------------------------------------------------------
+
+    def _set_leader(self, leading: bool) -> None:
+        was = self._is_leader
+        self._is_leader = leading
+        if leading and not was:
+            log.info("leader election: %s became leader", self.identity)
+            if self._on_started:
+                try:
+                    self._on_started()
+                except Exception:
+                    # A dead on_started (e.g. manager failed to start)
+                    # while we hold the lease would leave the process
+                    # "leading" but doing nothing. Abdicate and take the
+                    # fatal on_stopped path so the pod restarts.
+                    log.exception(
+                        "leader election: on_started_leading failed; abdicating"
+                    )
+                    self._release()
+                    self._set_leader(False)
+                    self._stop.set()
+        elif was and not leading:
+            log.warning("leader election: %s lost leadership", self.identity)
+            if self._on_stopped and not self._voluntary_stop:
+                self._on_stopped()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                self._set_leader(True)
+                self._renew_loop()
+                if self._stop.is_set():
+                    return
+                # lost leadership (renewal starvation) — fall back to
+                # candidate mode only via on_stopped; client-go exits here.
+                return
+            self._stop.wait(self._retry_period)
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            deadline = time.monotonic() + self._renew_deadline
+            renewed = False
+            while time.monotonic() < deadline and not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    renewed = True
+                    break
+                self._stop.wait(min(self._retry_period, 0.5))
+            if not renewed:
+                self._set_leader(False)
+                return
+            self._stop.wait(self._retry_period)
+
+    def _new_lease(self) -> K8sObject:
+        now = _now_micro()
+        return {
+            "apiVersion": LEASE_API_VERSION,
+            "kind": LEASE_KIND,
+            "metadata": {"name": self._lease_name, "namespace": self._namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self._lease_duration),
+                "acquireTime": now,
+                "renewTime": now,
+                "leaseTransitions": 0,
+            },
+        }
+
+    def _try_acquire_or_renew(self) -> bool:
+        try:
+            lease = self._client.get_or_none(
+                LEASE_API_VERSION, LEASE_KIND, self._namespace, self._lease_name
+            )
+            if lease is None:
+                try:
+                    self._client.create(self._new_lease())
+                    return True
+                except (AlreadyExists, Conflict):
+                    return False
+            spec = lease.setdefault("spec", {})
+            holder = spec.get("holderIdentity") or ""
+            duration = float(spec.get("leaseDurationSeconds") or self._lease_duration)
+            if holder and holder != self.identity:
+                record = (holder, spec.get("renewTime") or "")
+                if record != self._observed_record:
+                    # Renewal observed — restart the local expiry clock.
+                    # A fresh candidate therefore waits out one full
+                    # lease_duration before stealing, never trusting the
+                    # remote timestamp (which may be skewed).
+                    self._observed_record = record
+                    self._observed_at = time.monotonic()
+                if time.monotonic() - self._observed_at < duration:
+                    return False  # valid lease held by someone else
+            # Acquire (expired/unheld) or renew (ours).
+            now = _now_micro()
+            if holder != self.identity:
+                spec["leaseTransitions"] = int(spec.get("leaseTransitions") or 0) + 1
+                spec["acquireTime"] = now
+            spec["holderIdentity"] = self.identity
+            spec["leaseDurationSeconds"] = int(self._lease_duration)
+            spec["renewTime"] = now
+            try:
+                self._client.update(lease)
+                return True
+            except (Conflict, NotFound):
+                return False
+        except Exception:
+            log.exception("leader election: acquire/renew attempt failed")
+            return False
+
+    def _release(self) -> None:
+        """Clean handover: clear the holder so candidates don't wait out
+        the full lease duration (client-go's ReleaseOnCancel)."""
+        try:
+            lease = self._client.get_or_none(
+                LEASE_API_VERSION, LEASE_KIND, self._namespace, self._lease_name
+            )
+            if lease is None:
+                return
+            spec = lease.setdefault("spec", {})
+            if spec.get("holderIdentity") != self.identity:
+                return
+            spec["holderIdentity"] = ""
+            spec["leaseDurationSeconds"] = 1
+            spec["renewTime"] = _now_micro()
+            self._client.update(lease)
+        except Exception:
+            log.debug("leader election: release failed", exc_info=True)

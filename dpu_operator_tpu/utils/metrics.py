@@ -100,12 +100,23 @@ default_registry = Registry()
 
 
 class MetricsServer:
-    """HTTP /metrics + /healthz on a given port (0 → ephemeral)."""
+    """HTTP /metrics + /healthz on a given port (0 → ephemeral).
+
+    When `auth_token` is set (or METRICS_AUTH_TOKEN in the environment),
+    /metrics requires `Authorization: Bearer <token>` — the stand-in for
+    the reference's kube-rbac authn/authz filter on its metrics endpoint
+    (cmd/main.go:82-86, FilterProvider). Health endpoints stay open, as
+    kubelet probes are unauthenticated there too."""
 
     def __init__(self, registry: Optional[Registry] = None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, auth_token: Optional[str] = None):
+        import os
+
         self._registry = registry or default_registry
         registry_ref = self._registry
+        token = auth_token if auth_token is not None else os.environ.get(
+            "METRICS_AUTH_TOKEN"
+        )
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -113,6 +124,20 @@ class MetricsServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
+                    import hmac
+
+                    presented = self.headers.get("Authorization") or ""
+                    if token and not hmac.compare_digest(
+                        presented, f"Bearer {token}"
+                    ):
+                        body = b"unauthorized"
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate", "Bearer")
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     body = registry_ref.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")

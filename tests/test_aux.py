@@ -105,6 +105,36 @@ def test_metrics_server_serves_http():
         srv.stop()
 
 
+def test_metrics_server_bearer_auth():
+    """With a token configured, /metrics is 401 without the right
+    Authorization header; /healthz stays open (reference authn/authz
+    filter, cmd/main.go:82-86)."""
+    r = Registry()
+    r.counter_inc("x_total", help="x")
+    srv = MetricsServer(registry=r, port=0, auth_token="s3cret")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/metrics")
+        assert ei.value.code == 401
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req = urllib.request.Request(
+                f"{base}/metrics", headers={"Authorization": "Bearer wrong"}
+            )
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401
+
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Authorization": "Bearer s3cret"}
+        )
+        assert "x_total 1.0" in urllib.request.urlopen(req).read().decode()
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+    finally:
+        srv.stop()
+
+
 def test_cni_requests_counted_through_server(tmp_root):
     """The CNI server increments dpu_cni_requests_total on handled calls."""
     from dpu_operator_tpu.cni import CniRequest, CniServer, do_cni

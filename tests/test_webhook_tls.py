@@ -1,0 +1,139 @@
+"""Webhook TLS: the ssl-context branch a real apiserver uses, plus cert
+hot-reload (VERDICT r1 Missing #4 / Weak #5).
+
+Self-signed certs are minted in a tmpdir with `cryptography`; the
+hot-reload test rotates them on disk and asserts the rotated serial is
+served by the same listener without a restart — the guarantee the
+reference gets from fsnotify (cmd/nri/networkresourcesinjector.go:190-230)."""
+
+import datetime
+import ipaddress
+import json
+import socket
+import ssl
+import time
+import urllib.request
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+from dpu_operator_tpu.api.webhook import AdmissionWebhook, validate_dpu_operator_config
+
+
+def _mint_cert(tmp_path, serial: int):
+    """Self-signed localhost cert; returns (certfile, keyfile)."""
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(serial)
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [
+                    x509.DNSName("localhost"),
+                    x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                ]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    certfile = tmp_path / "tls.crt"
+    keyfile = tmp_path / "tls.key"
+    certfile.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    keyfile.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(certfile), str(keyfile)
+
+
+def _served_serial(port: int) -> int:
+    """Handshake and return the serial of the cert the server presents."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+        with ctx.wrap_socket(sock, server_hostname="localhost") as tls:
+            der = tls.getpeercert(binary_form=True)
+    return x509.load_der_x509_certificate(der).serial_number
+
+
+def _review(obj: dict) -> dict:
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "u-1", "object": obj},
+    }
+
+
+def test_admission_over_tls_with_verified_chain(tmp_path):
+    """Full AdmissionReview round trip over HTTPS, client *verifying* the
+    server cert — exactly what a real apiserver does with caBundle."""
+    certfile, keyfile = _mint_cert(tmp_path, serial=100)
+    wh = AdmissionWebhook(port=0, certfile=certfile, keyfile=keyfile)
+    wh.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
+    wh.start()
+    try:
+        ctx = ssl.create_default_context(cafile=certfile)
+        good = _review(
+            {
+                "metadata": {"name": "dpu-operator-config"},
+                "spec": {"logLevel": 1},
+            }
+        )
+        req = urllib.request.Request(
+            f"https://localhost:{wh.port}/validate-dpuoperatorconfig",
+            data=json.dumps(good).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, context=ctx).read())
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["uid"] == "u-1"
+
+        bad = _review({"metadata": {"name": "wrong-name"}, "spec": {}})
+        req = urllib.request.Request(
+            f"https://localhost:{wh.port}/validate-dpuoperatorconfig",
+            data=json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(urllib.request.urlopen(req, context=ctx).read())
+        assert resp["response"]["allowed"] is False
+    finally:
+        wh.stop()
+
+
+def test_cert_hot_reload_same_listener(tmp_path):
+    certfile, keyfile = _mint_cert(tmp_path, serial=1111)
+    wh = AdmissionWebhook(
+        port=0, certfile=certfile, keyfile=keyfile, cert_reload_interval=0.1
+    )
+    wh.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
+    wh.start()
+    try:
+        port = wh.port
+        assert _served_serial(port) == 1111
+
+        # Rotate on disk — same paths, new pair (cert-manager style).
+        _mint_cert(tmp_path, serial=2222)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and wh.certs_reloaded == 0:
+            time.sleep(0.05)
+        assert wh.certs_reloaded >= 1
+
+        # Same port, no restart, new cert served.
+        assert _served_serial(port) == 2222
+        assert wh.port == port
+    finally:
+        wh.stop()
