@@ -2,7 +2,7 @@
 # taskfile.yaml task system).
 
 .PHONY: all native proto test fast-test e2e-test traffic-flow-tests bench \
-        build-images deploy undeploy clean
+        build-images deploy undeploy clean bundle bundle-check
 
 IMG_REGISTRY ?= localhost
 KUSTOMIZE ?= kubectl kustomize
@@ -38,6 +38,17 @@ build-images:
 	docker build -f Dockerfile.tpuVSP -t $(IMG_REGISTRY)/tpu-vsp:latest .
 	docker build -f Dockerfile.cpAgent -t $(IMG_REGISTRY)/dpu-cp-agent:latest .
 	docker build -f Dockerfile.nri -t $(IMG_REGISTRY)/dpu-nri:latest .
+
+# Regenerate the OLM bundle from config/ (counterpart of the reference's
+# operator-sdk `make bundle IMG=...`, taskfiles/operator-sdk.yaml).
+# `make bundle IMG=reg/mgr:v1` pins the manager image; operand images via
+# e.g. `make bundle IMG=... ENV_IMAGES="DPU_DAEMON_IMAGE=reg/daemon:v1"`.
+bundle:
+	python scripts/gen_bundle.py $(if $(IMG),--img $(IMG)) \
+		$(foreach e,$(ENV_IMAGES),--env $(e))
+
+bundle-check:
+	python scripts/gen_bundle.py --check
 
 deploy:
 	$(KUSTOMIZE) config/default | kubectl apply -f -

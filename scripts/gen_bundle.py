@@ -1,0 +1,322 @@
+#!/usr/bin/env python3
+"""Regenerate bundle/ from config/ — the operator-sdk `make bundle`
+equivalent (reference taskfiles/operator-sdk.yaml drives operator-sdk
+generate kustomize manifests + bundle; we do the same merge in-process).
+
+Inputs:
+  config/manifests/bases/*.clusterserviceversion.yaml   hand-written CSV half
+  config/manager/manager.yaml                           Deployment → CSV install strategy
+  config/rbac/rbac.yaml                                 ClusterRole/Role rules → CSV permissions
+  config/webhook/webhook.yaml                           webhook config → CSV webhookdefinitions
+  config/crd/*.yaml                                     CRDs → bundle/manifests copies
+  config/rbac/{metrics_reader_role,metrics_service}.yaml, webhook Service
+                                                        → standalone bundle manifests
+  config/scorecard/                                     → bundle/tests/scorecard/config.yaml
+
+Outputs (overwritten in place):
+  bundle/manifests/*.yaml
+  bundle/metadata/annotations.yaml
+  bundle/tests/scorecard/config.yaml
+
+Deterministic: same inputs ⇒ byte-identical outputs, so
+tests/test_manifests.py can assert the committed bundle is fresh.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "config")
+BUNDLE = os.path.join(REPO, "bundle")  # output root; --check swaps in a tmpdir
+
+SA_NAME = "tpu-dpu-operator-controller-manager"
+
+
+def _load(path):
+    with open(path) as fh:
+        return list(yaml.safe_load_all(fh))
+
+
+def _write(relpath, docs, header=None):
+    path = os.path.join(BUNDLE, relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    out = []
+    if header:
+        out.append(header.rstrip() + "\n")
+    bodies = [
+        yaml.safe_dump(d, sort_keys=False, default_flow_style=False) for d in docs
+    ]
+    out.append("---\n".join(bodies))
+    with open(path, "w") as fh:
+        fh.write("".join(out))
+    return path
+
+
+def _find(docs, kind, name=None):
+    for d in docs:
+        if d and d.get("kind") == kind:
+            if name is None or d["metadata"]["name"] == name:
+                return d
+    raise SystemExit(f"gen_bundle: no {kind} {name or ''} found")
+
+
+def gen_crds():
+    """config/crd/*.yaml → bundle/manifests/config.tpu.io_<plural>.yaml
+    (reference bundle/manifests/config.openshift.io_*.yaml)."""
+    written = []
+    for path in sorted(glob.glob(os.path.join(CONFIG, "crd", "*.yaml"))):
+        if os.path.basename(path) == "kustomization.yaml":
+            continue
+        for doc in _load(path):
+            if not doc or doc.get("kind") != "CustomResourceDefinition":
+                continue
+            plural = doc["spec"]["names"]["plural"]
+            group = doc["spec"]["group"]
+            doc = copy.deepcopy(doc)
+            doc["metadata"].setdefault("annotations", {})[
+                "operators.operatorframework.io/builder"
+            ] = "gen_bundle.py"
+            doc["metadata"]["creationTimestamp"] = None
+            written.append(
+                _write(f"manifests/{group}_{plural}.yaml", [doc])
+            )
+    return written
+
+
+def gen_csv(img=None, env_images=None):
+    """Merge the base CSV with the generated install strategy, RBAC, and
+    webhook definitions. `img` substitutes the manager image, `env_images`
+    (dict of ENV_NAME→ref) the operand images — the `make bundle IMG=...`
+    flow; without them the config/ placeholders ship, as operator-sdk's
+    defaults do."""
+    base = _find(
+        _load(
+            os.path.join(
+                CONFIG, "manifests", "bases", "tpu-dpu-operator.clusterserviceversion.yaml"
+            )
+        ),
+        "ClusterServiceVersion",
+    )
+    csv = copy.deepcopy(base)
+
+    manager_docs = _load(os.path.join(CONFIG, "manager", "manager.yaml"))
+    deployment = copy.deepcopy(_find(manager_docs, "Deployment"))
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    if img:
+        container["image"] = img
+    for envvar in container.get("env", []):
+        if env_images and envvar["name"] in env_images:
+            envvar["value"] = env_images[envvar["name"]]
+    rbac_docs = _load(os.path.join(CONFIG, "rbac", "rbac.yaml"))
+    cluster_role = _find(rbac_docs, "ClusterRole")
+    leader_role = _find(rbac_docs, "Role")
+    metrics_auth = _find(
+        _load(os.path.join(CONFIG, "rbac", "metrics_auth_role.yaml")), "ClusterRole"
+    )
+    webhook_docs = _load(os.path.join(CONFIG, "webhook", "webhook.yaml"))
+    vwc = _find(webhook_docs, "ValidatingWebhookConfiguration")
+    webhook_svc_port = _find(webhook_docs, "Service")["spec"]["ports"][0]
+
+    csv["spec"]["install"] = {
+        "strategy": "deployment",
+        "spec": {
+            "deployments": [
+                {
+                    "label": deployment["metadata"].get("labels", {}),
+                    "name": deployment["metadata"]["name"],
+                    "spec": deployment["spec"],
+                }
+            ],
+            "permissions": [
+                {"serviceAccountName": SA_NAME, "rules": leader_role["rules"]}
+            ],
+            "clusterPermissions": [
+                {
+                    "serviceAccountName": SA_NAME,
+                    "rules": cluster_role["rules"] + metrics_auth["rules"],
+                }
+            ],
+        },
+    }
+
+    csv["spec"]["webhookdefinitions"] = [
+        {
+            "type": "ValidatingAdmissionWebhook",
+            "admissionReviewVersions": wh["admissionReviewVersions"],
+            "containerPort": webhook_svc_port["port"],
+            "targetPort": webhook_svc_port["targetPort"],
+            "deploymentName": deployment["metadata"]["name"],
+            "failurePolicy": wh["failurePolicy"],
+            "generateName": wh["name"],
+            "rules": wh["rules"],
+            "sideEffects": wh["sideEffects"],
+            "webhookPath": wh["clientConfig"]["service"]["path"],
+        }
+        for wh in vwc["webhooks"]
+    ]
+
+    images = {
+        env["name"]: env["value"]
+        for env in container.get("env", [])
+        if env["name"].endswith("_IMAGE")
+    }
+    csv["spec"]["relatedImages"] = [
+        {"name": "manager", "image": container["image"]}
+    ] + [
+        {"name": k.removesuffix("_IMAGE").lower(), "image": v}
+        for k, v in sorted(images.items())
+    ]
+
+    # alm-examples: one sample per owned CRD, from config/samples.
+    samples = []
+    for path in sorted(glob.glob(os.path.join(CONFIG, "samples", "*.yaml"))):
+        if os.path.basename(path) == "kustomization.yaml":
+            continue
+        samples.extend(d for d in _load(path) if d)
+    csv["metadata"].setdefault("annotations", {})["alm-examples"] = yaml.safe_dump(
+        samples, sort_keys=False
+    )
+
+    return _write(
+        "manifests/tpu-dpu-operator.clusterserviceversion.yaml",
+        [csv],
+        header=(
+            "# GENERATED by scripts/gen_bundle.py from config/ — do not edit.\n"
+            "# (counterpart of the reference's operator-sdk generated CSV,\n"
+            "# bundle/manifests/dpu-operator.clusterserviceversion.yaml)"
+        ),
+    )
+
+
+def gen_services_and_roles():
+    metrics_svc = _find(
+        _load(os.path.join(CONFIG, "rbac", "metrics_service.yaml")), "Service"
+    )
+    _write(
+        "manifests/tpu-dpu-operator-controller-manager-metrics-service_v1_service.yaml",
+        [metrics_svc],
+    )
+    reader = _find(
+        _load(os.path.join(CONFIG, "rbac", "metrics_reader_role.yaml")), "ClusterRole"
+    )
+    _write(
+        "manifests/tpu-dpu-operator-metrics-reader_rbac.authorization.k8s.io_v1_clusterrole.yaml",
+        [reader],
+    )
+    webhook_svc = _find(
+        _load(os.path.join(CONFIG, "webhook", "webhook.yaml")), "Service"
+    )
+    _write(
+        "manifests/tpu-dpu-operator-webhook-service_v1_service.yaml", [webhook_svc]
+    )
+
+
+def gen_scorecard():
+    """Apply the scorecard patches to the base the way kustomize would
+    (simple RFC6902 'add' ops only). The patch list comes from
+    config/scorecard/kustomization.yaml so it is the single source of
+    truth."""
+    scorecard_dir = os.path.join(CONFIG, "scorecard")
+    with open(os.path.join(scorecard_dir, "kustomization.yaml")) as fh:
+        kustomization = yaml.safe_load(fh)
+    base_rel = kustomization["resources"][0]
+    cfg = _find(_load(os.path.join(scorecard_dir, base_rel)), "Configuration")
+    cfg = copy.deepcopy(cfg)
+    for patch in kustomization.get("patches", []):
+        with open(os.path.join(scorecard_dir, patch["path"])) as fh:
+            for op in yaml.safe_load(fh):
+                assert op["op"] == "add" and op["path"] == "/stages/0/tests/-", op
+                cfg["stages"][0]["tests"].append(op["value"])
+    _write("tests/scorecard/config.yaml", [cfg])
+
+
+def gen_annotations():
+    annotations = {
+        "annotations": {
+            "operators.operatorframework.io.bundle.mediatype.v1": "registry+v1",
+            "operators.operatorframework.io.bundle.manifests.v1": "manifests/",
+            "operators.operatorframework.io.bundle.metadata.v1": "metadata/",
+            "operators.operatorframework.io.bundle.package.v1": "tpu-dpu-operator",
+            "operators.operatorframework.io.bundle.channels.v1": "alpha",
+            "operators.operatorframework.io.bundle.channel.default.v1": "alpha",
+            "operators.operatorframework.io.test.mediatype.v1": "scorecard+v1",
+            "operators.operatorframework.io.test.config.v1": "tests/scorecard/",
+        }
+    }
+    _write("metadata/annotations.yaml", [annotations])
+
+
+def main(check: bool = False) -> int:
+    if check:
+        # Generate into a scratch dir and diff — never mutate bundle/.
+        import subprocess
+        import tempfile
+
+        global BUNDLE
+        committed = BUNDLE
+        with tempfile.TemporaryDirectory() as tmp:
+            BUNDLE = os.path.join(tmp, "bundle")
+            try:
+                _run()
+            finally:
+                fresh, BUNDLE = BUNDLE, committed
+            rc = subprocess.run(
+                ["diff", "-r", committed, fresh], capture_output=True, text=True
+            )
+            if rc.returncode != 0:
+                print(rc.stdout)
+                print("bundle/ is stale — run `make bundle`", file=sys.stderr)
+                return 1
+        return 0
+    _run()
+    return 0
+
+
+def _run(img=None, env_images=None) -> None:
+    # Fresh output tree so deleted/renamed inputs can't leave stale
+    # manifests behind (which --check's diff would flag forever).
+    import shutil
+
+    for sub in ("manifests", "metadata", "tests"):
+        shutil.rmtree(os.path.join(BUNDLE, sub), ignore_errors=True)
+    gen_crds()
+    gen_csv(img=img, env_images=env_images)
+    gen_services_and_roles()
+    gen_scorecard()
+    gen_annotations()
+    print(f"bundle regenerated under {BUNDLE}")
+
+
+def _parse_args(argv):
+    """--check | [--img REF] [--env NAME=REF]..."""
+    img = None
+    env_images = {}
+    check = False
+    it = iter(argv)
+    for arg in it:
+        if arg == "--check":
+            check = True
+        elif arg == "--img":
+            img = next(it)
+        elif arg == "--env":
+            name, _, ref = next(it).partition("=")
+            env_images[name] = ref
+        else:
+            raise SystemExit(f"gen_bundle: unknown argument {arg}")
+    return check, img, env_images
+
+
+if __name__ == "__main__":
+    _check, _img, _envs = _parse_args(sys.argv[1:])
+    if _check and (_img or _envs):
+        raise SystemExit("gen_bundle: --check compares against config/ defaults")
+    if _check:
+        sys.exit(main(check=True))
+    _run(img=_img, env_images=_envs)
+    sys.exit(0)
