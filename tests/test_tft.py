@@ -5,6 +5,7 @@ submodule wiring (SURVEY §4 tier 4)."""
 import json
 import os
 import subprocess
+import time
 import sys
 
 import pytest
@@ -82,3 +83,39 @@ def test_traffic_flow_script_self_contained(netns):
     assert by_type["udp"]["gbps"] > 0
     assert by_type["tcp-stream"]["gbps"] > 0
     assert by_type["tcp-rr"]["tps"] > 0
+
+
+def test_native_pump_preferred_and_tagged(tmp_path):
+    """When native/build/tft-pump exists the engines exec it (interpreter
+    out of the byte loop); TFT_PUMP=python forces the fallback. Both tag
+    their JSON with `engine` so recorded numbers are honest about what
+    produced them (VERDICT r1 Weak #2)."""
+    from dpu_operator_tpu.tft.engine import find_pump
+
+    pump = find_pump()
+    if pump is None:
+        pytest.skip("native tft-pump not built")
+
+    def run_pair(env_extra):
+        port = 21000 + os.getpid() % 2000 + (1 if env_extra else 0)
+        env = dict(os.environ, **env_extra)
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "dpu_operator_tpu.tft.engine",
+             "server", "netperf-tcp-rr", "127.0.0.1", str(port), "1"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        time.sleep(0.3)
+        cli = subprocess.run(
+            [sys.executable, "-m", "dpu_operator_tpu.tft.engine",
+             "client", "netperf-tcp-rr", "127.0.0.1", str(port), "1"],
+            capture_output=True, text=True, env=env, timeout=30)
+        srv_out, _ = srv.communicate(timeout=30)
+        return (json.loads(srv_out.strip().splitlines()[-1]),
+                json.loads(cli.stdout.strip().splitlines()[-1]))
+
+    srv_res, cli_res = run_pair({})
+    assert srv_res["engine"] == "c" and cli_res["engine"] == "c"
+    assert cli_res["transactions"] > 0
+
+    srv_res, cli_res = run_pair({"TFT_PUMP": "python"})
+    assert srv_res["engine"] == "python" and cli_res["engine"] == "python"
+    assert cli_res["transactions"] > 0
