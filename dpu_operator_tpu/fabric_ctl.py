@@ -20,7 +20,13 @@ Commands:
     add-nf MAC0 MAC1                     chain two ports
     del-nf MAC0 MAC1                     unchain
     topology                             slice topology from env/JAX
-"""
+    ports [--bridge BR]                  bridge port + FDB state dump
+    stats [--bridge BR | DEV...] [--rate S]   per-port kernel counters
+    watch [--interval S] [--count N]     stream device-inventory changes
+
+ports/stats inspect the kernel dataplane directly (sysfs + bridge(8)),
+the way p4rt-ctl dumps pipeline tables/counters from infrap4d rather
+than through the dpu-api contract."""
 
 from __future__ import annotations
 
@@ -164,6 +170,158 @@ def cmd_probe(args, chan):
     }))
 
 
+# -- dataplane inspection (p4rt-ctl's table/counter dump surface) -------------
+
+_SYS_NET = "/sys/class/net"
+
+
+def _read_sys(path: str, default: str = "") -> str:
+    try:
+        with open(path) as fh:
+            return fh.read().strip()
+    except OSError:
+        return default
+
+
+def _bridge_ports(bridge: str):
+    import os
+
+    brif = f"{_SYS_NET}/{bridge}/brif"
+    if not os.path.isdir(brif):
+        raise SystemExit(f"fabric-ctl: {bridge} is not a bridge (no {brif})")
+    return sorted(os.listdir(brif))
+
+
+def _fdb_by_port(bridge: str):
+    """`bridge -j fdb show br X` grouped by port; tolerate missing tool."""
+    import subprocess
+    from collections import defaultdict
+
+    out = defaultdict(list)
+    try:
+        r = subprocess.run(
+            ["bridge", "-j", "fdb", "show", "br", bridge],
+            capture_output=True, text=True, check=True,
+        )
+        for e in json.loads(r.stdout or "[]"):
+            out[e.get("ifname", "?")].append(
+                {
+                    "mac": e.get("mac"),
+                    "state": e.get("state", "reachable"),
+                    "flags": e.get("flags", []),
+                }
+            )
+    except (OSError, subprocess.CalledProcessError):
+        pass
+    return out
+
+
+def cmd_ports(args, chan):
+    """Bridge/FDB state dump (p4rt-ctl's table-dump role for the linux-
+    bridge dataplane tpu_dataplane.py programs: enslaved ports, hairpin
+    for NF chaining, static-pinned MACs)."""
+    bridge = args.bridge
+    fdb = _fdb_by_port(bridge)
+    out = {
+        "bridge": bridge,
+        "address": _read_sys(f"{_SYS_NET}/{bridge}/address"),
+        "operstate": _read_sys(f"{_SYS_NET}/{bridge}/operstate"),
+        "ports": {},
+    }
+    for port in _bridge_ports(bridge):
+        out["ports"][port] = {
+            "address": _read_sys(f"{_SYS_NET}/{port}/address"),
+            "mtu": int(_read_sys(f"{_SYS_NET}/{port}/mtu", "0")),
+            "operstate": _read_sys(f"{_SYS_NET}/{port}/operstate"),
+            "hairpin": _read_sys(
+                f"{_SYS_NET}/{bridge}/brif/{port}/hairpin_mode", "0"
+            ) == "1",
+            "fdb": fdb.get(port, []),
+        }
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+_COUNTERS = (
+    "rx_bytes", "rx_packets", "rx_dropped", "rx_errors",
+    "tx_bytes", "tx_packets", "tx_dropped", "tx_errors",
+)
+
+
+def _read_counters(dev: str):
+    return {
+        c: int(_read_sys(f"{_SYS_NET}/{dev}/statistics/{c}", "0"))
+        for c in _COUNTERS
+    }
+
+
+def cmd_stats(args, chan):
+    """Per-port kernel counters (p4rt-ctl's counter-read role). With
+    --rate, sample twice and report per-second deltas alongside totals."""
+    import os
+    import time
+
+    devs = args.devices or _bridge_ports(args.bridge)
+    for d in devs:
+        # A typo'd name must not read as an idle port of all-zero counters.
+        if not os.path.isdir(f"{_SYS_NET}/{d}"):
+            raise SystemExit(f"fabric-ctl: no such netdev {d}")
+    first = {d: _read_counters(d) for d in devs}
+    if args.rate is None:
+        print(json.dumps(first, indent=2, sort_keys=True))
+        return
+    if args.rate <= 0:
+        raise SystemExit("fabric-ctl: --rate must be > 0")
+    time.sleep(args.rate)
+    out = {}
+    for d in devs:
+        second = _read_counters(d)
+        out[d] = {
+            "totals": second,
+            "per_second": {
+                c: round((second[c] - first[d][c]) / args.rate, 1)
+                for c in _COUNTERS
+            },
+        }
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+def cmd_watch(args, chan):
+    """Stream device-inventory changes as JSON lines: one snapshot line,
+    then added/removed/health-changed events per poll (p4rt-ctl has no
+    watch; ListAndWatch is the contract's streaming surface and this is
+    its CLI mirror)."""
+    import time
+
+    stub = services.DeviceStub(chan)
+
+    def poll():
+        resp = stub.GetDevices(empty_pb2.Empty(), timeout=10)
+        return {
+            dev_id: pb.Health.Name(d.health) for dev_id, d in resp.devices.items()
+        }
+
+    last = poll()
+    print(json.dumps({"event": "snapshot", "devices": last}), flush=True)
+    remaining = args.count
+    while remaining is None or remaining > 0:
+        time.sleep(args.interval)
+        current = poll()
+        for dev_id in sorted(current.keys() - last.keys()):
+            print(json.dumps(
+                {"event": "added", "id": dev_id, "health": current[dev_id]}
+            ), flush=True)
+        for dev_id in sorted(last.keys() - current.keys()):
+            print(json.dumps({"event": "removed", "id": dev_id}), flush=True)
+        for dev_id in sorted(current.keys() & last.keys()):
+            if current[dev_id] != last[dev_id]:
+                print(json.dumps(
+                    {"event": "health", "id": dev_id, "health": current[dev_id]}
+                ), flush=True)
+        last = current
+        if remaining is not None:
+            remaining -= 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fabric-ctl", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -188,6 +346,15 @@ def main(argv=None) -> int:
     p = sub.add_parser("topology"); p.set_defaults(fn=cmd_topology)
     p = sub.add_parser("probe"); p.add_argument("--mbytes", type=int, default=16)
     p.add_argument("--rounds", type=int, default=4); p.set_defaults(fn=cmd_probe)
+    p = sub.add_parser("ports"); p.add_argument("--bridge", default="br-fabric")
+    p.set_defaults(fn=cmd_ports)
+    p = sub.add_parser("stats"); p.add_argument("devices", nargs="*")
+    p.add_argument("--bridge", default="br-fabric")
+    p.add_argument("--rate", type=float, default=None)
+    p.set_defaults(fn=cmd_stats)
+    p = sub.add_parser("watch"); p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--count", type=int, default=None)
+    p.set_defaults(fn=cmd_watch)
 
     args = ap.parse_args(argv)
     chan = _channel(args)

@@ -252,10 +252,10 @@ int tcp_rr_client(const std::string& ip, int port, double duration) {
     while (Clock::now() < end) {
         if (send(s, &b, 1, 0) != 1) die("send");
         ssize_t n = recv(s, &r, 1, 0);
-        if (n != 1) {
-            recv_ended_cleanly(n);
-            break;
-        }
+        if (n == 0) break;   // server closed cleanly
+        if (n < 0) die("recv");  // incl. EAGAIN: a mid-run stall is a
+                                 // failure on the driving side, matching
+                                 // the Python client's uncaught timeout
         n_txn++;
     }
     double elapsed = seconds_since(start);
