@@ -42,6 +42,12 @@ class SideManager:
     def stop(self) -> None: ...
 
 
+# Default fabric partitioning applied at side-manager startup (the
+# reference hardcodes SetNumVfs(8) the same way, dpudevicehandler.go:84-106);
+# DataProcessingUnitConfig CRs override it afterwards.
+DEFAULT_NUM_ENDPOINTS = 8
+
+
 @dataclass
 class ManagedDpu:
     detection: DetectedDpu
@@ -50,6 +56,9 @@ class ManagedDpu:
     thread: Optional[threading.Thread] = None
     serve_error: Optional[str] = None
     applied_endpoints: Optional[int] = None
+    # Serializes startup's setup_devices against _apply_dpu_configs so a
+    # config landing mid-startup is neither clobbered nor double-applied.
+    endpoints_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class Daemon:
@@ -223,11 +232,21 @@ class Daemon:
                                 force = True
                                 deadline = _time.monotonic() + 30
                             _time.sleep(0.5)
-                        manager.setup_devices()
+                        with md.endpoints_lock:
+                            manager.setup_devices()
+                            md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
                     finally:
                         drainer.complete_drain_node(det.node_name)
                 else:
-                    manager.setup_devices()
+                    # Under the lock, and recording the count actually
+                    # applied: a DataProcessingUnitConfig landing during
+                    # this (async) startup is applied strictly before or
+                    # after — before: the record shows DEFAULT and the
+                    # next tick re-applies the config; after: the record
+                    # shows the config's count and nothing repeats.
+                    with md.endpoints_lock:
+                        manager.setup_devices()
+                        md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
                 manager.listen()
                 manager.serve()
             except Exception as e:
@@ -321,17 +340,18 @@ class Daemon:
                     continue
                 if not all(labels.get(k) == val for k, val in selector.items()):
                     continue
-                if md.applied_endpoints == count:
-                    continue
-                try:
-                    md.plugin.set_num_endpoints(int(count))
-                    md.applied_endpoints = int(count)
-                    log.info(
-                        "applied DataProcessingUnitConfig %s: %d endpoints on %s",
-                        cfg["metadata"]["name"], count, md.detection.identifier,
-                    )
-                except Exception:
-                    log.exception("SetNumEndpoints from DPUConfig failed")
+                with md.endpoints_lock:
+                    if md.applied_endpoints == count:
+                        continue
+                    try:
+                        md.plugin.set_num_endpoints(int(count))
+                        md.applied_endpoints = int(count)
+                        log.info(
+                            "applied DataProcessingUnitConfig %s: %d endpoints on %s",
+                            cfg["metadata"]["name"], count, md.detection.identifier,
+                        )
+                    except Exception:
+                        log.exception("SetNumEndpoints from DPUConfig failed")
 
     def _delete_cr(self, name: str) -> None:
         try:

@@ -211,7 +211,9 @@ def _fdb_by_port(bridge: str):
                     "flags": e.get("flags", []),
                 }
             )
-    except (OSError, subprocess.CalledProcessError):
+    except (OSError, subprocess.CalledProcessError, ValueError):
+        # Missing bridge(8), non-zero exit, or a vintage build that
+        # ignores -j and prints a table: degrade to an empty fdb view.
         pass
     return out
 
@@ -292,6 +294,8 @@ def cmd_watch(args, chan):
     its CLI mirror)."""
     import time
 
+    if args.interval <= 0:
+        raise SystemExit("fabric-ctl: --interval must be > 0")
     stub = services.DeviceStub(chan)
 
     def poll():

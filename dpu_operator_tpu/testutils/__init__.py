@@ -149,6 +149,7 @@ class KubeletSim:
             (p["metadata"].get("namespace"), p["metadata"]["name"]) for p in pods
         }
         self._release_gone_pods(live)
+        self._release_foreign_pods(pods)
         for pod in pods:
             phase = pod.get("status", {}).get("phase")
             if phase in ("Running", "Succeeded", "Failed"):
@@ -217,7 +218,18 @@ class KubeletSim:
             pod["metadata"].setdefault("annotations", {})["dpu.test/allocated"] = (
                 ",".join(d for devs in picked.values() for d in devs)
             )
-        pod = self._client.update(pod)
+        from ..k8s.store import Conflict
+
+        try:
+            pod = self._client.update(pod)
+        except Conflict:
+            # Another node's kubelet-sim won the bind race. Roll the
+            # allocation back or this node leaks the devices forever and
+            # reports "insufficient" for every later pod.
+            with self._lock:
+                for res in picked:
+                    self._allocated[res].pop(key, None)
+            return
         self._set_phase(pod, "Running", "")
 
     def _preferred(self, res: str, free: List[str], count: int) -> List[str]:
@@ -265,8 +277,22 @@ class KubeletSim:
                 return
 
     def _release_gone_pods(self, live: set) -> None:
+        """Release allocations whose pod is gone — or bound to a
+        DIFFERENT node (lost bind race detected after the fact)."""
         with self._lock:
             for res, allocs in self._allocated.items():
                 for key in list(allocs):
                     if key not in live:
+                        del allocs[key]
+
+    def _release_foreign_pods(self, pods) -> None:
+        foreign = {
+            (p["metadata"].get("namespace"), p["metadata"]["name"])
+            for p in pods
+            if p["spec"].get("nodeName") and p["spec"]["nodeName"] != self.node_name
+        }
+        with self._lock:
+            for res, allocs in self._allocated.items():
+                for key in list(allocs):
+                    if key in foreign:
                         del allocs[key]

@@ -371,47 +371,44 @@ def test_fabric_ctl_ports_and_stats(netns, capsys):
         subprocess.run(["ip", "link", "del", br], capture_output=True)
 
 
-def test_fabric_ctl_watch_streams_inventory_changes(tmp_root, capsys):
+def test_fabric_ctl_watch_streams_inventory_changes(tmp_root):
     """watch emits a snapshot then added/removed events as the VSP's
-    inventory changes between polls."""
-    import threading
-    import time
+    inventory changes between polls. Runs as a real subprocess so the
+    snapshot can be awaited on its stdout pipe (line-by-line, no capture
+    races)."""
+    import subprocess
+    import sys
 
     import grpc as grpclib
 
     from dpu_operator_tpu.dpu_api import services
     from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
-    from dpu_operator_tpu.fabric_ctl import main as fabric_ctl
     from dpu_operator_tpu.vsp import MockVsp, VspServer
 
     vsp = MockVsp(opi_port=free_port())
     server = VspServer(vsp, tmp_root)
     server.start()
+    proc = None
     try:
         sock = tmp_root.vendor_plugin_socket()
-        t = threading.Thread(
-            target=fabric_ctl,
-            args=(["--socket", sock, "watch", "--interval", "0.3", "--count", "3"],),
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dpu_operator_tpu.fabric_ctl",
+             "--socket", sock, "watch", "--interval", "0.3", "--count", "3"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        t.start()
-        # Wait for the snapshot to be emitted before mutating inventory —
-        # no wall-clock alignment assumptions.
-        buf = ""
-        deadline = time.monotonic() + 10
-        while '"snapshot"' not in buf and time.monotonic() < deadline:
-            buf += capsys.readouterr().out
-            time.sleep(0.02)
-        assert '"snapshot"' in buf, "watch never emitted its snapshot"
+        lines = [json.loads(proc.stdout.readline())]
+        assert lines[0]["event"] == "snapshot"
+        assert len(lines[0]["devices"]) == 4
+        # Snapshot seen — shrink the inventory, then drain the stream.
         chan = grpclib.insecure_channel(f"unix://{sock}")
         services.DeviceStub(chan).SetNumEndpoints(pb.EndpointCount(count=2), timeout=10)
         chan.close()
-        t.join(timeout=15)
-        assert not t.is_alive()
-        buf += capsys.readouterr().out
-        lines = [json.loads(l) for l in buf.strip().splitlines()]
-        assert lines[0]["event"] == "snapshot"
-        assert len(lines[0]["devices"]) == 4
+        out, err = proc.communicate(timeout=30)
+        assert proc.returncode == 0, err
+        lines += [json.loads(l) for l in out.strip().splitlines() if l]
         removed = {l["id"] for l in lines if l["event"] == "removed"}
         assert removed == {"mock-ep2", "mock-ep3"}
     finally:
+        if proc and proc.poll() is None:
+            proc.kill()
         server.stop()
