@@ -30,17 +30,14 @@ def main() -> int:
 
     from . import mxu_bench
 
-    jnp_res = mxu_bench.measure_matmul_tflops(
-        lambda x, w: x @ w, reps=2
-    )
+    jnp_res = mxu_bench.measure_matmul_tflops(lambda x, w: x @ w)
     out["mxu_jnp_tflops"] = round(jnp_res["tflops"], 1)
 
     try:
-        cfg, pallas_res = mxu_bench.best_pallas_config(reps=1)
-        best = functools.partial(
-            mxu_bench.pallas_matmul, bm=cfg[0], bn=cfg[1], bk=cfg[2]
-        )
-        pallas_res = mxu_bench.measure_matmul_tflops(best, reps=2)
+        # The sweep measures each config at full fidelity; its winning
+        # result IS the pallas number (re-measuring would recompile both
+        # chains and duplicate ~2400 matmuls of device work).
+        cfg, pallas_res = mxu_bench.best_pallas_config()
         out["mxu_pallas_tflops"] = round(pallas_res["tflops"], 1)
         out["mxu_pallas_config"] = list(cfg)
     except Exception as e:  # pallas regression must not hide the jnp number
@@ -55,7 +52,7 @@ def main() -> int:
     )
 
     try:
-        hbm = mxu_bench.measure_hbm_gbps(reps=2)
+        hbm = mxu_bench.measure_hbm_gbps()
         out["hbm_gbps"] = round(hbm["gbps"], 1)
         out["hbm_utilization"] = round(hbm["utilization_vs_v5e_peak"], 3)
     except Exception as e:  # never discard the MXU numbers already taken
