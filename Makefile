@@ -2,7 +2,7 @@
 # taskfile.yaml task system).
 
 .PHONY: all native proto test fast-test e2e-test traffic-flow-tests bench \
-        build-images deploy undeploy clean bundle bundle-check
+        build-images deploy undeploy clean bundle bundle-check provision provision-dry
 
 IMG_REGISTRY ?= localhost
 KUSTOMIZE ?= kubectl kustomize
@@ -49,6 +49,16 @@ bundle:
 
 bundle-check:
 	python scripts/gen_bundle.py --check
+
+# Cluster provisioning (counterpart of `task deploy` → cda.py,
+# taskfiles/clusters.yaml). provision-dry prints the plan; provision
+# executes it (needs gcloud auth + GCP_PROJECT).
+CLUSTER_CONFIG ?= hack/cluster-configs/config-1-cluster.yaml
+provision-dry:
+	python scripts/provision.py $(CLUSTER_CONFIG) --dry-run
+
+provision:
+	python scripts/provision.py $(CLUSTER_CONFIG)
 
 deploy:
 	$(KUSTOMIZE) config/default | kubectl apply -f -

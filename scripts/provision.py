@@ -1,0 +1,277 @@
+#!/usr/bin/env python3
+"""Cluster provisioning driver — the cda.py analogue.
+
+The reference provisions its test clusters with cluster-deployment-
+automation (`cda.py … deploy` driven by taskfiles/clusters.yaml:4-57 over
+hack/cluster-configs/*.yaml). This is the TPU-VM equivalent: it reads the
+same-shaped configs in hack/cluster-configs/, expands them into an
+ordered provisioning plan (gcloud TPU-VM creation, k3s bootstrap over
+ssh, node labelling, operator deploy, post-config test stages), and
+executes it — or prints it with --dry-run.
+
+    scripts/provision.py hack/cluster-configs/config-1-cluster.yaml --dry-run
+    scripts/provision.py hack/cluster-configs/config-1-cluster.yaml
+
+Execution requires gcloud credentials and network egress; --dry-run needs
+neither, and is what CI asserts on (tests/test_provision.py). Every step
+is a plain argv the operator could run by hand — no hidden state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+
+import yaml
+
+K3S_INSTALL = "curl -sfL https://get.k3s.io | sh -s - --disable traefik"
+
+
+class Plan:
+    """Ordered list of steps. A step may `capture` its stdout under a
+    name; later steps reference it as `{{captured.NAME}}` in any argv
+    element (how the k3s join token flows from the server bootstrap into
+    the agent join commands)."""
+
+    def __init__(self):
+        self.steps: list = []
+
+    def add(self, desc: str, argv: list, capture: str | None = None) -> None:
+        step = {"desc": desc, "argv": [str(a) for a in argv]}
+        if capture:
+            step["capture"] = capture
+        self.steps.append(step)
+
+    def run(self, dry_run: bool) -> int:
+        captured: dict = {}
+        for i, step in enumerate(self.steps, 1):
+            # Print the UNsubstituted argv: captured values include the
+            # k3s join token and the admin kubeconfig, which must not
+            # land in CI logs.
+            line = f"[{i}/{len(self.steps)}] {step['desc']}: " + " ".join(
+                shlex.quote(a) for a in step["argv"]
+            )
+            print(line, flush=True)
+            if dry_run:
+                continue
+            argv = [
+                re.sub(
+                    r"\{\{captured\.([a-z0-9_]+)\}\}",
+                    lambda m: captured.get(m.group(1), m.group(0)),
+                    a,
+                )
+                for a in step["argv"]
+            ]
+            r = subprocess.run(argv, capture_output="capture" in step, text=True)
+            if r.returncode != 0:
+                print(f"provision: step {i} failed (rc={r.returncode})",
+                      file=sys.stderr)
+                if r.stderr:
+                    print(r.stderr.rstrip(), file=sys.stderr)
+                return r.returncode
+            if "capture" in step:
+                captured[step["capture"]] = (r.stdout or "").strip()
+        return 0
+
+
+def _expand_env(value: str) -> str:
+    """`{{env.NAME}}` → $NAME (empty + warning when unset, so --dry-run
+    works without credentials)."""
+
+    def sub(m):
+        name = m.group(1)
+        val = os.environ.get(name)
+        if val is None:
+            print(f"provision: env {name} unset (placeholder kept)", file=sys.stderr)
+            return f"${name}"
+        return val
+
+    return re.sub(r"\{\{env\.([A-Z0-9_]+)\}\}", sub, value)
+
+
+def _write_kubeconfig_steps(cluster: dict, prefix: str, plan: Plan) -> None:
+    """Write the captured admin kubeconfig locally, pointing its server
+    at the captured node IP instead of 127.0.0.1."""
+    plan.add(
+        f"write kubeconfig to {cluster['kubeconfig']} (server → node IP)",
+        ["bash", "-c",
+         "printf '%s\\n' '{{captured." + prefix + "_kubeconfig}}' > "
+         + cluster["kubeconfig"]
+         + " && sed -i 's/127.0.0.1/{{captured." + prefix + "_server_ip}}/' "
+         + cluster["kubeconfig"]],
+    )
+
+
+def _label_steps(cluster: dict, plan: Plan) -> None:
+    labels = cluster.get("workers", {}).get("labels", {})
+    if labels:
+        label_args = [f"{k}={val}" for k, val in labels.items()]
+        plan.add(
+            f"label {cluster['name']} nodes for operator opt-in",
+            ["kubectl", "--kubeconfig", cluster["kubeconfig"],
+             "label", "nodes", "--all", "--overwrite"] + label_args,
+        )
+
+
+_K3S_JOIN = (
+    "curl -sfL https://get.k3s.io | "
+    "K3S_URL=https://{{captured.%s_server_ip}}:6443 "
+    "K3S_TOKEN={{captured.%s_token}} sh -"
+)
+
+
+def plan_tpu_cluster(cluster: dict, tpu: dict, plan: Plan) -> None:
+    """TPU-VM slice → one k8s cluster: create slice, k3s server on worker
+    0, agents on the rest, label every node. Captures are prefixed with
+    the cluster name so multi-cluster configs don't collide."""
+    project = _expand_env(str(tpu["project"]))
+    prefix = cluster["name"].replace("-", "_")
+
+    def ssh(worker: int, command: str) -> list:
+        return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu["name"],
+                "--zone", tpu["zone"], "--project", project,
+                "--worker", str(worker), "--command", command]
+
+    plan.add(
+        f"create TPU slice {tpu['name']} ({tpu['accelerator_type']})",
+        ["gcloud", "compute", "tpus", "tpu-vm", "create", tpu["name"],
+         "--zone", tpu["zone"], "--project", project,
+         "--accelerator-type", tpu["accelerator_type"],
+         "--version", tpu["runtime_version"],
+         "--network", tpu.get("network", "default")],
+    )
+    workers = int(cluster.get("workers", {}).get("count", 1))
+    plan.add("bootstrap k3s server on worker 0", ssh(0, K3S_INSTALL))
+    plan.add(
+        "read worker-0 internal IP",
+        ssh(0, "hostname -I | awk '{print $1}'"),
+        capture=f"{prefix}_server_ip",
+    )
+    plan.add(
+        "read k3s join token",
+        ssh(0, "sudo cat /var/lib/rancher/k3s/server/node-token"),
+        capture=f"{prefix}_token",
+    )
+    for w in range(1, workers):
+        plan.add(
+            f"join worker {w} as k3s agent",
+            ssh(w, _K3S_JOIN % (prefix, prefix)),
+        )
+    plan.add(
+        "fetch kubeconfig",
+        ssh(0, "sudo cat /etc/rancher/k3s/k3s.yaml"),
+        capture=f"{prefix}_kubeconfig",
+    )
+    _write_kubeconfig_steps(cluster, prefix, plan)
+    _label_steps(cluster, plan)
+
+
+def plan_vm_cluster(cluster: dict, plan: Plan) -> None:
+    """Plain GCE cluster (the 2-cluster host side): create VMs, k3s
+    server on worker 0, join the rest, fetch kubeconfig, label."""
+    w = cluster.get("workers", {})
+    zone = w.get("zone", "us-west4-a")
+    project = _expand_env(str(w.get("project", "{{env.GCP_PROJECT}}")))
+    prefix = cluster["name"].replace("-", "_")
+
+    def ssh(i: int, command: str) -> list:
+        return ["gcloud", "compute", "ssh", f"{cluster['name']}-worker-{i}",
+                "--zone", zone, "--project", project, "--command", command]
+
+    for i in range(int(w.get("count", 1))):
+        plan.add(
+            f"create host VM {cluster['name']}-worker-{i}",
+            ["gcloud", "compute", "instances", "create",
+             f"{cluster['name']}-worker-{i}",
+             "--zone", zone, "--project", project,
+             "--machine-type", w.get("machine_type", "n2-standard-8")],
+        )
+    plan.add(f"bootstrap k3s server on {cluster['name']}-worker-0",
+             ssh(0, K3S_INSTALL))
+    plan.add(
+        "read worker-0 internal IP",
+        ssh(0, "hostname -I | awk '{print $1}'"),
+        capture=f"{prefix}_server_ip",
+    )
+    plan.add(
+        "read k3s join token",
+        ssh(0, "sudo cat /var/lib/rancher/k3s/server/node-token"),
+        capture=f"{prefix}_token",
+    )
+    for i in range(1, int(w.get("count", 1))):
+        plan.add(
+            f"join {cluster['name']}-worker-{i} as k3s agent",
+            ssh(i, _K3S_JOIN % (prefix, prefix)),
+        )
+    plan.add(
+        "fetch kubeconfig",
+        ssh(0, "sudo cat /etc/rancher/k3s/k3s.yaml"),
+        capture=f"{prefix}_kubeconfig",
+    )
+    _write_kubeconfig_steps(cluster, prefix, plan)
+    _label_steps(cluster, plan)
+
+
+def plan_postconfig(doc: dict, kubeconfig: str, plan: Plan) -> None:
+    for stage in doc.get("postconfig", []) or []:
+        if "images" in stage:
+            plan.add(f"{stage['name']}: build images", shlex.split(stage["images"]))
+        if "deploy" in stage:
+            plan.add(
+                f"{stage['name']}: deploy operator",
+                shlex.split(stage["deploy"]) + [f"KUBECONFIG={kubeconfig}"],
+            )
+        if "run" in stage:
+            plan.add(f"{stage['name']}", shlex.split(stage["run"]))
+
+
+def build_plan(config_path: str) -> Plan:
+    with open(config_path) as fh:
+        doc = yaml.safe_load(fh)
+    plan = Plan()
+    if "clusters" in doc:  # 2-cluster shape
+        kubeconfig = None
+        for cluster in doc["clusters"]:
+            if "tpu" in cluster:
+                plan_tpu_cluster(cluster, cluster["tpu"], plan)
+            else:
+                plan_vm_cluster(cluster, plan)
+            kubeconfig = kubeconfig or cluster["kubeconfig"]
+        plan_postconfig(doc, kubeconfig, plan)
+    else:  # 1-cluster shape
+        cluster = doc["cluster"]
+        plan_tpu_cluster(cluster, doc["tpu"], plan)
+        plan_postconfig(doc, cluster["kubeconfig"], plan)
+    return plan
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("config", help="hack/cluster-configs/*.yaml")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the plan without executing (no gcloud needed)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --dry-run: emit the plan as one JSON document")
+    args = ap.parse_args(argv)
+
+    plan = build_plan(args.config)
+    if args.dry_run and args.json:
+        print(json.dumps({"config": args.config, "steps": plan.steps}, indent=2))
+        return 0
+    if not args.dry_run and not os.environ.get("GCP_PROJECT"):
+        print(
+            "provision: GCP_PROJECT unset — refusing to execute "
+            "(use --dry-run to inspect the plan)",
+            file=sys.stderr,
+        )
+        return 2
+    return plan.run(dry_run=args.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
