@@ -94,19 +94,6 @@ def _expand_env(value: str) -> str:
     return re.sub(r"\{\{env\.([A-Z0-9_]+)\}\}", sub, value)
 
 
-def _write_kubeconfig_steps(cluster: dict, prefix: str, plan: Plan) -> None:
-    """Write the captured admin kubeconfig locally, pointing its server
-    at the captured node IP instead of 127.0.0.1."""
-    plan.add(
-        f"write kubeconfig to {cluster['kubeconfig']} (server → node IP)",
-        ["bash", "-c",
-         "printf '%s\\n' '{{captured." + prefix + "_kubeconfig}}' > "
-         + cluster["kubeconfig"]
-         + " && sed -i 's/127.0.0.1/{{captured." + prefix + "_server_ip}}/' "
-         + cluster["kubeconfig"]],
-    )
-
-
 def _label_steps(cluster: dict, plan: Plan) -> None:
     labels = cluster.get("workers", {}).get("labels", {})
     if labels:
@@ -120,15 +107,59 @@ def _label_steps(cluster: dict, plan: Plan) -> None:
 
 _K3S_JOIN = (
     "curl -sfL https://get.k3s.io | "
-    "K3S_URL=https://{{captured.%s_server_ip}}:6443 "
+    "K3S_URL=https://{{captured.%s_internal_ip}}:6443 "
     "K3S_TOKEN={{captured.%s_token}} sh -"
 )
 
 
+def _plan_k3s_bootstrap(cluster: dict, ssh, workers: int, prefix: str,
+                        external_ip_argv: list, plan: Plan,
+                        worker_name) -> None:
+    """Shared k3s bring-up: server on worker 0, token + IP captures,
+    agent joins, kubeconfig materialization, labels. `ssh(i, cmd)` builds
+    the per-worker remote command; `external_ip_argv` reads the node's
+    EXTERNAL address (the provisioning machine's kubectl runs outside the
+    VPC — the internal IP is only for the in-VPC agent joins)."""
+    plan.add(f"bootstrap k3s server on {worker_name(0)}", ssh(0, K3S_INSTALL))
+    plan.add(
+        "read worker-0 internal IP (for in-VPC agent joins)",
+        ssh(0, "hostname -I | awk '{print $1}'"),
+        capture=f"{prefix}_internal_ip",
+    )
+    plan.add(
+        "read worker-0 external IP (for local kubectl)",
+        external_ip_argv,
+        capture=f"{prefix}_external_ip",
+    )
+    plan.add(
+        "read k3s join token",
+        ssh(0, "sudo cat /var/lib/rancher/k3s/server/node-token"),
+        capture=f"{prefix}_token",
+    )
+    for w in range(1, workers):
+        plan.add(
+            f"join {worker_name(w)} as k3s agent",
+            ssh(w, _K3S_JOIN % (prefix, prefix)),
+        )
+    plan.add(
+        "fetch kubeconfig",
+        ssh(0, "sudo cat /etc/rancher/k3s/k3s.yaml"),
+        capture=f"{prefix}_kubeconfig",
+    )
+    plan.add(
+        f"write kubeconfig to {cluster['kubeconfig']} (server → external IP)",
+        ["bash", "-c",
+         "printf '%s\\n' '{{captured." + prefix + "_kubeconfig}}' > "
+         + cluster["kubeconfig"]
+         + " && sed -i 's/127.0.0.1/{{captured." + prefix + "_external_ip}}/' "
+         + cluster["kubeconfig"]],
+    )
+    _label_steps(cluster, plan)
+
+
 def plan_tpu_cluster(cluster: dict, tpu: dict, plan: Plan) -> None:
-    """TPU-VM slice → one k8s cluster: create slice, k3s server on worker
-    0, agents on the rest, label every node. Captures are prefixed with
-    the cluster name so multi-cluster configs don't collide."""
+    """TPU-VM slice → one k8s cluster. Captures are prefixed with the
+    cluster name so multi-cluster configs don't collide."""
     project = _expand_env(str(tpu["project"]))
     prefix = cluster["name"].replace("-", "_")
 
@@ -145,76 +176,49 @@ def plan_tpu_cluster(cluster: dict, tpu: dict, plan: Plan) -> None:
          "--version", tpu["runtime_version"],
          "--network", tpu.get("network", "default")],
     )
+    external_ip = [
+        "gcloud", "compute", "tpus", "tpu-vm", "describe", tpu["name"],
+        "--zone", tpu["zone"], "--project", project,
+        "--format", "value(networkEndpoints[0].accessConfig.externalIp)",
+    ]
     workers = int(cluster.get("workers", {}).get("count", 1))
-    plan.add("bootstrap k3s server on worker 0", ssh(0, K3S_INSTALL))
-    plan.add(
-        "read worker-0 internal IP",
-        ssh(0, "hostname -I | awk '{print $1}'"),
-        capture=f"{prefix}_server_ip",
+    _plan_k3s_bootstrap(
+        cluster, ssh, workers, prefix, external_ip, plan,
+        worker_name=lambda w: f"worker {w}",
     )
-    plan.add(
-        "read k3s join token",
-        ssh(0, "sudo cat /var/lib/rancher/k3s/server/node-token"),
-        capture=f"{prefix}_token",
-    )
-    for w in range(1, workers):
-        plan.add(
-            f"join worker {w} as k3s agent",
-            ssh(w, _K3S_JOIN % (prefix, prefix)),
-        )
-    plan.add(
-        "fetch kubeconfig",
-        ssh(0, "sudo cat /etc/rancher/k3s/k3s.yaml"),
-        capture=f"{prefix}_kubeconfig",
-    )
-    _write_kubeconfig_steps(cluster, prefix, plan)
-    _label_steps(cluster, plan)
 
 
 def plan_vm_cluster(cluster: dict, plan: Plan) -> None:
-    """Plain GCE cluster (the 2-cluster host side): create VMs, k3s
-    server on worker 0, join the rest, fetch kubeconfig, label."""
+    """Plain GCE cluster (the 2-cluster host side)."""
     w = cluster.get("workers", {})
     zone = w.get("zone", "us-west4-a")
     project = _expand_env(str(w.get("project", "{{env.GCP_PROJECT}}")))
     prefix = cluster["name"].replace("-", "_")
 
+    def name(i: int) -> str:
+        return f"{cluster['name']}-worker-{i}"
+
     def ssh(i: int, command: str) -> list:
-        return ["gcloud", "compute", "ssh", f"{cluster['name']}-worker-{i}",
+        return ["gcloud", "compute", "ssh", name(i),
                 "--zone", zone, "--project", project, "--command", command]
 
     for i in range(int(w.get("count", 1))):
         plan.add(
-            f"create host VM {cluster['name']}-worker-{i}",
-            ["gcloud", "compute", "instances", "create",
-             f"{cluster['name']}-worker-{i}",
+            f"create host VM {name(i)}",
+            ["gcloud", "compute", "instances", "create", name(i),
              "--zone", zone, "--project", project,
              "--machine-type", w.get("machine_type", "n2-standard-8")],
         )
-    plan.add(f"bootstrap k3s server on {cluster['name']}-worker-0",
-             ssh(0, K3S_INSTALL))
-    plan.add(
-        "read worker-0 internal IP",
-        ssh(0, "hostname -I | awk '{print $1}'"),
-        capture=f"{prefix}_server_ip",
+    external_ip = [
+        "gcloud", "compute", "instances", "describe", name(0),
+        "--zone", zone, "--project", project,
+        "--format",
+        "value(networkInterfaces[0].accessConfigs[0].natIP)",
+    ]
+    _plan_k3s_bootstrap(
+        cluster, ssh, int(w.get("count", 1)), prefix, external_ip, plan,
+        worker_name=name,
     )
-    plan.add(
-        "read k3s join token",
-        ssh(0, "sudo cat /var/lib/rancher/k3s/server/node-token"),
-        capture=f"{prefix}_token",
-    )
-    for i in range(1, int(w.get("count", 1))):
-        plan.add(
-            f"join {cluster['name']}-worker-{i} as k3s agent",
-            ssh(i, _K3S_JOIN % (prefix, prefix)),
-        )
-    plan.add(
-        "fetch kubeconfig",
-        ssh(0, "sudo cat /etc/rancher/k3s/k3s.yaml"),
-        capture=f"{prefix}_kubeconfig",
-    )
-    _write_kubeconfig_steps(cluster, prefix, plan)
-    _label_steps(cluster, plan)
 
 
 def plan_postconfig(doc: dict, kubeconfig: str, plan: Plan) -> None:
@@ -256,8 +260,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dry-run", action="store_true",
                     help="print the plan without executing (no gcloud needed)")
     ap.add_argument("--json", action="store_true",
-                    help="with --dry-run: emit the plan as one JSON document")
+                    help="emit the plan as one JSON document (implies --dry-run)")
     args = ap.parse_args(argv)
+    if args.json:
+        args.dry_run = True  # inspecting must never execute
 
     plan = build_plan(args.config)
     if args.dry_run and args.json:

@@ -48,10 +48,13 @@ def test_one_cluster_plan():
     join = next(s for s in plan["steps"] if s["desc"].startswith("join worker"))
     cmd = " ".join(join["argv"])
     assert "{{captured.tpu_dpu_1c_token}}" in cmd
-    assert "{{captured.tpu_dpu_1c_server_ip}}" in cmd
+    assert "{{captured.tpu_dpu_1c_internal_ip}}" in cmd
     captures = {s.get("capture") for s in plan["steps"]}
-    assert {"tpu_dpu_1c_token", "tpu_dpu_1c_server_ip",
-            "tpu_dpu_1c_kubeconfig"} <= captures
+    assert {"tpu_dpu_1c_token", "tpu_dpu_1c_internal_ip",
+            "tpu_dpu_1c_external_ip", "tpu_dpu_1c_kubeconfig"} <= captures
+    # Local kubectl must point at the EXTERNAL address, not the VPC one.
+    write = next(s for s in plan["steps"] if "write kubeconfig" in s["desc"])
+    assert "{{captured.tpu_dpu_1c_external_ip}}" in " ".join(write["argv"])
 
     # Node labels come from the config.
     label = next(s for s in plan["steps"] if "label" in s["desc"])
