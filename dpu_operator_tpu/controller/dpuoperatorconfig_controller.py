@@ -113,4 +113,20 @@ class DpuOperatorConfigReconciler(Reconciler):
             self._renderer.apply_dir(os.path.join(BINDATA, d), variables, owner=cfg)
 
     def _ensure_nri(self, cfg: dict, variables: Dict[str, str]) -> None:
-        self._renderer.apply_dir(os.path.join(BINDATA, "nri"), variables, owner=cfg)
+        from ..render import render_dir
+
+        for obj in render_dir(os.path.join(BINDATA, "nri"), variables):
+            if obj.get("apiVersion", "").startswith("cert-manager.io"):
+                # Clusters without cert-manager lack these CRDs; the
+                # injector then serves plain HTTP (its secret volume is
+                # optional) instead of the whole NRI rollout failing.
+                try:
+                    self._renderer.apply(obj, owner=cfg)
+                except Exception as e:
+                    log.warning(
+                        "cert-manager object %s/%s not applied (%s); "
+                        "injector will serve plain HTTP",
+                        obj.get("kind"), obj["metadata"].get("name"), e,
+                    )
+            else:
+                self._renderer.apply(obj, owner=cfg)

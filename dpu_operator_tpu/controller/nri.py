@@ -11,6 +11,7 @@ attachment, so a pod attaching the NF NAD twice requests 2 endpoints
 from __future__ import annotations
 
 import logging
+import os
 from collections import Counter
 from typing import List, Optional, Tuple
 
@@ -141,7 +142,19 @@ class NetworkResourcesInjector:
         return True, "", patch
 
 
+def resolve_tls(certfile, keyfile):
+    """(certfile, keyfile) if both exist on disk, else (None, None) —
+    the serving-cert secret volume is optional, and a missing mount must
+    degrade to plain HTTP with a warning, not a crash loop."""
+    if certfile and os.path.exists(certfile) and keyfile and os.path.exists(keyfile):
+        return certfile, keyfile
+    if certfile:
+        log.warning("NRI serving cert %s not mounted; serving plain HTTP", certfile)
+    return None, None
+
+
 def main() -> None:  # container entrypoint (bindata/nri/01.deployment.yaml)
+    import sys
     import time
 
     from ..api.webhook import AdmissionWebhook
@@ -150,11 +163,31 @@ def main() -> None:  # container entrypoint (bindata/nri/01.deployment.yaml)
     logging.basicConfig(level=logging.INFO)
     client = client_from_kubeconfig()
     injector = NetworkResourcesInjector(client)
-    wh = AdmissionWebhook(host="0.0.0.0", port=8443)
+    # TLS when the serving-cert secret is mounted (reference serves :8443
+    # TLS with fsnotify cert reload, networkresourcesinjector.go:190-230;
+    # AdmissionWebhook hot-reloads rotated certs the same way).
+    want_cert = os.environ.get("NRI_TLS_CERT")
+    want_key = os.environ.get("NRI_TLS_KEY")
+    certfile, keyfile = resolve_tls(want_cert, want_key)
+    wh = AdmissionWebhook(
+        host="0.0.0.0",
+        port=int(os.environ.get("NRI_PORT", "8443")),
+        certfile=certfile,
+        keyfile=keyfile,
+    )
     wh.register("/mutate", injector.mutate)
     wh.start()
     while True:
-        time.sleep(3600)
+        time.sleep(5)
+        if certfile is None and resolve_tls(want_cert, want_key) != (None, None):
+            # First-install race: cert-manager issued the serving cert
+            # AFTER this pod started (the secret volume is optional, so
+            # kubelet mounted it empty). Re-exec so the listener comes
+            # back TLS — the apiserver speaks HTTPS only, and waiting for
+            # a manual restart would leave injection dead silently.
+            log.info("serving cert appeared at %s; re-exec for TLS", want_cert)
+            wh.stop()
+            os.execv(sys.executable, [sys.executable, "-m", __spec__.name])
 
 
 if __name__ == "__main__":

@@ -254,3 +254,85 @@ def test_nri_control_switches_disable_injection():
     injector2 = NetworkResourcesInjector(client)  # fresh cache
     ok, _, patch = injector2.mutate({"object": pod})
     assert ok and patch is None, "injection should be switched off"
+
+
+def test_nri_serves_mutate_over_tls(tmp_path):
+    """The injector's production wiring: TLS serving with the mounted
+    cert (reference serves :8443 TLS, networkresourcesinjector.go:190);
+    missing secret mount degrades to plain HTTP instead of crash-looping
+    (the deployment marks the volume optional)."""
+    import json as jsonlib
+    import ssl
+    import urllib.request
+
+    from test_webhook_tls import _mint_cert
+
+    from dpu_operator_tpu.api.webhook import AdmissionWebhook
+    from dpu_operator_tpu.controller.nri import (
+        NetworkResourcesInjector,
+        resolve_tls,
+    )
+    from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster
+
+    # Missing cert pair → plain HTTP fallback.
+    assert resolve_tls(str(tmp_path / "nope.crt"), str(tmp_path / "nope.key")) == (
+        None, None,
+    )
+    assert resolve_tls(None, None) == (None, None)
+
+    certfile, keyfile = _mint_cert(tmp_path, serial=31)
+    assert resolve_tls(certfile, keyfile) == (certfile, keyfile)
+
+    client = InMemoryClient(InMemoryCluster())
+    client.create({
+        "apiVersion": "k8s.cni.cncf.io/v1",
+        "kind": "NetworkAttachmentDefinition",
+        "metadata": {
+            "name": "dpunfcni-conf", "namespace": v.NAMESPACE,
+            "annotations": {
+                "k8s.v1.cni.cncf.io/resourceName": v.DPU_RESOURCE_NAME,
+            },
+        },
+    })
+    injector = NetworkResourcesInjector(client)
+    wh = AdmissionWebhook(port=0, certfile=certfile, keyfile=keyfile)
+    wh.register("/mutate", injector.mutate)
+    wh.start()
+    try:
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "tls-nri",
+                "namespace": v.NAMESPACE,
+                "object": {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": "nf", "namespace": v.NAMESPACE,
+                        "annotations": {
+                            "k8s.v1.cni.cncf.io/networks":
+                                "dpunfcni-conf, dpunfcni-conf",
+                        },
+                    },
+                    "spec": {"containers": [{"name": "c", "image": "i"}]},
+                },
+            },
+        }
+        ctx = ssl.create_default_context(cafile=certfile)
+        req = urllib.request.Request(
+            f"https://localhost:{wh.port}/mutate",
+            data=jsonlib.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = jsonlib.loads(urllib.request.urlopen(req, context=ctx).read())
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["patchType"] == "JSONPatch"
+        import base64
+
+        patch = jsonlib.loads(base64.b64decode(resp["response"]["patch"]))
+        assert any(
+            str(op.get("value")) == "2" and "resources" in op.get("path", "")
+            for op in patch
+        ), patch
+    finally:
+        wh.stop()
