@@ -336,3 +336,71 @@ def test_nri_serves_mutate_over_tls(tmp_path):
         ), patch
     finally:
         wh.stop()
+
+
+def test_nri_rollout_survives_missing_cert_manager():
+    """Clusters without cert-manager CRDs: the Certificate/Issuer applies
+    fail, but the rest of the NRI rollout (deployment, service, webhook
+    config) must land — the injector then serves plain HTTP (its secret
+    volume is optional)."""
+
+    class NoCertManagerClient(InMemoryClient):
+        def create(self, obj):
+            if obj.get("apiVersion", "").startswith("cert-manager.io"):
+                raise RuntimeError(
+                    'no matches for kind "Certificate" in version "cert-manager.io/v1"'
+                )
+            return super().create(obj)
+
+    client = NoCertManagerClient(InMemoryCluster())
+    mgr = build_manager(client, DummyImageManager())
+    mgr.start()
+    try:
+        client.create(v1.new_dpu_operator_config())
+        assert wait_for(
+            lambda: client.get_or_none(
+                "apps/v1", "Deployment", v.NAMESPACE, "network-resources-injector"
+            ) is not None
+        ), "NRI deployment never rendered"
+        assert client.get_or_none(
+            "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
+            None, "network-resources-injector",
+        ) is not None
+        # The cert objects were skipped, not rendered.
+        assert client.get_or_none(
+            "cert-manager.io/v1", "Certificate", v.NAMESPACE,
+            "network-resources-injector-cert",
+        ) is None
+    finally:
+        mgr.stop()
+
+
+def test_nri_cert_rendered_into_operand_namespace():
+    """With cert-manager present, the Certificate lands in the operand
+    namespace with SANs matching the Service the apiserver dials."""
+    client = InMemoryClient(InMemoryCluster())
+    mgr = build_manager(client, DummyImageManager())
+    mgr.start()
+    try:
+        client.create(v1.new_dpu_operator_config())
+        assert wait_for(
+            lambda: client.get_or_none(
+                "cert-manager.io/v1", "Certificate", v.NAMESPACE,
+                "network-resources-injector-cert",
+            ) is not None
+        ), "NRI Certificate never rendered"
+        cert = client.get(
+            "cert-manager.io/v1", "Certificate", v.NAMESPACE,
+            "network-resources-injector-cert",
+        )
+        assert f"network-resources-injector.{v.NAMESPACE}.svc" in cert["spec"]["dnsNames"]
+        assert cert["spec"]["secretName"] == "network-resources-injector-certs"
+        wh = client.get(
+            "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
+            None, "network-resources-injector",
+        )
+        assert wh["metadata"]["annotations"]["cert-manager.io/inject-ca-from"] == (
+            f"{v.NAMESPACE}/network-resources-injector-cert"
+        )
+    finally:
+        mgr.stop()
