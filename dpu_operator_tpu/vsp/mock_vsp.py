@@ -35,6 +35,7 @@ class MockVsp(
         self.init_calls: List[Tuple[int, str]] = []
         self.bridge_ports: List[str] = []
         self.network_functions: List[Tuple[str, str]] = []
+        self.fail_bridge_port = False  # failure injection (rollback tests)
 
     # LifeCycle
     def Init(self, request, context):
@@ -84,6 +85,10 @@ class MockVsp(
     # BridgePort
     def CreateBridgePort(self, request, context):
         with self._lock:
+            if self.fail_bridge_port:
+                # Failure injection for rollback tests (the reference's
+                # fakes are similarly steerable, hostsidemanager_test.go).
+                raise RuntimeError("injected bridge-port failure")
             self.bridge_ports.append(request.bridge_port.name)
         return bp.BridgePort(name=request.bridge_port.name)
 

@@ -163,3 +163,47 @@ def test_concurrent_cni_adds_do_not_cross_wires(two_sides, netns):
     finally:
         for ns in namespaces:
             subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_cni_add_rolls_back_when_bridge_port_fails(two_sides, netns):
+    """DPU-side CreateBridgePort failure mid-ADD: the host must unplumb
+    the already-created veth and report a CNI error — no half-attached
+    pod state left behind (host_side.py:132-136; reference hostsidemanager
+    dials with backoff then fails the ADD)."""
+    import subprocess
+
+    from dpu_operator_tpu.cni import CniRequest, do_cni
+
+    ns = "rbpod-" + uuid.uuid4().hex[:6]
+    subprocess.run(["ip", "netns", "add", ns], check=True)
+    try:
+        two_sides.dpu_vsp.fail_bridge_port = True
+        conf = {"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"}
+        cid = "rb" + uuid.uuid4().hex[:12]
+        req = CniRequest(
+            command="ADD", container_id=cid, netns=ns, ifname="net1", config=conf,
+        )
+        sock = two_sides.host.cni_server.socket_path
+        from dpu_operator_tpu.cni.types import CniError
+
+        with pytest.raises(CniError, match="CreateBridgePort"):
+            do_cni(sock, req)
+
+        # The veth was rolled back out of the pod netns.
+        r = subprocess.run(
+            ["ip", "-n", ns, "link", "show", "dev", "net1"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode != 0, "net1 left behind after failed ADD"
+
+        # Recovery: VSP healthy again → the same pod attaches cleanly.
+        two_sides.dpu_vsp.fail_bridge_port = False
+        result = do_cni(sock, req)
+        assert result.get("interfaces"), result
+        assert result["interfaces"][0]["name"] == "net1"
+        do_cni(sock, CniRequest(
+            command="DEL", container_id=cid, netns=ns, ifname="net1", config=conf,
+        ))
+    finally:
+        two_sides.dpu_vsp.fail_bridge_port = False
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
