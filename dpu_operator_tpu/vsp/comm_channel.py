@@ -1,0 +1,134 @@
+"""IPv6 link-local control channel between the host and DPU daemons.
+
+The reference's VSPs bring up fixed link-local addresses on the device
+that physically joins the two sides — Marvell puts fe80::1/fe80::2 on
+the SDP interfaces (marvell/main.go:32-52), NetSec on the backplane VFs
+(intel-netsec/main.go:131-177 configureCommChannelIPs, via
+vspnetutils.EnableIPV6LinkLocal with optimistic DAD) — so the OPI/
+heartbeat channel needs no DHCP, no routed subnet, and no discovery:
+the address is a constant of the contract and the scope id pins it to
+the right link.
+
+TPU-native mapping: the "device that joins the two sides" is the fabric
+uplink (DCN netdev on a TPU-VM, or the bridge uplink veth in the
+2-cluster test topology). `DPU_COMM_CHANNEL_DEV` opts the tpuvsp in;
+Init then advertises `[fe80::...:1%25dev]` — always the URI-encoded
+scope form, since both our binder and dialer are gRPC (see
+setup_comm_channel for why the reference's raw-% DPU-side form would
+corrupt hex-prefixed device names here).
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import time
+
+log = logging.getLogger(__name__)
+
+# Fixed per-side addresses, the reference's IPv6AddrDpu/IPv6AddrHost
+# analogues (distinct from the kernel's EUI-64 autoconf range).
+DPU_LINK_LOCAL = "fe80::d1:1"
+HOST_LINK_LOCAL = "fe80::d1:2"
+
+
+class CommChannelError(RuntimeError):
+    pass
+
+
+def _run(argv: list) -> str:
+    r = subprocess.run(argv, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise CommChannelError(f"{' '.join(argv)}: {r.stderr.strip()}")
+    return r.stdout
+
+
+def enable_ipv6_link_local(ifname: str, addr: str, netns: str | None = None) -> None:
+    """Static link-local + optimistic DAD on `ifname` (reference
+    vspnetutils.EnableIPV6LinkLocal, common/vspnetutils.go:78-127):
+    optimistic DAD lets the address be used immediately instead of
+    waiting out duplicate-address detection."""
+    ns = ["ip", "netns", "exec", netns] if netns else []
+    # sysctl splits keys on every dot; interface names with dots (VLAN
+    # devices like eth0.100) must be escaped as eth0/100.
+    sysctl_if = ifname.replace(".", "/")
+    for key, value in (
+        (f"net.ipv6.conf.{sysctl_if}.disable_ipv6", "0"),
+        # The channel addresses are fixed constants of the contract on a
+        # point-to-point link — duplicates are impossible by design, and
+        # DAD cannot even run until the peer side exists (no carrier),
+        # which would leave the address tentative and unbindable exactly
+        # when the VSP needs to bring the OPI server up first. Disable
+        # DAD outright; optimistic_dad stays as a fallback for kernels
+        # that ignore accept_dad on the interface.
+        (f"net.ipv6.conf.{sysctl_if}.accept_dad", "0"),
+        (f"net.ipv6.conf.{sysctl_if}.optimistic_dad", "1"),
+    ):
+        try:
+            _run(ns + ["sysctl", "-w", f"{key}={value}"])
+        except CommChannelError as e:
+            # optimistic_dad is a CONFIG_IPV6_OPTIMISTIC_DAD option;
+            # proceed without it (DAD just takes ~1 s longer).
+            log.debug("sysctl %s: %s", key, e)
+    _run(ns + ["ip", "link", "set", "dev", ifname, "up"])
+    def _already(e: Exception) -> bool:
+        return "File exists" in str(e) or "already assigned" in str(e)
+
+    try:
+        _run(ns + ["ip", "-6", "addr", "add", f"{addr}/64", "dev", ifname,
+                   "scope", "link", "optimistic"])
+    except CommChannelError as e:
+        if not _already(e):
+            # Retry without the optimistic flag (kernel without the option).
+            try:
+                _run(ns + ["ip", "-6", "addr", "add", f"{addr}/64", "dev",
+                           ifname, "scope", "link"])
+            except CommChannelError as e2:
+                if not _already(e2):
+                    raise
+
+
+def wait_link_local_ready(ifname: str, addr: str, timeout: float = 5.0,
+                          netns: str | None = None) -> None:
+    """Wait for DAD to finish (address leaves `tentative`) — the
+    reference's readiness waits (vspnetutils.go:301-359)."""
+    ns = ["ip", "netns", "exec", netns] if netns else []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = _run(ns + ["ip", "-6", "addr", "show", "dev", ifname])
+        # Strictly non-tentative ON OUR LINE (other addresses on the
+        # device, e.g. the kernel's EUI-64 autoconf one, may still be
+        # doing DAD — irrelevant). Binding a listener on a tentative
+        # address fails (EADDRNOTAVAIL); with accept_dad=0 the address
+        # never goes tentative, so this loop only matters on kernels
+        # where the sysctl was refused and real DAD has to finish.
+        for line in out.splitlines():
+            if f"{addr}/" in line and "tentative" not in line:
+                return
+        time.sleep(0.05)
+    raise CommChannelError(f"{addr} on {ifname} never left tentative")
+
+
+def setup_comm_channel(ifname: str, dpu_mode: bool,
+                       netns: str | None = None) -> str:
+    """Bring up this side's fixed link-local address and return the
+    connection string for the dpu-api IpPort.
+
+    The scope separator is ALWAYS the URI-encoded `%25`: gRPC
+    percent-decodes the whole authority, so a raw `%` followed by a
+    device name that happens to start with a hex pair (`%cc...`) is
+    silently decoded into a garbage byte and getaddrinfo fails. The
+    reference returns a raw-`%` form for the DPU side
+    (intel-netsec/main.go:163-168) because its server binds with Go's
+    net.Listen; ours binds with grpc too, so both sides take the
+    encoded form."""
+    addr = DPU_LINK_LOCAL if dpu_mode else HOST_LINK_LOCAL
+    enable_ipv6_link_local(ifname, addr, netns=netns)
+    wait_link_local_ready(ifname, addr, netns=netns)
+    return f"[{addr}%25{ifname}]"
+
+
+def peer_target(ifname: str) -> str:
+    """gRPC target the HOST side dials to reach the DPU-side OPI server
+    over the channel (scope id is the LOCAL egress interface)."""
+    return f"[{DPU_LINK_LOCAL}%25{ifname}]"

@@ -99,6 +99,29 @@ class TpuVsp(
 
                 self._dataplane = DebugDataplane()
                 self._dataplane.ensure_bridge()
+            # Optional IPv6 link-local control channel on the device that
+            # joins host and DPU sides (reference Marvell fe80::1/::2 on
+            # SDP, NetSec configureCommChannelIPs on the backplane): the
+            # OPI address becomes a constant of the contract, no routed
+            # IPs or discovery needed.
+            comm_dev = os.environ.get("DPU_COMM_CHANNEL_DEV")
+            if comm_dev:
+                from .comm_channel import peer_target, setup_comm_channel
+
+                try:
+                    dpu_mode = request.dpu_mode == pb.DPU_MODE_DPU
+                    conn = setup_comm_channel(comm_dev, dpu_mode=dpu_mode)
+                    if not dpu_mode:
+                        # The host daemon DIALS what Init returns; its own
+                        # address is only the source — the target is the
+                        # DPU side's fixed address over this device.
+                        conn = peer_target(comm_dev)
+                    self._opi = (conn, self._opi[1])
+                except Exception as e:
+                    log.warning(
+                        "comm channel on %s failed (%s); OPI stays on %s",
+                        comm_dev, e, self._opi[0],
+                    )
             self._initialized = True
         self._start_health_watchers()
         log.info(
