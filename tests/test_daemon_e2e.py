@@ -249,6 +249,77 @@ def test_cni_add_del_full_path(two_sides, netns):
         subprocess.run(["ip", "netns", "del", ns], capture_output=True)
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _two_cluster_stack(host_pm, opi_ip="127.0.0.1", pci_serial="serA1"):
+    """Two clusters, two daemons: DPU side runs the real tpuvsp (debug
+    dataplane) as a converged manager; host side PCI-detects the
+    accelerator and its MockVsp Init points at `opi_ip`:port for the
+    DPU-side OPI. Everything is torn down on exit regardless of where
+    setup or the test body fails."""
+    import shutil
+    import tempfile
+    from types import SimpleNamespace
+
+    from dpu_operator_tpu.platform import PciDevice
+    from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+    st = SimpleNamespace(
+        host_cluster=InMemoryClient(InMemoryCluster()),
+        dpu_cluster=InMemoryClient(InMemoryCluster()),
+        opi_port=free_port(),
+        dpu_root=tempfile.mkdtemp(prefix="dpu-"),
+        dpu_vsp=None, dpu_vsp_server=None, dpu_daemon=None,
+        host_vsp=None, host_vsp_server=None, host_daemon=None,
+    )
+    try:
+        st.host_cluster.create(
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "host-0"}}
+        )
+        st.dpu_cluster.create(
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpuvm-0"}}
+        )
+        dpu_pm = PathManager(root=st.dpu_root)
+        st.dpu_vsp = TpuVsp(dataplane=DebugDataplane(), opi_port=st.opi_port)
+        st.dpu_vsp_server = VspServer(st.dpu_vsp, dpu_pm)
+        st.dpu_vsp_server.start()
+        st.dpu_daemon = Daemon(
+            st.dpu_cluster,
+            FakePlatform(product="Google Cloud TPU", node="tpuvm-0", env=TPU_ENV),
+            path_manager=dpu_pm,
+            tick_interval=0.05,
+            register_device_plugin=False,
+        )
+        st.dpu_daemon.start()
+
+        host_platform = FakePlatform(node="host-0")
+        host_platform.add_device(
+            PciDevice(
+                address="0000:00:05.0", vendor_id="1ae0", device_id="0063",
+                class_name="0x120000", product_name="Google TPU accelerator",
+            ),
+            serial=pci_serial,
+        )
+        st.host_vsp = MockVsp(opi_ip=opi_ip, opi_port=st.opi_port)
+        st.host_vsp_server = VspServer(st.host_vsp, host_pm)
+        st.host_vsp_server.start()
+        st.host_daemon = Daemon(
+            st.host_cluster, host_platform, path_manager=host_pm,
+            tick_interval=0.05, register_device_plugin=False,
+        )
+        st.host_daemon.start()
+        yield st
+    finally:
+        for obj in (st.host_daemon, st.dpu_daemon, st.host_vsp_server,
+                    st.dpu_vsp_server):
+            if obj is not None:
+                obj.stop()
+        shutil.rmtree(st.dpu_root, ignore_errors=True)
+
+
 def test_two_cluster_topology(tmp_root):
     """The reference's 2-cluster deployment shape (README.md:38-44): the
     host cluster node PCI-detects the accelerator (is_dpu_side=False →
@@ -256,97 +327,39 @@ def test_two_cluster_topology(tmp_root):
     runtime (converged manager serving OPI); each cluster keeps its own
     DataProcessingUnit CR and side label, and the host's CNI ADD crosses
     the cluster boundary over OPI TCP to program the DPU-side VSP."""
-    import shutil
-    import tempfile
-
     from dpu_operator_tpu.cni import CniRequest, do_cni
-    from dpu_operator_tpu.platform import PciDevice
-    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
 
-    host_cluster = InMemoryClient(InMemoryCluster())
-    dpu_cluster = InMemoryClient(InMemoryCluster())
-    host_cluster.create(
-        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "host-0"}}
-    )
-    dpu_cluster.create(
-        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpuvm-0"}}
-    )
-
-    opi_port = free_port()
-    dpu_root = tempfile.mkdtemp(prefix="dpu-")
-    dpu_pm = PathManager(root=dpu_root)
-
-    # DPU-side cluster: converged daemon with the real tpuvsp (debug
-    # dataplane — no root needed), OPI bound on opi_port.
-    from dpu_operator_tpu.vsp.tpu_dataplane import DebugDataplane
-
-    dpu_vsp = TpuVsp(dataplane=DebugDataplane(), opi_port=opi_port)
-    dpu_vsp_server = VspServer(dpu_vsp, dpu_pm)
-    dpu_vsp_server.start()
-    dpu_daemon = Daemon(
-        dpu_cluster,
-        FakePlatform(product="Google Cloud TPU", node="tpuvm-0", env=TPU_ENV),
-        path_manager=dpu_pm,
-        tick_interval=0.05,
-        register_device_plugin=False,
-    )
-    dpu_daemon.start()
-
-    # Host cluster: PCI detection of the accelerator function.
-    host_platform = FakePlatform(node="host-0")
-    host_platform.add_device(
-        PciDevice(
-            address="0000:00:05.0",
-            vendor_id="1ae0",
-            device_id="0063",
-            class_name="0x120000",
-            product_name="Google TPU accelerator",
-        ),
-        serial="serA1",
-    )
-    host_vsp = MockVsp(opi_port=opi_port)  # Init → points at the DPU-side OPI
-    host_vsp_server = VspServer(host_vsp, tmp_root)
-    host_vsp_server.start()
-    host_daemon = Daemon(
-        host_cluster,
-        host_platform,
-        path_manager=tmp_root,
-        tick_interval=0.05,
-        register_device_plugin=False,
-    )
-    host_daemon.start()
-    try:
+    with _two_cluster_stack(tmp_root) as st:
         # Each cluster gets its own CR with the right side.
         assert wait_for(
-            lambda: dpu_cluster.get_or_none(
+            lambda: st.dpu_cluster.get_or_none(
                 v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
                 "tpu-v5litepod-8-w0-dpu",
             ) is not None
         ), "DPU-side CR never appeared"
         assert wait_for(
-            lambda: host_cluster.get_or_none(
+            lambda: st.host_cluster.get_or_none(
                 v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
                 "tpu-sera1-host",
             ) is not None
         ), "host-side CR never appeared"
-        assert host_cluster.get(
+        assert st.host_cluster.get(
             v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE, "tpu-sera1-host"
         )["spec"]["isDpuSide"] is False
 
         # Side labels derived per cluster (reference daemon.go:476-526).
         assert wait_for(
-            lambda: dpu_cluster.get("v1", "Node", None, "tpuvm-0")["metadata"]
+            lambda: st.dpu_cluster.get("v1", "Node", None, "tpuvm-0")["metadata"]
             .get("labels", {}).get(v.DPU_SIDE_LABEL) == v.DPU_SIDE_DPU
         )
         assert wait_for(
-            lambda: host_cluster.get("v1", "Node", None, "host-0")["metadata"]
+            lambda: st.host_cluster.get("v1", "Node", None, "host-0")["metadata"]
             .get("labels", {}).get(v.DPU_SIDE_LABEL) == v.DPU_SIDE_HOST
         )
 
         # Cross-cluster heartbeat: host manager pings DPU-side OPI over TCP.
-        host_mgr = None
-        assert wait_for(lambda: len(host_daemon.managed()) == 1)
-        host_mgr = list(host_daemon.managed().values())[0].manager
+        assert wait_for(lambda: len(st.host_daemon.managed()) == 1)
+        host_mgr = list(st.host_daemon.managed().values())[0].manager
         assert wait_for(host_mgr.check_ping, timeout=15), "cross-cluster ping failed"
 
         # Host CNI ADD → CreateBridgePort lands in the DPU-side tpuvsp.
@@ -361,15 +374,9 @@ def test_two_cluster_topology(tmp_root):
             config={"cniVersion": "1.0.0", "name": "default-ici-net", "type": "dpu-cni"},
         )
         do_cni(host_mgr.cni_server.socket_path, req)
-        assert wait_for(lambda: len(dpu_vsp._dataplane.ports) == 1), (
+        assert wait_for(lambda: len(st.dpu_vsp._dataplane.ports) == 1), (
             "bridge port never reached the DPU-side VSP"
         )
-    finally:
-        host_daemon.stop()
-        dpu_daemon.stop()
-        host_vsp_server.stop()
-        dpu_vsp_server.stop()
-        shutil.rmtree(dpu_root, ignore_errors=True)
 
 
 def test_dpu_config_applies_endpoint_partitioning(cluster_client, tmp_root):
@@ -413,3 +420,57 @@ def test_dpu_config_applies_endpoint_partitioning(cluster_client, tmp_root):
     finally:
         daemon.stop()
         vsp_server.stop()
+
+
+def test_two_cluster_over_link_local_comm_channel(tmp_root, netns, monkeypatch):
+    """The 2-cluster control plane riding the IPv6 link-local channel
+    end-to-end through the daemons: the DPU-side converged manager binds
+    its OPI server on the channel's fixed scoped address (returned by
+    TpuVsp Init with DPU_COMM_CHANNEL_DEV), and the host daemon — whose
+    VSP advertises the peer target — heartbeats and programs bridge
+    ports across the veth wire joining the two sides (reference Marvell
+    fe80::1/::2 SDP channel, marvell/main.go:32-52)."""
+    from dpu_operator_tpu.cni import CniRequest, do_cni
+    from dpu_operator_tpu.vsp.comm_channel import peer_target, setup_comm_channel
+
+    tag = uuid.uuid4().hex[:5]
+    host_dev, dpu_dev = f"xch{tag}", f"xcd{tag}"
+    r = subprocess.run(
+        ["ip", "link", "add", host_dev, "type", "veth", "peer", "name", dpu_dev],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    try:
+        # The DPU-side tpuvsp reads this at Init and binds the OPI on the
+        # channel; the host-side MockVsp ignores it.
+        monkeypatch.setenv("DPU_COMM_CHANNEL_DEV", dpu_dev)
+        with _two_cluster_stack(
+            tmp_root, opi_ip=peer_target(host_dev), pci_serial="serCC1"
+        ) as st:
+            # Bring the host's side of the wire up with its own address
+            # (the host-mode bring-up a real host tpuvsp performs).
+            setup_comm_channel(host_dev, dpu_mode=False)
+
+            assert wait_for(lambda: len(st.host_daemon.managed()) == 1)
+            host_mgr = list(st.host_daemon.managed().values())[0].manager
+            assert wait_for(host_mgr.check_ping, timeout=20), (
+                "heartbeat over the link-local channel never succeeded"
+            )
+
+            from bench import RecordingDataplane
+
+            host_mgr.dataplane = RecordingDataplane()
+            req = CniRequest(
+                command="ADD",
+                container_id="xcc" + uuid.uuid4().hex[:8],
+                netns="/proc/self/ns/net",
+                ifname="net1",
+                config={"cniVersion": "1.0.0", "name": "default-ici-net",
+                        "type": "dpu-cni"},
+            )
+            do_cni(host_mgr.cni_server.socket_path, req)
+            assert wait_for(lambda: len(st.dpu_vsp._dataplane.ports) == 1), (
+                "bridge port never crossed the channel to the DPU-side VSP"
+            )
+    finally:
+        subprocess.run(["ip", "link", "del", host_dev], capture_output=True)
