@@ -95,23 +95,27 @@ def mesh_from_topology(topology: SliceTopology, devices: Optional[Sequence] = No
     return build_mesh(n_devices=n, devices=devices)
 
 
-def ring_is_ici_adjacent(mesh, axis: str) -> Optional[bool]:
+def ring_is_ici_adjacent(mesh, axis: str, coords_of=None) -> Optional[bool]:
     """Whether consecutive devices along `axis` are physically adjacent
     on the chip grid (so a ring over the axis rides single ICI hops).
     Only open-chain hops are checked — the closing hop of a ring is a
     wrap link whose validity depends on the slice being a torus, which
     device coords alone can't tell. None when devices carry no coords
-    (virtual platforms)."""
+    (virtual platforms). `coords_of` overrides the coord source (device →
+    (x, y, z) or None) so virtual meshes can fabricate a chip grid and
+    exercise this check without TPU hardware."""
+    if coords_of is None:
+        coords_of = lambda d: getattr(d, "coords", None)  # noqa: E731
     devs = mesh.devices
     names = list(mesh.axis_names)
     ax = names.index(axis)
-    if not all(getattr(d, "coords", None) is not None for d in devs.flat):
+    if not all(coords_of(d) is not None for d in devs.flat):
         return None
     moved = np.moveaxis(devs, ax, -1)
     for lane in moved.reshape(-1, devs.shape[ax]):
         for i in range(len(lane) - 1):
-            a = np.array(lane[i].coords)
-            b = np.array(lane[i + 1].coords)
+            a = np.array(coords_of(lane[i]))
+            b = np.array(coords_of(lane[i + 1]))
             if np.abs(a - b).sum() != 1:
                 return False
     return True

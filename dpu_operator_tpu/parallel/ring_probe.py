@@ -31,17 +31,39 @@ except Exception:  # pragma: no cover
 
 
 def _ring_kernel(
-    n_axes, my_id_ref, right_ref, left_ref, local_ref, out_ref, comm_buf, send_sem, recv_sem
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    out_ref,
+    comm_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
 ):
-    """Per-device ring all-gather body (guide pattern): each step RDMAs
-    our current slot to the right neighbour while recording the chunk
-    that arrived from the left.
+    """Per-device ring all-gather body: each step RDMAs our current slot
+    to the right neighbour while recording the chunk that arrived from
+    the left.
 
     Neighbours are addressed with `DeviceIdType.MESH` coordinates spanning
     every mesh axis (only the ring axis differs from our own coords), so
     the ring stays on the sp axis even when the mesh also has dp/tp axes —
     LOGICAL ids would index the full flattened mesh and target the wrong
-    chip on any multi-axis mesh."""
+    chip on any multi-axis mesh.
+
+    Slot backpressure (`ack_sem`): waiting our own send/recv semaphores
+    bounds nothing about the *neighbours'* progress — a device's step-k
+    completion depends only on its left chain, so around an n-ring a
+    neighbour can run up to n-1 steps ahead and its step-(k+2) RDMA would
+    land in a slot whose step-k contents we have not yet forwarded
+    (first observed as chunk corruption on the 8-wide interpret-mode
+    ring; 2-wide rings never skew enough to expose it). Credit protocol:
+    our step-k write targets the right neighbour's slot (k+1)%2, which is
+    free once *its* step k-1 send completed — so each device signals
+    `ack_sem` to its left neighbour after rdma.wait() and waits one
+    credit before every send after the first. Skew is bounded to one
+    step, which double buffering absorbs."""
     num_devices = out_ref.shape[0] // local_ref.shape[0]
     chunk = local_ref.shape[0]
     my_id = my_id_ref[0]
@@ -66,6 +88,11 @@ def _ring_kernel(
         send_slot = jax.lax.rem(step, 2)
         recv_slot = jax.lax.rem(step + 1, 2)
         src = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+
+        @pl.when(step > 0)
+        def _wait_credit():
+            pltpu.semaphore_wait(ack_sem, 1)
+
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[send_slot],
             dst_ref=comm_buf.at[recv_slot],
@@ -76,6 +103,16 @@ def _ring_kernel(
         )
         rdma.start()
         rdma.wait()
+
+        # Send from send_slot is complete: the left neighbour may reuse it
+        # as its next step's target. The final step's credit would never
+        # be consumed (no step n-1), so skip it to exit with sems at zero.
+        @pl.when(step < num_devices - 2)
+        def _grant_credit():
+            pltpu.semaphore_signal(
+                ack_sem, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
+            )
+
         out_ref[pl.ds(src * chunk, chunk)] = comm_buf[recv_slot]
         return ()
 
@@ -102,6 +139,7 @@ def _pallas_all_gather(
             pltpu.VMEM((2, chunk, width), x_shard.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
         ],
     )
     return pl.pallas_call(
