@@ -142,11 +142,21 @@ class NetworkResourcesInjector:
         return True, "", patch
 
 
+def tls_mounted(certfile, keyfile) -> bool:
+    """Silent existence probe — safe to call from poll loops."""
+    return bool(
+        certfile and os.path.exists(certfile) and keyfile and os.path.exists(keyfile)
+    )
+
+
 def resolve_tls(certfile, keyfile):
     """(certfile, keyfile) if both exist on disk, else (None, None) —
     the serving-cert secret volume is optional, and a missing mount must
-    degrade to plain HTTP with a warning, not a crash loop."""
-    if certfile and os.path.exists(certfile) and keyfile and os.path.exists(keyfile):
+    degrade to plain HTTP with a warning, not a crash loop. Warns once at
+    resolution time; poll loops waiting for cert-manager should use
+    `tls_mounted` so a cluster without cert-manager doesn't get the same
+    warning every 5 seconds forever."""
+    if tls_mounted(certfile, keyfile):
         return certfile, keyfile
     if certfile:
         log.warning("NRI serving cert %s not mounted; serving plain HTTP", certfile)
@@ -179,7 +189,7 @@ def main() -> None:  # container entrypoint (bindata/nri/01.deployment.yaml)
     wh.start()
     while True:
         time.sleep(5)
-        if certfile is None and resolve_tls(want_cert, want_key) != (None, None):
+        if certfile is None and tls_mounted(want_cert, want_key):
             # First-install race: cert-manager issued the serving cert
             # AFTER this pod started (the secret volume is optional, so
             # kubelet mounted it empty). Re-exec so the listener comes

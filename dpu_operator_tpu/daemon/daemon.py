@@ -35,7 +35,9 @@ class SideManager:
     """The role interface (reference daemon.go:32-38)."""
 
     def start_vsp(self) -> None: ...
-    def setup_devices(self, num_endpoints: int = 8) -> None: ...
+    # Returns whether the partition count was actually applied (a DPU-side
+    # manager tolerates SetNumEndpoints failure and reports False).
+    def setup_devices(self, num_endpoints: int = 8) -> bool: ...
     def listen(self) -> None: ...
     def serve(self) -> None: ...
     def check_ping(self) -> bool: ...
@@ -56,6 +58,9 @@ class ManagedDpu:
     thread: Optional[threading.Thread] = None
     serve_error: Optional[str] = None
     applied_endpoints: Optional[int] = None
+    # True once startup's own setup_devices ran (success or tolerated
+    # failure) — gates the per-tick retry so it can't race start_vsp.
+    setup_attempted: bool = False
     # Serializes startup's setup_devices against _apply_dpu_configs so a
     # config landing mid-startup is neither clobbered nor double-applied.
     endpoints_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -233,8 +238,15 @@ class Daemon:
                                 deadline = _time.monotonic() + 30
                             _time.sleep(0.5)
                         with md.endpoints_lock:
-                            manager.setup_devices()
-                            md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
+                            # Record only on success: a tolerated
+                            # SetNumEndpoints failure must leave
+                            # applied_endpoints None so the next config
+                            # tick retries instead of treating the
+                            # never-partitioned fabric as already at the
+                            # requested count.
+                            if manager.setup_devices():
+                                md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
+                            md.setup_attempted = True
                     finally:
                         drainer.complete_drain_node(det.node_name)
                 else:
@@ -245,8 +257,9 @@ class Daemon:
                     # next tick re-applies the config; after: the record
                     # shows the config's count and nothing repeats.
                     with md.endpoints_lock:
-                        manager.setup_devices()
-                        md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
+                        if manager.setup_devices():
+                            md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
+                        md.setup_attempted = True
                 manager.listen()
                 manager.serve()
             except Exception as e:
@@ -327,6 +340,27 @@ class Daemon:
             )
         except Exception:
             return
+        # A tolerated startup setup_devices failure leaves
+        # applied_endpoints None; re-attempt the DEFAULT partition every
+        # tick until it lands — with no config CRs around there is no
+        # other path that would ever retry it.
+        for md in self._managed.values():
+            if not md.setup_attempted or md.applied_endpoints is not None:
+                continue
+            with md.endpoints_lock:
+                try:
+                    applied = (
+                        md.applied_endpoints is None and md.manager.setup_devices()
+                    )
+                except Exception:
+                    log.warning("default partition retry failed; will re-tick")
+                    applied = False
+                if applied:
+                    md.applied_endpoints = DEFAULT_NUM_ENDPOINTS
+                    log.info(
+                        "retried default fabric partition on %s: %d endpoints",
+                        md.detection.identifier, DEFAULT_NUM_ENDPOINTS,
+                    )
         if not configs:
             return
         for md in self._managed.values():

@@ -61,6 +61,10 @@ class DevicePlugin(services.DevicePluginServicer):
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._healthy: Dict[str, bool] = {}
+        # Full VSP inventory (backing device node, chip coords, worker id)
+        # for the allocated-device mounts/env Allocate builds; refreshed by
+        # every ListAndWatch poll alongside the health cache.
+        self._info: Dict[str, pb.Device] = {}
 
     # -- device translation --------------------------------------------------
 
@@ -68,6 +72,7 @@ class DevicePlugin(services.DevicePluginServicer):
         """Translate VSP devices into kubelet Device entries
         (reference dpudevicehandler.go:48-73)."""
         out: Dict[str, kdp.Device] = {}
+        info: Dict[str, pb.Device] = {}
         for dev_id, dev in self._vsp.get_devices().items():
             if self._id_policy == "host" and not _is_host_addressable(dev_id):
                 log.warning(
@@ -82,6 +87,9 @@ class DevicePlugin(services.DevicePluginServicer):
             if dev.topology:
                 kd.topology.nodes.add(ID=dev.topology.numa_node)
             out[dev_id] = kd
+            info[dev_id] = dev
+        with self._lock:
+            self._info = info
         return out
 
     # -- kubelet DevicePlugin service ---------------------------------------
@@ -152,11 +160,31 @@ class DevicePlugin(services.DevicePluginServicer):
             self._stop.wait(self.POLL_INTERVAL)
 
     def Allocate(self, request, context):
-        """Health-check from cache and pass NF-DEV env
-        (reference deviceplugin.go:114-142)."""
+        """Health-check from cache, pass NF-DEV env (reference
+        deviceplugin.go:114-142 stops there — its devices are
+        network-plumbed), and make char-device-backed endpoints actually
+        usable: each distinct backing `/dev/accel*` node becomes a
+        `DeviceSpec` mounted rw into the container, with the TPU runtime
+        env (`TPU_VISIBLE_DEVICES`, `TPU_WORKER_ID`, `TPU_CHIP_COORDS`)
+        derived from the VSP's topology inventory. Endpoints whose backing
+        is a netdev (mock VSP, SR-IOV-style vendors) keep the reference's
+        env-only semantics."""
         resp = kdp.AllocateResponse()
         with self._lock:
             healthy = dict(self._healthy)
+            info = dict(self._info)
+        if not info:
+            # Allocate before any ListAndWatch poll (kubelet restarts can
+            # replay allocations): fetch inventory inline once.
+            try:
+                self._fetch_devices()
+                with self._lock:
+                    healthy = dict(self._healthy) or {
+                        i: d.health == pb.HEALTHY for i, d in self._info.items()
+                    }
+                    info = dict(self._info)
+            except Exception:
+                log.exception("inline device fetch failed during Allocate")
         for creq in request.container_requests:
             for dev_id in creq.devices_ids:
                 if not healthy.get(dev_id, False):
@@ -166,6 +194,29 @@ class DevicePlugin(services.DevicePluginServicer):
                     )
             cresp = resp.container_responses.add()
             cresp.envs["NF-DEV"] = ",".join(creq.devices_ids)
+
+            chips: Dict[str, pb.Device] = {}  # backing dev node → VSP device
+            for dev_id in creq.devices_ids:
+                dev = info.get(dev_id)
+                if dev is not None and dev.backing.startswith("/dev/"):
+                    chips.setdefault(dev.backing, dev)
+            if not chips:
+                continue
+            ordered = sorted(chips)
+            for node in ordered:
+                spec = cresp.devices.add()
+                spec.host_path = node
+                spec.container_path = node
+                spec.permissions = "rw"
+            cresp.envs["TPU_VISIBLE_DEVICES"] = ",".join(
+                str(_chip_index(n)) for n in ordered
+            )
+            cresp.envs["TPU_CHIP_COORDS"] = ";".join(
+                chips[n].topology.coords for n in ordered
+            )
+            cresp.envs["TPU_WORKER_ID"] = str(
+                chips[ordered[0]].topology.worker_id
+            )
         return resp
 
     # -- lifecycle -----------------------------------------------------------
@@ -214,6 +265,15 @@ class DevicePlugin(services.DevicePluginServicer):
         self._stop.set()
         if self._server is not None:
             self._server.stop(0.5)
+
+
+def _chip_index(dev_node: str) -> int:
+    """`/dev/accel3` → 3 (the index a TPU runtime lists in
+    TPU_VISIBLE_DEVICES)."""
+    import re
+
+    m = re.search(r"(\d+)$", dev_node)
+    return int(m.group(1)) if m else 0
 
 
 def _grid_distance(a: Optional[tuple], b: Optional[tuple]) -> int:

@@ -101,12 +101,16 @@ class DpuSideManager:
         self._opi_addr = (ip, port)
         log.info("dpu side: VSP initialised, OPI server will bind %s:%s", ip, port)
 
-    def setup_devices(self, num_endpoints: int = 8) -> None:
-        # Errors tolerated in DPU mode (reference dpudevicehandler.go:84-106).
+    def setup_devices(self, num_endpoints: int = 8) -> bool:
+        # Errors tolerated in DPU mode (reference dpudevicehandler.go:84-106)
+        # — but report whether the count was actually applied so the daemon
+        # doesn't record a partition that never happened.
         try:
             self.device_plugin.setup_devices(num_endpoints)
+            return True
         except grpc.RpcError:
             log.warning("SetNumEndpoints failed on DPU side (tolerated)")
+            return False
 
     def listen(self) -> None:
         ip, port = self._opi_addr

@@ -82,8 +82,9 @@ class HostSideManager:
         self._opi_addr = (ip, port)
         log.info("host side: VSP initialised, DPU-side OPI at %s:%s", ip, port)
 
-    def setup_devices(self, num_endpoints: int = 8) -> None:
+    def setup_devices(self, num_endpoints: int = 8) -> bool:
         self.device_plugin.setup_devices(num_endpoints)
+        return True
 
     def listen(self) -> None:
         self.cni_server.start()

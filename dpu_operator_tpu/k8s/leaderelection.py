@@ -118,6 +118,20 @@ class LeaderElector:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                # A renew RPC is hung past the join timeout: releasing now
+                # would race it — the late renew could rewrite
+                # holderIdentity after our release and resurrect a lease
+                # nobody holds, forcing the next candidate to wait out a
+                # full lease_duration. Leave the lease to expire naturally
+                # instead (same worst case, no corrupted handover).
+                log.warning(
+                    "leader election: %s renew thread still alive after "
+                    "%.1fs; skipping lease release to avoid a late-renew "
+                    "race (lease will expire naturally)",
+                    self.identity, timeout,
+                )
+                return
         if self._is_leader:
             self._release()
             self._is_leader = False

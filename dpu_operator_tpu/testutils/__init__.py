@@ -54,6 +54,10 @@ class KubeletSim:
         self._devices: Dict[str, Set[str]] = {}
         # res → (namespace, name) → allocated device ids
         self._allocated: Dict[str, Dict[tuple, List[str]]] = {}
+        # res → (namespace, name) → the AllocateResponse the plugin
+        # returned (what a real kubelet hands the container runtime:
+        # device nodes to mount + env) for test assertions.
+        self._alloc_responses: Dict[str, Dict[tuple, object]] = {}
         self._threads: List[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -199,7 +203,7 @@ class KubeletSim:
                 self._allocated[res][key] = devs
         try:
             for res, devs in picked.items():
-                self._stubs[res].Allocate(
+                aresp = self._stubs[res].Allocate(
                     kdp.AllocateRequest(
                         container_requests=[
                             kdp.ContainerAllocateRequest(devices_ids=devs)
@@ -207,17 +211,40 @@ class KubeletSim:
                     ),
                     timeout=5.0,
                 )
+                with self._lock:
+                    self._alloc_responses.setdefault(res, {})[key] = aresp
         except grpc.RpcError as e:
             with self._lock:
                 for res in picked:
                     self._allocated[res].pop(key, None)
+                    self._alloc_responses.get(res, {}).pop(key, None)
             self._set_phase(pod, "Pending", f"Allocate failed: {e.code()}")
             return
         pod["spec"]["nodeName"] = self.node_name
         if picked:
-            pod["metadata"].setdefault("annotations", {})["dpu.test/allocated"] = (
-                ",".join(d for devs in picked.values() for d in devs)
+            ann = pod["metadata"].setdefault("annotations", {})
+            ann["dpu.test/allocated"] = ",".join(
+                d for devs in picked.values() for d in devs
             )
+            # Surface what the container runtime would receive so e2e
+            # tests can assert a granted chip is actually reachable from
+            # inside the pod (device nodes mounted + TPU env present).
+            nodes: List[str] = []
+            tpu_env: List[str] = []
+            with self._lock:
+                for res in picked:
+                    aresp = self._alloc_responses.get(res, {}).get(key)
+                    if aresp is None:
+                        continue
+                    for cresp in aresp.container_responses:
+                        nodes.extend(d.container_path for d in cresp.devices)
+                        v = cresp.envs.get("TPU_VISIBLE_DEVICES")
+                        if v:
+                            tpu_env.append(v)
+            if nodes:
+                ann["dpu.test/device-nodes"] = ",".join(sorted(set(nodes)))
+            if tpu_env:
+                ann["dpu.test/tpu-visible-devices"] = ",".join(tpu_env)
         from ..k8s.store import Conflict
 
         try:
@@ -284,6 +311,7 @@ class KubeletSim:
                 for key in list(allocs):
                     if key not in live:
                         del allocs[key]
+                        self._alloc_responses.get(res, {}).pop(key, None)
 
     def _release_foreign_pods(self, pods) -> None:
         foreign = {
@@ -296,3 +324,11 @@ class KubeletSim:
                 for key in list(allocs):
                     if key in foreign:
                         del allocs[key]
+                        self._alloc_responses.get(res, {}).pop(key, None)
+
+    def allocate_response(self, resource_name: str, namespace, name):
+        """The AllocateResponse returned for a bound pod, or None."""
+        with self._lock:
+            return self._alloc_responses.get(resource_name, {}).get(
+                (namespace, name)
+            )

