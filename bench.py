@@ -327,6 +327,8 @@ def main() -> int:
         "pod_attach_concurrent_per_s": "attaches/s",
         "mxu_jnp_tflops": "TFLOP/s",
         "mxu_pallas_tflops": "TFLOP/s",
+        "burn_jnp_tflops": "TFLOP/s",
+        "burn_pallas_tflops": "TFLOP/s",
         "mxu_tflops": "TFLOP/s",
         "mxu_utilization": "frac_v5e_peak",
         "hbm_gbps": "GB/s",

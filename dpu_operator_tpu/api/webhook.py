@@ -70,6 +70,7 @@ class AdmissionWebhook:
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ssl_ctx: Optional[ssl.SSLContext] = None
+        self._live_ctx: Optional[ssl.SSLContext] = None
         self._reload_interval = cert_reload_interval
         self._reload_stop = threading.Event()
         self._reload_thread: Optional[threading.Thread] = None
@@ -148,8 +149,23 @@ class AdmissionWebhook:
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)
         self._reload_stop.clear()  # allow stop() → start() reuse
         if self._certfile:
+            # Rotation safety: `load_cert_chain` on the LIVE context is
+            # two OpenSSL calls (cert, then key) — a handshake landing
+            # between them sees a mismatched pair and fails with a
+            # handshake alert (caught by the rotation-under-load test).
+            # Instead, each rotation builds a FRESH context and publishes
+            # it with one reference assignment; the sni_callback pins
+            # every new handshake to whatever complete context is
+            # current. (Reference gets the same guarantee from its
+            # GetCertificate callback, networkresourcesinjector.go:190-230.)
             self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             self._ssl_ctx.load_cert_chain(self._certfile, self._keyfile)
+            self._live_ctx = self._ssl_ctx
+
+            def _pin_current_ctx(sock, server_name, outer_ctx):
+                sock.context = self._live_ctx
+
+            self._ssl_ctx.sni_callback = _pin_current_ctx
             self._cert_mtimes = self._stat_certs()
             self._server.socket = self._ssl_ctx.wrap_socket(
                 self._server.socket, server_side=True
@@ -175,10 +191,14 @@ class AdmissionWebhook:
             return self._cert_mtimes
 
     def reload_certs(self) -> None:
-        """Load the on-disk chain into the live context; new handshakes
-        serve the new cert, the listener never closes."""
+        """Build a fresh context from the on-disk chain and publish it
+        atomically; new handshakes serve the new cert (via the listener
+        context's sni_callback), the listener never closes, and no
+        handshake can observe a half-installed cert/key pair."""
         assert self._ssl_ctx is not None
-        self._ssl_ctx.load_cert_chain(self._certfile, self._keyfile)
+        new_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        new_ctx.load_cert_chain(self._certfile, self._keyfile)
+        self._live_ctx = new_ctx
         self.certs_reloaded += 1
         log.info("webhook: serving certificate reloaded from %s", self._certfile)
 

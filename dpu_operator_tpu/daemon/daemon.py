@@ -334,16 +334,12 @@ class Daemon:
         (dataprocessingunitconfig_types.go:251-254); here it carries the
         obvious real knob, fabric endpoint partitioning. Last-applied is
         tracked per device so the VSP only sees changes."""
-        try:
-            configs = self._client.list(
-                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG, self._namespace
-            )
-        except Exception:
-            return
         # A tolerated startup setup_devices failure leaves
         # applied_endpoints None; re-attempt the DEFAULT partition every
-        # tick until it lands — with no config CRs around there is no
-        # other path that would ever retry it.
+        # tick until it lands. This runs BEFORE (and regardless of) the
+        # config-CR list: with no config CRs around — or the CRD not even
+        # installed, making the list raise — there is no other path that
+        # would ever retry it.
         for md in self._managed.values():
             if not md.setup_attempted or md.applied_endpoints is not None:
                 continue
@@ -361,6 +357,12 @@ class Daemon:
                         "retried default fabric partition on %s: %d endpoints",
                         md.detection.identifier, DEFAULT_NUM_ENDPOINTS,
                     )
+        try:
+            configs = self._client.list(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG, self._namespace
+            )
+        except Exception:
+            return
         if not configs:
             return
         for md in self._managed.values():

@@ -202,7 +202,9 @@ class DevicePlugin(services.DevicePluginServicer):
                     chips.setdefault(dev.backing, dev)
             if not chips:
                 continue
-            ordered = sorted(chips)
+            # Numeric order: lexicographic would scramble ≥10 chips
+            # (/dev/accel10 before /dev/accel2).
+            ordered = sorted(chips, key=_chip_index)
             for node in ordered:
                 spec = cresp.devices.add()
                 spec.host_path = node

@@ -47,16 +47,30 @@ V5E_PEAK_HBM_GBPS = 819.0  # per-chip HBM bandwidth, TPU v5e datasheet
 
 def _mm_kernel(x_ref, w_ref, o_ref, acc_ref, *, n_k: int):
     k = pl.program_id(2)
+    prod = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
 
+    # First K step INITIALISES the accumulator (no separate zero pass —
+    # a zero+add spends an extra VMEM write/read of the whole acc tile).
     @pl.when(k == 0)
-    def _zero():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    def _init():
+        acc_ref[:] = prod
 
-    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    @pl.when(k > 0)
+    def _accum():
+        acc_ref[:] += prod
 
     @pl.when(k == n_k - 1)
     def _write():
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _mm_kernel_fullk(x_ref, w_ref, o_ref):
+    """Full-K block (grid has no K dim): the product IS the result, so
+    skip the f32 accumulator scratch entirely — the zero/add/read-back
+    round trips through VMEM are pure overhead when K never revisits."""
+    o_ref[:] = jnp.dot(
+        x_ref[:], w_ref[:], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
 
 
 def pallas_matmul(
@@ -73,6 +87,32 @@ def pallas_matmul(
     assert k == k2 and m % bm == 0 and n % bn == 0 and k % bk == 0
     n_k = k // bk
     kwargs = {"memory_space": pltpu.VMEM} if pltpu is not None else {}
+    cost = pl.CostEstimate(
+        flops=2 * m * n * k,
+        bytes_accessed=(m * k + k * n + m * n) * x.dtype.itemsize,
+        transcendentals=0,
+    )
+    if n_k == 1:
+        # Accumulator-free fast path: one grid step covers all of K.
+        return pl.pallas_call(
+            _mm_kernel_fullk,
+            out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+            grid=(m // bm, n // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda i, j: (i, 0), **kwargs),
+                pl.BlockSpec((k, bn), lambda i, j: (0, j), **kwargs),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j), **kwargs),
+            compiler_params=(
+                pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "parallel"),
+                )
+                if pltpu and not interpret
+                else None
+            ),
+            cost_estimate=cost,
+            interpret=interpret,
+        )(x, w)
     return pl.pallas_call(
         functools.partial(_mm_kernel, n_k=n_k),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
@@ -90,11 +130,7 @@ def pallas_matmul(
             if pltpu and not interpret
             else None
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=2 * m * n * k,
-            bytes_accessed=(m * k + k * n + m * n) * x.dtype.itemsize,
-            transcendentals=0,
-        ),
+        cost_estimate=cost,
         interpret=interpret,
     )(x, w)
 

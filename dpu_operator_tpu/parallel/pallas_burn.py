@@ -35,6 +35,26 @@ def _burn_kernel(x_ref, w_ref, o_ref):
     o_ref[:] = jnp.tanh(acc).astype(o_ref.dtype)
 
 
+def _burn_chain_kernel(x_ref, w_ref, o_ref, h_ref, *, length: int):
+    """The WHOLE 8-matmul burn chain in one kernel, h resident in VMEM.
+
+    At BURN_DIM=1024 the bf16 operands are 2 MB each, so the chain state
+    never leaves the chip: one pallas_call replaces `length` calls, and
+    with them the per-call boundaries a lax.scan of opaque custom calls
+    pays (XLA cannot overlap across a custom-call edge the way it
+    software-pipelines its own scan body — measured ~5% at this size,
+    BASELINE.md MXU notes)."""
+    h_ref[:] = x_ref[:]
+
+    def step(_, carry):
+        acc = jnp.dot(h_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+        h_ref[:] = jnp.tanh(acc).astype(h_ref.dtype)
+        return carry
+
+    jax.lax.fori_loop(0, length, step, 0)
+    o_ref[:] = h_ref[:]
+
+
 def _block_specs(k: int):
     kwargs = {"memory_space": _MEMSPACE} if _MEMSPACE is not None else {}
     return (
@@ -46,13 +66,50 @@ def _block_specs(k: int):
     )
 
 
+# bf16 bytes of (x + w + h scratch + out) that must fit in VMEM (~16 MB
+# on v5e) for the single-call chain kernel; beyond it, fall back to the
+# per-matmul tiled kernel under lax.scan.
+_CHAIN_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def burn_chain_pallas(
+    x: jax.Array, w: jax.Array, length: int = 8, interpret: bool = False
+) -> jax.Array:
+    """`length` chained matmul+tanh passes as ONE pallas call (VMEM-
+    resident state). Shapes must satisfy the VMEM budget — callers use
+    `chain_fits_vmem` or burn_step_pallas which picks automatically."""
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2 and m == n, "chain needs square h@w"
+    kwargs = {"memory_space": _MEMSPACE} if _MEMSPACE is not None else {}
+    return pl.pallas_call(
+        functools.partial(_burn_chain_kernel, length=length),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+        in_specs=[pl.BlockSpec(**kwargs), pl.BlockSpec(**kwargs)],
+        out_specs=pl.BlockSpec(**kwargs),
+        scratch_shapes=(
+            [pltpu.VMEM((m, n), jnp.bfloat16)] if pltpu is not None else []
+        ),
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), w.astype(jnp.bfloat16))
+
+
+def chain_fits_vmem(m: int, n: int) -> bool:
+    return 4 * m * n * 2 <= _CHAIN_VMEM_BUDGET
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def burn_step_pallas(x: jax.Array, w: jax.Array, interpret: bool = False) -> jax.Array:
-    """Eight chained tiled matmul+tanh passes; same contract as
-    fabric_probe.burn_step (f32 scalar health signature)."""
+    """Eight chained matmul+tanh passes; same contract as
+    fabric_probe.burn_step (f32 scalar health signature). Small shapes
+    (the default BURN_DIM=1024) run as one VMEM-resident chain kernel;
+    larger ones scan the per-matmul tiled kernel."""
     m, k = x.shape
     k2, n = w.shape
     assert k == k2 and m % TILE == 0 and n % TILE == 0, "tile-aligned shapes only"
+    if m == n and chain_fits_vmem(m, n):
+        h = burn_chain_pallas(x, w, length=8, interpret=interpret)
+        return jnp.sum(h.astype(jnp.float32) ** 2)
     in_specs, out_spec = _block_specs(k)
     matmul = pl.pallas_call(
         _burn_kernel,

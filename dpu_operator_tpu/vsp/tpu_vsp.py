@@ -74,6 +74,11 @@ class TpuVsp(
         self._agent_health_cache: Dict[int, bool] = {}
         self._watcher_stop = threading.Event()
         self._watcher_threads: list = []
+        # Set by a cp-agent `reset` event (chip bounced — octep PERST
+        # analogue): wakes the deep-health loop for an immediate re-probe
+        # instead of trusting the returned chip until the next TTL pass.
+        self._deep_health_kick = threading.Event()
+        self.resets_seen = 0
 
     # -- LifeCycle -----------------------------------------------------------
 
@@ -159,6 +164,16 @@ class TpuVsp(
     def SetNumEndpoints(self, request, context):
         with self._lock:
             self._num_endpoints = request.count
+            dataplane = self._dataplane
+        # The partition has a dataplane effect, not just an inventory one
+        # (reference SetNumVfs creates real VFs, vspnetutils.go:50): each
+        # endpoint's egress share of the fabric budget is enforced per
+        # attached port when the budget is known (tpu_dataplane).
+        if dataplane is not None and hasattr(dataplane, "partition_endpoints"):
+            try:
+                dataplane.partition_endpoints(request.count)
+            except Exception:
+                log.exception("endpoint repartition failed on the dataplane")
         log.info("tpuvsp: fabric partitioned into %d endpoints", request.count)
         return pb.EndpointCount(count=request.count)
 
@@ -226,6 +241,17 @@ class TpuVsp(
         while not self._watcher_stop.is_set():
             try:
                 for event in self._cp_agent.subscribe(stop=self._watcher_stop):
+                    if event.get("event") == "reset":
+                        # A chip vanished and came back: re-probe its
+                        # compute path now — it may have bounced through
+                        # a reset and hold stale state even though the
+                        # device node reopened.
+                        self.resets_seen += 1
+                        log.warning(
+                            "cp-agent reported chip reset (%s); re-probing",
+                            event.get("chips_reset"),
+                        )
+                        self._deep_health_kick.set()
                     if "chips" in event:
                         with self._lock:
                             self._agent_health_cache = dict(event["chips"])
@@ -272,8 +298,18 @@ class TpuVsp(
                 result = {}
             with self._lock:
                 self._deep_health_cache = result
-            if self._watcher_stop.wait(self.DEEP_HEALTH_TTL):
-                return
+            # TTL sleep, interruptible by stop OR a reset kick (chip
+            # bounced: re-probe immediately, don't wait out the TTL).
+            deadline = self.DEEP_HEALTH_TTL
+            step = 0.2
+            waited = 0.0
+            while waited < deadline:
+                if self._watcher_stop.wait(step):
+                    return
+                if self._deep_health_kick.is_set():
+                    self._deep_health_kick.clear()
+                    break
+                waited += step
 
     # -- BridgePort ----------------------------------------------------------
 

@@ -1,0 +1,70 @@
+#!/bin/bash
+# Diagnose the loopback-vs-bridge tft-pump gap: MTU and GRO/TSO experiments.
+set -u
+PUMP=/root/repo/native/build/tft-pump
+DUR=2
+run_pair() {  # server_ns client_ns ip label
+  local sns=$1 cns=$2 ip=$3 label=$4
+  ip netns exec $sns $PUMP server iperf-tcp $ip 15301 $DUR >/tmp/diag_s.json 2>/dev/null &
+  local spid=$!
+  sleep 0.3
+  ip netns exec $cns $PUMP client iperf-tcp $ip 15301 $DUR >/dev/null 2>&1
+  wait $spid
+  local gbps=$(python3 -c "import json;print(json.load(open('/tmp/diag_s.json'))['gbps'])" 2>/dev/null || echo "?")
+  echo "$label: $gbps Gbps"
+}
+# Baseline: loopback inside one netns
+ip netns add dgL 2>/dev/null
+ip netns exec dgL ip link set lo up
+ip netns exec dgL $PUMP server iperf-tcp 127.0.0.1 15301 $DUR >/tmp/diag_s.json 2>/dev/null &
+sp=$!; sleep 0.3
+ip netns exec dgL $PUMP client iperf-tcp 127.0.0.1 15301 $DUR >/dev/null 2>&1
+wait $sp
+echo "loopback(netns): $(python3 -c "import json;print(json.load(open('/tmp/diag_s.json'))['gbps'])") Gbps"
+ip netns del dgL
+
+# Bridge between two netns — default veth config
+setup() {  # mtu
+  local mtu=$1
+  ip link add brDG type bridge 2>/dev/null
+  ip link set brDG up
+  for n in A B; do
+    ip netns add dg$n
+    ip link add vdg$n type veth peer name eth0 netns dg$n
+    ip link set vdg$n master brDG
+    ip link set vdg$n up
+    ip netns exec dg$n ip link set lo up
+    ip netns exec dg$n ip link set eth0 up
+    if [ "$mtu" != "1500" ]; then
+      ip link set vdg$n mtu $mtu
+      ip netns exec dg$n ip link set eth0 mtu $mtu
+      ip link set brDG mtu $mtu
+    fi
+  done
+  ip netns exec dgA ip addr add 10.98.0.1/24 dev eth0
+  ip netns exec dgB ip addr add 10.98.0.2/24 dev eth0
+}
+teardown() {
+  ip netns del dgA 2>/dev/null; ip netns del dgB 2>/dev/null
+  ip link del brDG 2>/dev/null
+}
+teardown
+setup 1500
+run_pair dgB dgA 10.98.0.2 "bridge mtu1500 (default)"
+# GRO/TSO state
+for n in A B; do
+  echo "offloads vdg$n: $(ethtool -k vdg$n 2>/dev/null | grep -E 'tcp-segmentation-offload|generic-receive-offload|generic-segmentation-offload' | tr '\n' ' ')"
+done
+# Toggle GRO on on veth host sides (default often off for veth? check), try gro on pod sides too
+for n in A B; do
+  ethtool -K vdg$n gro on 2>/dev/null
+  ip netns exec dg$n ethtool -K eth0 gro on 2>/dev/null
+done
+run_pair dgB dgA 10.98.0.2 "bridge mtu1500 + gro on"
+teardown
+setup 9000
+run_pair dgB dgA 10.98.0.2 "bridge mtu9000"
+teardown
+setup 65535
+run_pair dgB dgA 10.98.0.2 "bridge mtu65535"
+teardown

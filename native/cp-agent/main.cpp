@@ -67,8 +67,12 @@ std::string chips_json(const cpagent::Topology& topo) {
   return out;
 }
 
-bool all_healthy(const cpagent::Topology& topo) {
+// Health policy skips chips the config marks non-required (handed to
+// another tenant / known-dark slot) — their raw state still shows in
+// `chips`, it just can't fail the node.
+bool all_healthy(const cpagent::Topology& topo, const cpagent::Config& cfg) {
   for (const auto& chip : topo.chips) {
+    if (!cfg.chip_required(chip.index)) continue;
     if (!chip.present || !chip.openable) return false;
   }
   return true;
@@ -82,6 +86,22 @@ int healthy_count(const cpagent::Topology& topo) {
   return n;
 }
 
+std::string chip_config_json(const cpagent::Config& cfg) {
+  std::string out = "{";
+  bool first = true;
+  for (const auto& kv : cfg.chips) {
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + std::to_string(kv.first) + "\":" +
+           cpagent::Json()
+               .str("expectedCoords", kv.second.expected_coords)
+               .boolean("required", kv.second.required)
+               .done();
+  }
+  out += "}";
+  return out;
+}
+
 std::string handle_op(const std::string& op, const std::string&) {
   const cpagent::Config& cfg = g_monitor->config();
   if (op == "ping") {
@@ -90,7 +110,7 @@ std::string handle_op(const std::string& op, const std::string&) {
     // a minimum count; an accelerator-type mismatch always degrades.
     bool healthy = cfg.min_healthy_chips > 0
                        ? healthy_count(topo) >= cfg.min_healthy_chips
-                       : all_healthy(topo);
+                       : all_healthy(topo, cfg);
     if (!g_monitor->accel_type_matches()) healthy = false;
     return cpagent::Json()
         .boolean("healthy", healthy)
@@ -115,6 +135,7 @@ std::string handle_op(const std::string& op, const std::string&) {
         .str("hostBounds", topo.host_bounds)
         .num("numChips", static_cast<int64_t>(topo.chips.size()))
         .raw("chips", chips_json(topo))
+        .raw("chipConfig", chip_config_json(cfg))
         .done();
   }
   if (op == "subscribe") {
@@ -126,7 +147,7 @@ std::string handle_op(const std::string& op, const std::string&) {
     return cpagent::Json()
         .str("event", "baseline")
         .num("generation", static_cast<int64_t>(g_monitor->generation()))
-        .boolean("healthy", all_healthy(topo))
+        .boolean("healthy", all_healthy(topo, cfg))
         .raw("chips", chips_json(topo))
         .done();
   }
@@ -138,6 +159,7 @@ std::string handle_op(const std::string& op, const std::string&) {
         .num("rescan_ms", static_cast<int64_t>(cfg.rescan_ms))
         .num("heartbeat_ms", static_cast<int64_t>(cfg.heartbeat_ms))
         .str("accelerator_type", cfg.accelerator_type)
+        .raw("chips", chip_config_json(cfg))
         .done();
   }
   if (op == "stats") {

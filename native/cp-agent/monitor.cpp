@@ -65,6 +65,20 @@ Config load_config(const std::string& path) {
     else if (key == "rescan_ms") cfg.rescan_ms = std::atoi(value.c_str());
     else if (key == "heartbeat_ms") cfg.heartbeat_ms = std::atoi(value.c_str());
     else if (key == "accelerator_type") cfg.accelerator_type = value;
+    else if (key.rfind("chip.", 0) == 0) {
+      // Per-chip overrides (app_config.c analogue): chip.<N>.<field>.
+      auto dot = key.find('.', 5);
+      if (dot == std::string::npos) continue;
+      std::string idx_s = key.substr(5, dot - 5);
+      bool numeric = !idx_s.empty();
+      for (char ch : idx_s) numeric = numeric && ch >= '0' && ch <= '9';
+      if (!numeric) continue;
+      int idx = std::atoi(idx_s.c_str());
+      std::string field = key.substr(dot + 1);
+      if (field == "expected_coords") cfg.chips[idx].expected_coords = value;
+      else if (field == "required")
+        cfg.chips[idx].required = (value == "true" || value == "1" || value == "yes");
+    }
   }
   if (cfg.rescan_ms < 50) cfg.rescan_ms = 50;
   if (cfg.heartbeat_ms < 50) cfg.heartbeat_ms = 50;
@@ -139,7 +153,7 @@ Topology Monitor::read_with_config() const {
 }
 
 std::string Monitor::event_json(const char* kind, const Topology& t,
-                                uint64_t gen) {
+                                uint64_t gen) const {
   std::string chips = "{";
   bool first = true;
   bool all = true;
@@ -148,7 +162,9 @@ std::string Monitor::event_json(const char* kind, const Topology& t,
     first = false;
     bool ok = chip.present && chip.openable;
     chips += "\"" + std::to_string(chip.index) + "\":" + (ok ? "true" : "false");
-    if (!ok) all = false;
+    // A chip the config marks non-required reports its raw state in
+    // `chips` but does not drag down the aggregate.
+    if (!ok && cfg_.chip_required(chip.index)) all = false;
   }
   chips += "}";
   return Json()
@@ -167,17 +183,40 @@ void Monitor::rescan_and_publish() {
   health.reserve(t.chips.size());
   for (const auto& chip : t.chips) health.push_back(chip.present && chip.openable);
 
-  std::string event;
+  std::vector<std::string> events;
   std::vector<int> targets;
   {
     std::lock_guard<std::mutex> lock(mu_);
     bool changed = (health != last_health_);
     snapshot_ = t;
     if (!changed) return;
+    // Reset detection (octep PERST analogue, main.c:45-62): a chip that
+    // went unhealthy and later returns triggers a distinct `reset` event
+    // BEFORE the health_change, so consumers re-probe/re-apply state
+    // instead of just re-marking healthy. Tracked even with no
+    // subscribers — the loss may predate the subscription.
+    std::string reset_list;
+    if (was_lost_.size() < health.size()) was_lost_.resize(health.size(), false);
+    for (size_t i = 0; i < health.size(); ++i) {
+      bool before = i < last_health_.size() && last_health_[i];
+      if (before && !health[i]) {
+        was_lost_[i] = true;
+      } else if (!before && health[i] && was_lost_[i]) {
+        was_lost_[i] = false;
+        if (!reset_list.empty()) reset_list += ",";
+        reset_list += std::to_string(i);
+      }
+    }
     last_health_ = health;
     uint64_t gen = ++generation_;
     if (subscribers_.empty()) return;
-    event = event_json("health_change", t, gen);
+    if (!reset_list.empty()) {
+      std::string base = event_json("reset", t, gen);
+      // Splice the reset indices into the frame: {...,"chips_reset":[..]}
+      base.insert(base.size() - 1, ",\"chips_reset\":[" + reset_list + "]");
+      events.push_back(std::move(base));
+    }
+    events.push_back(event_json("health_change", t, gen));
     targets = subscribers_;
   }
   // Sends happen OUTSIDE the lock: a stalled subscriber must not wedge
@@ -186,8 +225,12 @@ void Monitor::rescan_and_publish() {
   // client reconnects (slow-consumer disconnect policy).
   std::vector<int> dead;
   for (int fd : targets) {
-    if (send_frame_nonblock(fd, event)) {
-      ++events_pushed_;
+    bool ok = true;
+    for (const auto& event : events) {
+      ok = ok && send_frame_nonblock(fd, event);
+    }
+    if (ok) {
+      events_pushed_ += events.size();
     } else {
       dead.push_back(fd);
       shutdown(fd, SHUT_RDWR);

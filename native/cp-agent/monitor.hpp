@@ -16,6 +16,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -25,7 +26,15 @@
 
 namespace cpagent {
 
-// Per-chip config application — the app_config.c analogue. Parsed from a
+// Per-chip overrides (octep app_config.c applies per-PF/VF entries the
+// same way): `chip.<N>.<key> = value` lines in the config file.
+struct ChipConfig {
+  std::string expected_coords;  // declarative grid coords, e.g. "0,0,0"
+  bool required = true;  // false: chip excluded from the health policy
+                         // (handed to another tenant / known-dark slot)
+};
+
+// Config application — the app_config.c analogue. Parsed from a
 // `key = value` file (see load_config); zero values mean "unset".
 struct Config {
   int expected_chips = 0;     // chips that MUST exist; missing => unhealthy
@@ -34,6 +43,12 @@ struct Config {
   int heartbeat_ms = 1000;    // heartbeat timer tick
   std::string accelerator_type;  // expected slice type; mismatch => degraded
   std::string source;            // path the config was loaded from
+  std::map<int, ChipConfig> chips;  // per-chip overrides
+
+  bool chip_required(int index) const {
+    auto it = chips.find(index);
+    return it == chips.end() || it->second.required;
+  }
 };
 
 Config load_config(const std::string& path);
@@ -69,8 +84,8 @@ class Monitor {
   void loop();
   void rescan_and_publish();
   Topology read_with_config() const;
-  static std::string event_json(const char* kind, const Topology& t,
-                                uint64_t gen);
+  std::string event_json(const char* kind, const Topology& t,
+                         uint64_t gen) const;
 
   std::string root_;
   Config cfg_;
@@ -78,6 +93,11 @@ class Monitor {
   Topology snapshot_;
   std::vector<int> subscribers_;
   std::vector<bool> last_health_;
+  // Chips that transitioned healthy→unhealthy and have not yet returned:
+  // when one reappears healthy, a distinct `reset` event precedes the
+  // health_change (octep PERST analogue — consumers re-probe, not just
+  // re-mark healthy, because a chip that bounced may hold stale state).
+  std::vector<bool> was_lost_;
   std::atomic<uint64_t> generation_{0};
   std::atomic<uint64_t> heartbeats_{0};
   std::atomic<uint64_t> events_pushed_{0};

@@ -177,6 +177,87 @@ def test_cp_agent_pushes_health_change_events(native_binaries, tmp_root):
         proc.wait(timeout=5)
 
 
+def test_cp_agent_reset_event_on_chip_return(native_binaries, tmp_root):
+    """octep PERST analogue (reference apps/octep_cp_agent/main.c:45-62):
+    yank + restore a chip node → the subscriber sees health_change
+    (down), then a distinct `reset` event naming the returned chip,
+    then health_change (up). Consumers re-probe on reset instead of
+    just trusting the reopened node."""
+    devdir = os.path.join(tmp_root.root, "dev")
+    os.makedirs(devdir, exist_ok=True)
+    open(os.path.join(devdir, "accel0"), "w").close()
+    open(os.path.join(devdir, "accel1"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("expected_chips = 2\nrescan_ms = 100\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+        events = client.subscribe()
+        assert next(events)["event"] == "baseline"
+
+        os.unlink(os.path.join(devdir, "accel1"))
+        down = next(events)
+        assert down["event"] == "health_change"
+        assert down["chips"] == {0: True, 1: False}
+
+        open(os.path.join(devdir, "accel1"), "w").close()
+        reset = next(events)
+        assert reset["event"] == "reset"
+        assert reset["chips_reset"] == [1]
+        assert reset["chips"] == {0: True, 1: True}
+        up = next(events)
+        assert up["event"] == "health_change"
+        assert up["chips"] == {0: True, 1: True}
+        assert up["healthy"] is True
+        events.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_cp_agent_per_chip_config(native_binaries, tmp_root):
+    """Per-chip config entries (octep app_config.c applies per-PF/VF
+    config): expected coords surface in `topology`, and a chip marked
+    required=false cannot fail the node's ping."""
+    os.makedirs(os.path.join(tmp_root.root, "dev"), exist_ok=True)
+    open(os.path.join(tmp_root.root, "dev", "accel0"), "w").close()
+    # accel1 is expected but absent — yet marked non-required.
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write(
+            "expected_chips = 2\nrescan_ms = 100\n"
+            "chip.0.expected_coords = 0,0,0\n"
+            "chip.1.expected_coords = 1,0,0\n"
+            "chip.1.required = false\n"
+        )
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+        topo = client.topology()
+        assert topo["chipConfig"]["0"] == {
+            "expectedCoords": "0,0,0", "required": True,
+        }
+        assert topo["chipConfig"]["1"] == {
+            "expectedCoords": "1,0,0", "required": False,
+        }
+        # Raw chip state still reports the absence...
+        assert client.chip_health() == {0: True, 1: False}
+        # ...but the non-required chip can't fail the node.
+        assert client.ping()["healthy"] is True
+        conf = client.config()
+        assert conf["chips"]["1"]["required"] is False
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_cp_agent_stats_histograms(cp_agent):
     from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
 
@@ -248,6 +329,19 @@ def test_vsp_reacts_to_pushed_chip_loss(native_binaries, tmp_root):
         assert not health_of("tpu1-ep0"), "chip loss never surfaced"
         assert health_of("tpu0-ep0"), "healthy chip must stay healthy"
         assert flipped_in < 1.0, f"flip took {flipped_in:.2f}s (event path broken?)"
+
+        # Restore the node: the agent pushes `reset` + health_change, the
+        # VSP flips the device back AND schedules a compute re-probe
+        # (resets_seen) instead of silently trusting the returned chip.
+        open(os.path.join(devdir, "accel1"), "w").close()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 3.0 and not health_of("tpu1-ep0"):
+            time.sleep(0.02)
+        assert health_of("tpu1-ep0"), "returned chip never re-advertised"
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and vsp.resets_seen == 0:
+            time.sleep(0.02)
+        assert vsp.resets_seen >= 1, "VSP never saw the reset event"
     finally:
         if vsp is not None:
             vsp.stop_watchers()
