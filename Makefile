@@ -1,7 +1,7 @@
 # Build/test entry points (counterpart of the reference's Makefile +
 # taskfile.yaml task system).
 
-.PHONY: all native proto test fast-test e2e-test traffic-flow-tests bench \
+.PHONY: all native proto test fast-test e2e-test kind-test traffic-flow-tests bench \
         build-images deploy undeploy clean bundle bundle-check provision provision-dry
 
 IMG_REGISTRY ?= localhost
@@ -24,6 +24,14 @@ fast-test:
 
 e2e-test:
 	python -m pytest tests/test_e2e.py -q
+
+# Real-cluster tier: runs the production HttpClient + operator against an
+# actual kube-apiserver (TEST_KUBECONFIG, or a kind cluster it creates
+# when docker+kind are present); skips with the validated-vs-modeled
+# boundary named otherwise. Counterpart of the reference's Kind tier
+# (internal/testutils/kindcluster.go).
+kind-test:
+	python -m pytest tests/test_kind.py -q -rs
 
 traffic-flow-tests:
 	./hack/traffic_flow_tests.sh

@@ -121,6 +121,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self) -> Tuple[Optional[_Route], dict]:
         parsed = urllib.parse.urlsplit(self.path)
         query = dict(urllib.parse.parse_qsl(parsed.query))
+        rec = getattr(self.server, "request_log", None)
+        if rec is not None and len(rec) < 10_000:
+            rec.append(
+                {
+                    "method": self.command,
+                    "path": parsed.path,
+                    "query": query,
+                    "content_type": self.headers.get("Content-Type"),
+                }
+            )
         return _parse_path(parsed.path), query
 
     # -- verbs ----------------------------------------------------------------
@@ -268,16 +278,24 @@ class ApiServer:
         cluster: InMemoryCluster,
         port: int = 0,
         token: Optional[str] = None,
+        record_requests: bool = False,
     ):
         self.cluster = cluster
         self.token = token
         self.stopping = threading.Event()
+        # With record_requests, every request's (method, path, query,
+        # content-type) is appended here — the protocol-fidelity tests
+        # assert these wire shapes match kube-apiserver's documented
+        # forms, so a drift in HttpClient's URL/verb construction fails a
+        # test instead of a real cluster.
+        self.request_log: Optional[list] = [] if record_requests else None
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.daemon_threads = True
         # Hand the handler its back-references via the server object.
         self._httpd.cluster = cluster  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.stopping = self.stopping  # type: ignore[attr-defined]
+        self._httpd.request_log = self.request_log  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -107,7 +107,7 @@ def burn_step_pallas(x: jax.Array, w: jax.Array, interpret: bool = False) -> jax
     m, k = x.shape
     k2, n = w.shape
     assert k == k2 and m % TILE == 0 and n % TILE == 0, "tile-aligned shapes only"
-    if m == n and chain_fits_vmem(m, n):
+    if pltpu is not None and m == n and chain_fits_vmem(m, n):
         h = burn_chain_pallas(x, w, length=8, interpret=interpret)
         return jnp.sum(h.astype(jnp.float32) ** 2)
     in_specs, out_spec = _block_specs(k)

@@ -105,15 +105,20 @@ class TpuFabricDataplane:
                 log.warning("endpoint share on %s failed: %s", port, e)
 
     def _apply_share(self, port: str) -> None:
-        """HTB egress share on a bridge port: rate == ceil == the
-        endpoint's slice of the fabric budget, so the partition count is
-        observable as measured throughput, not just an advertised
-        number."""
+        """Both directions of a bridge port get the endpoint's slice of
+        the fabric budget, so the partition count is observable as
+        measured throughput, not just an advertised number:
+
+          * egress HTB (host→pod): caps what the pod can RECEIVE;
+          * ingress police (pod→host): caps what the pod can TRANSMIT
+            toward the bridge/uplink — without it one pod could blast the
+            fabric at line rate and starve every other endpoint, which is
+            exactly what the SR-IOV-VF-share semantics must prevent."""
         if self.fabric_gbps is None or not self.endpoint_count:
             return
         share_mbit = max(1, int(self.fabric_gbps * 1000 / self.endpoint_count))
         # Recreate from scratch: `replace` on an existing HTB root degrades
-        # to a change op HTB rejects.
+        # to a change op HTB rejects; same for the ingress qdisc.
         subprocess.run(
             ["tc", "qdisc", "del", "dev", port, "root"], capture_output=True
         )
@@ -126,6 +131,16 @@ class TpuFabricDataplane:
              "classid", "1:10", "htb",
              "rate", f"{share_mbit}mbit", "ceil", f"{share_mbit}mbit",
              "burst", "256k", "cburst", "256k"]
+        )
+        subprocess.run(
+            ["tc", "qdisc", "del", "dev", port, "ingress"], capture_output=True
+        )
+        _run(["tc", "qdisc", "add", "dev", port, "handle", "ffff:", "ingress"])
+        _run(
+            ["tc", "filter", "add", "dev", port, "parent", "ffff:",
+             "matchall", "action", "police",
+             "rate", f"{share_mbit}mbit", "burst", "256k", "conform-exceed",
+             "drop"]
         )
 
     def detach_port(self, netdev: str) -> None:

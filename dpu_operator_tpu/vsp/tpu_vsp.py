@@ -241,11 +241,13 @@ class TpuVsp(
         while not self._watcher_stop.is_set():
             try:
                 for event in self._cp_agent.subscribe(stop=self._watcher_stop):
-                    if event.get("event") == "reset":
-                        # A chip vanished and came back: re-probe its
-                        # compute path now — it may have bounced through
-                        # a reset and hold stale state even though the
-                        # device node reopened.
+                    if event.get("chips_reset"):
+                        # A chip vanished and came back (dedicated `reset`
+                        # event, or a baseline carrying resets that
+                        # happened during our reconnect window): re-probe
+                        # its compute path now — it may have bounced
+                        # through a reset and hold stale state even
+                        # though the device node reopened.
                         self.resets_seen += 1
                         log.warning(
                             "cp-agent reported chip reset (%s); re-probing",

@@ -78,9 +78,13 @@ bool all_healthy(const cpagent::Topology& topo, const cpagent::Config& cfg) {
   return true;
 }
 
-int healthy_count(const cpagent::Topology& topo) {
+int healthy_count(const cpagent::Topology& topo, const cpagent::Config& cfg) {
+  // Count only chips this node may actually use: a non-required chip
+  // (another tenant's) being healthy must not mask dead required chips
+  // under the min_healthy_chips policy.
   int n = 0;
   for (const auto& chip : topo.chips) {
+    if (!cfg.chip_required(chip.index)) continue;
     if (chip.present && chip.openable) ++n;
   }
   return n;
@@ -109,7 +113,7 @@ std::string handle_op(const std::string& op, const std::string&) {
     // Health policy: all chips healthy, unless the config relaxes it to
     // a minimum count; an accelerator-type mismatch always degrades.
     bool healthy = cfg.min_healthy_chips > 0
-                       ? healthy_count(topo) >= cfg.min_healthy_chips
+                       ? healthy_count(topo, cfg) >= cfg.min_healthy_chips
                        : all_healthy(topo, cfg);
     if (!g_monitor->accel_type_matches()) healthy = false;
     return cpagent::Json()

@@ -115,9 +115,30 @@ void Monitor::add_subscriber(int fd) {
   std::lock_guard<std::mutex> lock(mu_);
   // Baseline is sent under the same lock hold that registers the fd, so
   // a concurrent health change either lands in this baseline or is
-  // pushed as an event after it — never lost between the two.
-  send_frame_nonblock(fd, event_json("baseline", snapshot_, generation_.load()));
+  // pushed as an event after it — never lost between the two. Resets
+  // that happened while nobody was subscribed (e.g. during the VSP's
+  // reconnect window) ride the baseline as chips_reset, so a bounced
+  // chip is never silently trusted.
+  std::string base = event_json("baseline", snapshot_, generation_.load());
+  std::string pending = take_pending_resets();
+  if (!pending.empty()) {
+    base.insert(base.size() - 1, ",\"chips_reset\":[" + pending + "]");
+  }
+  send_frame_nonblock(fd, base);
   subscribers_.push_back(fd);
+}
+
+std::string Monitor::take_pending_resets() {
+  // Caller holds mu_.
+  std::string list;
+  for (size_t i = 0; i < pending_reset_.size(); ++i) {
+    if (pending_reset_[i]) {
+      pending_reset_[i] = false;
+      if (!list.empty()) list += ",";
+      list += std::to_string(i);
+    }
+  }
+  return list;
 }
 
 void Monitor::remove_subscriber(int fd) {
@@ -194,22 +215,25 @@ void Monitor::rescan_and_publish() {
     // went unhealthy and later returns triggers a distinct `reset` event
     // BEFORE the health_change, so consumers re-probe/re-apply state
     // instead of just re-marking healthy. Tracked even with no
-    // subscribers — the loss may predate the subscription.
-    std::string reset_list;
+    // subscribers — the loss (or the whole bounce) may predate the
+    // subscription, so unobserved returns park in pending_reset_ and are
+    // delivered in the next subscriber's baseline frame.
     if (was_lost_.size() < health.size()) was_lost_.resize(health.size(), false);
+    if (pending_reset_.size() < health.size())
+      pending_reset_.resize(health.size(), false);
     for (size_t i = 0; i < health.size(); ++i) {
       bool before = i < last_health_.size() && last_health_[i];
       if (before && !health[i]) {
         was_lost_[i] = true;
       } else if (!before && health[i] && was_lost_[i]) {
         was_lost_[i] = false;
-        if (!reset_list.empty()) reset_list += ",";
-        reset_list += std::to_string(i);
+        pending_reset_[i] = true;
       }
     }
     last_health_ = health;
     uint64_t gen = ++generation_;
-    if (subscribers_.empty()) return;
+    if (subscribers_.empty()) return;  // pending resets survive for later
+    std::string reset_list = take_pending_resets();
     if (!reset_list.empty()) {
       std::string base = event_json("reset", t, gen);
       // Splice the reset indices into the frame: {...,"chips_reset":[..]}

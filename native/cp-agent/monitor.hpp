@@ -97,7 +97,11 @@ class Monitor {
   // when one reappears healthy, a distinct `reset` event precedes the
   // health_change (octep PERST analogue — consumers re-probe, not just
   // re-mark healthy, because a chip that bounced may hold stale state).
+  // Returns observed while nobody was subscribed park in pending_reset_
+  // and are delivered in the next subscriber's baseline frame.
   std::vector<bool> was_lost_;
+  std::vector<bool> pending_reset_;
+  std::string take_pending_resets();
   std::atomic<uint64_t> generation_{0};
   std::atomic<uint64_t> heartbeats_{0};
   std::atomic<uint64_t> events_pushed_{0};

@@ -1,0 +1,165 @@
+"""Protocol-fidelity regression (round-2 verdict Missing #1 containment):
+record every request HttpClient puts on the wire in http mode and assert
+the shapes match kube-apiserver's documented REST forms — path grammar,
+verbs, query params, content types — plus the documented response shapes
+(Status bodies, List envelopes, watch event lines). The modeled ApiServer
+accepting a malformed request would hide it; these assertions pin the
+*client's* output against the upstream API convention independent of what
+the model tolerates. The real-cluster tier (tests/test_kind.py) validates
+the same client against an actual kube-apiserver when one is reachable.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from dpu_operator_tpu import vars as v
+from dpu_operator_tpu.api import v1
+from dpu_operator_tpu.k8s.http_client import HttpClient
+from dpu_operator_tpu.k8s.http_server import ApiServer
+from dpu_operator_tpu.k8s.store import Conflict, InMemoryCluster, NotFound
+
+
+@pytest.fixture
+def recording_stack():
+    server = ApiServer(InMemoryCluster(), record_requests=True).start()
+    client = HttpClient(server.url)
+    try:
+        yield server, client
+    finally:
+        server.stop()
+
+
+def _find(log, method, path_re):
+    for entry in log:
+        if entry["method"] == method and re.fullmatch(path_re, entry["path"]):
+            return entry
+    raise AssertionError(
+        f"no {method} {path_re} in wire log:\n"
+        + "\n".join(f"{e['method']} {e['path']} {e['query']}" for e in log)
+    )
+
+
+def test_request_shapes_match_kube_rest_grammar(recording_stack):
+    server, client = recording_stack
+    ns = "default"
+
+    # Namespaced custom resource CRUD + /status + list-by-label.
+    cfg = v1.new_dpu_operator_config()
+    cfg["metadata"]["namespace"] = ns
+    created = client.create(cfg)
+    created.setdefault("status", {})["phase"] = "Ready"
+    client.update_status(created)
+    fetched = client.get(v1.GROUP_VERSION, "DpuOperatorConfig", ns, v.DPU_OPERATOR_CONFIG_NAME)
+    fetched["metadata"]["labels"] = {"a": "b"}
+    client.update(fetched)
+    client.list(v1.GROUP_VERSION, "DpuOperatorConfig", ns, label_selector={"a": "b"})
+    client.delete(v1.GROUP_VERSION, "DpuOperatorConfig", ns, v.DPU_OPERATOR_CONFIG_NAME)
+
+    # Core-group resource (different URL root) + cluster-scoped resource.
+    client.create(
+        {"apiVersion": "v1", "kind": "ConfigMap",
+         "metadata": {"name": "cm1", "namespace": ns}, "data": {"k": "v"}}
+    )
+    client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"}})
+    client.list("v1", "Node")
+
+    log = list(server.request_log)
+    group = v1.GROUP_VERSION  # e.g. "config.tpu.io/v1"
+    base = f"/apis/{group}/namespaces/{ns}/dpuoperatorconfigs"
+
+    # Documented kube REST grammar:
+    #   custom resources:  /apis/GROUP/VERSION/namespaces/NS/PLURAL[/NAME[/status]]
+    #   core v1:           /api/v1/namespaces/NS/PLURAL[/NAME]
+    #   cluster-scoped:    /api/v1/nodes
+    post = _find(log, "POST", re.escape(base))
+    assert post["content_type"] == "application/json"
+    _find(log, "PUT", re.escape(f"{base}/{v.DPU_OPERATOR_CONFIG_NAME}/status"))
+    _find(log, "GET", re.escape(f"{base}/{v.DPU_OPERATOR_CONFIG_NAME}"))
+    _find(log, "PUT", re.escape(f"{base}/{v.DPU_OPERATOR_CONFIG_NAME}"))
+    sel = _find(log, "GET", re.escape(base))
+    assert sel["query"] == {"labelSelector": "a=b"}, sel["query"]
+    _find(log, "DELETE", re.escape(f"{base}/{v.DPU_OPERATOR_CONFIG_NAME}"))
+    _find(log, "POST", re.escape("/api/v1/namespaces/default/configmaps"))
+    _find(log, "POST", re.escape("/api/v1/nodes"))
+    _find(log, "GET", re.escape("/api/v1/nodes"))
+
+    # No stray shapes: every logged path parses under the two documented
+    # roots, and watch/namespaces never appear mangled.
+    for entry in log:
+        assert re.match(r"^/(api/v1|apis/[a-z0-9.\-]+/v[0-9a-z]+)/", entry["path"]), entry
+
+
+def test_watch_request_and_event_wire_shape(recording_stack):
+    import time
+
+    server, client = recording_stack
+    client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "w0"}})
+    w = client.watch("v1", "Node")
+    ev = w.events.get(timeout=10)  # initial relist
+    assert ev.type in ("ADDED", "MODIFIED")
+    assert ev.object["metadata"]["name"] == "w0"
+    # An event arriving through the LIVE stream proves the watch GET is
+    # on the wire (the first ADDED can come from the client's relist).
+    client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "w1"}})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ev = w.events.get(timeout=10)
+        if ev.object["metadata"]["name"] == "w1":
+            break
+    assert ev.object["metadata"]["name"] == "w1"
+    client.stop_watch(w)
+
+    watch_req = next(
+        e for e in server.request_log
+        if e["method"] == "GET" and e["query"].get("watch") in ("1", "true")
+    )
+    # watch=1 parses true under kube's strconv.ParseBool; resume point and
+    # bookmark opt-out ride the documented query params.
+    assert watch_req["path"] == "/api/v1/nodes"
+    assert "resourceVersion" in watch_req["query"]
+    assert watch_req["query"]["allowWatchBookmarks"] == "false"
+
+    # Raw wire: watch events are newline-delimited JSON {type, object}
+    # exactly as a real apiserver streams them.
+    with urllib.request.urlopen(
+        f"{server.url}/api/v1/nodes?watch=1&resourceVersion=0", timeout=10
+    ) as resp:
+        line = resp.readline()
+    parsed = json.loads(line)
+    assert set(parsed) == {"type", "object"}
+    assert parsed["object"]["kind"] == "Node"
+
+
+def test_error_and_list_response_shapes(recording_stack):
+    server, client = recording_stack
+    client.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "e0"}})
+
+    # 409 Conflict carries a kube Status body.
+    stale = client.get("v1", "Node", None, "e0")
+    client.update(dict(stale))
+    with pytest.raises(Conflict):
+        client.update(stale)
+    with pytest.raises(NotFound):
+        client.get("v1", "Node", None, "nope")
+
+    # Raw shapes: List envelope and Status error body.
+    with urllib.request.urlopen(f"{server.url}/api/v1/nodes", timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body["kind"] == "NodeList"
+    assert body["apiVersion"] == "v1"
+    assert "resourceVersion" in body["metadata"]
+    assert isinstance(body["items"], list)
+
+    try:
+        urllib.request.urlopen(f"{server.url}/api/v1/nodes/nope", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        status = json.loads(e.read())
+        assert status["kind"] == "Status"
+        assert status["apiVersion"] == "v1"
+        assert status["status"] == "Failure"
+        assert status["reason"] == "NotFound"
+        assert status["code"] == 404

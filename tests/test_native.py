@@ -219,6 +219,85 @@ def test_cp_agent_reset_event_on_chip_return(native_binaries, tmp_root):
         proc.wait(timeout=5)
 
 
+def test_cp_agent_reset_during_no_subscriber_window_rides_baseline(
+    native_binaries, tmp_root
+):
+    """A bounce that completes while nobody is subscribed (the VSP's
+    reconnect window) must not be silently swallowed: the next
+    subscriber's baseline carries chips_reset so the consumer still
+    re-probes the returned chip."""
+    devdir = os.path.join(tmp_root.root, "dev")
+    os.makedirs(devdir, exist_ok=True)
+    open(os.path.join(devdir, "accel0"), "w").close()
+    open(os.path.join(devdir, "accel1"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("expected_chips = 2\nrescan_ms = 50\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+
+        def wait_health(want):
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if client.chip_health() == want:
+                    return True
+                time.sleep(0.05)
+            return False
+
+        # Bounce chip 1 with NO subscriber attached.
+        os.unlink(os.path.join(devdir, "accel1"))
+        assert wait_health({0: True, 1: False})
+        open(os.path.join(devdir, "accel1"), "w").close()
+        assert wait_health({0: True, 1: True})
+
+        events = client.subscribe()
+        baseline = next(events)
+        assert baseline["event"] == "baseline"
+        assert baseline["chips_reset"] == [1], baseline
+        events.close()
+
+        # Consumed: a second subscriber sees a clean baseline.
+        events2 = client.subscribe()
+        baseline2 = next(events2)
+        assert "chips_reset" not in baseline2
+        events2.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_cp_agent_min_healthy_counts_required_chips_only(native_binaries, tmp_root):
+    """min_healthy_chips counts REQUIRED chips: another tenant's healthy
+    chips must not mask this node's dead required chips."""
+    os.makedirs(os.path.join(tmp_root.root, "dev"), exist_ok=True)
+    # Chips 2,3 present+openable but marked required=false; required
+    # chips 0,1 are expected-but-absent (dead).
+    open(os.path.join(tmp_root.root, "dev", "accel2"), "w").close()
+    open(os.path.join(tmp_root.root, "dev", "accel3"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write(
+            "expected_chips = 4\nmin_healthy_chips = 2\nrescan_ms = 100\n"
+            "chip.2.required = false\nchip.3.required = false\n"
+        )
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+    try:
+        from dpu_operator_tpu.vsp.cp_agent_client import CpAgentClient
+
+        client = CpAgentClient(sock)
+        assert client.chip_health() == {0: False, 1: False, 2: True, 3: True}
+        # 2 healthy chips exist, but zero REQUIRED ones — unhealthy.
+        assert client.ping()["healthy"] is False
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_cp_agent_per_chip_config(native_binaries, tmp_root):
     """Per-chip config entries (octep app_config.c applies per-PF/VF
     config): expected coords surface in `topology`, and a chip marked
