@@ -292,13 +292,15 @@ def bench_virtual_ring() -> dict:
         }
     )
     code = (
-        "import json, sys; sys.path.insert(0, %r)\n"
+        "import json, statistics, sys; sys.path.insert(0, %r)\n"
         "from dpu_operator_tpu.parallel.mesh import build_mesh\n"
         "from dpu_operator_tpu.parallel.ring_probe import measure_ring_bandwidth\n"
         "m = build_mesh()\n"
-        "r = measure_ring_bandwidth(m, axis='sp')\n"
-        "print(json.dumps({'virtual_ring_gbps': round(r['effective_gbps'], 2),"
-        " 'virtual_ring_axis_size': r['axis_size']}))\n" % repo
+        "# Median of 3: a CPU-contended single run swung 0.3-1.3 Gb/s.\n"
+        "rs = [measure_ring_bandwidth(m, axis='sp') for _ in range(3)]\n"
+        "gbps = statistics.median(r['effective_gbps'] for r in rs)\n"
+        "print(json.dumps({'virtual_ring_gbps': round(gbps, 2),"
+        " 'virtual_ring_axis_size': rs[0]['axis_size']}))\n" % repo
     )
     try:
         r = subprocess.run(

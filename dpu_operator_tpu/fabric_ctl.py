@@ -23,10 +23,13 @@ Commands:
     ports [--bridge BR]                  bridge port + FDB state dump
     stats [--bridge BR | DEV...] [--rate S]   per-port kernel counters
     watch [--interval S] [--count N]     stream device-inventory changes
+    events [--agent-socket P] [--count N]  tail the cp-agent event plane
+                                         (health_change / reset frames)
 
 ports/stats inspect the kernel dataplane directly (sysfs + bridge(8)),
 the way p4rt-ctl dumps pipeline tables/counters from infrap4d rather
-than through the dpu-api contract."""
+than through the dpu-api contract; events talks to the native cp-agent's
+unix socket, bypassing gRPC entirely."""
 
 from __future__ import annotations
 
@@ -326,6 +329,27 @@ def cmd_watch(args, chan):
             remaining -= 1
 
 
+def cmd_events(args, chan):
+    """Stream the native cp-agent's pushed events as JSON lines: the
+    baseline frame, then health_change / reset frames as they happen —
+    the CLI surface of the event plane the tpuvsp consumes. A
+    `chips_reset` list marks PERST-analogue chip bounces (the chip
+    vanished and returned; consumers should re-probe, not just trust
+    it). Connects to the agent socket directly, no gRPC involved."""
+    from .utils import PathManager
+    from .vsp.cp_agent_client import CpAgentClient
+
+    sock = args.agent_socket or PathManager().cp_agent_socket()
+    client = CpAgentClient(sock)
+    remaining = args.count
+    for event in client.subscribe():
+        print(json.dumps(event), flush=True)
+        if remaining is not None:
+            remaining -= 1
+            if remaining <= 0:
+                return
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fabric-ctl", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -359,16 +383,20 @@ def main(argv=None) -> int:
     p = sub.add_parser("watch"); p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--count", type=int, default=None)
     p.set_defaults(fn=cmd_watch)
+    p = sub.add_parser("events"); p.add_argument("--agent-socket", default=None)
+    p.add_argument("--count", type=int, default=None)
+    p.set_defaults(fn=cmd_events, no_chan=True)  # agent socket, not gRPC
 
     args = ap.parse_args(argv)
-    chan = _channel(args)
+    chan = None if getattr(args, "no_chan", False) else _channel(args)
     try:
         args.fn(args, chan)
     except grpc.RpcError as e:
         print(json.dumps({"error": e.code().name, "details": e.details()}), file=sys.stderr)
         return 1
     finally:
-        chan.close()
+        if chan is not None:
+            chan.close()
     return 0
 
 

@@ -65,6 +65,7 @@ Config load_config(const std::string& path) {
     else if (key == "rescan_ms") cfg.rescan_ms = std::atoi(value.c_str());
     else if (key == "heartbeat_ms") cfg.heartbeat_ms = std::atoi(value.c_str());
     else if (key == "accelerator_type") cfg.accelerator_type = value;
+    else if (key == "reset_memory_ms") cfg.reset_memory_ms = std::atoi(value.c_str());
     else if (key.rfind("chip.", 0) == 0) {
       // Per-chip overrides (app_config.c analogue): chip.<N>.<field>.
       auto dot = key.find('.', 5);
@@ -120,20 +121,30 @@ void Monitor::add_subscriber(int fd) {
   // reconnect window) ride the baseline as chips_reset, so a bounced
   // chip is never silently trusted.
   std::string base = event_json("baseline", snapshot_, generation_.load());
-  std::string pending = take_pending_resets();
-  if (!pending.empty()) {
-    base.insert(base.size() - 1, ",\"chips_reset\":[" + pending + "]");
+  std::string recent = recent_resets_locked();
+  if (!recent.empty()) {
+    base.insert(base.size() - 1, ",\"chips_reset\":[" + recent + "]");
   }
-  send_frame_nonblock(fd, base);
+  if (!send_frame_nonblock(fd, base)) {
+    // Dead on arrival (client gone before the baseline landed): don't
+    // register the fd — the rescan path would only discover it on the
+    // next health change and meanwhile count it as a live subscriber.
+    shutdown(fd, SHUT_RDWR);
+    return;
+  }
   subscribers_.push_back(fd);
 }
 
-std::string Monitor::take_pending_resets() {
-  // Caller holds mu_.
+std::string Monitor::recent_resets_locked() const {
+  // Caller holds mu_. Delivery does NOT consume: resets stay visible in
+  // baselines for reset_memory_ms so no subscriber can swallow another
+  // consumer's notification.
+  auto now = std::chrono::steady_clock::now();
+  auto ttl = std::chrono::milliseconds(cfg_.reset_memory_ms);
   std::string list;
-  for (size_t i = 0; i < pending_reset_.size(); ++i) {
-    if (pending_reset_[i]) {
-      pending_reset_[i] = false;
+  for (size_t i = 0; i < last_reset_.size(); ++i) {
+    if (last_reset_[i].time_since_epoch().count() != 0 &&
+        now - last_reset_[i] <= ttl) {
       if (!list.empty()) list += ",";
       list += std::to_string(i);
     }
@@ -215,25 +226,26 @@ void Monitor::rescan_and_publish() {
     // went unhealthy and later returns triggers a distinct `reset` event
     // BEFORE the health_change, so consumers re-probe/re-apply state
     // instead of just re-marking healthy. Tracked even with no
-    // subscribers — the loss (or the whole bounce) may predate the
-    // subscription, so unobserved returns park in pending_reset_ and are
-    // delivered in the next subscriber's baseline frame.
+    // subscribers — the loss (or the whole bounce) may predate any
+    // subscription — and remembered for reset_memory_ms so baselines
+    // keep announcing it (recent_resets_locked).
     if (was_lost_.size() < health.size()) was_lost_.resize(health.size(), false);
-    if (pending_reset_.size() < health.size())
-      pending_reset_.resize(health.size(), false);
+    if (last_reset_.size() < health.size()) last_reset_.resize(health.size());
+    std::string reset_list;
     for (size_t i = 0; i < health.size(); ++i) {
       bool before = i < last_health_.size() && last_health_[i];
       if (before && !health[i]) {
         was_lost_[i] = true;
       } else if (!before && health[i] && was_lost_[i]) {
         was_lost_[i] = false;
-        pending_reset_[i] = true;
+        last_reset_[i] = std::chrono::steady_clock::now();
+        if (!reset_list.empty()) reset_list += ",";
+        reset_list += std::to_string(i);
       }
     }
     last_health_ = health;
     uint64_t gen = ++generation_;
-    if (subscribers_.empty()) return;  // pending resets survive for later
-    std::string reset_list = take_pending_resets();
+    if (subscribers_.empty()) return;  // reset memory survives for later
     if (!reset_list.empty()) {
       std::string base = event_json("reset", t, gen);
       // Splice the reset indices into the frame: {...,"chips_reset":[..]}

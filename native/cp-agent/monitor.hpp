@@ -15,6 +15,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <map>
 #include <mutex>
@@ -41,6 +42,8 @@ struct Config {
   int min_healthy_chips = 0;  // ping healthy iff healthy count >= this
   int rescan_ms = 1000;       // periodic full rescan interval
   int heartbeat_ms = 1000;    // heartbeat timer tick
+  int reset_memory_ms = 120000;  // how long a chip reset stays visible in
+                                 // new subscribers' baselines
   std::string accelerator_type;  // expected slice type; mismatch => degraded
   std::string source;            // path the config was loaded from
   std::map<int, ChipConfig> chips;  // per-chip overrides
@@ -97,11 +100,15 @@ class Monitor {
   // when one reappears healthy, a distinct `reset` event precedes the
   // health_change (octep PERST analogue — consumers re-probe, not just
   // re-mark healthy, because a chip that bounced may hold stale state).
-  // Returns observed while nobody was subscribed park in pending_reset_
-  // and are delivered in the next subscriber's baseline frame.
+  // Every reset also records its time; baselines carry all resets
+  // younger than reset_memory_ms, NOT consumed by delivery — a consumer
+  // that was disconnected when the reset fired (or when another
+  // subscriber's baseline was served) still learns about it on its next
+  // subscribe, and duplicate notifications are harmless (the re-probe is
+  // idempotent).
   std::vector<bool> was_lost_;
-  std::vector<bool> pending_reset_;
-  std::string take_pending_resets();
+  std::vector<std::chrono::steady_clock::time_point> last_reset_;
+  std::string recent_resets_locked() const;
   std::atomic<uint64_t> generation_{0};
   std::atomic<uint64_t> heartbeats_{0};
   std::atomic<uint64_t> events_pushed_{0};

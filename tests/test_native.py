@@ -5,6 +5,7 @@ dpu-cni shim binary end-to-end against a live CNI server."""
 import json
 import os
 import subprocess
+import sys
 import time
 import uuid
 
@@ -260,10 +261,13 @@ def test_cp_agent_reset_during_no_subscriber_window_rides_baseline(
         assert baseline["chips_reset"] == [1], baseline
         events.close()
 
-        # Consumed: a second subscriber sees a clean baseline.
+        # NOT consumed by delivery: a second subscriber (e.g. the VSP
+        # reconnecting after a debugging `fabric-ctl events` session took
+        # the first baseline) still learns about the bounce — resets stay
+        # visible for reset_memory_ms and re-probes are idempotent.
         events2 = client.subscribe()
         baseline2 = next(events2)
-        assert "chips_reset" not in baseline2
+        assert baseline2["chips_reset"] == [1], baseline2
         events2.close()
     finally:
         proc.terminate()
@@ -335,6 +339,46 @@ def test_cp_agent_per_chip_config(native_binaries, tmp_root):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_fabric_ctl_events_streams_agent_frames(native_binaries, tmp_root):
+    """`fabric-ctl events` tails the cp-agent event plane: baseline, then
+    pushed health_change/reset frames, as JSON lines on stdout."""
+    devdir = os.path.join(tmp_root.root, "dev")
+    os.makedirs(devdir, exist_ok=True)
+    open(os.path.join(devdir, "accel0"), "w").close()
+    cfg = os.path.join(tmp_root.root, "agent.cfg")
+    with open(cfg, "w") as f:
+        f.write("expected_chips = 1\nrescan_ms = 50\n")
+    sock = tmp_root.cp_agent_socket()
+    proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
+
+    ctl = subprocess.Popen(
+        [sys.executable, "-m", "dpu_operator_tpu.fabric_ctl",
+         "events", "--agent-socket", sock, "--count", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+    )
+    try:
+        # Subscribe confirmed (baseline on stdout) BEFORE bouncing, so
+        # the down/reset/up frames arrive as live pushes.
+        baseline = json.loads(ctl.stdout.readline())
+        assert baseline["event"] == "baseline"
+        os.unlink(os.path.join(devdir, "accel0"))
+        time.sleep(0.5)
+        open(os.path.join(devdir, "accel0"), "w").close()
+        out, err = ctl.communicate(timeout=30)
+        assert ctl.returncode == 0, err
+        frames = [baseline] + [json.loads(ln) for ln in out.strip().splitlines()]
+        assert [f["event"] for f in frames] == [
+            "baseline", "health_change", "reset", "health_change",
+        ]
+        assert frames[2]["chips_reset"] == [0]
+    finally:
+        if ctl.poll() is None:
+            ctl.kill()
+        proc.terminate()
+        proc.wait(timeout=5)
+
 
 
 def test_cp_agent_stats_histograms(cp_agent):
