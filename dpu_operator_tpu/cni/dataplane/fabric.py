@@ -47,6 +47,42 @@ class FabricDataplane:
     def __init__(self, state_store: StateStore, ipam: HostLocalIpam):
         self._store = state_store
         self._ipam = ipam
+        # Per-NAD IPAM: a NetworkAttachmentDefinition's config may carry
+        # its own `ipam` section (upstream host-local grammar: subnet,
+        # rangeStart/rangeEnd, exclude, gateway, routes); allocators are
+        # cached per subnet so every request against the same NAD shares
+        # one lease file.
+        self._ipam_cache: dict = {}
+        self._ipam_lock = threading.Lock()
+
+    def _ipam_for(self, req: CniRequest):
+        """(allocator, routes) for this request: the NAD's own `ipam`
+        config when present, the daemon-level default otherwise."""
+        conf = (req.config or {}).get("ipam") or {}
+        subnet = conf.get("subnet")
+        if not subnet:
+            return self._ipam, []
+        routes = [
+            r for r in (conf.get("routes") or [])
+            if isinstance(r, dict) and r.get("dst")
+        ]
+        key = (
+            subnet, conf.get("rangeStart"), conf.get("rangeEnd"),
+            conf.get("gateway"), tuple(conf.get("exclude") or ()),
+        )
+        with self._ipam_lock:
+            ipam = self._ipam_cache.get(key)
+            if ipam is None:
+                ipam = HostLocalIpam(
+                    self._ipam.state_dir,
+                    subnet,
+                    gateway=conf.get("gateway"),
+                    range_start=conf.get("rangeStart"),
+                    range_end=conf.get("rangeEnd"),
+                    exclude=conf.get("exclude"),
+                )
+                self._ipam_cache[key] = ipam
+        return ipam, routes
 
     def cmd_add(self, req: CniRequest) -> CniResult:
         if not req.netns:
@@ -78,7 +114,8 @@ class FabricDataplane:
                     nl.set_mtu(tmp_if, int(mtu))
                 nl.move_link_to_netns(tmp_if, netns)
                 nl.rename_link(tmp_if, req.ifname, netns)
-            cidr, gateway = self._ipam.allocate(owner)
+            ipam, routes = self._ipam_for(req)
+            cidr, gateway = ipam.allocate(owner)
             nl.add_addr(req.ifname, cidr, netns)
             nl.set_up(req.ifname, netns)
             nl.set_up(host_if)
@@ -87,17 +124,36 @@ class FabricDataplane:
                     nl.add_route("default", gateway, req.ifname, netns)
                 except nl.NetlinkError:
                     log.debug("default route exists in %s", netns)
+            for route in routes:
+                # NAD-declared routes (host-local `routes` grammar): dst
+                # required, gw defaults to the range gateway.
+                try:
+                    nl.add_route(
+                        route["dst"], route.get("gw") or gateway,
+                        req.ifname, netns,
+                    )
+                except nl.NetlinkError as e:
+                    log.warning("route %s failed in %s: %s", route, netns, e)
             # Announce the new MAC/IP so bridge FDBs and peers learn it
             # immediately (reference GARP after IPAM, sriov.go:466-480).
             from .. import arp
 
             arp.announce(req.ifname, mac, cidr, netns, blocking=False)
-        except (nl.NetlinkError, OSError, IpamError) as e:
+        except (nl.NetlinkError, OSError, IpamError, ValueError) as e:
             # Full rollback — never leave a half-plumbed pod (the reference
             # guarantees the same on its move protocol, networkfn.go:36-149).
             # IpamError included: the veth already exists in the pod netns
-            # when range exhaustion hits.
-            self._rollback(host_if, tmp_if, req.ifname, netns, owner)
+            # when range exhaustion hits. ValueError: a malformed NAD ipam
+            # subnet raises from ipaddress inside _ipam_for. The rollback
+            # allocator is resolved DEFENSIVELY — when the failure IS the
+            # bad ipam config, _ipam_for would just raise again and skip
+            # the cleanup entirely.
+            try:
+                rollback_ipam = self._ipam_for(req)[0]
+            except Exception:
+                rollback_ipam = self._ipam
+            self._rollback(host_if, tmp_if, req.ifname, netns, owner,
+                           rollback_ipam)
             nl.release_named_netns(netns, netns_created)
             raise CniError(f"fabric ADD failed: {e}") from e
 
@@ -143,7 +199,11 @@ class FabricDataplane:
             except nl.NetlinkError:
                 # Fall back to synchronous destruction.
                 nl.delete_link(host_if)
-        self._ipam.release(state.get("owner", f"{req.container_id}/{req.ifname}"))
+        # CNI guarantees DEL carries the same config as ADD, so the same
+        # NAD-level allocator is resolved for the release.
+        self._ipam_for(req)[0].release(
+            state.get("owner", f"{req.container_id}/{req.ifname}")
+        )
         self._store.delete(req.container_id, req.ifname)
         return {}, True
 
@@ -215,13 +275,14 @@ class FabricDataplane:
         result.add_ip(state["address"], idx, state.get("gateway"))
         return result
 
-    def _rollback(self, host_if: str, tmp_if: str, ifname: str, netns: str, owner: str) -> None:
+    def _rollback(self, host_if: str, tmp_if: str, ifname: str, netns: str,
+                  owner: str, ipam: Optional[HostLocalIpam] = None) -> None:
         for name, ns in ((tmp_if, netns), (ifname, netns), (tmp_if, None), (host_if, None)):
             try:
                 nl.delete_link(name, ns)
             except nl.NetlinkError:
                 pass
         try:
-            self._ipam.release(owner)
+            (ipam or self._ipam).release(owner)
         except Exception:
             pass

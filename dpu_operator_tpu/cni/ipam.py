@@ -21,10 +21,34 @@ class IpamError(RuntimeError):
 
 
 class HostLocalIpam:
-    def __init__(self, state_dir: str, range_cidr: str, gateway: Optional[str] = None):
-        self._dir = state_dir
+    def __init__(
+        self,
+        state_dir: str,
+        range_cidr: str,
+        gateway: Optional[str] = None,
+        range_start: Optional[str] = None,
+        range_end: Optional[str] = None,
+        exclude: Optional[list] = None,
+    ):
+        """`range_start`/`range_end`/`exclude` mirror upstream host-local's
+        NAD knobs (rangeStart/rangeEnd/exclude), so a NetworkAttachment-
+        Definition can carve pod addresses out of a shared fabric subnet
+        without colliding with statically assigned peers."""
+        self.state_dir = state_dir
         self._net = ipaddress.ip_network(range_cidr, strict=False)
         self._gateway = gateway
+        self._start = ipaddress.ip_address(range_start) if range_start else None
+        self._end = ipaddress.ip_address(range_end) if range_end else None
+        for bound, name in ((self._start, "rangeStart"), (self._end, "rangeEnd")):
+            if bound is not None and bound not in self._net:
+                raise IpamError(f"{name} {bound} outside range {self._net}")
+        # Kept as networks and tested by containment at allocation time:
+        # pre-expanding would hand out an excluded block's network/
+        # broadcast addresses (valid hosts of the ENCLOSING range) and
+        # materialize millions of strings for a wide exclude.
+        self._exclude = [
+            ipaddress.ip_network(item, strict=False) for item in exclude or []
+        ]
         os.makedirs(state_dir, exist_ok=True)
         self._store = os.path.join(
             state_dir, f"ipam-{self._net.network_address}-{self._net.prefixlen}.json"
@@ -54,6 +78,12 @@ class HostLocalIpam:
             if self._gateway:
                 used.add(self._gateway)
             for host in self._net.hosts():
+                if self._start is not None and host < self._start:
+                    continue
+                if self._end is not None and host > self._end:
+                    break
+                if any(host in net for net in self._exclude):
+                    continue
                 h = str(host)
                 if h not in used:
                     leases[h] = owner

@@ -60,6 +60,7 @@ class DevicePlugin(services.DevicePluginServicer):
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        self._kubelet_watch_started = False
         self._healthy: Dict[str, bool] = {}
         # Full VSP inventory (backing device node, chip coords, worker id)
         # for the allocated-device mounts/env Allocate builds; refreshed by
@@ -245,23 +246,86 @@ class DevicePlugin(services.DevicePluginServicer):
 
         kubelet_sock = self._pm.kubelet_registry_socket()
         channel = grpc.insecure_channel(f"unix://{kubelet_sock}")
-        grpc.channel_ready_future(channel).result(timeout=timeout)
-        stub = services.KubeletRegistrationStub(channel)
-        stub.Register(
-            kdp.RegisterRequest(
-                version=API_VERSION,
-                endpoint=os.path.basename(self._pm.device_plugin_socket()),
-                resource_name=self.resource_name,
-            ),
-            timeout=timeout,
-        )
-        channel.close()
+        try:
+            grpc.channel_ready_future(channel).result(timeout=timeout)
+            stub = services.KubeletRegistrationStub(channel)
+            stub.Register(
+                kdp.RegisterRequest(
+                    version=API_VERSION,
+                    endpoint=os.path.basename(self._pm.device_plugin_socket()),
+                    resource_name=self.resource_name,
+                ),
+                timeout=timeout,
+            )
+        finally:
+            # Close on failure too: the re-registration loop retries every
+            # second during a kubelet outage, and an unclosed channel per
+            # attempt leaks fds until the daemon exhausts them.
+            channel.close()
         log.info("registered %s with kubelet", self.resource_name)
+        self._start_kubelet_watch()
+
+    def _start_kubelet_watch(self) -> None:
+        """Once per plugin: watch the registry socket for a kubelet
+        restart so registration survives it. Snapshot the incarnation
+        SYNCHRONOUSLY — the registration just succeeded against this
+        socket, so it is the known-registered baseline; letting the
+        thread take its own first sample would race a restart landing
+        before the thread's first poll. Called from register_with_kubelet
+        so every registration path (serve(), the side managers' direct
+        calls) gets the watcher."""
+        if self._kubelet_watch_started:
+            return
+        self._kubelet_watch_started = True
+        t = threading.Thread(
+            target=self._reregistration_loop,
+            args=(self._kubelet_incarnation(),),
+            daemon=True,
+            name="dp-kubelet-watch",
+        )
+        t.start()
 
     def serve(self, register: bool = True) -> None:
         self.start()
         if register:
             self.register_with_kubelet()
+
+    def _kubelet_incarnation(self):
+        import os
+
+        try:
+            st = os.stat(self._pm.kubelet_registry_socket())
+            # ctime_ns included because a freshly unlinked inode can be
+            # reused for the new socket immediately (tmpfs does), which
+            # would make (ino, dev) alone miss a fast restart.
+            return (st.st_ino, st.st_dev, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def _reregistration_loop(self, last, interval: float = 1.0) -> None:
+        """Re-register after a kubelet restart. A restarted kubelet
+        forgets every plugin and recreates its registry socket; plugins
+        that do not watch for this silently drop off the node's
+        allocatable resources (upstream device plugins and the reference
+        both depend on re-registration; its Kind harness restarts kubelet
+        in place, kindcluster.go:162-214). The registry socket's inode
+        identifies the kubelet incarnation: when it changes (or the
+        socket vanishes and returns), register again."""
+        while not self._stop.wait(interval):
+            current = self._kubelet_incarnation()
+            if current is not None and current != last:
+                try:
+                    self.register_with_kubelet()
+                    log.info(
+                        "kubelet registry socket changed; re-registered %s",
+                        self.resource_name,
+                    )
+                except Exception:
+                    # Kubelet may still be coming up; retry next tick
+                    # without advancing `last` so the attempt repeats.
+                    log.warning("kubelet re-registration failed; will retry")
+                    continue
+            last = current if current is not None else last
 
     def stop(self) -> None:
         self._stop.set()

@@ -144,6 +144,36 @@ def test_allocate_healthy_and_unknown(vsp_and_plugin, tmp_root):
         dp.stop()
 
 
+def test_reregisters_after_kubelet_restart(vsp_and_plugin, tmp_root):
+    """A restarted kubelet forgets every plugin and recreates its
+    registry socket; the plugin watches the socket's identity and
+    registers again, so the resource never silently drops off the node
+    (the failure mode upstream device plugins guard against; the
+    reference relies on the same re-registration behavior)."""
+    vsp, plugin = vsp_and_plugin
+    kubelet = FakeKubelet(tmp_root)
+    kubelet.start()
+    dp = DevicePlugin(plugin, tmp_root, poll_interval=0.1)
+    try:
+        dp.serve(register=True)
+        assert kubelet.registered.wait(timeout=5)
+        kubelet.stop()
+
+        # "Restart": a brand-new kubelet process, fresh registry socket.
+        kubelet2 = FakeKubelet(tmp_root)
+        kubelet2.start()
+        try:
+            assert kubelet2.registered.wait(timeout=10), (
+                "plugin never re-registered with the restarted kubelet"
+            )
+            assert kubelet2.resource_name == "tpu.dpu.io/endpoint"
+            assert wait_for(lambda: len(kubelet2.allocatable()) == 4)
+        finally:
+            kubelet2.stop()
+    finally:
+        dp.stop()
+
+
 def test_allocate_mounts_tpu_chips(tmp_root):
     """Endpoints backed by /dev/accel* become usable inside the pod:
     Allocate returns DeviceSpec mounts for each distinct backing chip

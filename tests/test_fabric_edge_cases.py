@@ -141,3 +141,94 @@ def test_cni_check_semantics(dataplane, pod_ns):
     dataplane.cmd_del(_req(pod_ns, req.container_id, "DEL"))
     with pytest.raises(CniError, match="no recorded attachment"):
         dataplane.cmd_check(_req(pod_ns, req.container_id, "CHECK"))
+
+
+def test_ipam_range_start_end_exclude(tmp_path):
+    """Upstream host-local grammar: rangeStart/rangeEnd bound allocation,
+    exclude carves addresses out, gateway is never handed out."""
+    ipam = HostLocalIpam(
+        str(tmp_path / "ipam2"), "10.88.0.0/28",
+        gateway="10.88.0.1",
+        range_start="10.88.0.4", range_end="10.88.0.7",
+        exclude=["10.88.0.5", "10.88.0.6/31"],
+    )
+    # Range 4..7; .5 excluded singly, .6 and .7 via the /31 → only .4 left.
+    assert ipam.allocate("own0")[0] == "10.88.0.4/28"
+    with pytest.raises(IpamError, match="exhausted"):
+        ipam.allocate("own2")
+    ipam.release("own0")
+    assert ipam.allocate("own3")[0].startswith("10.88.0.4/")
+
+    with pytest.raises(IpamError, match="rangeStart"):
+        HostLocalIpam(str(tmp_path / "ipam3"), "10.88.0.0/28",
+                      range_start="10.99.0.1")
+
+
+def test_nad_level_ipam_config_drives_allocation(dataplane, pod_ns):
+    """A NetworkAttachmentDefinition carrying its own `ipam` section
+    (subnet + rangeStart + routes) allocates from THAT range — not the
+    daemon default — and programs the declared routes in the pod netns."""
+    req = _req(pod_ns)
+    req.config["ipam"] = {
+        "type": "host-local",
+        "subnet": "10.89.0.0/24",
+        "rangeStart": "10.89.0.50",
+        "gateway": "10.89.0.1",
+        "routes": [{"dst": "192.168.77.0/24", "gw": "10.89.0.1"}],
+    }
+    result = dataplane.cmd_add(req)
+    addr = result.ips[0]["address"]
+    assert addr.startswith("10.89.0.5"), addr
+    routes = subprocess.run(
+        ["ip", "-n", pod_ns, "route"], capture_output=True, text=True, check=True
+    ).stdout
+    assert "192.168.77.0/24 via 10.89.0.1" in routes
+    assert "default via 10.89.0.1" in routes
+
+    # DEL resolves the same per-NAD allocator and frees the lease.
+    del_req = _req(pod_ns, req.container_id, "DEL")
+    del_req.config = req.config
+    dataplane.cmd_del(del_req)
+    ipam, _ = dataplane._ipam_for(req)
+    assert ipam.allocate("fresh")[0].startswith("10.89.0.50/"), (
+        "lease not released through the per-NAD allocator"
+    )
+
+
+def test_bad_nad_ipam_config_rolls_back_cleanly(dataplane, pod_ns):
+    """A malformed NAD ipam section (bad subnet / rangeStart outside the
+    range) must surface as a CniError AND leave nothing behind — no pod
+    interface, no host veth, no consumed netns (kubelet retries would
+    otherwise leak a veth pair per attempt)."""
+    from dpu_operator_tpu.cni.dataplane.fabric import _host_ifname
+
+    for bad_ipam in (
+        {"subnet": "10.89.0.0/24", "rangeStart": "10.99.0.1"},  # outside
+        {"subnet": "not-a-subnet"},                             # ValueError
+    ):
+        req = _req(pod_ns)
+        req.config["ipam"] = bad_ipam
+        with pytest.raises(CniError):
+            dataplane.cmd_add(req)
+        r = subprocess.run(
+            ["ip", "-n", pod_ns, "link", "show", "dev", "net1"],
+            capture_output=True,
+        )
+        assert r.returncode != 0, f"pod interface leaked for {bad_ipam}"
+        host_if = _host_ifname(req.container_id, "net1")
+        r = subprocess.run(["ip", "link", "show", "dev", host_if],
+                           capture_output=True)
+        assert r.returncode != 0, f"host veth leaked for {bad_ipam}"
+
+
+def test_ipam_exclude_covers_block_edges(tmp_path):
+    """An excluded CIDR excludes ALL its addresses — including the
+    block's network/broadcast addresses, which are ordinary allocatable
+    hosts of the enclosing range."""
+    ipam = HostLocalIpam(
+        str(tmp_path / "ipam4"), "10.90.0.0/28", exclude=["10.90.0.4/30"],
+    )
+    got = {ipam.allocate(f"o{i}")[0].split("/")[0] for i in range(10)}
+    assert got == {f"10.90.0.{n}" for n in (1, 2, 3, 8, 9, 10, 11, 12, 13, 14)}
+    with pytest.raises(IpamError, match="exhausted"):
+        ipam.allocate("over")
