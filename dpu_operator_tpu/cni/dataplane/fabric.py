@@ -243,6 +243,30 @@ class FabricDataplane:
             log.info("swept %d leftover doomed link(s) from a prior run", swept)
         return swept
 
+    def gc_stale_leases(self) -> int:
+        """Drop IPAM leases with no recorded attachment (every range file
+        under the shared state dir, incl. per-NAD allocators' files): the
+        owner died without a DEL, so nothing will ever release them.
+        Called at dataplane startup, before any request is served.
+
+        Fails CLOSED: the keep-set comes from a STRICT state listing - a
+        single unreadable attachment record means the set may be missing
+        a live pod, and releasing that pod's lease would hand its address
+        to another pod. Leaking a few addresses until the next clean
+        startup is the safe failure."""
+        try:
+            owners = {
+                f"{s.get('containerId')}/{s.get('ifname')}"
+                for s in self._store.list_all(strict=True)
+            }
+        except Exception as e:
+            log.warning("stale-lease GC skipped (unreadable state): %s", e)
+            return 0
+        released = HostLocalIpam.gc_directory(self._ipam.state_dir, owners)
+        if released:
+            log.info("released %d stale IPAM lease(s) from prior runs", released)
+        return released
+
     def host_interface(self, container_id: str, ifname: str) -> Optional[str]:
         state = self._store.load(container_id, ifname)
         return state.get("hostIf") if state else None

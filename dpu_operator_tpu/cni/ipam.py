@@ -54,12 +54,14 @@ class HostLocalIpam:
             state_dir, f"ipam-{self._net.network_address}-{self._net.prefixlen}.json"
         )
 
-    def _load_locked(self, f) -> dict:
+    @staticmethod
+    def _load_locked(f) -> dict:
         f.seek(0)
         raw = f.read()
         return json.loads(raw) if raw.strip() else {}
 
-    def _save_locked(self, f, data: dict) -> None:
+    @staticmethod
+    def _save_locked(f, data: dict) -> None:
         f.seek(0)
         f.truncate()
         f.write(json.dumps(data))
@@ -102,3 +104,36 @@ class HostLocalIpam:
         with open(self._store, "a+") as f:
             fcntl.flock(f, fcntl.LOCK_SH)
             return self._load_locked(f)
+
+    @staticmethod
+    def gc_directory(state_dir: str, keep_owners) -> int:
+        """Release leases (across every range file in `state_dir`) whose
+        owner is not in `keep_owners` — pods that died without a DEL
+        (daemon crash mid-teardown, node reset) otherwise leak their
+        addresses until the range exhausts. Counterpart of the
+        reference's PCIAllocator netns-liveness sweep
+        (pci_allocator.go:25-61). Returns the number released."""
+        import glob
+        import logging
+
+        keep = set(keep_owners)
+        released = 0
+        for path in glob.glob(os.path.join(state_dir, "ipam-*.json")):
+            with open(path, "a+") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                try:
+                    leases = HostLocalIpam._load_locked(f)
+                except json.JSONDecodeError:
+                    # A power loss mid-save can leave partial JSON; the
+                    # GC must not turn one damaged range file into a
+                    # daemon crash-loop — skip it (requests against the
+                    # range will surface the damage where it belongs).
+                    logging.getLogger(__name__).warning(
+                        "stale-lease GC: skipping unparseable %s", path
+                    )
+                    continue
+                kept = {ip: who for ip, who in leases.items() if who in keep}
+                if len(kept) != len(leases):
+                    released += len(leases) - len(kept)
+                    HostLocalIpam._save_locked(f, kept)
+        return released

@@ -76,6 +76,20 @@ def do_cni(socket_path: str, req: CniRequest, timeout: float = 125.0) -> dict:
 def main(argv: Optional[list] = None) -> int:
     """CLI entrypoint with CNI plugin semantics: env in, JSON out, exit
     code signalling success (reference dpu-cni.go:17-30)."""
+    # VERSION is answered by the plugin binary itself (CNI spec): the
+    # runtime probes it before/without any daemon.
+    if os.environ.get("CNI_COMMAND") == "VERSION":
+        from .types import CNI_VERSION
+
+        sys.stdout.write(
+            json.dumps(
+                {
+                    "cniVersion": CNI_VERSION,
+                    "supportedVersions": ["0.4.0", CNI_VERSION],
+                }
+            )
+        )
+        return 0
     socket_path = os.environ.get(
         "DPU_CNI_SOCKET", "/var/run/dpu-daemon/dpu-cni/dpu-cni-server.sock"
     )

@@ -37,7 +37,12 @@ class StateStore:
         except FileNotFoundError:
             pass
 
-    def list_all(self) -> list:
+    def list_all(self, strict: bool = False) -> list:
+        """All recorded attachments. With `strict`, an unreadable or
+        corrupt file raises instead of being skipped — consumers whose
+        correctness depends on completeness (the stale-lease GC: a
+        silently dropped record would release a LIVE pod's address) must
+        fail closed, while best-effort listings keep tolerating damage."""
         out = []
         for name in sorted(os.listdir(self._dir)):
             if name.endswith(".json"):
@@ -45,5 +50,7 @@ class StateStore:
                     with open(os.path.join(self._dir, name)) as f:
                         out.append(json.load(f))
                 except (OSError, json.JSONDecodeError):
+                    if strict:
+                        raise
                     continue
         return out

@@ -59,8 +59,10 @@ class HostSideManager:
         ipam = HostLocalIpam(self._pm.cni_state_dir(), pod_cidr)
         self.dataplane = FabricDataplane(state, ipam)
         # A prior daemon may have died between the fast-DEL rename and the
-        # deferred destroy; reclaim those links before serving CNI.
+        # deferred destroy; reclaim those links before serving CNI — and
+        # release IPAM leases whose owners have no recorded attachment.
         FabricDataplane.sweep_doomed()
+        self.dataplane.gc_stale_leases()
         self.cni_server = CniServer(self._pm)
         self.cni_server.set_handlers(
             self._cni_add, self._cni_del, check=self._cni_check

@@ -157,6 +157,17 @@ int http_post_unix(const std::string& socket_path, const std::string& body_in,
 }  // namespace
 
 int main() {
+  // VERSION is answered by the plugin binary itself (CNI spec): the
+  // runtime probes it before/without any daemon — requiring the socket
+  // here would report the plugin broken whenever the daemon restarts.
+  if (env_or_empty("CNI_COMMAND") == "VERSION") {
+    std::fputs(
+        "{\"cniVersion\":\"1.0.0\","
+        "\"supportedVersions\":[\"0.4.0\",\"1.0.0\"]}",
+        stdout);
+    return 0;
+  }
+
   std::string socket_path = env_or_empty("DPU_CNI_SOCKET");
   if (socket_path.empty()) socket_path = kDefaultSocket;
 

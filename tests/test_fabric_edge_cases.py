@@ -232,3 +232,55 @@ def test_ipam_exclude_covers_block_edges(tmp_path):
     assert got == {f"10.90.0.{n}" for n in (1, 2, 3, 8, 9, 10, 11, 12, 13, 14)}
     with pytest.raises(IpamError, match="exhausted"):
         ipam.allocate("over")
+
+
+def test_stale_lease_gc(tmp_path):
+    """Leases whose owner has no recorded attachment (pod died without a
+    DEL — daemon crash mid-teardown, node reset) are released at startup
+    across EVERY range file, incl. per-NAD allocators' (reference
+    PCIAllocator's liveness sweep, pci_allocator.go:25-61)."""
+    store = StateStore(str(tmp_path / "state"))
+    ipam_dir = str(tmp_path / "leases")
+    default = HostLocalIpam(ipam_dir, "10.77.0.0/24")
+    nad = HostLocalIpam(ipam_dir, "10.78.0.0/24")
+
+    default.allocate("live1/net1")
+    default.allocate("dead1/net1")
+    nad.allocate("live1/net2")
+    nad.allocate("dead2/net1")
+    store.save("live1", "net1", {"containerId": "live1", "ifname": "net1"})
+    store.save("live1", "net2", {"containerId": "live1", "ifname": "net2"})
+
+    dp = FabricDataplane(store, default)
+    assert dp.gc_stale_leases() == 2
+    assert set(default.leases().values()) == {"live1/net1"}
+    assert set(nad.leases().values()) == {"live1/net2"}
+    # Idempotent.
+    assert dp.gc_stale_leases() == 0
+
+
+def test_stale_lease_gc_fails_safe(tmp_path):
+    """GC must not crash on a corrupt lease file (power loss mid-save)
+    and must SKIP entirely when the attachment state is unreadable — a
+    missing record could belong to a live pod whose address would
+    otherwise be handed out twice."""
+    import os
+
+    store = StateStore(str(tmp_path / "state"))
+    ipam_dir = str(tmp_path / "leases")
+    ipam = HostLocalIpam(ipam_dir, "10.79.0.0/24")
+    ipam.allocate("dead/net1")
+    dp = FabricDataplane(store, ipam)
+
+    # Corrupt range file: skipped with a warning, not a crash.
+    with open(os.path.join(ipam_dir, "ipam-10.80.0.0-24.json"), "w") as f:
+        f.write("{truncated")
+    assert dp.gc_stale_leases() == 1  # the good file still sweeps
+
+    # Corrupt ATTACHMENT record: GC skips everything (fail closed).
+    ipam.allocate("dead2/net1")
+    attach_dir = os.path.join(str(tmp_path / "state"), "attachments")
+    with open(os.path.join(attach_dir, "broken-net1.json"), "w") as f:
+        f.write("{nope")
+    assert dp.gc_stale_leases() == 0
+    assert "dead2/net1" in ipam.leases().values()

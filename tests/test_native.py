@@ -533,3 +533,29 @@ def test_cni_shim_binary_against_live_server(native_binaries, tmp_root, netns):
     finally:
         subprocess.run(["ip", "netns", "del", ns], capture_output=True)
         server.stop()
+
+
+def test_cni_shim_answers_version_without_daemon(native_binaries):
+    """CNI VERSION is answered by the plugin binary itself (spec): the
+    runtime probes it with no daemon around, so requiring the socket
+    would report the plugin broken during every daemon restart."""
+    r = subprocess.run(
+        [native_binaries["shim"]],
+        input="", capture_output=True, text=True, timeout=10,
+        env={"PATH": os.environ["PATH"], "CNI_COMMAND": "VERSION",
+             "DPU_CNI_SOCKET": "/nonexistent/sock"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["cniVersion"] == "1.0.0"
+    assert "1.0.0" in out["supportedVersions"]
+
+    # Python shim: same contract.
+    r = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.cni.shim"],
+        input="", capture_output=True, text=True, timeout=30, cwd=REPO,
+        env={**os.environ, "CNI_COMMAND": "VERSION",
+             "DPU_CNI_SOCKET": "/nonexistent/sock"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["cniVersion"] == "1.0.0"
