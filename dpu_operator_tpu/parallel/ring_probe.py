@@ -119,10 +119,104 @@ def _ring_kernel(
     jax.lax.fori_loop(0, num_devices - 1, step_body, ())
 
 
-def _pallas_all_gather(
-    x_shard: jax.Array, axis: str, axis_size: int, axis_names: tuple
-) -> jax.Array:
-    chunk, width = x_shard.shape
+def _ring_kernel_bidir(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    out_ref,
+    cw_buf,
+    ccw_buf,
+    cw_send,
+    cw_recv,
+    ccw_send,
+    ccw_recv,
+    cw_ack,
+    ccw_ack,
+):
+    """Bidirectional ring all-gather (guide "Bi-directional Ring"): each
+    chunk's top half circulates clockwise, bottom half counter-clockwise,
+    so both duplex directions of every ICI link carry payload and the
+    wall time halves versus the one-way ring. Each direction runs the
+    same credit-gated double-buffer protocol as `_ring_kernel`, with its
+    own buffers/semaphores; the two in-flight RDMAs per step overlap
+    (start both, then wait both)."""
+    num_devices = out_ref.shape[0] // local_ref.shape[0]
+    chunk = local_ref.shape[0]
+    half = chunk // 2
+    my_id = my_id_ref[0]
+    right = tuple(right_ref[i] for i in range(n_axes))
+    left = tuple(left_ref[i] for i in range(n_axes))
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
+    cw_buf[0] = local_ref[pl.ds(0, half)]
+    ccw_buf[0] = local_ref[pl.ds(half, half)]
+
+    def step_body(step, _):
+        send_slot = jax.lax.rem(step, 2)
+        recv_slot = jax.lax.rem(step + 1, 2)
+        src_cw = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+        src_ccw = jax.lax.rem(my_id + step + 1, num_devices)
+
+        @pl.when(step > 0)
+        def _wait_credits():
+            pltpu.semaphore_wait(cw_ack, 1)
+            pltpu.semaphore_wait(ccw_ack, 1)
+
+        cw = pltpu.make_async_remote_copy(
+            src_ref=cw_buf.at[send_slot],
+            dst_ref=cw_buf.at[recv_slot],
+            send_sem=cw_send.at[send_slot],
+            recv_sem=cw_recv.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        ccw = pltpu.make_async_remote_copy(
+            src_ref=ccw_buf.at[send_slot],
+            dst_ref=ccw_buf.at[recv_slot],
+            send_sem=ccw_send.at[send_slot],
+            recv_sem=ccw_recv.at[recv_slot],
+            device_id=left,
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        cw.start()
+        ccw.start()
+        cw.wait()
+        ccw.wait()
+
+        @pl.when(step < num_devices - 2)
+        def _grant_credits():
+            pltpu.semaphore_signal(
+                cw_ack, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            pltpu.semaphore_signal(
+                ccw_ack, inc=1, device_id=right,
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+        out_ref[pl.ds(src_cw * chunk, half)] = cw_buf[recv_slot]
+        out_ref[pl.ds(src_ccw * chunk + half, half)] = ccw_buf[recv_slot]
+        return ()
+
+    jax.lax.fori_loop(0, num_devices - 1, step_body, ())
+
+
+def _ring_ids(axis: str, axis_size: int, axis_names: tuple):
+    """(my_id, right, left) mesh coordinates for the ring over `axis` —
+    MESH device ids spanning every axis (see _ring_kernel docstring for
+    why LOGICAL ids would be wrong on multi-axis meshes). Shared by both
+    ring kernels so neighbour addressing can never diverge between them."""
     ring_pos = axis_names.index(axis)
     my_id = jax.lax.axis_index(axis)
     coords = [jax.lax.axis_index(n) for n in axis_names]
@@ -130,6 +224,55 @@ def _pallas_all_gather(
     right[ring_pos] = jax.lax.rem(my_id + 1, axis_size)
     left = list(coords)
     left[ring_pos] = jax.lax.rem(my_id - 1 + axis_size, axis_size)
+    return my_id, right, left
+
+
+def _pallas_all_gather_bidir(
+    x_shard: jax.Array, axis: str, axis_size: int, axis_names: tuple
+) -> jax.Array:
+    chunk, width = x_shard.shape
+    half = chunk // 2
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, half, width), x_shard.dtype),
+            pltpu.VMEM((2, half, width), x_shard.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ring_kernel_bidir, len(axis_names)),
+        out_shape=jax.ShapeDtypeStruct((axis_size * chunk, width), x_shard.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        x_shard,
+    )
+
+
+def _pallas_all_gather(
+    x_shard: jax.Array,
+    axis: str,
+    axis_size: int,
+    axis_names: tuple,
+    bidirectional: bool = False,
+) -> jax.Array:
+    chunk, width = x_shard.shape
+    if bidirectional and chunk % 2 == 0:
+        return _pallas_all_gather_bidir(x_shard, axis, axis_size, axis_names)
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(1,),
@@ -159,10 +302,19 @@ def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
     return jax.lax.all_gather(x_shard, axis, tiled=True)
 
 
-def make_ring_all_gather(mesh, axis: str = "sp", use_pallas: Optional[bool] = None):
+def make_ring_all_gather(
+    mesh,
+    axis: str = "sp",
+    use_pallas: Optional[bool] = None,
+    bidirectional: bool = True,
+):
     """jitted fn: sharded [N, W] over `axis` → fully gathered [N, W] on
     every shard. Chooses the pallas RDMA ring on multi-chip TPU meshes,
-    XLA all_gather otherwise (or per `use_pallas`)."""
+    XLA all_gather otherwise (or per `use_pallas`). The pallas ring runs
+    bidirectionally by default (both duplex directions of each ICI link
+    carry half of every chunk — guide "Bi-directional Ring"); pass
+    `bidirectional=False` for the one-way ring, and odd per-shard row
+    counts fall back to it automatically (halves must split evenly)."""
     from jax import shard_map
 
     axis_size = mesh.shape[axis]
@@ -178,6 +330,7 @@ def make_ring_all_gather(mesh, axis: str = "sp", use_pallas: Optional[bool] = No
             axis=axis,
             axis_size=axis_size,
             axis_names=tuple(mesh.axis_names),
+            bidirectional=bidirectional,
         )
     else:
         inner = functools.partial(_xla_all_gather, axis=axis, axis_size=axis_size)
@@ -199,13 +352,24 @@ def measure_ring_bandwidth(
     mbytes: int = 16,
     rounds: int = 4,
     use_pallas: Optional[bool] = None,
+    bidirectional: bool = False,
 ) -> dict:
     """Time repeated ring all-gathers of an `mbytes` payload; returns
-    {"seconds_per_round", "effective_gbps", "axis_size", "ici_adjacent"}.
-    On a slice the bytes cross every ring hop, so a slow/dead link shows
-    up directly. `ici_adjacent` qualifies the per-hop-bandwidth reading:
-    True when consecutive ring devices are single ICI hops, False when
-    the mesh order jumps chips, None without physical coords."""
+    {"seconds_per_round", "effective_gbps", "axis_size", "ici_adjacent",
+    "mode"}. On a slice the bytes cross every ring hop, so a slow/dead
+    link shows up directly.
+
+    Defaults to the ONE-WAY ring so `effective_gbps` keeps its per-hop,
+    per-direction meaning (comparable against a link's per-direction
+    rate and against prior BENCH records). With `bidirectional=True` the
+    same byte count moves in roughly half the time by riding both duplex
+    directions — the figure then aggregates BOTH directions of each link
+    and can legitimately exceed the per-direction rate; `mode` in the
+    result records which protocol actually ran so no figure is read
+    against the wrong ceiling. `ici_adjacent` qualifies the per-hop
+    reading: True when consecutive ring devices are single ICI hops,
+    False when the mesh order jumps chips, None without physical
+    coords."""
     import time
 
     from .mesh import ring_is_ici_adjacent
@@ -215,9 +379,26 @@ def measure_ring_bandwidth(
     rows = max(axis_size, (mbytes * 1024 * 1024) // (4 * width))
     rows -= rows % axis_size or 0
     rows = max(rows, axis_size)
+    if use_pallas is None:
+        pallas_active = (
+            pltpu is not None
+            and axis_size > 1
+            and all(d.platform == "tpu" for d in mesh.devices.flat)
+        )
+    else:
+        pallas_active = use_pallas
+    chunk = rows // axis_size
+    if not pallas_active:
+        mode = "xla"
+    elif bidirectional and chunk % 2 == 0:
+        mode = "bidir"
+    else:
+        mode = "unidir"
     x = jnp.ones((rows, width), jnp.float32)
     x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
-    fn = make_ring_all_gather(mesh, axis, use_pallas=use_pallas)
+    fn = make_ring_all_gather(
+        mesh, axis, use_pallas=use_pallas, bidirectional=bidirectional
+    )
     fn(x).block_until_ready()  # compile
     start = time.perf_counter()
     for _ in range(rounds):
@@ -233,4 +414,5 @@ def measure_ring_bandwidth(
         # hops; surface whether this mesh's axis actually does (None on
         # virtual platforms without chip coords).
         "ici_adjacent": ring_is_ici_adjacent(mesh, axis),
+        "mode": mode,
     }

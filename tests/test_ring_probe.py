@@ -168,10 +168,12 @@ def test_xla_fallback_all_gather_correct():
 
 @pytest.mark.slow
 def test_pallas_ring_interpret_mode_executes():
-    """The pallas kernel EXECUTES under TPU interpret mode on the virtual
-    mesh and matches the XLA fallback: 8-wide ring (7 steps — maximum
-    neighbour skew, the case that exposed the missing backpressure) and a
-    4-wide ring on a multi-axis mesh (MESH addressing with dp present)."""
+    """Both pallas kernels EXECUTE under TPU interpret mode on the
+    virtual mesh and match the XLA fallback: the one-way ring on 8-wide
+    (7 steps — maximum neighbour skew, the case that exposed the missing
+    backpressure) and 4-wide multi-axis meshes, and the bidirectional
+    ring (both duplex directions carrying half of every chunk, separate
+    credit chains per direction) on 8/4/2-wide rings."""
     r = _run_virtual(
         "import sys; sys.path.insert(0, %r)\n"
         "import numpy as np, jax, jax.numpy as jnp\n"
@@ -179,17 +181,28 @@ def test_pallas_ring_interpret_mode_executes():
         "from jax.experimental.pallas import tpu as pltpu\n"
         "from dpu_operator_tpu.parallel.ring_probe import make_ring_all_gather\n"
         "with pltpu.force_tpu_interpret_mode():\n"
-        "    for shape, n in (((1, 8, 1), 8), ((2, 4, 1), 4)):\n"
+        "    for shape, n in (((1, 8, 1), 8), ((2, 4, 1), 4), ((1, 2, 4), 2)):\n"
         "        mesh = Mesh(np.array(jax.devices()).reshape(shape),\n"
         "                    axis_names=('dp', 'sp', 'tp'))\n"
         "        x = jnp.arange(4 * n * 8, dtype=jnp.float32).reshape(-1, 8)\n"
         "        xs = jax.device_put(x, NamedSharding(mesh, P('sp', None)))\n"
         "        ref = np.asarray(make_ring_all_gather(mesh, 'sp',\n"
         "                         use_pallas=False)(xs))\n"
-        "        out = np.asarray(make_ring_all_gather(mesh, 'sp',\n"
-        "                         use_pallas=True)(xs))\n"
-        "        np.testing.assert_array_equal(out, ref)\n"
-        "        np.testing.assert_array_equal(out, np.asarray(x))\n"
+        "        for bidir in (False, True):\n"
+        "            out = np.asarray(make_ring_all_gather(mesh, 'sp',\n"
+        "                  use_pallas=True, bidirectional=bidir)(xs))\n"
+        "            np.testing.assert_array_equal(out, ref)\n"
+        "            np.testing.assert_array_equal(out, np.asarray(x))\n"
+        "    # Odd per-shard chunk (3 rows): bidirectional halves can't\n"
+        "    # split, so the request must fall back to the one-way ring\n"
+        "    # and still gather correctly.\n"
+        "    mesh = Mesh(np.array(jax.devices()).reshape(1, 8, 1),\n"
+        "                axis_names=('dp', 'sp', 'tp'))\n"
+        "    x = jnp.arange(3 * 8 * 8, dtype=jnp.float32).reshape(24, 8)\n"
+        "    xs = jax.device_put(x, NamedSharding(mesh, P('sp', None)))\n"
+        "    out = np.asarray(make_ring_all_gather(mesh, 'sp',\n"
+        "          use_pallas=True, bidirectional=True)(xs))\n"
+        "    np.testing.assert_array_equal(out, np.asarray(x))\n"
         "print('ok')\n" % REPO
     )
     assert r.returncode == 0, r.stdout + r.stderr
@@ -209,11 +222,13 @@ def test_pallas_ring_aot_lowers_for_tpu():
         "from dpu_operator_tpu.parallel.ring_probe import make_ring_all_gather\n"
         "mesh = Mesh(np.array(jax.devices()).reshape(1, 8, 1),\n"
         "            axis_names=('dp', 'sp', 'tp'))\n"
-        "fn = make_ring_all_gather(mesh, 'sp', use_pallas=True)\n"
         "spec = jax.ShapeDtypeStruct((32, 8), jnp.float32,\n"
         "        sharding=NamedSharding(mesh, P('sp', None)))\n"
-        "exp = jax.export.export(fn, platforms=['tpu'])(spec)\n"
-        "assert 'tpu_custom_call' in exp.mlir_module()\n"
+        "for bidir in (False, True):\n"
+        "    fn = make_ring_all_gather(mesh, 'sp', use_pallas=True,\n"
+        "                              bidirectional=bidir)\n"
+        "    exp = jax.export.export(fn, platforms=['tpu'])(spec)\n"
+        "    assert 'tpu_custom_call' in exp.mlir_module()\n"
         "print('ok')\n" % REPO
     )
     assert r.returncode == 0, r.stdout + r.stderr
