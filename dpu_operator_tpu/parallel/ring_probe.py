@@ -30,6 +30,20 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
+def _neighbor_barrier(left, right):
+    """Both ring neighbours must have entered the kernel (comm slots
+    live) before any RDMA is allowed to land in them. Shared by every
+    ring kernel so the handshake protocol cannot diverge."""
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.MESH
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+
 def _ring_kernel(
     n_axes,
     my_id_ref,
@@ -70,16 +84,7 @@ def _ring_kernel(
     right = tuple(right_ref[i] for i in range(n_axes))
     left = tuple(left_ref[i] for i in range(n_axes))
 
-    # Neighbour barrier: both ring neighbours must have entered the kernel
-    # (comm slots live) before any RDMA is allowed to land in them.
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
-    )
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.MESH
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    _neighbor_barrier(left, right)
 
     out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
     comm_buf[0] = local_ref[:]
@@ -149,14 +154,7 @@ def _ring_kernel_bidir(
     right = tuple(right_ref[i] for i in range(n_axes))
     left = tuple(left_ref[i] for i in range(n_axes))
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
-    )
-    pltpu.semaphore_signal(
-        barrier, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.MESH
-    )
-    pltpu.semaphore_wait(barrier, 2)
+    _neighbor_barrier(left, right)
 
     out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
     cw_buf[0] = local_ref[pl.ds(0, half)]
@@ -296,6 +294,169 @@ def _pallas_all_gather(
         jnp.stack(left).astype(jnp.int32),
         x_shard,
     )
+
+
+def _rs_kernel(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    out_ref,
+    send_buf,
+    recv_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Ring reduce-scatter (sum): `local_ref` is this device's full
+    [n*chunk, W] contribution; `out_ref` ends as the SUM over devices of
+    chunk `my_id`. Chunk j circulates right from device (j+1)%n,
+    accumulating each host's local chunk j en route, and lands complete
+    on device j after n-1 hops: at step k device d sends the partial for
+    chunk (d-k-1)%n (what arrived last step, plus its own contribution)
+    and receives the partial for chunk (d-k-2)%n.
+
+    Backpressure mirrors `_ring_kernel`'s credit protocol, shifted one
+    step: our step-k RDMA lands in the right neighbour's recv slot
+    (k+1)%2, whose previous contents it consumed at its step k-1 — so
+    consumption grants a credit to the left, and sends from step 2 on
+    wait for one (step 0 targets a virgin slot; step 1's target was never
+    written)."""
+    num_devices = local_ref.shape[0] // out_ref.shape[0]
+    chunk = out_ref.shape[0]
+    my_id = my_id_ref[0]
+    right = tuple(right_ref[i] for i in range(n_axes))
+    left = tuple(left_ref[i] for i in range(n_axes))
+
+    _neighbor_barrier(left, right)
+
+    def local_chunk(idx):
+        return local_ref[pl.ds(idx * chunk, chunk)]
+
+    def step_body(step, _):
+        slot = jax.lax.rem(step, 2)
+        nxt = jax.lax.rem(step + 1, 2)
+        send_idx = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+
+        @pl.when(step == 0)
+        def _first():
+            send_buf[slot] = local_chunk(send_idx)
+
+        @pl.when(step > 0)
+        def _accumulate():
+            # Consume last step's arrival; freeing recv_buf[slot] is what
+            # the credit below advertises to the left neighbour.
+            send_buf[slot] = recv_buf[slot] + local_chunk(send_idx)
+
+        @pl.when((step > 0) & (step < num_devices - 2))
+        def _grant_credit():
+            pltpu.semaphore_signal(
+                ack_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+        @pl.when(step > 1)
+        def _wait_credit():
+            pltpu.semaphore_wait(ack_sem, 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[slot],
+            dst_ref=recv_buf.at[nxt],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nxt],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        rdma.wait()
+        return ()
+
+    jax.lax.fori_loop(0, num_devices - 1, step_body, ())
+    # Last arrival (step n-2) landed in slot (n-1)%2; our own chunk joins.
+    out_ref[:] = recv_buf[(num_devices - 1) % 2] + local_chunk(my_id)
+
+
+def _pallas_reduce_scatter(
+    x_local: jax.Array, axis: str, axis_size: int, axis_names: tuple
+) -> jax.Array:
+    rows, width = x_local.shape
+    if rows % axis_size != 0:
+        # Match the psum_scatter fallback's contract: error loudly, never
+        # truncate - a floored chunk would make the kernel derive a wrong
+        # ring size and return silent garbage.
+        raise ValueError(
+            f"reduce-scatter rows {rows} must divide by axis size {axis_size}"
+        )
+    if axis_size == 1:
+        # One-device ring: the reduction is the identity; the kernel's
+        # zero-step loop would add uninitialized recv scratch instead.
+        return x_local
+    chunk = rows // axis_size
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, width), x_local.dtype),
+            pltpu.VMEM((2, chunk, width), x_local.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_rs_kernel, len(axis_names)),
+        out_shape=jax.ShapeDtypeStruct((chunk, width), x_local.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        x_local,
+    )
+
+
+def make_ring_reduce_scatter(mesh, axis: str = "sp", use_pallas: Optional[bool] = None):
+    """jitted fn: replicated-per-shard [N, W] contributions → each shard
+    holds the SUM of its [N/n, W] chunk (ring reduce-scatter). Pallas
+    RDMA ring on multi-chip TPU meshes, `psum_scatter` fallback
+    elsewhere. Composed with `make_ring_all_gather` this is a full
+    bandwidth-optimal all-reduce — together the probes exercise every
+    collective shape the fabric-validation step leans on."""
+    from jax import shard_map
+
+    axis_size = mesh.shape[axis]
+    if use_pallas is None:
+        use_pallas = (
+            pltpu is not None
+            and axis_size > 1
+            and all(d.platform == "tpu" for d in mesh.devices.flat)
+        )
+    if use_pallas:
+        inner = functools.partial(
+            _pallas_reduce_scatter,
+            axis=axis,
+            axis_size=axis_size,
+            axis_names=tuple(mesh.axis_names),
+        )
+    else:
+        def inner(x_local):
+            return jax.lax.psum_scatter(
+                x_local, axis, scatter_dimension=0, tiled=True
+            )
+
+    mapped = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
 
 
 def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
