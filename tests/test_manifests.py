@@ -4,6 +4,7 @@ CRD set covers all four kinds (counterpart of the reference's kustomize/
 OLM asset tree, SURVEY §2.6)."""
 
 import glob
+import json
 import os
 
 import yaml
@@ -144,3 +145,43 @@ def test_bundle_is_fresh():
         text=True,
     )
     assert rc.returncode == 0, rc.stdout + rc.stderr
+
+
+def test_nad_configs_are_valid_cni_json():
+    """Every NetworkAttachmentDefinition (bindata + examples) embeds a
+    spec.config that parses as JSON, names the dpu-cni plugin, and — when
+    it carries an `ipam` section — uses only keys the fabric dataplane's
+    host-local grammar understands (a typo'd key would silently fall back
+    to defaults in production)."""
+    import glob as _glob
+
+    known_ipam_keys = {
+        "type", "subnet", "rangeStart", "rangeEnd", "exclude", "gateway",
+        "routes",
+    }
+    nads = 0
+    import re as _re
+
+    for pattern in ("dpu_operator_tpu/controller/bindata/**/*.yaml",
+                    "examples/*.yaml"):
+        for path in _glob.glob(os.path.join(REPO, pattern), recursive=True):
+            with open(path) as f:
+                text = f.read()
+            if "bindata" in path:
+                # bindata templates hold {{var}} placeholders.
+                text = _re.sub(r"{{\s*([a-zA-Z0-9_]+)\s*}}", "placeholder", text)
+            for doc in yaml.safe_load_all(text):
+                    if not doc or doc.get("kind") != "NetworkAttachmentDefinition":
+                        continue
+                    nads += 1
+                    conf = json.loads(doc["spec"]["config"])
+                    assert conf["type"] == "dpu-cni", path
+                    assert conf.get("cniVersion"), path
+                    ipam = conf.get("ipam")
+                    if ipam:
+                        unknown = set(ipam) - known_ipam_keys
+                        assert not unknown, f"{path}: unknown ipam keys {unknown}"
+                        assert "subnet" in ipam, f"{path}: ipam without subnet"
+                        for r in ipam.get("routes", []):
+                            assert "dst" in r, f"{path}: route without dst"
+    assert nads >= 3, f"expected the NAD set, found {nads}"
