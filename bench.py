@@ -336,6 +336,7 @@ def main() -> int:
         "hbm_gbps": "GB/s",
         "hbm_utilization": "frac_v5e_peak",
         "ici_ring_gbps": "Gb/s",
+        "ici_ring_bidir_gbps": "Gb/s",
         "virtual_ring_gbps": "Gb/s",
     }
     for key, unit in units.items():

@@ -59,6 +59,14 @@ class FabricDataplane:
         """(allocator, routes) for this request: the NAD's own `ipam`
         config when present, the daemon-level default otherwise."""
         conf = (req.config or {}).get("ipam") or {}
+        from ..ipam import KNOWN_IPAM_KEYS
+
+        unknown = set(conf) - KNOWN_IPAM_KEYS
+        if unknown:
+            # A typo'd key silently falling back to defaults is the worst
+            # failure mode for addressing config; say so in the log (the
+            # manifest tier rejects it at CI time for in-repo NADs).
+            log.warning("NAD ipam config: unknown keys %s ignored", sorted(unknown))
         subnet = conf.get("subnet")
         if not subnet:
             return self._ipam, []

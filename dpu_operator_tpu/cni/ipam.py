@@ -20,6 +20,16 @@ class IpamError(RuntimeError):
     pass
 
 
+# The NAD `ipam` grammar the fabric dataplane understands
+# (FabricDataplane._ipam_for feeds these into HostLocalIpam). Single
+# source of truth — the manifest tests validate example/bindata NADs
+# against it so a typo'd key fails CI instead of silently falling back
+# to daemon defaults in production.
+KNOWN_IPAM_KEYS = frozenset(
+    {"type", "subnet", "rangeStart", "rangeEnd", "exclude", "gateway", "routes"}
+)
+
+
 class HostLocalIpam:
     def __init__(
         self,

@@ -164,7 +164,10 @@ def cmd_probe(args, chan):
     fn = best_burn_step()
     sig = float(fn(*burn_example_args()))
     mesh = build_mesh()
-    ring = measure_ring_bandwidth(mesh, mbytes=args.mbytes, rounds=args.rounds)
+    ring = measure_ring_bandwidth(
+        mesh, mbytes=args.mbytes, rounds=args.rounds,
+        bidirectional=args.bidir,
+    )
     print(json.dumps({
         "platform": jax.devices()[0].platform,
         "devices": len(jax.devices()),
@@ -373,7 +376,11 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_del_nf)
     p = sub.add_parser("topology"); p.set_defaults(fn=cmd_topology)
     p = sub.add_parser("probe"); p.add_argument("--mbytes", type=int, default=16)
-    p.add_argument("--rounds", type=int, default=4); p.set_defaults(fn=cmd_probe)
+    p.add_argument("--rounds", type=int, default=4)
+    # Bidirectional ring: both duplex directions carry payload; the probe
+    # output's ring.mode records which protocol actually ran.
+    p.add_argument("--bidir", action="store_true")
+    p.set_defaults(fn=cmd_probe)
     p = sub.add_parser("ports"); p.add_argument("--bridge", default="br-fabric")
     p.set_defaults(fn=cmd_ports)
     p = sub.add_parser("stats"); p.add_argument("devices", nargs="*")

@@ -149,6 +149,24 @@ def main() -> int:
             out["ici_ring_axis_size"] = ring["axis_size"]
         except Exception as e:
             out["ici_ring_error"] = str(e)[:200]
+            ring = None
+        # Bidirectional figure aggregates BOTH duplex directions of each
+        # link (mode recorded; never compare it against a per-direction
+        # link rate). Own try + error key: a bidir failure must not
+        # mislabel the already-recorded unidirectional figure. Only
+        # meaningful where the pallas ring actually ran.
+        if ring is not None and ring.get("mode") == "unidir":
+            try:
+                _record(
+                    out, "ici_ring_bidir_gbps",
+                    _runs(
+                        lambda: measure_ring_bandwidth(
+                            mesh, axis=axis, bidirectional=True
+                        )["effective_gbps"]
+                    ),
+                )
+            except Exception as e:
+                out["ici_ring_bidir_error"] = str(e)[:200]
 
     print(json.dumps(out))
     return 0

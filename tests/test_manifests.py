@@ -26,18 +26,23 @@ def _yaml_files():
     return sorted(set(files))
 
 
+def _rendered(path: str) -> str:
+    """File text with bindata {{var}} template placeholders rendered with
+    dummies — shared by every test that parses asset YAML."""
+    import re
+
+    with open(path) as fh:
+        text = fh.read()
+    if "bindata" in path:
+        text = re.sub(r"{{\s*([a-zA-Z0-9_]+)\s*}}", "placeholder", text)
+    return text
+
+
 def test_all_yaml_parses():
     files = _yaml_files()
     assert len(files) > 20, f"expected a full asset tree, found {len(files)}"
     for f in files:
-        with open(f) as fh:
-            text = fh.read()
-        # bindata templates hold {{var}} placeholders; render with dummies.
-        if "bindata" in f:
-            import re
-
-            text = re.sub(r"{{\s*([a-zA-Z0-9_]+)\s*}}", "placeholder", text)
-        list(yaml.safe_load_all(text)), f
+        list(yaml.safe_load_all(_rendered(f))), f
 
 
 def test_kustomizations_resolve():
@@ -153,24 +158,13 @@ def test_nad_configs_are_valid_cni_json():
     it carries an `ipam` section — uses only keys the fabric dataplane's
     host-local grammar understands (a typo'd key would silently fall back
     to defaults in production)."""
-    import glob as _glob
+    from dpu_operator_tpu.cni.ipam import KNOWN_IPAM_KEYS
 
-    known_ipam_keys = {
-        "type", "subnet", "rangeStart", "rangeEnd", "exclude", "gateway",
-        "routes",
-    }
     nads = 0
-    import re as _re
-
     for pattern in ("dpu_operator_tpu/controller/bindata/**/*.yaml",
                     "examples/*.yaml"):
-        for path in _glob.glob(os.path.join(REPO, pattern), recursive=True):
-            with open(path) as f:
-                text = f.read()
-            if "bindata" in path:
-                # bindata templates hold {{var}} placeholders.
-                text = _re.sub(r"{{\s*([a-zA-Z0-9_]+)\s*}}", "placeholder", text)
-            for doc in yaml.safe_load_all(text):
+        for path in glob.glob(os.path.join(REPO, pattern), recursive=True):
+            for doc in yaml.safe_load_all(_rendered(path)):
                     if not doc or doc.get("kind") != "NetworkAttachmentDefinition":
                         continue
                     nads += 1
@@ -179,7 +173,7 @@ def test_nad_configs_are_valid_cni_json():
                     assert conf.get("cniVersion"), path
                     ipam = conf.get("ipam")
                     if ipam:
-                        unknown = set(ipam) - known_ipam_keys
+                        unknown = set(ipam) - KNOWN_IPAM_KEYS
                         assert not unknown, f"{path}: unknown ipam keys {unknown}"
                         assert "subnet" in ipam, f"{path}: ipam without subnet"
                         for r in ipam.get("routes", []):
