@@ -94,6 +94,9 @@ class Daemon:
         self._drain_on_setup = drain_on_setup
 
         self._managed: Dict[str, ManagedDpu] = {}
+        # config name -> last appliedTo state this daemon wrote (skips the
+        # per-tick status read in steady state).
+        self._config_status_memo: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -365,29 +368,77 @@ class Daemon:
             return
         if not configs:
             return
-        for md in self._managed.values():
-            cr = md.detection.to_cr(self._namespace)
-            labels = cr["metadata"].get("labels", {})
-            for cfg in configs:
-                spec = cfg.get("spec", {})
-                selector = spec.get("dpuSelector", {}) or {}
-                count = spec.get("numEndpoints")
-                if count is None:
-                    continue
+        for cfg in configs:
+            spec = cfg.get("spec", {})
+            selector = spec.get("dpuSelector", {}) or {}
+            count = spec.get("numEndpoints")
+            if count is None:
+                continue
+            # Which of THIS daemon's DPUs the config currently applies to
+            # (selector match + partition actually landed) — drives both
+            # the apply and the status reconciliation below, so a config
+            # whose selector stops matching gets its stale entry pruned.
+            desired: Dict[str, int] = {}
+            for md in self._managed.values():
+                cr = md.detection.to_cr(self._namespace)
+                labels = cr["metadata"].get("labels", {})
                 if not all(labels.get(k) == val for k, val in selector.items()):
                     continue
                 with md.endpoints_lock:
-                    if md.applied_endpoints == count:
-                        continue
-                    try:
-                        md.plugin.set_num_endpoints(int(count))
-                        md.applied_endpoints = int(count)
-                        log.info(
-                            "applied DataProcessingUnitConfig %s: %d endpoints on %s",
-                            cfg["metadata"]["name"], count, md.detection.identifier,
-                        )
-                    except Exception:
-                        log.exception("SetNumEndpoints from DPUConfig failed")
+                    if md.applied_endpoints != count:
+                        try:
+                            md.plugin.set_num_endpoints(int(count))
+                            md.applied_endpoints = int(count)
+                            log.info(
+                                "applied DataProcessingUnitConfig %s: %d endpoints on %s",
+                                cfg["metadata"]["name"], count, md.detection.identifier,
+                            )
+                        except Exception:
+                            log.exception("SetNumEndpoints from DPUConfig failed")
+                            continue
+                desired[md.detection.identifier] = int(count)
+            # Outside the locks (network I/O).
+            self._reconcile_config_status(cfg, desired)
+
+    def _reconcile_config_status(self, cfg: dict, desired: Dict[str, int]) -> None:
+        """Feedback loop on the DataProcessingUnitConfig CR: status.appliedTo
+        records which of this daemon's DPUs the partition is applied to
+        (the reference's placeholder CRD has no status at all). Entries for
+        DPUs other daemons manage are left untouched; entries for OUR DPUs
+        are made to match `desired` exactly, so a selector edit prunes the
+        stale record. Memoized per config so the steady state costs no API
+        reads; best-effort — a failed write retries on a later tick."""
+        name = cfg["metadata"]["name"]
+        if self._config_status_memo.get(name) == desired:
+            return
+        try:
+            fresh = self._client.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+                cfg["metadata"].get("namespace"), name,
+            )
+            if fresh is None:
+                self._config_status_memo.pop(name, None)
+                return
+            managed = {md.detection.identifier for md in self._managed.values()}
+            status = fresh.setdefault("status", {})
+            entries = status.get("appliedTo", []) or []
+            ours = {
+                e.get("dpu"): e.get("numEndpoints")
+                for e in entries if e.get("dpu") in managed
+            }
+            if ours == desired:
+                self._config_status_memo[name] = dict(desired)
+                return
+            kept = [e for e in entries if e.get("dpu") not in managed]
+            kept.extend(
+                {"dpu": d, "numEndpoints": c} for d, c in desired.items()
+            )
+            status["appliedTo"] = sorted(kept, key=lambda e: e.get("dpu", ""))
+            self._client.update_status(fresh)
+            self._config_status_memo[name] = dict(desired)
+        except Exception:
+            self._config_status_memo.pop(name, None)
+            log.debug("DPUConfig status update skipped", exc_info=True)
 
     def _delete_cr(self, name: str) -> None:
         try:

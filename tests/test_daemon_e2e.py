@@ -408,6 +408,37 @@ def test_dpu_config_applies_endpoint_partitioning(cluster_client, tmp_root):
             timeout=10,
         ), "numEndpoints never applied"
 
+        # The daemon records the feedback loop on the CR status: which DPU
+        # the partition landed on (the reference's placeholder CRD has no
+        # status at all).
+        def applied_to():
+            cfg = cluster_client.get_or_none(
+                v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+                v.NAMESPACE, "tune-tpu",
+            )
+            return (cfg or {}).get("status", {}).get("appliedTo", [])
+
+        def recorded():
+            a = applied_to()
+            return len(a) == 1 and a[0]["numEndpoints"] == 12
+
+        assert wait_for(recorded, timeout=10), (
+            f"status never recorded: {applied_to()}"
+        )
+
+        # Selector edit prunes the stale entry: the config no longer
+        # matches any managed DPU, so the feedback loop must not keep
+        # claiming it is applied.
+        cfg = cluster_client.get(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+            v.NAMESPACE, "tune-tpu",
+        )
+        cfg["spec"]["dpuSelector"] = {"dpu.tpu.io/vendor": "nonesuch"}
+        cluster_client.update(cfg)
+        assert wait_for(lambda: applied_to() == [], timeout=10), (
+            f"stale appliedTo never pruned: {applied_to()}"
+        )
+
         # Non-matching selector is ignored.
         cluster_client.create(
             v1.new_data_processing_unit_config(
@@ -417,6 +448,10 @@ def test_dpu_config_applies_endpoint_partitioning(cluster_client, tmp_root):
         )
         time.sleep(0.5)
         assert len(vsp.GetDevices(None, None).devices) == 12
+        assert not cluster_client.get_or_none(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+            v.NAMESPACE, "tune-other",
+        ).get("status", {}).get("appliedTo")
     finally:
         daemon.stop()
         vsp_server.stop()

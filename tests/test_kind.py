@@ -140,7 +140,7 @@ def test_operator_reconciles_on_real_cluster(real_client):
     client = real_client
     crd_dir = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "config", "crd", "bases",
+        "config", "crd",
     )
     for fname in sorted(os.listdir(crd_dir)):
         if not fname.endswith(".yaml") or fname == "kustomization.yaml":
