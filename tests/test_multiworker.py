@@ -227,6 +227,30 @@ def test_sfc_spans_workers(slice_cluster):
         assert wait_for(
             lambda: len(w.advertised_ids()) == 2, timeout=20
         ), f"worker {w.worker_id} never repartitioned to 2 endpoints"
+
+    # Both daemons record their own DPU in the config's status and
+    # preserve the other's entry (each owns only its managed DPUs), so
+    # the CR shows the whole slice applied — two entries, two distinct
+    # DPUs, both at the requested count — even with both daemons writing
+    # the status concurrently (409s retry on later ticks).
+    def applied_to():
+        cfg = client.get_or_none(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG,
+            v.NAMESPACE, "shrink-all",
+        )
+        return (cfg or {}).get("status", {}).get("appliedTo", [])
+
+    def both_recorded():
+        a = applied_to()
+        return (
+            len(a) == 2
+            and len({e["dpu"] for e in a}) == 2
+            and all(e["numEndpoints"] == 2 for e in a)
+        )
+
+    assert wait_for(both_recorded, timeout=20), (
+        f"slice-wide status never converged: {applied_to()}"
+    )
     # Both daemons have labelled their node dpuside=dpu by now.
     for w in workers:
         assert wait_for(
