@@ -1,17 +1,26 @@
-"""ICI ring-bandwidth probe — pallas remote-DMA all-gather.
+"""ICI ring collectives — pallas remote-DMA probe kernels.
 
 The sp-axis counterpart of the MXU burn: moves real bytes over each ICI
 ring hop so link bandwidth (and link death) is observable per hop. On a
-multi-chip TPU backend the transfer is a pallas kernel driving
+multi-chip TPU backend the transfers are pallas kernels driving
 `make_async_remote_copy` around the logical ring (pallas_guide.md
 "Patterns: Ring Collectives" — double-buffered comm slots, send/recv
-semaphore pairs, neighbour barrier); everywhere else (CPU tests, the
-driver's virtual mesh, single-chip) it falls back to XLA's all_gather,
-which has identical semantics.
+semaphore pairs, neighbour barrier, plus a credit-gated backpressure
+protocol the guide's naive pattern lacks); everywhere else (CPU tests,
+the driver's virtual mesh, single-chip) they fall back to the XLA
+collectives, which have identical semantics.
 
-`measure_ring_bandwidth` returns per-round wall time and an effective
-GB/s figure the traffic-flow harness can sanity-check against the
-topology's `bisection_gbps`."""
+The family:
+  * `make_ring_all_gather` — one-way ring, or bidirectional by default
+    (both duplex directions of each link carry half of every chunk);
+  * `make_ring_reduce_scatter` — sum-reduce ring; composed with the
+    all-gather it forms a bandwidth-optimal all-reduce.
+
+`measure_ring_bandwidth` returns per-round wall time, an effective GB/s
+figure the traffic-flow harness can sanity-check against the topology's
+`bisection_gbps`, and the `mode` that actually ran (a bidirectional
+figure aggregates both duplex directions and must not be read against a
+per-direction link rate)."""
 
 from __future__ import annotations
 
