@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import threading
 from typing import Optional
 
 import grpc
@@ -38,6 +39,7 @@ class ConvergedSideManager(HostSideManager):
         super().__init__(vendor_plugin, identifier, path_manager, **kwargs)
         self._opi_server: Optional[grpc.Server] = None
         self._last_local_ping = 0.0
+        self._vsp_restarted = threading.Event()
 
     # Reuse the DPU side's OPI service shape: it needs .plugin and
     # .record_ping, both of which this class provides.
@@ -76,15 +78,35 @@ class ConvergedSideManager(HostSideManager):
         import time as _time
 
         was_down = False
+        seen_instance = None
         while not self._stop.is_set():
             ok = self.plugin.ping()
-            if ok and was_down:
+            instance = getattr(self.plugin, "last_ping_instance", None)
+            bounced = (
+                ok
+                and not was_down
+                and instance is not None
+                and seen_instance is not None
+                and instance != seen_instance
+            )
+            if ok and (was_down or bounced):
                 # VSP restarted: re-run Init so it redoes hardware setup.
+                # `bounced` catches a restart FASTER than the heartbeat
+                # interval (no failed ping in between) via the per-process
+                # instance_id the VSP echoes in Ping.
                 addr = self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
                 if addr is None:
                     ok = False
                 else:
-                    log.info("converged side: re-adopted restarted VSP")
+                    log.info(
+                        "converged side: re-adopted restarted VSP%s",
+                        " (sub-heartbeat bounce)" if bounced else "",
+                    )
+                    # The fresh process lost its applied partition; tell
+                    # the daemon tick to re-apply (take_vsp_restarted).
+                    self._vsp_restarted.set()
+            if ok and instance is not None:
+                seen_instance = instance
             if ok:
                 was_down = False
                 with self._ping_lock:
@@ -96,6 +118,12 @@ class ConvergedSideManager(HostSideManager):
                 # Nudge a dead channel so grpc redials promptly.
                 self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
             self._stop.wait(1.0)
+
+    def take_vsp_restarted(self) -> bool:
+        if self._vsp_restarted.is_set():
+            self._vsp_restarted.clear()
+            return True
+        return False
 
     def stop(self) -> None:
         if self._opi_server is not None:

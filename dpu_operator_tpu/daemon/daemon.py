@@ -38,6 +38,13 @@ class SideManager:
     # Returns whether the partition count was actually applied (a DPU-side
     # manager tolerates SetNumEndpoints failure and reports False).
     def setup_devices(self, num_endpoints: int = 8) -> bool: ...
+
+    def take_vsp_restarted(self) -> bool:
+        """True once per VSP restart the manager re-adopted: a fresh VSP
+        process lost its applied partition, so the daemon must forget
+        applied_endpoints and re-partition. Default: restarts unobserved."""
+        return False
+
     def listen(self) -> None: ...
     def serve(self) -> None: ...
     def check_ping(self) -> bool: ...
@@ -176,6 +183,43 @@ class Daemon:
                 md.plugin.close()
                 md.manager.stop()
                 self._delete_cr(md.detection.cr_name())
+
+        # A re-adopted (restarted) VSP lost its applied partition: forget
+        # the record so the default-partition retry and the config tick
+        # below re-apply against the fresh process.
+        for md in self._managed.values():
+            # getattr, not try/except: host/dpu side managers don't expose
+            # the hook (their VSP restarts are re-adopted via GrpcPlugin's
+            # "already initialized" path), and a genuine bug in a concrete
+            # take_vsp_restarted must surface, not be swallowed.
+            take = getattr(md.manager, "take_vsp_restarted", None)
+            if take is None or not take():
+                continue
+            with md.endpoints_lock:
+                prev = md.applied_endpoints
+                md.applied_endpoints = None
+            self._config_status_memo.clear()
+            log.info(
+                "VSP for %s restarted; re-applying endpoint partition",
+                md.detection.identifier,
+            )
+            if prev is not None:
+                # One-shot re-apply of what was in force before the
+                # restart (a config's count, or the default) — funneling
+                # through the default-partition retry would repartition
+                # the fabric twice (DEFAULT, then the config's count) and
+                # expose a transient wrong inventory. The config tick
+                # still corrects if the config changed meanwhile; on
+                # failure applied stays None and the retry path heals.
+                try:
+                    md.plugin.set_num_endpoints(int(prev))
+                    with md.endpoints_lock:
+                        md.applied_endpoints = int(prev)
+                except Exception:
+                    log.warning(
+                        "re-applying %d endpoints after VSP restart failed; "
+                        "will retry", prev,
+                    )
 
         self._sync_crs()
         self._apply_dpu_configs()

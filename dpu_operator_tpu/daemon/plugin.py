@@ -62,6 +62,7 @@ class GrpcPlugin(VendorPlugin):
 
     def __init__(self, socket_path: str):
         self._socket_path = socket_path
+        self.last_ping_instance = None
         self._lock = threading.Lock()
         self._channel: Optional[grpc.Channel] = None
         self._initialized = False
@@ -120,13 +121,16 @@ class GrpcPlugin(VendorPlugin):
     def ping(self, timeout: float = 2.0) -> bool:
         """One VSP heartbeat over the vendor channel. A dead VSP marks
         the plugin uninitialised so the daemon's Ready condition flips
-        (converged-node liveness path)."""
+        (converged-node liveness path). Records the VSP's instance_id
+        (`last_ping_instance`) so callers can detect a process restart
+        that happened faster than the heartbeat interval."""
         try:
             stub = services.HeartbeatStub(self._ensure_channel())
             resp = stub.Ping(
                 pb.PingRequest(timestamp_ns=time.monotonic_ns(), sender_id="daemon"),
                 timeout=timeout,
             )
+            self.last_ping_instance = resp.instance_id or None
             return bool(resp.healthy)
         except grpc.RpcError:
             with self._lock:

@@ -31,6 +31,9 @@ class MockVsp(
     def __init__(self, opi_ip: str = "127.0.0.1", opi_port: int = 50151, num_devices: int = 4):
         self._opi = (opi_ip, opi_port)
         self._lock = threading.Lock()
+        import uuid as _uuid
+
+        self._instance_id = _uuid.uuid4().hex
         self._num_endpoints = num_devices
         self.init_calls: List[Tuple[int, str]] = []
         self.bridge_ports: List[str] = []
@@ -66,7 +69,7 @@ class MockVsp(
 
     # Heartbeat
     def Ping(self, request, context):
-        return pb.PingResponse(healthy=True)
+        return pb.PingResponse(healthy=True, instance_id=self._instance_id)
 
     # NetworkFunction
     def CreateNetworkFunction(self, request, context):

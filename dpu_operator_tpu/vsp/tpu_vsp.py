@@ -66,6 +66,12 @@ class TpuVsp(
         self._cp_agent = cp_agent_client
         self._lock = threading.Lock()
         self._num_endpoints = num_endpoints
+        # Fresh per process: echoed in Ping so the daemon detects VSP
+        # restarts deterministically (sub-heartbeat bounces included) and
+        # re-applies the fabric partition the new process lost.
+        import uuid as _uuid
+
+        self._instance_id = _uuid.uuid4().hex
         self._initialized = False
         # Health caches, maintained by background threads (never refreshed
         # inline — a slow probe must not stall the kubelet's 5 s
@@ -181,13 +187,14 @@ class TpuVsp(
 
     def Ping(self, request, context):
         healthy = True
+        instance_id = self._instance_id
         if self._cp_agent is not None:
             try:
                 healthy = self._cp_agent.healthy()
             except Exception:
                 log.warning("cp-agent unreachable; reporting unhealthy")
                 healthy = False
-        return pb.PingResponse(healthy=healthy)
+        return pb.PingResponse(healthy=healthy, instance_id=instance_id)
 
     def _chip_health(self, n_local: int) -> Dict[int, bool]:
         """Cache reads only — the caches are fed by background threads

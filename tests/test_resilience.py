@@ -87,6 +87,18 @@ def test_vsp_restart_recovers_ready_condition(tmp_root):
     try:
         assert wait_for(lambda: _ready(client) == "True"), "never became Ready"
 
+        # A config partitions the fabric to a non-default count; the VSP
+        # inventory follows.
+        client.create(
+            v1.new_data_processing_unit_config(
+                "resil-tune", dpu_selector={"dpu.tpu.io/vendor": "tpu"},
+                num_endpoints=12,
+            )
+        )
+        assert wait_for(
+            lambda: len(vsp.GetDevices(None, None).devices) == 12, timeout=10
+        ), "partition never applied before the restart"
+
         # VSP dies. The converged manager's own OPI server keeps heartbeats
         # local, but VSP liveness is tracked via the plugin channel: the
         # next Ping forward fails → Ready must flip.
@@ -104,6 +116,14 @@ def test_vsp_restart_recovers_ready_condition(tmp_root):
                 "Ready never recovered after VSP restart"
             )
             assert len(vsp2.init_calls) >= 1, "plugin never re-Init'ed the new VSP"
+
+            # The fresh process lost its partition; the daemon must
+            # notice the restart, forget applied_endpoints, and re-apply
+            # the config's count — not trust its stale record.
+            assert wait_for(
+                lambda: len(vsp2.GetDevices(None, None).devices) == 12,
+                timeout=15,
+            ), "endpoint partition never re-applied after VSP restart"
         finally:
             server2.stop()
     finally:
@@ -207,3 +227,55 @@ def test_cni_add_rolls_back_when_bridge_port_fails(two_sides, netns):
     finally:
         two_sides.dpu_vsp.fail_bridge_port = False
         subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_fast_vsp_bounce_reapplies_partition(tmp_root):
+    """A VSP that restarts FASTER than the heartbeat interval (no failed
+    ping in between) is still detected — the per-process instance_id
+    echoed in Ping changes — and the fabric partition is re-applied to
+    the fresh process instead of trusting the daemon's stale record."""
+    client = InMemoryClient(InMemoryCluster())
+    client.create(
+        {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "tpu-node-0"}}
+    )
+    port = free_port()
+    vsp = MockVsp(opi_port=port)
+    server = VspServer(vsp, tmp_root)
+    server.start()
+    daemon = Daemon(
+        client,
+        FakePlatform(product="Google Cloud TPU", node="tpu-node-0", env=TPU_ENV),
+        path_manager=tmp_root,
+        tick_interval=0.05,
+        register_device_plugin=False,
+    )
+    daemon.start()
+    server2 = None
+    try:
+        assert wait_for(lambda: _ready(client) == "True"), "never became Ready"
+        client.create(
+            v1.new_data_processing_unit_config(
+                "bounce-tune", dpu_selector={"dpu.tpu.io/vendor": "tpu"},
+                num_endpoints=6,
+            )
+        )
+        assert wait_for(
+            lambda: len(vsp.GetDevices(None, None).devices) == 6, timeout=10
+        )
+
+        # Bounce: stop and immediately restart on the same socket — far
+        # inside the 1 s heartbeat interval.
+        server.stop()
+        vsp2 = MockVsp(opi_port=port)
+        server2 = VspServer(vsp2, tmp_root)
+        server2.start()
+
+        assert wait_for(
+            lambda: len(vsp2.GetDevices(None, None).devices) == 6, timeout=20
+        ), "partition never re-applied after fast bounce"
+    finally:
+        daemon.stop()
+        if server2 is not None:
+            server2.stop()
+        else:
+            server.stop()
