@@ -22,6 +22,7 @@ import grpc
 from ..dpu_api import services
 from ..utils import PathManager
 from .dpu_side import _OpiService
+from .plugin import VspRestartWatcher
 from .host_side import HostSideManager
 from .plugin import VendorPlugin
 
@@ -39,7 +40,9 @@ class ConvergedSideManager(HostSideManager):
         super().__init__(vendor_plugin, identifier, path_manager, **kwargs)
         self._opi_server: Optional[grpc.Server] = None
         self._last_local_ping = 0.0
-        self._vsp_restarted = threading.Event()
+        self._vsp_watcher = VspRestartWatcher(
+            self.plugin, dpu_mode=True, identifier=identifier
+        )
 
     # Reuse the DPU side's OPI service shape: it needs .plugin and
     # .record_ping, both of which this class provides.
@@ -77,53 +80,14 @@ class ConvergedSideManager(HostSideManager):
         is re-adopted with a single-shot Init (fresh-process semantics)."""
         import time as _time
 
-        was_down = False
-        seen_instance = None
         while not self._stop.is_set():
-            ok = self.plugin.ping()
-            instance = getattr(self.plugin, "last_ping_instance", None)
-            bounced = (
-                ok
-                and not was_down
-                and instance is not None
-                and seen_instance is not None
-                and instance != seen_instance
-            )
-            if ok and (was_down or bounced):
-                # VSP restarted: re-run Init so it redoes hardware setup.
-                # `bounced` catches a restart FASTER than the heartbeat
-                # interval (no failed ping in between) via the per-process
-                # instance_id the VSP echoes in Ping.
-                addr = self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
-                if addr is None:
-                    ok = False
-                else:
-                    log.info(
-                        "converged side: re-adopted restarted VSP%s",
-                        " (sub-heartbeat bounce)" if bounced else "",
-                    )
-                    # The fresh process lost its applied partition; tell
-                    # the daemon tick to re-apply (take_vsp_restarted).
-                    self._vsp_restarted.set()
-            if ok and instance is not None:
-                seen_instance = instance
-            if ok:
-                was_down = False
+            if self._vsp_watcher.poll_once():
                 with self._ping_lock:
                     self._last_pong = _time.monotonic()
-            else:
-                if not was_down:
-                    log.warning("converged side: VSP heartbeat lost")
-                was_down = True
-                # Nudge a dead channel so grpc redials promptly.
-                self.plugin.try_init(dpu_mode=True, identifier=self.identifier)
             self._stop.wait(1.0)
 
     def take_vsp_restarted(self) -> bool:
-        if self._vsp_restarted.is_set():
-            self._vsp_restarted.clear()
-            return True
-        return False
+        return self._vsp_watcher.take_restarted()
 
     def stop(self) -> None:
         if self._opi_server is not None:

@@ -27,7 +27,7 @@ from ..dpu_api.gen import bridge_port_pb2 as bp
 from ..dpu_api.gen import dpu_api_pb2 as pb
 from ..utils import PathManager
 from .device_plugin import DevicePlugin
-from .plugin import VendorPlugin
+from .plugin import VendorPlugin, VspRestartWatcher
 
 log = logging.getLogger(__name__)
 
@@ -93,6 +93,10 @@ class DpuSideManager:
         self._mac_store: Dict[str, List[str]] = {}
         self._mac_lock = threading.Lock()
         self._ctrl_manager = None
+        self._stop_watch = threading.Event()
+        self._vsp_watcher = VspRestartWatcher(
+            vendor_plugin, dpu_mode=True, identifier=identifier
+        )
 
     # -- SideManager interface ----------------------------------------------
 
@@ -144,6 +148,16 @@ class DpuSideManager:
             self._ctrl_manager = Manager(self._client)
             setup_sfc_controller(self._ctrl_manager, self._client, self._node_name)
             self._ctrl_manager.start()
+        # VSP restart watcher: same guarantee as the converged role — a
+        # restarted VSP is re-Init'ed and the daemon re-applies the
+        # partition (take_vsp_restarted).
+        threading.Thread(
+            target=self._vsp_watcher.run, args=(self._stop_watch,),
+            daemon=True, name="dpu-vsp-watch",
+        ).start()
+
+    def take_vsp_restarted(self) -> bool:
+        return self._vsp_watcher.take_restarted()
 
     def check_ping(self) -> bool:
         with self._ping_lock:
@@ -154,6 +168,7 @@ class DpuSideManager:
             self._last_ping = time.monotonic()
 
     def stop(self) -> None:
+        self._stop_watch.set()
         if self._ctrl_manager is not None:
             self._ctrl_manager.stop()
         if self._opi_server is not None:

@@ -26,7 +26,7 @@ from ..dpu_api.gen import bridge_port_pb2 as bp
 from ..dpu_api.gen import dpu_api_pb2 as pb
 from ..utils import PathManager
 from .device_plugin import DevicePlugin
-from .plugin import VendorPlugin
+from .plugin import VendorPlugin, VspRestartWatcher
 
 log = logging.getLogger(__name__)
 
@@ -76,6 +76,9 @@ class HostSideManager:
         self._stop = threading.Event()
         self._threads = []
         self._ctrl_manager = None
+        self._vsp_watcher = VspRestartWatcher(
+            vendor_plugin, dpu_mode=False, identifier=identifier
+        )
 
     # -- SideManager interface ----------------------------------------------
 
@@ -110,6 +113,17 @@ class HostSideManager:
         t = threading.Thread(target=self._ping_loop, daemon=True, name="host-ping")
         t.start()
         self._threads.append(t)
+        # Host-side VSP restart watcher (same guarantee as the other
+        # roles; host VSPs own the host device inventory + partition).
+        t = threading.Thread(
+            target=self._vsp_watcher.run, args=(self._stop,),
+            daemon=True, name="host-vsp-watch",
+        )
+        t.start()
+        self._threads.append(t)
+
+    def take_vsp_restarted(self) -> bool:
+        return self._vsp_watcher.take_restarted()
 
     def check_ping(self) -> bool:
         with self._ping_lock:

@@ -198,3 +198,75 @@ class GrpcPlugin(VendorPlugin):
 
         stub = services.BridgePortStub(self._ensure_channel())
         stub.DeleteBridgePort(bp.DeleteBridgePortRequest(name=name), timeout=self.RPC_TIMEOUT)
+
+
+class VspRestartWatcher:
+    """Detects VSP process restarts and re-adopts them — shared by every
+    side manager so the 2-node roles get the same guarantee as the
+    converged one (a fresh VSP process lost its fabric partition and
+    needs Init re-run).
+
+    Two signals, polled via `poll_once()`:
+      * failed-ping recovery (the classic down→up transition);
+      * a changed per-process `instance_id` echoed in Ping — catches a
+        restart FASTER than the poll interval, where no ping ever fails.
+
+    On either, `try_init` re-runs hardware setup and `take_restarted()`
+    hands a one-shot signal to the daemon tick, which forgets
+    applied_endpoints and re-applies the partition."""
+
+    def __init__(self, plugin, dpu_mode: bool, identifier: str):
+        self._plugin = plugin
+        self._dpu_mode = dpu_mode
+        self._identifier = identifier
+        self._was_down = False
+        self._seen_instance: Optional[str] = None
+        self._restarted = threading.Event()
+
+    def poll_once(self) -> bool:
+        """One liveness round; returns VSP health."""
+        ok = self._plugin.ping()
+        instance = getattr(self._plugin, "last_ping_instance", None)
+        bounced = (
+            ok
+            and not self._was_down
+            and instance is not None
+            and self._seen_instance is not None
+            and instance != self._seen_instance
+        )
+        if ok and (self._was_down or bounced):
+            addr = self._plugin.try_init(
+                dpu_mode=self._dpu_mode, identifier=self._identifier
+            )
+            if addr is None:
+                ok = False
+            else:
+                log.info(
+                    "re-adopted restarted VSP%s",
+                    " (sub-heartbeat bounce)" if bounced else "",
+                )
+                self._restarted.set()
+        if ok:
+            self._was_down = False
+            if instance is not None:
+                self._seen_instance = instance
+        else:
+            if not self._was_down:
+                log.warning("VSP heartbeat lost")
+            self._was_down = True
+            # Nudge a dead channel so grpc redials promptly.
+            self._plugin.try_init(
+                dpu_mode=self._dpu_mode, identifier=self._identifier
+            )
+        return ok
+
+    def take_restarted(self) -> bool:
+        if self._restarted.is_set():
+            self._restarted.clear()
+            return True
+        return False
+
+    def run(self, stop: "threading.Event", interval: float = 1.0) -> None:
+        """Background loop for managers without their own ping cadence."""
+        while not stop.wait(interval):
+            self.poll_once()

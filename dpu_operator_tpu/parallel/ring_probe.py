@@ -14,7 +14,9 @@ The family:
   * `make_ring_all_gather` — one-way ring, or bidirectional by default
     (both duplex directions of each link carry half of every chunk);
   * `make_ring_reduce_scatter` — sum-reduce ring; composed with the
-    all-gather it forms a bandwidth-optimal all-reduce.
+    all-gather it forms a bandwidth-optimal all-reduce;
+  * `make_all_to_all` — the Ulysses-style sequence/expert-parallel
+    exchange (arbitrary-target RDMAs riding the torus).
 
 `measure_ring_bandwidth` returns per-round wall time, an effective GB/s
 figure the traffic-flow harness can sanity-check against the topology's
@@ -386,6 +388,146 @@ def _rs_kernel(
     out_ref[:] = recv_buf[(num_devices - 1) % 2] + local_chunk(my_id)
 
 
+def _a2a_kernel(
+    n_axes,
+    ring_pos,
+    num_devices,
+    my_id_ref,
+    coords_ref,
+    local_ref,
+    out_ref,
+    send_sem,
+    recv_sem,
+):
+    """All-to-all (the Ulysses-style sequence/expert-parallel exchange):
+    block j of our local data goes to device j; our output block s comes
+    from device s. Unlike the ring kernels the RDMAs target ARBITRARY
+    devices on the axis — ICI routes them through the torus — and every
+    write lands in a distinct output region (indexed by the SOURCE id),
+    so no slot reuse exists and the only synchronisation needed is an
+    all-devices entry barrier plus counting the n-1 equal-sized arrivals
+    on one shared recv semaphore."""
+    chunk = local_ref.shape[0] // num_devices
+    my_id = my_id_ref[0]
+
+    def axis_target(dst):
+        return tuple(
+            dst if i == ring_pos else coords_ref[0, i] for i in range(n_axes)
+        )
+
+    # All-devices barrier: any peer may RDMA into us, so every device on
+    # the axis must have entered the kernel (out_ref live) before anyone
+    # sends.
+    barrier = pltpu.get_barrier_semaphore()
+
+    def bsig(k, _):
+        pltpu.semaphore_signal(
+            barrier, inc=1,
+            device_id=axis_target(jax.lax.rem(my_id + k, num_devices)),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        return ()
+
+    jax.lax.fori_loop(1, num_devices, bsig, ())
+    pltpu.semaphore_wait(barrier, num_devices - 1)
+
+    out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[pl.ds(my_id * chunk, chunk)]
+
+    def make_rdma(k):
+        dst = jax.lax.rem(my_id + k, num_devices)
+        return pltpu.make_async_remote_copy(
+            src_ref=local_ref.at[pl.ds(dst * chunk, chunk)],
+            dst_ref=out_ref.at[pl.ds(my_id * chunk, chunk)],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=axis_target(dst),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    # Start ALL n-1 transfers before waiting any: every write targets a
+    # distinct region, so the transfers are independent and overlap —
+    # waiting inside the start loop would chain each send on an inbound
+    # arrival from an arbitrary peer and measure latency, not bandwidth.
+    def start_body(k, _):
+        make_rdma(k).start()
+        return ()
+
+    jax.lax.fori_loop(1, num_devices, start_body, ())
+
+    # Drain: each equal-sized descriptor wait consumes one send completion
+    # and one inbound arrival (DMA semaphores count bytes, they don't
+    # address), so n-1 waits cover all outbound and inbound transfers
+    # regardless of completion order.
+    def drain_body(k, _):
+        make_rdma(k).wait()
+        return ()
+
+    jax.lax.fori_loop(1, num_devices, drain_body, ())
+
+
+def _pallas_all_to_all(
+    x_local: jax.Array, axis: str, axis_size: int, axis_names: tuple
+) -> jax.Array:
+    rows, width = x_local.shape
+    if rows % axis_size != 0:
+        raise ValueError(
+            f"all-to-all rows {rows} must divide by axis size {axis_size}"
+        )
+    if axis_size == 1:
+        return x_local
+    ring_pos = axis_names.index(axis)
+    my_id = jax.lax.axis_index(axis)
+    coords = jnp.stack(
+        [jax.lax.axis_index(n) for n in axis_names]
+    ).astype(jnp.int32)[None, :]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_a2a_kernel, len(axis_names), ring_pos, axis_size),
+        out_shape=jax.ShapeDtypeStruct((rows, width), x_local.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        coords,
+        x_local,
+    )
+
+
+def make_all_to_all(mesh, axis: str = "sp", use_pallas: Optional[bool] = None):
+    """jitted fn: each shard's [n*chunk, W] local block exchanges chunk j
+    with device j along `axis` (all-to-all — the sequence/expert-parallel
+    shuffle behind Ulysses-style context parallelism and MoE dispatch).
+    Pallas remote-DMA kernel on multi-chip TPU meshes (arbitrary-target
+    RDMAs riding the torus), `jax.lax.all_to_all` fallback elsewhere."""
+    axis_size = mesh.shape[axis]
+
+    def xla_inner(x_local):
+        return jax.lax.all_to_all(
+            x_local, axis, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    return _axis_collective(
+        mesh, axis, use_pallas,
+        functools.partial(
+            _pallas_all_to_all,
+            axis=axis,
+            axis_size=axis_size,
+            axis_names=tuple(mesh.axis_names),
+        ),
+        xla_inner,
+        out_specs=P(axis, None),
+    )
+
+
 def _pallas_reduce_scatter(
     x_local: jax.Array, axis: str, axis_size: int, axis_names: tuple
 ) -> jax.Array:
@@ -436,6 +578,36 @@ def make_ring_reduce_scatter(mesh, axis: str = "sp", use_pallas: Optional[bool] 
     elsewhere. Composed with `make_ring_all_gather` this is a full
     bandwidth-optimal all-reduce — together the probes exercise every
     collective shape the fabric-validation step leans on."""
+    axis_size = mesh.shape[axis]
+
+    def xla_inner(x_local):
+        return jax.lax.psum_scatter(
+            x_local, axis, scatter_dimension=0, tiled=True
+        )
+
+    return _axis_collective(
+        mesh, axis, use_pallas,
+        functools.partial(
+            _pallas_reduce_scatter,
+            axis=axis,
+            axis_size=axis_size,
+            axis_names=tuple(mesh.axis_names),
+        ),
+        xla_inner,
+        out_specs=P(axis, None),
+    )
+
+
+def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
+    return jax.lax.all_gather(x_shard, axis, tiled=True)
+
+
+def _axis_collective(mesh, axis, use_pallas, pallas_inner, xla_inner,
+                     out_specs):
+    """Shared factory plumbing for every collective in this module: TPU
+    autodetection (pallas only on real multi-chip TPU meshes), then the
+    chosen per-shard body wrapped in shard_map + jit. One definition so
+    the three factories can never diverge on detection or mapping args."""
     from jax import shard_map
 
     axis_size = mesh.shape[axis]
@@ -445,31 +617,15 @@ def make_ring_reduce_scatter(mesh, axis: str = "sp", use_pallas: Optional[bool] 
             and axis_size > 1
             and all(d.platform == "tpu" for d in mesh.devices.flat)
         )
-    if use_pallas:
-        inner = functools.partial(
-            _pallas_reduce_scatter,
-            axis=axis,
-            axis_size=axis_size,
-            axis_names=tuple(mesh.axis_names),
-        )
-    else:
-        def inner(x_local):
-            return jax.lax.psum_scatter(
-                x_local, axis, scatter_dimension=0, tiled=True
-            )
-
+    inner = pallas_inner if use_pallas else xla_inner
     mapped = shard_map(
         inner,
         mesh=mesh,
         in_specs=P(axis, None),
-        out_specs=P(axis, None),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(mapped)
-
-
-def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
-    return jax.lax.all_gather(x_shard, axis, tiled=True)
 
 
 def make_ring_all_gather(
@@ -485,35 +641,19 @@ def make_ring_all_gather(
     carry half of every chunk — guide "Bi-directional Ring"); pass
     `bidirectional=False` for the one-way ring, and odd per-shard row
     counts fall back to it automatically (halves must split evenly)."""
-    from jax import shard_map
-
     axis_size = mesh.shape[axis]
-    if use_pallas is None:
-        use_pallas = (
-            pltpu is not None
-            and axis_size > 1
-            and all(d.platform == "tpu" for d in mesh.devices.flat)
-        )
-    if use_pallas:
-        inner = functools.partial(
+    return _axis_collective(
+        mesh, axis, use_pallas,
+        functools.partial(
             _pallas_all_gather,
             axis=axis,
             axis_size=axis_size,
             axis_names=tuple(mesh.axis_names),
             bidirectional=bidirectional,
-        )
-    else:
-        inner = functools.partial(_xla_all_gather, axis=axis, axis_size=axis_size)
-
-    spec_axes = tuple(axis if i == 0 else None for i in range(2))
-    mapped = shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=P(*spec_axes),
+        ),
+        functools.partial(_xla_all_gather, axis=axis, axis_size=axis_size),
         out_specs=P(),
-        check_vma=False,
     )
-    return jax.jit(mapped)
 
 
 def measure_ring_bandwidth(
