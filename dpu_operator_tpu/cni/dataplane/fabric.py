@@ -44,9 +44,22 @@ def _stable_mac(container_id: str, ifname: str) -> str:
 
 
 class FabricDataplane:
-    def __init__(self, state_store: StateStore, ipam: HostLocalIpam):
+    def __init__(
+        self,
+        state_store: StateStore,
+        ipam: HostLocalIpam,
+        default_mtu=None,
+    ):
         self._store = state_store
         self._ipam = ipam
+        # Node fabric MTU applied when the NAD config carries no `mtu`
+        # key (utils/mtu.py policy; a per-NAD `mtu` still wins). None
+        # preserves the kernel default (1500). A CALLABLE is resolved at
+        # every ADD: the uplink's MTU can change after daemon startup
+        # (the VSP raises it toward a DPU_FABRIC_MTU override when it
+        # brings the bridge up), and per-attach resolution means new
+        # pods track the fabric instead of a stale startup snapshot.
+        self._default_mtu = default_mtu
         # Per-NAD IPAM: a NetworkAttachmentDefinition's config may carry
         # its own `ipam` section (upstream host-local grammar: subnet,
         # rangeStart/rangeEnd, exclude, gateway, routes); allocators are
@@ -54,6 +67,15 @@ class FabricDataplane:
         # one lease file.
         self._ipam_cache: dict = {}
         self._ipam_lock = threading.Lock()
+
+    def _resolve_default_mtu(self) -> Optional[int]:
+        if callable(self._default_mtu):
+            try:
+                return self._default_mtu()
+            except Exception as e:
+                log.warning("fabric MTU resolver failed (%s); kernel default", e)
+                return None
+        return self._default_mtu
 
     def _ipam_for(self, req: CniRequest):
         """(allocator, routes) for this request: the NAD's own `ipam`
@@ -109,7 +131,7 @@ class FabricDataplane:
                 return self._result_from_state(state)
 
         try:
-            mtu = req.config.get("mtu")
+            mtu = req.config.get("mtu") or self._resolve_default_mtu()
             if not nl.create_veth_in_netns(
                 host_if, req.ifname, netns, mac, int(mtu) if mtu else None
             ):

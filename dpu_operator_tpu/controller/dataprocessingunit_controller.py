@@ -69,6 +69,12 @@ class DataProcessingUnitReconciler(Reconciler):
             "ImagePullPolicy": self._pull_policy,
             "NodeName": dpu["spec"]["nodeName"],
             "VspImage": self._images.get_image(image_key),
+            # Same fabric policy env the daemonset gets (see
+            # dpuoperatorconfig_controller._yaml_vars): daemon and VSP
+            # must resolve the same fabric MTU or veth pairs end up
+            # sized differently from the bridge they're enslaved to.
+            "FabricUplink": os.environ.get("DPU_FABRIC_UPLINK", ""),
+            "FabricMtu": os.environ.get("DPU_FABRIC_MTU", ""),
         }
         renderer.apply_dir(os.path.join(BINDATA, "vsp", "shared"), variables, owner=dpu)
         renderer.apply_dir(

@@ -98,6 +98,12 @@ class DpuOperatorConfigReconciler(Reconciler):
             "CniBinDir": self._pm.cni_host_dir(flavour, fs_mode),
             "ResourceName": v.DPU_RESOURCE_NAME,
             "HostNadName": v.DEFAULT_HOST_NAD_NAME,
+            # Fabric MTU/uplink policy inputs (utils/mtu.py): rendered
+            # into BOTH the daemonset and the VSP pod from the operator's
+            # own env, so the CNI veth sizing and the VSP bridge sizing
+            # can never resolve different MTUs from skewed pod envs.
+            "FabricUplink": os.environ.get("DPU_FABRIC_UPLINK", ""),
+            "FabricMtu": os.environ.get("DPU_FABRIC_MTU", ""),
         }
         return merge_vars_with_images(
             self._images,

@@ -10,6 +10,7 @@ then calls CreateBridgePort on the DPU-side OPI server with retry backoff
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional, Tuple
@@ -25,6 +26,7 @@ from ..dpu_api import services
 from ..dpu_api.gen import bridge_port_pb2 as bp
 from ..dpu_api.gen import dpu_api_pb2 as pb
 from ..utils import PathManager
+from ..utils.mtu import resolve_fabric_mtu
 from .device_plugin import DevicePlugin
 from .plugin import VendorPlugin, VspRestartWatcher
 
@@ -57,7 +59,19 @@ class HostSideManager:
 
         state = StateStore(self._pm.cni_state_dir())
         ipam = HostLocalIpam(self._pm.cni_state_dir(), pod_cidr)
-        self.dataplane = FabricDataplane(state, ipam)
+        # Node fabric MTU: pods attached here default to the largest
+        # frame the fabric path carries (uplink-bound when an uplink
+        # exists, veth-max otherwise — utils/mtu.py has the measured
+        # rationale). A NAD-level `mtu` key still overrides per network.
+        # Resolved PER ATTACH (callable): the VSP may raise the uplink
+        # MTU after this daemon starts, and an override the uplink can't
+        # carry is clamped to what it currently does.
+        self.dataplane = FabricDataplane(
+            state, ipam,
+            default_mtu=lambda: resolve_fabric_mtu(
+                os.environ.get("DPU_FABRIC_UPLINK")
+            ),
+        )
         # A prior daemon may have died between the fast-DEL rename and the
         # deferred destroy; reclaim those links before serving CNI — and
         # release IPAM leases whose owners have no recorded attachment.

@@ -1,0 +1,104 @@
+"""Fabric MTU policy — one resolver shared by the CNI veth path and the
+VSP bridge so every hop of the pod-to-pod path agrees on frame size.
+
+Why this exists (measured, BASELINE.md "Bridge-vs-loopback gap"): at the
+default 1500-byte MTU the veth+bridge fabric path pays ~40% of its
+throughput to per-packet CPU cost. The diagnostic sweep recovered
+12.9 -> 17.8 Gbps by raising the bridge-path MTU alone; this policy —
+which also sizes both pod veth ends at creation — measures 21.5 Gbps
+tft-pump tcp-stream on the same host, ~97% of the engine's loopback
+ceiling. The reference leaves MTU to the sriov NetConf
+knob (dpu-cni/pkgs/cnitypes/cnitypes.go NetConf) with no node policy;
+on the TPU fabric the right default is computable, so compute it.
+
+Resolution order:
+  1. `DPU_FABRIC_MTU` env — operator override. With an uplink configured
+     it is additionally clamped to the uplink's CURRENT MTU: an override
+     the uplink hardware can't carry (e.g. 9500 on an 8896-max gVNIC)
+     must not size pod veths above what the bridge can forward — frames
+     over the uplink MTU drop silently at L2 (no ICMP), a TCP blackhole
+     that only hits bulk transfers. The VSP raises the uplink toward the
+     override first (tpu_dataplane.ensure_bridge); callers that resolve
+     per-attach then pick the raised value up automatically.
+  2. The fabric uplink's current MTU — when pods talk across nodes the
+     uplink is the binding constraint (gVNIC on a TPU-VM: 8896); frames
+     bigger than it would fragment or drop at the first hop.
+  3. `VETH_MAX_MTU` (65535) — no uplink means the bridge only carries
+     intra-node traffic, where the veth maximum is purely a win.
+
+A NAD-level `mtu` key in the CNI config still beats all of this for the
+pod interface it configures (per-network override, reference NetConf
+semantics)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+VETH_MAX_MTU = 65535
+# When an uplink is configured but its MTU can't be read, fail SAFE: any
+# real fabric carries at least 1500, while guessing high silently drops
+# every frame between the guess and the truth.
+FAIL_SAFE_MTU = 1500
+
+
+def uplink_mtu(uplink: str, root: str = "/") -> Optional[int]:
+    """Current MTU of a host netdev via sysfs; None when unreadable.
+    `root` re-roots the sysfs path for tests (PathManager convention)."""
+    path = os.path.join(root, "sys/class/net", uplink, "mtu")
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def resolve_fabric_mtu(
+    uplink: Optional[str] = None,
+    root: str = "/",
+    clamp_to_uplink: bool = True,
+) -> int:
+    """The MTU every fabric-attached interface (veth ends, bridge, NF
+    devices) should carry on this node. Never raises; a junk override is
+    logged and ignored rather than breaking pod attach.
+
+    `clamp_to_uplink=False` is for the ONE caller that is about to apply
+    the override TO the uplink itself (tpu_dataplane.ensure_bridge): it
+    needs the raw target — pre-clamping to the uplink's current MTU
+    would make raising it impossible. Everyone else (per-attach veth
+    sizing) keeps the clamp, so pods are never sized above what the
+    uplink currently carries."""
+    env = os.environ.get("DPU_FABRIC_MTU")
+    if env:
+        try:
+            value = int(env)
+            if 576 <= value <= VETH_MAX_MTU:
+                if uplink and clamp_to_uplink:
+                    limit = uplink_mtu(uplink, root=root)
+                    if limit is None:
+                        log.warning(
+                            "uplink %s MTU unreadable; fail-safe clamp of "
+                            "DPU_FABRIC_MTU=%d to %d", uplink, value,
+                            FAIL_SAFE_MTU)
+                        return min(value, FAIL_SAFE_MTU)
+                    if limit < value:
+                        log.warning(
+                            "DPU_FABRIC_MTU=%d above uplink %s MTU %d; "
+                            "clamping", value, uplink, limit)
+                        return limit
+                return value
+            log.warning("DPU_FABRIC_MTU=%s out of range [576, %d]; ignored",
+                        env, VETH_MAX_MTU)
+        except ValueError:
+            log.warning("DPU_FABRIC_MTU=%r not an integer; ignored", env)
+    if uplink:
+        value = uplink_mtu(uplink, root=root)
+        if value is not None:
+            return value
+        log.warning("uplink %s MTU unreadable; fail-safe %d",
+                    uplink, FAIL_SAFE_MTU)
+        return FAIL_SAFE_MTU
+    return VETH_MAX_MTU

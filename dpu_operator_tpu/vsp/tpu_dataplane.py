@@ -42,11 +42,25 @@ class TpuFabricDataplane:
         bridge: str = BRIDGE_NAME,
         uplink: Optional[str] = None,
         fabric_gbps: Optional[float] = None,
+        mtu: Optional[int] = None,
     ):
         import os
 
+        from ..utils.mtu import resolve_fabric_mtu
+
         self.bridge = bridge
         self.uplink = uplink
+        # Same MTU policy as the CNI veth path (utils/mtu.py) — but
+        # resolved UNCLAMPED: this is the one component that applies the
+        # override TO the uplink (ensure_bridge raises it toward the
+        # target and clamps self.mtu on failure). Pre-clamping to the
+        # uplink's boot-time MTU would make raising it impossible — a
+        # gVNIC that boots at 1460 with DPU_FABRIC_MTU=8896 must end up
+        # at 8896, not pin the fabric to 1460 forever.
+        self.mtu = (
+            mtu if mtu is not None
+            else resolve_fabric_mtu(uplink, clamp_to_uplink=False)
+        )
         self.ports: Dict[str, str] = {}  # port name -> mac
         self.nf_pairs: List[Tuple[str, str]] = []
         # Endpoint partitioning with a DATAPLANE meaning (reference
@@ -68,10 +82,50 @@ class TpuFabricDataplane:
             _run(["ip", "link", "show", "dev", self.bridge])
         except DataplaneError:
             _run(["ip", "link", "add", self.bridge, "type", "bridge"])
-        _run(["ip", "link", "set", "dev", self.bridge, "up"])
         if self.uplink:
             _run(["ip", "link", "set", "dev", self.uplink, "master", self.bridge])
             _run(["ip", "link", "set", "dev", self.uplink, "up"])
+            # Propagate the fabric MTU to the uplink: an explicit
+            # DPU_FABRIC_MTU override above the uplink's current MTU
+            # means the operator resized the fabric — apply it. If the
+            # device rejects it (above its hardware max), clamp the
+            # whole node fabric to what the uplink actually carries: a
+            # bridge that forwards frames bigger than its uplink's MTU
+            # drops them silently (L2, no ICMP) — a TCP blackhole.
+            try:
+                _run(["ip", "link", "set", "dev", self.uplink,
+                      "mtu", str(self.mtu)])
+            except DataplaneError as e:
+                from ..utils.mtu import FAIL_SAFE_MTU, uplink_mtu
+
+                actual = uplink_mtu(self.uplink)
+                if actual is None:
+                    # Set failed AND the current MTU is unreadable (device
+                    # flapping): fail safe — a bridge pinned above what
+                    # the uplink carries blackholes silently.
+                    log.warning(
+                        "uplink %s rejects MTU %d (%s) and its current "
+                        "MTU is unreadable; fail-safe fabric MTU %d",
+                        self.uplink, self.mtu, e, FAIL_SAFE_MTU)
+                    self.mtu = min(self.mtu, FAIL_SAFE_MTU)
+                elif actual < self.mtu:
+                    log.warning(
+                        "uplink %s rejects MTU %d (%s); clamping fabric "
+                        "MTU to %d", self.uplink, self.mtu, e, actual)
+                    self.mtu = actual
+                else:
+                    log.warning(
+                        "uplink %s rejects MTU set %d (%s) but already "
+                        "carries %d; keeping %d",
+                        self.uplink, self.mtu, e, actual, self.mtu)
+        # Pin the bridge MTU explicitly: an unpinned linux bridge tracks
+        # the minimum of its ports, so one legacy-MTU port would clamp
+        # every pod's frames down.
+        try:
+            _run(["ip", "link", "set", "dev", self.bridge, "mtu", str(self.mtu)])
+        except DataplaneError as e:
+            log.warning("bridge MTU %d rejected: %s", self.mtu, e)
+        _run(["ip", "link", "set", "dev", self.bridge, "up"])
 
     def attach_port(self, netdev: str, mac: str) -> None:
         # Hot path: direct RTNETLINK via the shared netlink layer (falls
@@ -83,6 +137,13 @@ class TpuFabricDataplane:
             nl.set_up(netdev)
         except nl.NetlinkError as e:
             raise DataplaneError(str(e)) from e
+        # Deliberately no MTU forcing here: the CNI sized BOTH veth ends
+        # (node policy or per-NAD `mtu` override) before CreateBridgePort
+        # reaches us; resizing only the bridge-side end would make the
+        # pair asymmetric — the kernel accepts per-end veth MTUs
+        # independently, and oversized frames then vanish at the smaller
+        # peer with no error. The pinned bridge MTU (ensure_bridge) keeps
+        # a small port from clamping anyone else.
         self.ports[netdev] = mac
         try:
             self._apply_share(netdev)

@@ -284,3 +284,121 @@ def test_stale_lease_gc_fails_safe(tmp_path):
         f.write("{nope")
     assert dp.gc_stale_leases() == 0
     assert "dead2/net1" in ipam.leases().values()
+
+
+def test_default_fabric_mtu_applied_to_both_veth_ends(tmp_path, pod_ns):
+    """When the NAD config carries no `mtu`, the node fabric MTU policy
+    (utils/mtu.py) sizes both ends of the veth pair; a NAD-level `mtu`
+    still wins per network. Measured rationale in BASELINE.md: 1500-byte
+    frames cost ~40% of fabric throughput to per-packet CPU."""
+    from dpu_operator_tpu.cni import netlink as nl
+    from dpu_operator_tpu.cni.dataplane.fabric import _host_ifname
+
+    dp = FabricDataplane(
+        StateStore(str(tmp_path / "state")),
+        HostLocalIpam(str(tmp_path / "ipam"), "10.78.0.0/29"),
+        default_mtu=9000,
+    )
+
+    def mtu_of(dev, ns=None):
+        return nl.get_link(dev, ns)["mtu"]
+
+    req = _req(pod_ns)
+    dp.cmd_add(req)
+    host_if = _host_ifname(req.container_id, "net1")
+    assert mtu_of("net1", pod_ns) == 9000
+    assert mtu_of(host_if) == 9000
+    dp.cmd_del(_req(pod_ns, req.container_id, "DEL"))
+
+    # Per-NAD override beats the node default (reference NetConf knob).
+    req2 = _req(pod_ns)
+    req2.config["mtu"] = 4000
+    dp.cmd_add(req2)
+    assert mtu_of("net1", pod_ns) == 4000
+    dp.cmd_del(_req(pod_ns, req2.container_id, "DEL"))
+
+
+def test_bridge_pins_fabric_mtu_ports_keep_their_own(netns, tmp_path):
+    """TpuFabricDataplane pins the bridge MTU so a small port can't clamp
+    everyone else — but it must NOT resize an attached port: the CNI
+    sized both veth ends (policy or per-NAD override), and forcing only
+    the bridge-side end would make the pair asymmetric (the kernel
+    accepts per-end veth MTUs independently; oversized frames then
+    vanish at the smaller peer with no error)."""
+    from dpu_operator_tpu.cni import netlink as nl
+    from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+    bridge = "brM" + uuid.uuid4().hex[:6]
+    va = "vm" + uuid.uuid4().hex[:6]
+    vb = "vn" + uuid.uuid4().hex[:6]
+    subprocess.run(
+        ["ip", "link", "add", va, "mtu", "4000",
+         "type", "veth", "peer", "name", vb, "mtu", "4000"], check=True
+    )
+    try:
+        dp = TpuFabricDataplane(bridge=bridge, mtu=65535)
+        dp.ensure_bridge()
+
+        def mtu_of(dev):
+            return nl.get_link(dev)["mtu"]
+
+        assert mtu_of(bridge) == 65535
+        dp.attach_port(va, "02:00:00:00:00:aa")
+        # Port keeps the MTU the CNI (or NAD override) gave the pair;
+        # the pinned bridge stays at the fabric MTU regardless.
+        assert mtu_of(va) == 4000
+        assert mtu_of(bridge) == 65535
+    finally:
+        subprocess.run(["ip", "link", "del", va], capture_output=True)
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+
+
+def test_uplink_carries_fabric_mtu_or_clamps(netns, tmp_path):
+    """ensure_bridge propagates the fabric MTU to the enslaved uplink —
+    a bridge forwarding frames bigger than its uplink's MTU drops them
+    silently (L2, no ICMP). veth accepts 65535, so the propagate path
+    is observable directly."""
+    from dpu_operator_tpu.cni import netlink as nl
+    from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+    bridge = "brU" + uuid.uuid4().hex[:6]
+    up_a = "uq" + uuid.uuid4().hex[:6]
+    up_b = "ur" + uuid.uuid4().hex[:6]
+    subprocess.run(
+        ["ip", "link", "add", up_a, "type", "veth", "peer", "name", up_b],
+        check=True,
+    )
+    try:
+        dp = TpuFabricDataplane(bridge=bridge, uplink=up_a, mtu=65535)
+        dp.ensure_bridge()
+        assert nl.get_link(up_a)["mtu"] == 65535
+    finally:
+        subprocess.run(["ip", "link", "del", up_a], capture_output=True)
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+
+
+def test_override_raises_uplink_above_boot_mtu(netns, monkeypatch):
+    """The motivating override case: an uplink that boots at a small MTU
+    (gVNIC: 1460) with DPU_FABRIC_MTU set higher must be RAISED by
+    ensure_bridge — not have the override silently pre-clamped to the
+    boot value."""
+    from dpu_operator_tpu.cni import netlink as nl
+    from dpu_operator_tpu.vsp.tpu_dataplane import TpuFabricDataplane
+
+    bridge = "brR" + uuid.uuid4().hex[:6]
+    up_a = "us" + uuid.uuid4().hex[:6]
+    up_b = "ut" + uuid.uuid4().hex[:6]
+    subprocess.run(
+        ["ip", "link", "add", up_a, "mtu", "1460",
+         "type", "veth", "peer", "name", up_b, "mtu", "1460"], check=True
+    )
+    monkeypatch.setenv("DPU_FABRIC_MTU", "9000")
+    try:
+        dp = TpuFabricDataplane(bridge=bridge, uplink=up_a)
+        assert dp.mtu == 9000  # unclamped target
+        dp.ensure_bridge()
+        assert nl.get_link(up_a)["mtu"] == 9000
+        assert nl.get_link(bridge)["mtu"] == 9000
+    finally:
+        subprocess.run(["ip", "link", "del", up_a], capture_output=True)
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)

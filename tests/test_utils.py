@@ -58,3 +58,92 @@ def test_atomic_write(tmp_path):
     p = str(tmp_path / "d" / "f.json")
     fileutils.atomic_write(p, "{}")
     assert open(p).read() == "{}"
+
+
+# -- fabric MTU policy (utils/mtu.py) ----------------------------------------
+
+
+def test_resolve_fabric_mtu_default_is_veth_max(monkeypatch):
+    """No override, no uplink: the bridge only carries intra-node
+    traffic, where the veth maximum is the measured win (BASELINE.md
+    bridge-gap diagnosis: 12.9 -> 17.8 Gbps)."""
+    from dpu_operator_tpu.utils.mtu import VETH_MAX_MTU, resolve_fabric_mtu
+
+    monkeypatch.delenv("DPU_FABRIC_MTU", raising=False)
+    assert resolve_fabric_mtu() == VETH_MAX_MTU
+
+
+def test_resolve_fabric_mtu_env_override(monkeypatch):
+    from dpu_operator_tpu.utils.mtu import resolve_fabric_mtu
+
+    monkeypatch.setenv("DPU_FABRIC_MTU", "8896")
+    assert resolve_fabric_mtu() == 8896
+
+
+def test_resolve_fabric_mtu_junk_env_ignored(monkeypatch):
+    """A junk override must never break pod attach — log and fall
+    through to the next policy tier."""
+    from dpu_operator_tpu.utils.mtu import VETH_MAX_MTU, resolve_fabric_mtu
+
+    monkeypatch.setenv("DPU_FABRIC_MTU", "jumbo")
+    assert resolve_fabric_mtu() == VETH_MAX_MTU
+    monkeypatch.setenv("DPU_FABRIC_MTU", "100")  # below IPv4 minimum
+    assert resolve_fabric_mtu() == VETH_MAX_MTU
+
+
+def test_resolve_fabric_mtu_follows_uplink(monkeypatch, tmp_path):
+    """With an uplink the first hop is the binding constraint (gVNIC on
+    a TPU-VM caps at 8896); frames above it would fragment or drop."""
+    from dpu_operator_tpu.utils.mtu import VETH_MAX_MTU, resolve_fabric_mtu
+
+    monkeypatch.delenv("DPU_FABRIC_MTU", raising=False)
+    sysdir = tmp_path / "sys" / "class" / "net" / "gvnic0"
+    os.makedirs(sysdir)
+    (sysdir / "mtu").write_text("8896\n")
+    assert resolve_fabric_mtu("gvnic0", root=str(tmp_path)) == 8896
+    # Unreadable uplink fails SAFE (1500): guessing high would silently
+    # drop every frame between the guess and the truth.
+    from dpu_operator_tpu.utils.mtu import FAIL_SAFE_MTU
+
+    assert VETH_MAX_MTU  # imported above; uplink tier never returns it blind
+    assert resolve_fabric_mtu("missing0", root=str(tmp_path)) == FAIL_SAFE_MTU
+
+
+def test_resolve_fabric_mtu_override_clamped_to_uplink(monkeypatch, tmp_path):
+    """An override the uplink can't carry must not size pod veths above
+    what the bridge can forward — oversized frames drop silently at L2
+    (no ICMP), a bulk-TCP-only blackhole."""
+    from dpu_operator_tpu.utils.mtu import resolve_fabric_mtu
+
+    sysdir = tmp_path / "sys" / "class" / "net" / "gvnic0"
+    os.makedirs(sysdir)
+    (sysdir / "mtu").write_text("8896\n")
+    monkeypatch.setenv("DPU_FABRIC_MTU", "9500")
+    assert resolve_fabric_mtu("gvnic0", root=str(tmp_path)) == 8896
+    # Override below the uplink MTU is honored as-is.
+    monkeypatch.setenv("DPU_FABRIC_MTU", "4000")
+    assert resolve_fabric_mtu("gvnic0", root=str(tmp_path)) == 4000
+    # No uplink: override wins unclamped.
+    monkeypatch.setenv("DPU_FABRIC_MTU", "9500")
+    assert resolve_fabric_mtu() == 9500
+
+
+def test_resolve_fabric_mtu_unclamped_for_uplink_applier(monkeypatch, tmp_path):
+    """clamp_to_uplink=False returns the raw override — the VSP applies
+    it TO the uplink (ensure_bridge), so pre-clamping to the boot-time
+    MTU would make raising the uplink impossible."""
+    from dpu_operator_tpu.utils.mtu import FAIL_SAFE_MTU, resolve_fabric_mtu
+
+    sysdir = tmp_path / "sys" / "class" / "net" / "gvnic0"
+    os.makedirs(sysdir)
+    (sysdir / "mtu").write_text("1460\n")  # gVNIC boot default
+    monkeypatch.setenv("DPU_FABRIC_MTU", "8896")
+    assert resolve_fabric_mtu(
+        "gvnic0", root=str(tmp_path), clamp_to_uplink=False
+    ) == 8896
+    # The clamped (default) resolution — what per-attach veth sizing
+    # uses — still tracks the uplink's current value.
+    assert resolve_fabric_mtu("gvnic0", root=str(tmp_path)) == 1460
+    # Override with an UNREADABLE uplink fails safe even when clamping.
+    monkeypatch.setenv("DPU_FABRIC_MTU", "9500")
+    assert resolve_fabric_mtu("gone0", root=str(tmp_path)) == FAIL_SAFE_MTU
