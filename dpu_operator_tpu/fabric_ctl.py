@@ -22,6 +22,13 @@ Commands:
     topology                             slice topology from env/JAX
     ports [--bridge BR]                  bridge port + FDB state dump
     stats [--bridge BR | DEV...] [--rate S]   per-port kernel counters
+    rule-add DEV --pref N --action A [match...]  program a match-action
+                                         flow rule (nf_tables via raw
+                                         netlink) on a port's ingress
+    rule-del DEV PREF                    remove one rule
+    rule-list DEV [--stats]              dump rules as the kernel holds
+                                         them, with live counters
+    rule-flush DEV                       remove every programmed rule
     watch [--interval S] [--count N]     stream device-inventory changes
     events [--agent-socket P] [--count N]  tail the cp-agent event plane
                                          (health_change / reset frames)
@@ -332,6 +339,42 @@ def cmd_watch(args, chan):
             remaining -= 1
 
 
+def cmd_rule_add(args, chan):
+    """Program one match-action rule (p4rt-ctl's table-add role; the
+    rule model and its nf_tables expression-program translation live in
+    vsp/flow_table.py, the raw-netlink codec in cni/nftnl.py)."""
+    from .vsp.flow_table import FlowRule, FlowTable
+
+    rule = FlowRule(
+        pref=args.pref, action=args.action,
+        src_mac=args.src_mac, dst_mac=args.dst_mac, proto=args.proto,
+        src_ip=args.src_ip, dst_ip=args.dst_ip,
+        src_port=args.src_port, dst_port=args.dst_port,
+    )
+    FlowTable(args.dev).add(rule)
+    print(json.dumps({"added": {"dev": args.dev, "pref": args.pref,
+                                "action": args.action}}))
+
+
+def cmd_rule_del(args, chan):
+    from .vsp.flow_table import FlowTable
+
+    FlowTable(args.dev).delete(args.pref)
+    print(json.dumps({"deleted": {"dev": args.dev, "pref": args.pref}}))
+
+
+def cmd_rule_list(args, chan):
+    from .vsp.flow_table import FlowTable
+
+    print(json.dumps(FlowTable(args.dev).list(stats=args.stats), indent=2))
+
+
+def cmd_rule_flush(args, chan):
+    from .vsp.flow_table import FlowTable
+
+    print(json.dumps({"flushed": FlowTable(args.dev).flush()}))
+
+
 def cmd_events(args, chan):
     """Stream the native cp-agent's pushed events as JSON lines: the
     baseline frame, then health_change / reset frames as they happen —
@@ -390,6 +433,22 @@ def main(argv=None) -> int:
     p = sub.add_parser("watch"); p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--count", type=int, default=None)
     p.set_defaults(fn=cmd_watch)
+    p = sub.add_parser("rule-add"); p.add_argument("dev")
+    p.add_argument("--pref", type=int, required=True)
+    p.add_argument("--action", required=True,
+                   help="drop | accept | redirect:<dev> | mirror:<dev> | police:<mbit>")
+    p.add_argument("--src-mac"); p.add_argument("--dst-mac")
+    p.add_argument("--proto", choices=["tcp", "udp", "icmp", "sctp"])
+    p.add_argument("--src-ip"); p.add_argument("--dst-ip")
+    p.add_argument("--src-port", type=int); p.add_argument("--dst-port", type=int)
+    p.set_defaults(fn=cmd_rule_add, no_chan=True)
+    p = sub.add_parser("rule-del"); p.add_argument("dev")
+    p.add_argument("pref", type=int); p.set_defaults(fn=cmd_rule_del, no_chan=True)
+    p = sub.add_parser("rule-list"); p.add_argument("dev")
+    p.add_argument("--stats", action="store_true")
+    p.set_defaults(fn=cmd_rule_list, no_chan=True)
+    p = sub.add_parser("rule-flush"); p.add_argument("dev")
+    p.set_defaults(fn=cmd_rule_flush, no_chan=True)
     p = sub.add_parser("events"); p.add_argument("--agent-socket", default=None)
     p.add_argument("--count", type=int, default=None)
     p.set_defaults(fn=cmd_events, no_chan=True)  # agent socket, not gRPC
@@ -400,6 +459,18 @@ def main(argv=None) -> int:
         args.fn(args, chan)
     except grpc.RpcError as e:
         print(json.dumps({"error": e.code().name, "details": e.details()}), file=sys.stderr)
+        return 1
+    except Exception as e:
+        # Expected rule/table errors get CLI-grade reporting; anything
+        # else keeps its traceback (hiding a genuine bug's file/line
+        # behind a one-liner would hurt every other subcommand).
+        from .cni.nftnl import NftError
+        from .vsp.flow_table import FlowError
+
+        if not isinstance(e, (FlowError, NftError)):
+            raise
+        print(json.dumps({"error": type(e).__name__, "details": str(e)}),
+              file=sys.stderr)
         return 1
     finally:
         if chan is not None:

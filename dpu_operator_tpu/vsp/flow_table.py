@@ -1,0 +1,244 @@
+"""Match-action flow table for the fabric dataplane.
+
+The role P4Runtime tables play for the Intel VSP (cmd/intelvsp/p4rt-ctl
+programs match-action entries — set-pipe, table add/del/dump — into the
+FXP pipeline via infrap4d; p4rtclient.go:612-939 builds phy-port/host-VF/
+NF rule sets) and OVS flows play for Marvell (main.go:515-588): a
+programmable per-port rule table that classifies fabric traffic and
+applies an action.
+
+Backend: the kernel's own nf_tables engine, programmed over raw netlink
+(cni/nftnl.py) — no `nft`, no `tc` classifier modules, no OVS/P4
+userspace anywhere. Each bridge port gets a netdev-family ingress chain;
+rules are nft expression programs (ethertype/proto/ip/port loads + cmp,
+counter, verdict/fwd/dup/limit). The kernel is the single source of
+truth: `list()` dumps rules back out of it — the operator's rule spec
+rides in NFTA_RULE_USERDATA (the nft CLI's comment slot) and the
+packet/byte counters come live from the counter expression, the
+counter-read surface p4rt-ctl exposes.
+
+Rule model:
+    pref       — evaluation order (lower first); unique per port.
+    match      — any of src_mac/dst_mac, proto (tcp/udp/icmp/sctp),
+                 src_ip/dst_ip (CIDR ok), src_port/dst_port.
+    action     — drop | accept | redirect:<dev> | mirror:<dev>
+                 | police:<mbit>
+
+`accept` terminates the chain (exempts the flow from later rules);
+`mirror` duplicates to the target and CONTINUES, so a broader rule
+below it still applies — the classic tap semantics.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import logging
+import re
+import socket as socketlib
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..cni import nftnl
+
+log = logging.getLogger(__name__)
+
+TABLE = "dpu_fabric"
+MAX_PREF = 32000
+_PROTOS = {"tcp": 6, "udp": 17, "icmp": 1, "sctp": 132}
+_MAC_RE = re.compile(r"^[0-9a-f]{2}(:[0-9a-f]{2}){5}$", re.IGNORECASE)
+
+
+class FlowError(RuntimeError):
+    pass
+
+
+@dataclass
+class FlowRule:
+    pref: int
+    action: str
+    src_mac: Optional[str] = None
+    dst_mac: Optional[str] = None
+    proto: Optional[str] = None
+    src_ip: Optional[str] = None
+    dst_ip: Optional[str] = None
+    src_port: Optional[int] = None
+    dst_port: Optional[int] = None
+
+    def validate(self) -> None:
+        if not 1 <= self.pref <= MAX_PREF:
+            raise FlowError(f"pref {self.pref} outside [1, {MAX_PREF}]")
+        for name, mac in (("src_mac", self.src_mac), ("dst_mac", self.dst_mac)):
+            if mac is not None and not _MAC_RE.match(mac):
+                raise FlowError(f"{name} {mac!r} is not a MAC address")
+        if self.proto is not None and self.proto not in _PROTOS:
+            raise FlowError(f"proto {self.proto!r} not one of {sorted(_PROTOS)}")
+        for name, cidr in (("src_ip", self.src_ip), ("dst_ip", self.dst_ip)):
+            if cidr is not None:
+                try:
+                    net = ipaddress.ip_network(cidr, strict=False)
+                    if net.version != 4:
+                        raise FlowError(f"{name}: only IPv4 matches supported")
+                except ValueError as e:
+                    raise FlowError(f"{name} {cidr!r}: {e}") from e
+        for name, port in (("src_port", self.src_port), ("dst_port", self.dst_port)):
+            if port is not None:
+                if self.proto not in ("tcp", "udp", "sctp"):
+                    raise FlowError(f"{name} requires proto tcp/udp/sctp")
+                if not 0 < port < 65536:
+                    raise FlowError(f"{name} {port} outside [1, 65535]")
+        kind = self.action.split(":", 1)[0]
+        if kind in ("redirect", "mirror"):
+            if ":" not in self.action or not self.action.split(":", 1)[1]:
+                raise FlowError(f"{kind} action needs a device: {kind}:<dev>")
+        elif kind == "police":
+            import math
+
+            try:
+                mbit = float(self.action.split(":", 1)[1])
+                if not math.isfinite(mbit) or mbit <= 0:
+                    raise ValueError
+            except (IndexError, ValueError):
+                raise FlowError("police action needs a positive finite mbit "
+                                "rate: police:<mbit>") from None
+        elif kind not in ("drop", "accept"):
+            raise FlowError(
+                f"action {self.action!r} not drop/accept/redirect:<dev>/"
+                "mirror:<dev>/police:<mbit>")
+
+    # -- nft expression program ---------------------------------------------
+
+    def _needs_ip(self) -> bool:
+        return any((self.proto, self.src_ip, self.dst_ip,
+                    self.src_port, self.dst_port))
+
+    def to_nft_exprs(self) -> List[bytes]:
+        """The rule as an nf_tables expression program: loads + compares
+        narrowing the match, then counter, then the action."""
+        self.validate()
+        n = nftnl
+        exprs: List[bytes] = []
+        if self.src_mac:
+            exprs += [n.payload_load(n.NFT_PAYLOAD_LL_HEADER, 6, 6),
+                      n.cmp_eq(bytes.fromhex(self.src_mac.replace(":", "")))]
+        if self.dst_mac:
+            exprs += [n.payload_load(n.NFT_PAYLOAD_LL_HEADER, 0, 6),
+                      n.cmp_eq(bytes.fromhex(self.dst_mac.replace(":", "")))]
+        if self._needs_ip():
+            # Ethertype guard: network/transport loads are meaningless on
+            # non-IPv4 frames (ARP would otherwise false-match).
+            exprs += [n.payload_load(n.NFT_PAYLOAD_LL_HEADER, 12, 2),
+                      n.cmp_eq(b"\x08\x00")]
+        if self.proto:
+            exprs += [n.payload_load(n.NFT_PAYLOAD_NETWORK_HEADER, 9, 1),
+                      n.cmp_eq(bytes([_PROTOS[self.proto]]))]
+        for cidr, offset in ((self.src_ip, 12), (self.dst_ip, 16)):
+            if not cidr:
+                continue
+            net = ipaddress.ip_network(cidr, strict=False)
+            exprs.append(n.payload_load(n.NFT_PAYLOAD_NETWORK_HEADER, offset, 4))
+            if net.prefixlen < 32:
+                exprs.append(n.bitwise_mask(4, net.netmask.packed))
+            exprs.append(n.cmp_eq(net.network_address.packed))
+        for port, offset in ((self.src_port, 0), (self.dst_port, 2)):
+            if port is None:
+                continue
+            exprs += [n.payload_load(n.NFT_PAYLOAD_TRANSPORT_HEADER, offset, 2),
+                      n.cmp_eq(struct.pack(">H", port))]
+        exprs.append(n.counter())
+        kind, _, arg = self.action.partition(":")
+        if kind == "drop":
+            exprs.append(n.verdict(n.NF_DROP))
+        elif kind == "accept":
+            exprs.append(n.verdict(n.NF_ACCEPT))
+        elif kind == "redirect":
+            exprs += n.fwd_to(arg)
+        elif kind == "mirror":
+            exprs += n.dup_to(arg)  # continues: tap, not teleport
+        elif kind == "police":
+            exprs += [n.limit_over_mbit(float(arg)), n.verdict(n.NF_DROP)]
+        return exprs
+
+    def spec(self) -> Dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+class FlowTable:
+    """Rule programming + readback for one netdev's ingress hook."""
+
+    def __init__(self, dev: str):
+        self.dev = dev
+        try:
+            socketlib.if_nametoindex(dev)
+        except OSError as e:
+            raise FlowError(f"no such netdev {dev}") from e
+
+    def _chain(self) -> str:
+        return self.dev  # one ingress chain per port, named after it
+
+    def _our_rules(self, nft: "nftnl.Nft") -> List[Dict]:
+        """Kernel rules carrying our userdata spec, in evaluation order;
+        foreign rules (no parseable spec) are left alone everywhere."""
+        out = []
+        for r in nft.dump_rules(TABLE, self._chain()):
+            try:
+                spec = json.loads(r["userdata"].decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if not isinstance(spec, dict) or "pref" not in spec:
+                continue  # foreign userdata that merely parses as JSON
+            out.append({**spec, "handle": r["handle"],
+                        "packets": r.get("packets"), "bytes": r.get("bytes")})
+        return out
+
+    def add(self, rule: FlowRule) -> None:
+        exprs = rule.to_nft_exprs()  # validates first
+        with nftnl.Nft() as nft:
+            existing = self._our_rules(nft)
+            if any(r["pref"] == rule.pref for r in existing):
+                raise FlowError(
+                    f"pref {rule.pref} already programmed on {self.dev}")
+            nft.ensure_table(TABLE)
+            nft.ensure_ingress_chain(TABLE, self._chain(), self.dev)
+            # Evaluation order IS list order: insert before the first
+            # rule with a higher pref, else append.
+            before = next((r["handle"] for r in existing
+                           if r["pref"] > rule.pref), None)
+            try:
+                nft.add_rule(TABLE, self._chain(), exprs,
+                             userdata=json.dumps(rule.spec()).encode(),
+                             before_handle=before)
+            except nftnl.NftError as e:
+                raise FlowError(f"rule add on {self.dev}: {e}") from e
+
+    def delete(self, pref: int) -> None:
+        with nftnl.Nft() as nft:
+            match = [r for r in self._our_rules(nft) if r["pref"] == pref]
+            if not match:
+                raise FlowError(f"no rule pref {pref} on {self.dev}")
+            nft.delete_rule(TABLE, self._chain(), match[0]["handle"])
+
+    def flush(self) -> int:
+        """Remove every rule WE programmed (foreign rules survive); the
+        per-port chain is dropped when it ends up empty."""
+        with nftnl.Nft() as nft:
+            ours = self._our_rules(nft)
+            nft.delete_rules(TABLE, self._chain(),
+                             [r["handle"] for r in ours])
+            if ours and not nft.dump_rules(TABLE, self._chain()):
+                nft.delete_chain(TABLE, self._chain())
+            return len(ours)
+
+    def list(self, stats: bool = False) -> List[Dict]:
+        """Rules as the KERNEL holds them, in evaluation order, with live
+        packet/byte counters when stats=True."""
+        with nftnl.Nft() as nft:
+            rules = []
+            for r in self._our_rules(nft):
+                r.pop("handle")
+                if not stats:
+                    r.pop("packets", None)
+                    r.pop("bytes", None)
+                rules.append(r)
+            return rules
