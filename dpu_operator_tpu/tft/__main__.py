@@ -110,13 +110,26 @@ def main(argv=None) -> int:
     ap.add_argument("config")
     ap.add_argument("--duration", type=float, default=None)
     ap.add_argument("--self-contained", action="store_true")
+    ap.add_argument("--case-matrix", action="store_true",
+                    help="run the numbered endpoint-topology case matrix "
+                         "(config test_cases / --cases selection)")
+    ap.add_argument("--cases", default=None,
+                    help='case selection override, e.g. "1-9,15-19"')
     ap.add_argument("--server-netns")
     ap.add_argument("--client-netns")
     ap.add_argument("--server-ip")
     args = ap.parse_args(argv)
+    if args.cases and not args.case_matrix:
+        ap.error("--cases only selects topologies in --case-matrix mode")
 
     tests = load_config(args.config)
-    if args.self_contained:
+    if args.case_matrix:
+        from .tft import run_case_matrix
+
+        results = run_case_matrix(
+            tests, duration_override=args.duration,
+            cases_override=args.cases)
+    elif args.self_contained:
         results = _self_contained_run(tests, args.duration)
     else:
         if not args.server_ip:

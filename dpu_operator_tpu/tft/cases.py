@@ -1,0 +1,239 @@
+"""Numbered traffic-flow test cases — the endpoint-topology matrix.
+
+The reference's config selects cases by number with a range grammar
+(`test_cases: "1"`, "1-9,15-19" — /root/reference/hack/cluster-configs/
+ocp-tft-config.yaml:4-5) against the kubernetes-traffic-flow-tests
+matrix of {pod, host} × {pod, host, clusterIP, nodePort} × {same node,
+different node} endpoints. This module carries that numbering and maps
+each case onto a locally-realisable topology:
+
+  * pod endpoints    — a network namespace attached to the fabric bridge
+  * host endpoints   — the node's root namespace, addressed on the
+                       bridge device itself (how a host reaches the
+                       fabric without a pod sandbox)
+  * same node        — one bridge
+  * different node   — two bridges joined by a veth uplink pair, the
+                       two-"node" fabric emulation (same L2 domain, the
+                       flat-ICI shape; traffic really crosses
+                       bridge A -> uplink -> bridge B)
+  * clusterIP/nodePort/external cases — need a cluster service plane (or
+    an off-fabric external host); reported as SKIPPED with the reason,
+    never silently dropped.
+
+The case grammar parser accepts exactly the reference's forms:
+"1", "1,3,17", "1-9,15-19".
+"""
+
+from __future__ import annotations
+
+import subprocess
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+# (case id) -> (name, client_kind, server_kind, same_node) or an
+# unsupported-locally reason. Numbering follows the upstream
+# kubernetes-traffic-flow-tests TestCaseType convention the reference
+# selects from ("1-9,15-19" supported there).
+_CLUSTER = "needs a cluster service plane (clusterIP/nodePort) — run on a real cluster via make kind-test"
+_EXTERNAL = "needs an off-fabric external host — covered by tests/test_e2e.py external scenarios"
+
+CASES = {
+    1: ("pod-to-pod-same-node", "pod", "pod", True),
+    2: ("pod-to-pod-diff-node", "pod", "pod", False),
+    3: ("pod-to-host-same-node", "pod", "host", True),
+    4: ("pod-to-host-diff-node", "pod", "host", False),
+    5: ("pod-to-clusterip-to-pod-same-node", _CLUSTER),
+    6: ("pod-to-clusterip-to-pod-diff-node", _CLUSTER),
+    7: ("pod-to-clusterip-to-host-same-node", _CLUSTER),
+    8: ("pod-to-clusterip-to-host-diff-node", _CLUSTER),
+    9: ("pod-to-nodeport-to-pod-same-node", _CLUSTER),
+    10: ("pod-to-nodeport-to-pod-diff-node", _CLUSTER),
+    11: ("pod-to-nodeport-to-host-same-node", _CLUSTER),
+    12: ("pod-to-nodeport-to-host-diff-node", _CLUSTER),
+    13: ("pod-to-nodeport-to-host-same-node-v6", _CLUSTER),
+    14: ("pod-to-nodeport-to-host-diff-node-v6", _CLUSTER),
+    15: ("host-to-host-same-node", "host", "host", True),
+    16: ("host-to-host-diff-node", "host", "host", False),
+    17: ("host-to-pod-same-node", "host", "pod", True),
+    18: ("host-to-pod-diff-node", "host", "pod", False),
+    19: ("host-to-clusterip-to-pod-same-node", _CLUSTER),
+    20: ("host-to-clusterip-to-pod-diff-node", _CLUSTER),
+    21: ("host-to-clusterip-to-host-same-node", _CLUSTER),
+    22: ("host-to-clusterip-to-host-diff-node", _CLUSTER),
+    23: ("host-to-nodeport-to-pod-same-node", _CLUSTER),
+    24: ("host-to-nodeport-to-pod-diff-node", _CLUSTER),
+    25: ("pod-to-external", _EXTERNAL),
+    26: ("host-to-external", _EXTERNAL),
+}
+
+
+def parse_cases(spec: str) -> List[int]:
+    """The reference's selection grammar: '1', '1,3,17', '1-9,15-19'."""
+    out: List[int] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo_s, _, hi_s = part.partition("-")
+            lo, hi = int(lo_s), int(hi_s)
+            if lo > hi:
+                raise ValueError(f"test_cases range {part!r}: {lo} > {hi}")
+            out.extend(range(lo, hi + 1))
+        else:
+            out.append(int(part))
+    unknown = [c for c in out if c not in CASES]
+    if unknown:
+        raise ValueError(f"unknown test case id(s) {unknown}; known: 1-26")
+    if not out:
+        # A perf matrix silently measuring nothing is the worst outcome.
+        raise ValueError(f"test_cases {spec!r} selects no cases")
+    # De-dup preserving order.
+    seen: set = set()
+    return [c for c in out if not (c in seen or seen.add(c))]
+
+
+def case_reason(case_id: int) -> Optional[str]:
+    """The skip reason for locally-unsupported cases, else None."""
+    entry = CASES[case_id]
+    return entry[1] if len(entry) == 2 else None
+
+
+@dataclass
+class CaseTopology:
+    """Built endpoints for one case: netns of None means the root
+    namespace (host endpoint)."""
+    case_id: int
+    name: str
+    client_netns: Optional[str]
+    server_netns: Optional[str]
+    server_ip: str
+    _cleanups: List[Callable[[], None]] = field(default_factory=list)
+
+    def cleanup(self) -> None:
+        for fn in reversed(self._cleanups):
+            try:
+                fn()
+            except Exception:
+                pass
+
+
+def _run(args: List[str]) -> None:
+    subprocess.run(args, check=True, capture_output=True)
+
+
+def _fabric_mtu() -> int:
+    """Case topologies carry the same frame-size policy the shipped
+    dataplane applies (utils/mtu.py) — a 1500-byte test topology would
+    measure a fabric the CNI never builds."""
+    from ..utils.mtu import resolve_fabric_mtu
+
+    return resolve_fabric_mtu()
+
+
+def _pod(ns: str, host_if: str, pod_if: str, bridge: str, ip: str,
+         cleanups: List, mtu: int) -> None:
+    _run(["ip", "netns", "add", ns])
+    cleanups.append(lambda: subprocess.run(
+        ["ip", "netns", "del", ns], capture_output=True))
+    _run(["ip", "link", "add", host_if, "mtu", str(mtu),
+          "type", "veth", "peer", "name", pod_if, "mtu", str(mtu)])
+    _run(["ip", "link", "set", pod_if, "netns", ns])
+    _run(["ip", "link", "set", host_if, "master", bridge])
+    _run(["ip", "link", "set", host_if, "up"])
+    _run(["ip", "-n", ns, "link", "set", pod_if, "up"])
+    _run(["ip", "-n", ns, "addr", "add", f"{ip}/24", "dev", pod_if])
+
+
+def build_case_topology(case_id: int) -> CaseTopology:
+    """Stand up the case's endpoint topology with a unique name tag;
+    raises ValueError for locally-unsupported cases (use case_reason
+    first to report a skip instead)."""
+    reason = case_reason(case_id)
+    if reason is not None:
+        raise ValueError(f"case {case_id} unsupported locally: {reason}")
+    name, client_kind, server_kind, same_node = CASES[case_id]
+    tag = uuid.uuid4().hex[:5]
+    cleanups: List = []
+    try:
+        return _build(case_id, name, client_kind, server_kind, same_node,
+                      tag, cleanups)
+    except Exception:
+        # A half-built topology must not leak bridges/netns on the host.
+        for fn in reversed(cleanups):
+            try:
+                fn()
+            except Exception:
+                pass
+        raise
+
+
+def _build(case_id: int, name: str, client_kind: str, server_kind: str,
+           same_node: bool, tag: str, cleanups: List) -> CaseTopology:
+    mtu = _fabric_mtu()
+
+    bridge_a = "bta" + tag
+    _run(["ip", "link", "add", bridge_a, "mtu", str(mtu), "type", "bridge"])
+    cleanups.append(lambda: subprocess.run(
+        ["ip", "link", "del", bridge_a], capture_output=True))
+    _run(["ip", "link", "set", bridge_a, "up"])
+
+    if same_node:
+        bridge_b = bridge_a
+    else:
+        # "Node B" = a second bridge, fabric-linked to node A by a veth
+        # uplink pair — cross-node traffic really transits both bridges.
+        bridge_b = "btb" + tag
+        _run(["ip", "link", "add", bridge_b, "mtu", str(mtu),
+              "type", "bridge"])
+        cleanups.append(lambda: subprocess.run(
+            ["ip", "link", "del", bridge_b], capture_output=True))
+        _run(["ip", "link", "set", bridge_b, "up"])
+        up_a, up_b = "bua" + tag, "bub" + tag
+        _run(["ip", "link", "add", up_a, "mtu", str(mtu),
+              "type", "veth", "peer", "name", up_b, "mtu", str(mtu)])
+        cleanups.append(lambda: subprocess.run(
+            ["ip", "link", "del", up_a], capture_output=True))
+        _run(["ip", "link", "set", up_a, "master", bridge_a])
+        _run(["ip", "link", "set", up_b, "master", bridge_b])
+        _run(["ip", "link", "set", up_a, "up"])
+        _run(["ip", "link", "set", up_b, "up"])
+
+    # Address plan: hosts .1/.2, pods .11/.12 — one flat /24, the
+    # flat-ICI L2 shape.
+    endpoints = {}  # role -> (netns or None, ip)
+    for role, kind, bridge, host_ip, pod_ip, idx in (
+        ("client", client_kind, bridge_a, "10.94.0.1", "10.94.0.11", 0),
+        ("server", server_kind, bridge_b, "10.94.0.2", "10.94.0.12", 1),
+    ):
+        if kind == "host" and role == "server" and not same_node:
+            # "Node B's root namespace": a host endpoint in the SAME
+            # (test-runner) netns as the client would satisfy the local
+            # route table and short-circuit over loopback, never touching
+            # the fabric. A remote node's root ns is a different ns, so
+            # model it as one — its fabric interface rides bridge B.
+            ns = f"tn{idx}{tag}"
+            _pod(ns, f"th{idx}{tag}", f"tp{idx}{tag}", bridge, host_ip,
+                 cleanups, mtu)
+            endpoints[role] = (ns, host_ip)
+        elif kind == "host":
+            _run(["ip", "addr", "add", f"{host_ip}/24", "dev", bridge])
+            cleanups.append(lambda b=bridge, ip=host_ip: subprocess.run(
+                ["ip", "addr", "del", f"{ip}/24", "dev", b],
+                capture_output=True))
+            endpoints[role] = (None, host_ip)
+        else:
+            ns = f"tc{idx}{tag}"
+            _pod(ns, f"th{idx}{tag}", f"tp{idx}{tag}", bridge, pod_ip,
+                 cleanups, mtu)
+            endpoints[role] = (ns, pod_ip)
+
+    return CaseTopology(
+        case_id=case_id,
+        name=name,
+        client_netns=endpoints["client"][0],
+        server_netns=endpoints["server"][0],
+        server_ip=endpoints["server"][1],
+        _cleanups=cleanups,
+    )

@@ -15,7 +15,7 @@ import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -49,6 +49,10 @@ class TestSpec:
     duration: float = 30.0
     connections: List[ConnectionSpec] = field(default_factory=list)
     secondary_network_nad: str = "default-ici-net"
+    # Case selection, reference grammar ("1", "1-9,15-19") — consumed by
+    # run_case_matrix; plain run_suite measures whatever endpoints the
+    # caller built (the self-contained CNI-backed pair).
+    test_cases: str = "1"
 
 
 def load_config(path: str) -> List[TestSpec]:
@@ -76,6 +80,7 @@ def load_config(path: str) -> List[TestSpec]:
                 duration=float(t.get("duration", 30)),
                 connections=conns,
                 secondary_network_nad=nad or "default-ici-net",
+                test_cases=str(t.get("test_cases", "1")),
             )
         )
     return tests
@@ -129,6 +134,34 @@ def run_connection(
     return {"connection": conn.name, "type": conn.type, **result}
 
 
+def _run_test_connections(
+    t: TestSpec,
+    server_netns: Optional[str],
+    client_netns: Optional[str],
+    server_ip: str,
+    duration_override: Optional[float],
+    port: int,
+    tags: Optional[Dict] = None,
+) -> Tuple[List[dict], int]:
+    """One test's connections × instances against one endpoint pair —
+    the execution loop run_suite and run_case_matrix share. Returns
+    (results, next free port)."""
+    results = []
+    label = (" ".join(f"{k}={v}" for k, v in tags.items()) + " ") if tags else ""
+    for conn in t.connections:
+        for i in range(conn.instances):
+            port += 1
+            d = duration_override if duration_override is not None else t.duration
+            log.info("tft: %s%s / %s instance %d (%.1fs)",
+                     label, t.name, conn.name, i, d)
+            r = run_connection(conn, server_netns, client_netns, server_ip, d, port)
+            r["test"] = t.name
+            if tags:
+                r.update(tags)
+            results.append(r)
+    return results, port
+
+
 def run_suite(
     tests: List[TestSpec],
     server_netns: Optional[str],
@@ -139,24 +172,60 @@ def run_suite(
     results = []
     port = BASE_PORT
     for t in tests:
-        for conn in t.connections:
-            for i in range(conn.instances):
-                port += 1
-                d = duration_override if duration_override is not None else t.duration
-                log.info("tft: %s / %s instance %d (%.1fs)", t.name, conn.name, i, d)
-                r = run_connection(conn, server_netns, client_netns, server_ip, d, port)
-                r["test"] = t.name
-                results.append(r)
+        rs, port = _run_test_connections(
+            t, server_netns, client_netns, server_ip, duration_override, port)
+        results.extend(rs)
+    return results
+
+
+def run_case_matrix(
+    tests: List[TestSpec],
+    duration_override: Optional[float] = None,
+    cases_override: Optional[str] = None,
+) -> List[dict]:
+    """Run each test's connection list over every selected numbered case
+    topology (tft/cases.py). Locally-unsupported cases are reported as
+    skipped entries with the reason — selection is never silently
+    narrowed."""
+    from .cases import CASES, build_case_topology, case_reason, parse_cases
+
+    results = []
+    port = BASE_PORT + 500  # clear of run_suite's range
+    for t in tests:
+        for cid in parse_cases(cases_override or t.test_cases):
+            case_name = CASES[cid][0]
+            reason = case_reason(cid)
+            if reason is not None:
+                log.info("tft: case %d (%s) skipped: %s", cid, case_name, reason)
+                results.append({
+                    "test": t.name, "case": cid, "case_name": case_name,
+                    "skipped": reason,
+                })
+                continue
+            topo = build_case_topology(cid)
+            try:
+                rs, port = _run_test_connections(
+                    t, topo.server_netns, topo.client_netns, topo.server_ip,
+                    duration_override, port,
+                    tags={"case": cid, "case_name": case_name})
+                results.extend(rs)
+            finally:
+                topo.cleanup()
     return results
 
 
 def print_results(results: List[dict], file=None) -> None:
     file = file or sys.stdout
     for r in results:
+        case = f' case {r["case"]:>2} {r["case_name"]:<26}' if "case" in r else ""
         if "gbps" in r:
-            line = f'{r["test"]:<10} {r["connection"]:<14} {r["type"]:<20} {r["gbps"]:>9.3f} Gbps'
+            line = (f'{r["test"]:<10}{case} {r["connection"]:<14} '
+                    f'{r["type"]:<20} {r["gbps"]:>9.3f} Gbps')
         elif "tps" in r:
-            line = f'{r["test"]:<10} {r["connection"]:<14} {r["type"]:<20} {r["tps"]:>9.1f} tps'
+            line = (f'{r["test"]:<10}{case} {r["connection"]:<14} '
+                    f'{r["type"]:<20} {r["tps"]:>9.1f} tps')
+        elif "skipped" in r:
+            line = f'{r["test"]:<10}{case} SKIPPED: {r["skipped"]}'
         else:
             line = json.dumps(r)
         print(line, file=file)

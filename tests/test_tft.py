@@ -177,3 +177,85 @@ def test_native_pump_preferred_and_tagged(tmp_path):
     srv_res, cli_res = run_pair({"TFT_PUMP": "python"})
     assert srv_res["engine"] == "python" and cli_res["engine"] == "python"
     assert cli_res["transactions"] > 0
+
+
+# -- numbered case matrix (tft/cases.py) --------------------------------------
+
+
+def test_case_selection_grammar():
+    """The reference's selection grammar: single ids, lists, ranges —
+    and loud failure on junk (a typo'd case silently not running is the
+    worst outcome for a perf matrix)."""
+    from dpu_operator_tpu.tft.cases import parse_cases
+
+    assert parse_cases("1") == [1]
+    assert parse_cases("1,3,17") == [1, 3, 17]
+    assert parse_cases("1-4,15-19") == [1, 2, 3, 4, 15, 16, 17, 18, 19]
+    assert parse_cases("2,1-3") == [2, 1, 3]  # dedup, order-preserving
+    with pytest.raises(ValueError, match="unknown test case"):
+        parse_cases("99")
+    with pytest.raises(ValueError, match="> "):
+        parse_cases("9-1")
+    with pytest.raises(ValueError):
+        parse_cases("banana")
+
+
+def test_case_table_covers_reference_range():
+    """Every id in the reference's advertised '1-9,15-19' selection must
+    resolve — supported locally or carrying an explicit skip reason."""
+    from dpu_operator_tpu.tft.cases import CASES, case_reason, parse_cases
+
+    for cid in parse_cases("1-9,15-19"):
+        assert cid in CASES
+        entry = CASES[cid]
+        if case_reason(cid) is None:
+            assert entry[1] in ("pod", "host") and entry[2] in ("pod", "host")
+
+
+def test_case_matrix_topologies_carry_traffic(netns):
+    """Root tier: the four endpoint-topology shapes actually carry
+    engine traffic — pod/pod same node, pod/pod across the two-bridge
+    fabric, host-to-pod, and host-to-host across nodes (which must NOT
+    short-circuit over loopback: server host lives in node B's netns)."""
+    from dpu_operator_tpu.tft import ConnectionSpec, TestSpec
+    from dpu_operator_tpu.tft.tft import run_case_matrix
+
+    spec = TestSpec(
+        name="matrix", duration=0.5,
+        connections=[ConnectionSpec(name="c", type="iperf-tcp")],
+        test_cases="1,2,5,16,17",
+    )
+    results = run_case_matrix([spec])
+    by_case = {r["case"]: r for r in results}
+    assert set(by_case) == {1, 2, 5, 16, 17}
+    for cid in (1, 2, 16, 17):
+        assert by_case[cid]["gbps"] > 0, by_case[cid]
+        assert by_case[cid]["case_name"]
+    # ClusterIP case: reported as skipped with the reason, not dropped.
+    assert "skipped" in by_case[5] and "service plane" in by_case[5]["skipped"]
+    # Nothing leaked: no bta/btb bridges or tc/tn netns remain.
+    links = subprocess.run(["ip", "-o", "link"], capture_output=True,
+                           text=True).stdout
+    assert "bta" not in links and "btb" not in links
+
+
+def test_empty_case_selection_is_loud():
+    from dpu_operator_tpu.tft.cases import parse_cases
+
+    with pytest.raises(ValueError, match="selects no cases"):
+        parse_cases("")
+    with pytest.raises(ValueError, match="selects no cases"):
+        parse_cases(" , ")
+
+
+def test_cases_flag_requires_case_matrix_mode(tmp_path):
+    """--cases without --case-matrix must error, not silently run the
+    self-contained pair instead of the requested topologies."""
+    from dpu_operator_tpu.tft.__main__ import main
+
+    cfg = tmp_path / "t.yaml"
+    cfg.write_text("tft:\n  - name: t\n    connections:\n"
+                   "      - name: c\n        type: iperf-tcp\n")
+    with pytest.raises(SystemExit) as e:
+        main([str(cfg), "--self-contained", "--cases", "1-4"])
+    assert e.value.code == 2
