@@ -1,0 +1,324 @@
+"""Collective matmul — communication overlapped behind the MXU.
+
+The scaling-book's flagship distributed-kernel pattern (jax-ml.github.io
+/scaling-book, "sharded matmuls"; no reference-repo analogue — the
+reference is an operator, this is the TPU-native compute path the
+operator exists to serve): when a matmul needs a collective on one side,
+DECOMPOSE the collective into its ring steps and compute each step's
+block while the next block's transfer is in flight, so ICI time hides
+behind MXU time instead of serialising with it.
+
+Two canonical forms:
+
+  * `make_allgather_matmul` — Y[B, F/n] = AllGather_B(X[B/n, K]) @ W[K, F/n]
+    (the sequence-parallel -> tensor-parallel boundary: activations
+    gathered over the batch/sequence axis against feature-sharded
+    weights). Ring: each step matmuls the block in hand while RDMAing it
+    onward.
+  * `make_matmul_reduce_scatter` — Y[B/n, F] = ReduceScatter_B(
+    X[B, K/n] @ W[K/n, F]) (the reverse boundary: contraction-sharded
+    partials summed and re-sharded). Ring: each step computes ONLY the
+    row-block it is about to send, accumulating arrivals en route —
+    compute is sliced into the ring instead of done up front.
+
+Backend selection matches ring_probe.py: pallas RDMA kernels on real
+multi-chip TPU meshes (the ring machinery — MESH addressing, neighbour
+barrier, credit-gated double buffering — is shared with
+`ring_probe._ring_kernel`/`_rs_kernel`); XLA collectives elsewhere. The
+XLA overlapped path expresses the same decomposition with `ppermute`
+inside the loop, which XLA's async collective-permute + latency-hiding
+scheduler overlap on TPU; `overlap=False` gives the naive
+gather-then-matmul for A/B comparison."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .ring_probe import (
+    _axis_collective,
+    _ring_ids,
+    _run_ring_stream,
+    _run_rs_ring,
+)
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+# -- all-gather x matmul -----------------------------------------------------
+
+
+def _ag_mm_kernel(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    w_ref,
+    out_ref,
+    comm_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Fused ring all-gather matmul: `_run_ring_stream`'s transfer
+    protocol (shared with the plain all-gather — slots, credits,
+    addressing) with a matmul consumer. The runner issues consume()
+    BETWEEN rdma.start() and rdma.wait(), so each block's MXU work runs
+    while that block is in flight; reading the send slot for the dot
+    concurrent with the send is safe — both are reads."""
+    chunk = local_ref.shape[0]
+    num_devices = out_ref.shape[0] // chunk
+
+    def consume(idx, block):
+        out_ref[pl.ds(idx * chunk, chunk)] = jnp.dot(
+            block, w_ref[:], preferred_element_type=jnp.float32
+        ).astype(out_ref.dtype)
+
+    _run_ring_stream(
+        n_axes, num_devices, consume, my_id_ref, right_ref, left_ref,
+        local_ref, comm_buf, send_sem, recv_sem, ack_sem,
+    )
+
+
+def _pallas_ag_matmul(
+    x_shard: jax.Array, w_local: jax.Array, axis: str, axis_size: int,
+    axis_names: tuple
+) -> jax.Array:
+    chunk, k = x_shard.shape
+    f = w_local.shape[1]
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, k), x_shard.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ag_mm_kernel, len(axis_names)),
+        out_shape=jax.ShapeDtypeStruct((axis_size * chunk, f), x_shard.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        x_shard,
+        w_local,
+    )
+
+
+def _xla_ag_matmul_overlapped(
+    x_shard: jax.Array, w_local: jax.Array, axis: str, axis_size: int
+) -> jax.Array:
+    """The same decomposition in XLA terms: matmul block k while
+    `ppermute` moves it to the right neighbour — XLA's async
+    collective-permute overlaps the transfer with the dot on TPU."""
+    n = axis_size
+    my_id = jax.lax.axis_index(axis)
+    chunk = x_shard.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n * chunk, w_local.shape[1]), x_shard.dtype)
+
+    def body(k, carry):
+        buf, out = carry
+        src = jax.lax.rem(my_id - k + n, n)
+        moved = jax.lax.cond(
+            k < n - 1,
+            lambda b: jax.lax.ppermute(b, axis, perm),
+            lambda b: b,
+            buf,
+        )
+        y = jnp.dot(buf, w_local, preferred_element_type=jnp.float32)
+        out = jax.lax.dynamic_update_slice(
+            out, y.astype(out.dtype), (src * chunk, 0))
+        return (moved, out)
+
+    _, out = jax.lax.fori_loop(0, axis_size, body, (x_shard, out))
+    return out
+
+
+def _xla_ag_matmul_naive(
+    x_shard: jax.Array, w_local: jax.Array, axis: str
+) -> jax.Array:
+    return jnp.dot(
+        jax.lax.all_gather(x_shard, axis, tiled=True), w_local,
+        preferred_element_type=jnp.float32,
+    ).astype(x_shard.dtype)
+
+
+def make_allgather_matmul(
+    mesh,
+    axis: str = "tp",
+    use_pallas: Optional[bool] = None,
+    overlap: bool = True,
+):
+    """jitted fn(x, w) with x:[B, K] sharded over `axis` rows and
+    w:[K, F] sharded over `axis` columns → Y:[B, F] sharded over `axis`
+    columns, Y = AllGather(x) @ w_local — the gather decomposed into
+    ring steps so each block's transfer hides behind the previous
+    block's matmul. `overlap=False` keeps the naive gather-then-matmul
+    (the A/B baseline) — it forces the XLA path, because the pallas
+    kernel is inherently overlapped and would silently measure the fused
+    schedule against itself."""
+    axis_size = mesh.shape[axis]
+    if not overlap:
+        if use_pallas:
+            raise ValueError(
+                "overlap=False has no pallas form (the kernel is "
+                "inherently overlapped); leave use_pallas unset")
+        use_pallas = False
+
+    def pallas_inner(x_shard, w_local):
+        return _pallas_ag_matmul(
+            x_shard, w_local, axis, axis_size, tuple(mesh.axis_names))
+
+    def xla_inner(x_shard, w_local):
+        if overlap and axis_size > 1:
+            return _xla_ag_matmul_overlapped(x_shard, w_local, axis, axis_size)
+        return _xla_ag_matmul_naive(x_shard, w_local, axis)
+
+    return _axis_collective(
+        mesh, axis, use_pallas, pallas_inner, xla_inner,
+        out_specs=P(None, axis),
+        in_specs=(P(axis, None), P(None, axis)),
+    )
+
+
+# -- matmul x reduce-scatter -------------------------------------------------
+
+
+def _mm_rs_kernel(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    x_ref,
+    w_ref,
+    out_ref,
+    send_buf,
+    recv_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Fused matmul reduce-scatter: `_run_rs_ring`'s protocol (shared
+    with the plain reduce-scatter) with an on-demand block-matmul
+    producer — the runner schedules produce() between rdma.start() and
+    rdma.wait(), so each block's MXU work hides behind the previous
+    block's transfer. The f32 scratch keeps the whole reduction at f32
+    like the XLA fallback (f32 dot + f32 psum_scatter); the single cast
+    happens at finish()."""
+    num_devices = x_ref.shape[0] // out_ref.shape[0]
+    chunk = out_ref.shape[0]
+
+    def produce(idx):
+        return jnp.dot(
+            x_ref[pl.ds(idx * chunk, chunk)], w_ref[:],
+            preferred_element_type=jnp.float32,
+        )
+
+    def finish(total):
+        out_ref[:] = total.astype(out_ref.dtype)
+
+    _run_rs_ring(
+        n_axes, num_devices, produce, finish, my_id_ref, right_ref,
+        left_ref, send_buf, recv_buf, send_sem, recv_sem, ack_sem,
+    )
+
+
+def _pallas_mm_rs(
+    x_local: jax.Array, w_local: jax.Array, axis: str, axis_size: int,
+    axis_names: tuple
+) -> jax.Array:
+    rows, _k = x_local.shape
+    f = w_local.shape[1]
+    if rows % axis_size != 0:
+        raise ValueError(
+            f"matmul-reduce-scatter rows {rows} must divide by axis size "
+            f"{axis_size}")
+    if axis_size == 1:
+        return jnp.dot(
+            x_local, w_local, preferred_element_type=jnp.float32
+        ).astype(x_local.dtype)
+    chunk = rows // axis_size
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            # f32 circulation: the reduction stays at f32 end to end like
+            # the XLA fallback (f32 dot + f32 psum_scatter) — bf16 inputs
+            # must not round at every one of the ring's n-1 hops.
+            pltpu.VMEM((2, chunk, f), jnp.float32),
+            pltpu.VMEM((2, chunk, f), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mm_rs_kernel, len(axis_names)),
+        out_shape=jax.ShapeDtypeStruct((chunk, f), x_local.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        x_local,
+        w_local,
+    )
+
+
+def make_matmul_reduce_scatter(
+    mesh,
+    axis: str = "tp",
+    use_pallas: Optional[bool] = None,
+):
+    """jitted fn(x, w) with x:[B, K] sharded over `axis` columns
+    (contraction) and w:[K, F] sharded over `axis` rows → Y:[B/n, F]
+    sharded over `axis` rows, Y = ReduceScatter(x_local @ w_local) —
+    the partial-sum ring with each row-block's matmul computed at its
+    ring step (the reverse boundary of `make_allgather_matmul`; composed
+    they form the classic TP pair around a feature-sharded layer)."""
+    axis_size = mesh.shape[axis]
+
+    def pallas_inner(x_local, w_local):
+        return _pallas_mm_rs(
+            x_local, w_local, axis, axis_size, tuple(mesh.axis_names))
+
+    def xla_inner(x_local, w_local):
+        y = jnp.dot(x_local, w_local, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            y, axis, scatter_dimension=0, tiled=True
+        ).astype(x_local.dtype)
+
+    return _axis_collective(
+        mesh, axis, use_pallas, pallas_inner, xla_inner,
+        out_specs=P(axis, None),
+        in_specs=(P(None, axis), P(axis, None)),
+    )

@@ -55,21 +55,32 @@ def _neighbor_barrier(left, right):
     pltpu.semaphore_wait(barrier, 2)
 
 
-def _ring_kernel(
+def _run_ring_stream(
     n_axes,
+    num_devices,
+    consume,
     my_id_ref,
     right_ref,
     left_ref,
     local_ref,
-    out_ref,
     comm_buf,
     send_sem,
     recv_sem,
     ack_sem,
 ):
-    """Per-device ring all-gather body: each step RDMAs our current slot
-    to the right neighbour while recording the chunk that arrived from
-    the left.
+    """Per-device one-way ring protocol, parameterized by `consume(idx,
+    block)` — what to do with each block as it passes through. The plain
+    all-gather's consume copies the block to its output rows
+    (`_ring_kernel`); the fused allgather-matmul's
+    (collective_matmul._ag_mm_kernel) multiplies it against the local
+    weight shard — ONE protocol body serves both, so a credit fix can
+    never land in one and miss the other.
+
+    Each step RDMAs our current slot to the right neighbour and consumes
+    the block IN HAND (the one being sent) between rdma.start() and
+    rdma.wait() — reads of the send slot are safe concurrent with the
+    send, and any MXU work in `consume` overlaps the transfer. The final
+    arrival (nothing left to send) is consumed after the loop.
 
     Neighbours are addressed with `DeviceIdType.MESH` coordinates spanning
     every mesh axis (only the ring axis differs from our own coords), so
@@ -89,21 +100,18 @@ def _ring_kernel(
     `ack_sem` to its left neighbour after rdma.wait() and waits one
     credit before every send after the first. Skew is bounded to one
     step, which double buffering absorbs."""
-    num_devices = out_ref.shape[0] // local_ref.shape[0]
-    chunk = local_ref.shape[0]
     my_id = my_id_ref[0]
     right = tuple(right_ref[i] for i in range(n_axes))
     left = tuple(left_ref[i] for i in range(n_axes))
 
     _neighbor_barrier(left, right)
 
-    out_ref[pl.ds(my_id * chunk, chunk)] = local_ref[:]
     comm_buf[0] = local_ref[:]
 
     def step_body(step, _):
         send_slot = jax.lax.rem(step, 2)
         recv_slot = jax.lax.rem(step + 1, 2)
-        src = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+        cur = jax.lax.rem(my_id - step + num_devices, num_devices)
 
         @pl.when(step > 0)
         def _wait_credit():
@@ -118,6 +126,7 @@ def _ring_kernel(
             device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
+        consume(cur, comm_buf[send_slot])
         rdma.wait()
 
         # Send from send_slot is complete: the left neighbour may reuse it
@@ -129,10 +138,39 @@ def _ring_kernel(
                 ack_sem, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.MESH
             )
 
-        out_ref[pl.ds(src * chunk, chunk)] = comm_buf[recv_slot]
         return ()
 
     jax.lax.fori_loop(0, num_devices - 1, step_body, ())
+    # Final arrival: block (my+1)%n in the last-written recv slot.
+    consume(
+        jax.lax.rem(my_id + 1, num_devices),
+        comm_buf[jax.lax.rem(num_devices - 1, 2)],
+    )
+
+
+def _ring_kernel(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    out_ref,
+    comm_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Ring all-gather: the stream protocol with a copy consumer."""
+    chunk = local_ref.shape[0]
+    num_devices = out_ref.shape[0] // chunk
+
+    def consume(idx, block):
+        out_ref[pl.ds(idx * chunk, chunk)] = block
+
+    _run_ring_stream(
+        n_axes, num_devices, consume, my_id_ref, right_ref, left_ref,
+        local_ref, comm_buf, send_sem, recv_sem, ack_sem,
+    )
 
 
 def _ring_kernel_bidir(
@@ -307,65 +345,59 @@ def _pallas_all_gather(
     )
 
 
-def _rs_kernel(
+def _run_rs_ring(
     n_axes,
+    num_devices,
+    produce,
+    finish,
     my_id_ref,
     right_ref,
     left_ref,
-    local_ref,
-    out_ref,
     send_buf,
     recv_buf,
     send_sem,
     recv_sem,
     ack_sem,
 ):
-    """Ring reduce-scatter (sum): `local_ref` is this device's full
-    [n*chunk, W] contribution; `out_ref` ends as the SUM over devices of
-    chunk `my_id`. Chunk j circulates right from device (j+1)%n,
-    accumulating each host's local chunk j en route, and lands complete
-    on device j after n-1 hops: at step k device d sends the partial for
-    chunk (d-k-1)%n (what arrived last step, plus its own contribution)
-    and receives the partial for chunk (d-k-2)%n.
+    """Ring reduce-scatter (sum) protocol, parameterized by
+    `produce(idx)` — the local contribution for row-block idx, in the
+    scratch dtype — and `finish(total)` — where the completed block
+    goes. The plain reduce-scatter's produce slices a precomputed array
+    (`_rs_kernel`); the fused matmul-reduce-scatter's
+    (collective_matmul._mm_rs_kernel) computes the block matmul on
+    demand. ONE protocol body serves both (same reason as
+    `_run_ring_stream`).
 
-    Backpressure mirrors `_ring_kernel`'s credit protocol, shifted one
-    step: our step-k RDMA lands in the right neighbour's recv slot
-    (k+1)%2, whose previous contents it consumed at its step k-1 — so
-    consumption grants a credit to the left, and sends from step 2 on
-    wait for one (step 0 targets a virgin slot; step 1's target was never
-    written)."""
-    num_devices = local_ref.shape[0] // out_ref.shape[0]
-    chunk = out_ref.shape[0]
+    Chunk j circulates right from device (j+1)%n, accumulating each
+    host's contribution en route, landing complete on device j after
+    n-1 hops. The schedule OVERLAPS produce with the transfer: step k
+    sends the accumulated block, and while the RDMA is in flight
+    computes the NEXT block's contribution into the just-freed send slot
+    (its previous send completed at step k-1); the arrival is folded in
+    after the wait. So any MXU work in `produce` hides behind ICI time.
+
+    Backpressure (`ack_sem`): our step-k RDMA lands in the right
+    neighbour's recv slot (k+1)%2, which also receives its step-(k+2)
+    arrival — the neighbour folds arrival k at the end of its step k and
+    grants the left a credit; sends from step 2 on wait for one (step
+    0's target slot is virgin; step 1's was never written). Grants stop
+    at step n-4: later folds' credits would have no consuming send."""
     my_id = my_id_ref[0]
     right = tuple(right_ref[i] for i in range(n_axes))
     left = tuple(left_ref[i] for i in range(n_axes))
 
     _neighbor_barrier(left, right)
 
-    def local_chunk(idx):
-        return local_ref[pl.ds(idx * chunk, chunk)]
-
     def step_body(step, _):
         slot = jax.lax.rem(step, 2)
         nxt = jax.lax.rem(step + 1, 2)
         send_idx = jax.lax.rem(my_id - step - 1 + 2 * num_devices, num_devices)
+        # == my_id at the final step, priming the finish() combine.
+        next_idx = jax.lax.rem(my_id - step - 2 + 2 * num_devices, num_devices)
 
         @pl.when(step == 0)
         def _first():
-            send_buf[slot] = local_chunk(send_idx)
-
-        @pl.when(step > 0)
-        def _accumulate():
-            # Consume last step's arrival; freeing recv_buf[slot] is what
-            # the credit below advertises to the left neighbour.
-            send_buf[slot] = recv_buf[slot] + local_chunk(send_idx)
-
-        @pl.when((step > 0) & (step < num_devices - 2))
-        def _grant_credit():
-            pltpu.semaphore_signal(
-                ack_sem, inc=1, device_id=left,
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
+            send_buf[slot] = produce(send_idx)
 
         @pl.when(step > 1)
         def _wait_credit():
@@ -380,12 +412,63 @@ def _rs_kernel(
             device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
+        # Overlap: the next block's contribution computes while the
+        # bytes fly. Its target slot's previous send completed at step
+        # k-1, and inbound RDMAs only touch recv_buf.
+        send_buf[nxt] = produce(next_idx)
         rdma.wait()
+
+        @pl.when(step < num_devices - 2)
+        def _fold_arrival():
+            send_buf[nxt] = send_buf[nxt] + recv_buf[nxt]
+
+        @pl.when(step < num_devices - 3)
+        def _grant_credit():
+            pltpu.semaphore_signal(
+                ack_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
         return ()
 
     jax.lax.fori_loop(0, num_devices - 1, step_body, ())
-    # Last arrival (step n-2) landed in slot (n-1)%2; our own chunk joins.
-    out_ref[:] = recv_buf[(num_devices - 1) % 2] + local_chunk(my_id)
+    # Last arrival (step n-2) landed in recv slot (n-1)%2; our own
+    # contribution was produced into send_buf[(n-1)%2] during that
+    # step's flight (next_idx == my_id there).
+    finish(
+        recv_buf[(num_devices - 1) % 2] + send_buf[(num_devices - 1) % 2]
+    )
+
+
+def _rs_kernel(
+    n_axes,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    local_ref,
+    out_ref,
+    send_buf,
+    recv_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Ring reduce-scatter over a precomputed local contribution:
+    `local_ref` is this device's full [n*chunk, W] array; `out_ref` ends
+    as the SUM over devices of chunk `my_id`."""
+    num_devices = local_ref.shape[0] // out_ref.shape[0]
+    chunk = out_ref.shape[0]
+
+    def produce(idx):
+        return local_ref[pl.ds(idx * chunk, chunk)]
+
+    def finish(total):
+        out_ref[:] = total
+
+    _run_rs_ring(
+        n_axes, num_devices, produce, finish, my_id_ref, right_ref,
+        left_ref, send_buf, recv_buf, send_sem, recv_sem, ack_sem,
+    )
 
 
 def _a2a_kernel(
@@ -603,11 +686,14 @@ def _xla_all_gather(x_shard: jax.Array, axis: str, axis_size: int) -> jax.Array:
 
 
 def _axis_collective(mesh, axis, use_pallas, pallas_inner, xla_inner,
-                     out_specs):
-    """Shared factory plumbing for every collective in this module: TPU
-    autodetection (pallas only on real multi-chip TPU meshes), then the
-    chosen per-shard body wrapped in shard_map + jit. One definition so
-    the three factories can never diverge on detection or mapping args."""
+                     out_specs, in_specs=None):
+    """Shared factory plumbing for every collective in this module (and
+    collective_matmul.py): TPU autodetection (pallas only on real
+    multi-chip TPU meshes), then the chosen per-shard body wrapped in
+    shard_map + jit. One definition so the factories can never diverge
+    on detection or mapping args. `in_specs` defaults to the single
+    axis-sharded operand the probe collectives take; two-operand fused
+    kernels pass their own tuple."""
     from jax import shard_map
 
     axis_size = mesh.shape[axis]
@@ -621,7 +707,7 @@ def _axis_collective(mesh, axis, use_pallas, pallas_inner, xla_inner,
     mapped = shard_map(
         inner,
         mesh=mesh,
-        in_specs=P(axis, None),
+        in_specs=P(axis, None) if in_specs is None else in_specs,
         out_specs=out_specs,
         check_vma=False,
     )

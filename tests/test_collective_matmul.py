@@ -1,0 +1,176 @@
+"""Collective matmul (parallel/collective_matmul.py) — the overlapped
+allgather-matmul / matmul-reduce-scatter pair. Proof standard matches
+the ring-collective family: XLA paths correct on the virtual mesh,
+pallas kernels EXECUTED under TPU interpret mode against the naive
+reference, and AOT-lowered for a multi-device TPU topology so Mosaic
+compilation is proven without multi-chip hardware."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_virtual(code: str, timeout: int = 600) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def _mesh(shape=(1, 1, 8)):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(shape),
+                axis_names=("dp", "sp", "tp"))
+
+
+def test_xla_overlapped_matches_naive():
+    """The decomposed ppermute loop computes exactly AllGather(x) @ w —
+    block placement (src indexing) and the skipped final permute are the
+    parts worth distrusting."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.collective_matmul import make_allgather_matmul
+
+    for shape, n in (((1, 1, 8), 8), ((2, 1, 4), 4), ((4, 1, 2), 2)):
+        mesh = _mesh(shape)
+        b, k, f = 2 * n, 16, 8 * n
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (b, k), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (k, f), jnp.float32)
+        xs = jax.device_put(x, NamedSharding(mesh, P("tp", None)))
+        ws = jax.device_put(w, NamedSharding(mesh, P(None, "tp")))
+        naive = make_allgather_matmul(mesh, "tp", overlap=False)(xs, ws)
+        fused = make_allgather_matmul(mesh, "tp", overlap=True)(xs, ws)
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(naive), rtol=1e-6)
+        # vs numpy: accumulation order differs (XLA blocked dot), so a
+        # handful of elements land a few ulps apart at f32.
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(x) @ np.asarray(w),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_xla_matmul_reduce_scatter_matches_reference():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.collective_matmul import (
+        make_matmul_reduce_scatter,
+    )
+
+    for shape, n in (((1, 1, 8), 8), ((2, 1, 4), 4)):
+        mesh = _mesh(shape)
+        b, k, f = 2 * n, 8 * n, 16
+        x = jax.random.normal(jax.random.PRNGKey(2), (b, k), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (k, f), jnp.float32)
+        xs = jax.device_put(x, NamedSharding(mesh, P(None, "tp")))
+        ws = jax.device_put(w, NamedSharding(mesh, P("tp", None)))
+        out = make_matmul_reduce_scatter(mesh, "tp")(xs, ws)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x) @ np.asarray(w),
+            rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_collective_matmul_interpret_mode():
+    """Both fused kernels EXECUTE under TPU interpret mode on the
+    virtual mesh and match the XLA paths — the ag-matmul's
+    compute-between-start-and-wait overlap and the mm-rs kernel's
+    on-demand partial blocks both ride the shared credit protocol, so
+    execution is the only honest check."""
+    r = _run_virtual(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "from dpu_operator_tpu.parallel.collective_matmul import (\n"
+        "    make_allgather_matmul, make_matmul_reduce_scatter)\n"
+        "with pltpu.force_tpu_interpret_mode():\n"
+        "    for shape, n in (((1, 1, 8), 8), ((2, 1, 4), 4), ((1, 4, 2), 2)):\n"
+        "        mesh = Mesh(np.array(jax.devices()).reshape(shape),\n"
+        "                    axis_names=('dp', 'sp', 'tp'))\n"
+        "        b, k, f = 2 * n, 16, 8 * n\n"
+        "        x = jax.random.normal(jax.random.PRNGKey(0), (b, k), jnp.float32)\n"
+        "        w = jax.random.normal(jax.random.PRNGKey(1), (k, f), jnp.float32)\n"
+        "        xs = jax.device_put(x, NamedSharding(mesh, P('tp', None)))\n"
+        "        ws = jax.device_put(w, NamedSharding(mesh, P(None, 'tp')))\n"
+        "        ref = np.asarray(make_allgather_matmul(mesh, 'tp',\n"
+        "              use_pallas=False, overlap=False)(xs, ws))\n"
+        "        out = np.asarray(make_allgather_matmul(mesh, 'tp',\n"
+        "              use_pallas=True)(xs, ws))\n"
+        "        np.testing.assert_allclose(out, ref, rtol=1e-5)\n"
+        "        x2 = jax.random.normal(jax.random.PRNGKey(2), (2 * n, 8 * n))\n"
+        "        w2 = jax.random.normal(jax.random.PRNGKey(3), (8 * n, 16))\n"
+        "        x2s = jax.device_put(x2, NamedSharding(mesh, P(None, 'tp')))\n"
+        "        w2s = jax.device_put(w2, NamedSharding(mesh, P('tp', None)))\n"
+        "        ref2 = np.asarray(make_matmul_reduce_scatter(mesh, 'tp',\n"
+        "               use_pallas=False)(x2s, w2s))\n"
+        "        out2 = np.asarray(make_matmul_reduce_scatter(mesh, 'tp',\n"
+        "               use_pallas=True)(x2s, w2s))\n"
+        "        np.testing.assert_allclose(out2, ref2, rtol=1e-4, atol=1e-4)\n"
+        "    # bf16 inputs on the widest ring: both backends keep the\n"
+        "    # reduction at f32 (f32 scratch / f32 psum_scatter), so they\n"
+        "    # must agree to bf16 output resolution — per-hop bf16\n"
+        "    # rounding would drift visibly at n=8.\n"
+        "    mesh = Mesh(np.array(jax.devices()).reshape(1, 1, 8),\n"
+        "                axis_names=('dp', 'sp', 'tp'))\n"
+        "    xb = jax.random.normal(jax.random.PRNGKey(4), (16, 64)\n"
+        "         ).astype(jnp.bfloat16)\n"
+        "    wb = jax.random.normal(jax.random.PRNGKey(5), (64, 16)\n"
+        "         ).astype(jnp.bfloat16)\n"
+        "    xbs = jax.device_put(xb, NamedSharding(mesh, P(None, 'tp')))\n"
+        "    wbs = jax.device_put(wb, NamedSharding(mesh, P('tp', None)))\n"
+        "    refb = np.asarray(make_matmul_reduce_scatter(mesh, 'tp',\n"
+        "           use_pallas=False)(xbs, wbs)).astype(np.float32)\n"
+        "    outb = np.asarray(make_matmul_reduce_scatter(mesh, 'tp',\n"
+        "           use_pallas=True)(xbs, wbs)).astype(np.float32)\n"
+        "    np.testing.assert_allclose(outb, refb, rtol=1e-2, atol=1e-2)\n"
+        "print('ok')\n" % REPO
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_pallas_collective_matmul_aot_lowers_for_tpu():
+    """Mosaic compilation proof without multi-chip hardware: AOT-lower
+    both fused kernels for an abstract 8-device TPU v5e topology."""
+    r = _run_virtual(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from dpu_operator_tpu.parallel.collective_matmul import (\n"
+        "    make_allgather_matmul, make_matmul_reduce_scatter)\n"
+        "mesh = Mesh(np.array(jax.devices()).reshape(1, 1, 8),\n"
+        "            axis_names=('dp', 'sp', 'tp'))\n"
+        "xa = jax.ShapeDtypeStruct((256, 256), jnp.bfloat16,\n"
+        "     sharding=NamedSharding(mesh, P('tp', None)))\n"
+        "wa = jax.ShapeDtypeStruct((256, 1024), jnp.bfloat16,\n"
+        "     sharding=NamedSharding(mesh, P(None, 'tp')))\n"
+        "fn = make_allgather_matmul(mesh, 'tp', use_pallas=True)\n"
+        "exp = jax.export.export(fn, platforms=['tpu'])(xa, wa)\n"
+        "assert 'tpu_custom_call' in exp.mlir_module()\n"
+        "x2 = jax.ShapeDtypeStruct((256, 1024), jnp.bfloat16,\n"
+        "     sharding=NamedSharding(mesh, P(None, 'tp')))\n"
+        "w2 = jax.ShapeDtypeStruct((1024, 256), jnp.bfloat16,\n"
+        "     sharding=NamedSharding(mesh, P('tp', None)))\n"
+        "rs = make_matmul_reduce_scatter(mesh, 'tp', use_pallas=True)\n"
+        "exp2 = jax.export.export(rs, platforms=['tpu'])(x2, w2)\n"
+        "assert 'tpu_custom_call' in exp2.mlir_module()\n"
+        "print('ok')\n" % REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
