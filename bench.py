@@ -236,6 +236,50 @@ def bench_pod_attach() -> dict:
                 shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_fabric_throughput() -> dict:
+    """Traffic THROUGH the fabric dataplane (tft case-1 topology: two pod
+    netns on a fabric-MTU-sized bridge; tft-pump engines): the number the
+    MTU policy moved from ~13 to ~21.5 Gbps. Root-gated — unprivileged
+    environments skip, they cannot build the topology."""
+    if not _can_use_netns():
+        return {}
+    from dpu_operator_tpu.tft.cases import build_case_topology
+    from dpu_operator_tpu.tft.tft import ConnectionSpec, run_connection
+
+    out: dict = {}
+    topo = None
+    try:
+        topo = build_case_topology(1)
+        for conn_type, key in (
+            ("iperf-tcp", "fabric_tcp_gbps"),
+            ("iperf-udp", "fabric_udp_gbps"),
+            ("netperf-tcp-rr", "fabric_tcp_rr_tps"),
+        ):
+            r = run_connection(
+                ConnectionSpec(name="bench", type=conn_type),
+                topo.server_netns, topo.client_netns, topo.server_ip,
+                duration=1.5, port=_free_port(),
+            )
+            out[key] = r.get("gbps", r.get("tps"))
+            out.setdefault("fabric_engine", r.get("engine"))
+        print(
+            f"fabric throughput (case-1 topology): "
+            f"tcp {out.get('fabric_tcp_gbps')} Gbps, "
+            f"udp {out.get('fabric_udp_gbps')} Gbps, "
+            f"rr {out.get('fabric_tcp_rr_tps')} tps "
+            f"[engine={out.get('fabric_engine')}]",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        # Recorded, never fatal: the remaining bench sections must still
+        # run when the topology cannot be built here.
+        out["fabric_throughput_error"] = str(e)[:200]
+    finally:
+        if topo is not None:
+            topo.cleanup()
+    return out
+
+
 def _tunnel_alive() -> bool:
     """The axon TPU tunnel serves 127.0.0.1:{8082..8117}; when it is down,
     jax device discovery blocks forever in a claim-retry loop, so probe
@@ -320,6 +364,7 @@ def bench_virtual_ring() -> dict:
 def main() -> int:
     metrics: dict = {}
     metrics.update(bench_pod_attach())
+    metrics.update(bench_fabric_throughput())
     metrics.update(bench_virtual_ring())
     metrics.update(bench_tpu())
 
@@ -338,6 +383,9 @@ def main() -> int:
         "ici_ring_gbps": "Gb/s",
         "ici_ring_bidir_gbps": "Gb/s",
         "virtual_ring_gbps": "Gb/s",
+        "fabric_tcp_gbps": "Gb/s",
+        "fabric_udp_gbps": "Gb/s",
+        "fabric_tcp_rr_tps": "transactions/s",
     }
     for key, unit in units.items():
         if key in metrics:

@@ -5,25 +5,10 @@ pallas kernels EXECUTED under TPU interpret mode against the naive
 reference, and AOT-lowered for a multi-device TPU topology so Mosaic
 compilation is proven without multi-chip hardware."""
 
-import os
-import subprocess
-import sys
-
 import numpy as np
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _run_virtual(code: str, timeout: int = 600) -> subprocess.CompletedProcess:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    return subprocess.run(
-        [sys.executable, "-c", code],
-        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
-    )
+from virtual_mesh import REPO, run_virtual as _run_virtual
 
 
 def _mesh(shape=(1, 1, 8)):

@@ -23,20 +23,7 @@ import sys
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _run_virtual(code: str, timeout: int = 600) -> subprocess.CompletedProcess:
-    """Run `code` in a clean interpreter on the 8-device virtual CPU mesh
-    (no sitecustomize, so jax is not pinned to the tunnelled TPU)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    return subprocess.run(
-        [sys.executable, "-c", code],
-        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
-    )
+from virtual_mesh import REPO, run_virtual as _run_virtual
 
 
 def test_ring_schedule_covers_all_chunks():

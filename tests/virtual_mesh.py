@@ -1,0 +1,23 @@
+"""Shared launcher for virtual-mesh subprocess tests: a clean
+interpreter (no sitecustomize on PYTHONPATH, so jax is not pinned to the
+tunnelled TPU) on the 8-device virtual CPU platform — the same
+environment the driver's dryrun uses. One copy so an environment fix
+(new XLA flag, sitecustomize workaround) can never land in one test
+file and miss another."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_virtual(code: str, timeout: int = 600) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
