@@ -95,6 +95,75 @@ def mesh_from_topology(topology: SliceTopology, devices: Optional[Sequence] = No
     return build_mesh(n_devices=n, devices=devices)
 
 
+def build_hybrid_mesh(
+    devices: Optional[Sequence] = None,
+    slice_index_of=None,
+    topology: Optional[SliceTopology] = None,
+):
+    """Multislice hybrid mesh: ("dcn", "dp", "sp", "tp") with the DCN
+    dimension OUTERMOST — collectives over `dcn` cross slices and ride
+    the data-center network, everything inner stays on ICI. This is the
+    standard multislice recipe (data parallelism over DCN, model axes
+    within the slice): DCN is an order of magnitude thinner than ICI,
+    so only the lowest-frequency, most-overlappable collective
+    (gradient sync) belongs on it.
+
+    jax multislice runtimes expose `device.slice_index`; `slice_index_of`
+    overrides the grouping for virtual meshes (no such attribute on CPU
+    devices) and tests. Every slice must contribute the same device
+    count — ragged slices have no rectangular mesh."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if slice_index_of is None:
+        def slice_index_of(d):
+            return getattr(d, "slice_index", 0) or 0
+
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(slice_index_of(d), []).append(d)
+    sizes = {len(v) for v in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"ragged slices: {sorted((k, len(v)) for k, v in groups.items())}"
+        )
+    per_slice = sizes.pop()
+    have_coords = all(
+        getattr(d, "coords", None) is not None
+        for g in groups.values() for d in g
+    )
+    shape = hybrid_inner_shape(per_slice, topology, have_coords)
+    arr = np.stack([
+        np.array(order_by_ici(groups[k])).reshape(shape)
+        for k in sorted(groups)
+    ])
+    return Mesh(arr, axis_names=("dcn",) + AXES)
+
+
+def hybrid_inner_shape(
+    per_slice: int,
+    topology: Optional[SliceTopology],
+    have_coords: bool,
+) -> Tuple[int, int, int]:
+    """Per-slice (dp, sp, tp) factoring for the hybrid mesh:
+    grid-aligned when the slice topology is known, matches the device
+    count, and devices carry physical coords (tp along x, sp along y,
+    dp along z — every inner-axis step a single ICI hop, same reasoning
+    as mesh_from_topology); the generic 2x2-preferring factoring
+    otherwise. On real slices wider than 2 the generic factoring strides
+    non-adjacent chips, so callers with a SliceTopology should pass it."""
+    if (
+        topology is not None
+        and per_slice == topology.num_chips
+        and have_coords
+    ):
+        gx, gy, gz = topology.grid
+        return (gz, gy, gx)
+    return axis_sizes(per_slice)
+
+
 def ring_is_ici_adjacent(mesh, axis: str, coords_of=None) -> Optional[bool]:
     """Whether consecutive devices along `axis` are physically adjacent
     on the chip grid (so a ring over the axis rides single ICI hops).

@@ -72,6 +72,13 @@ class SliceTopology:
     grid: Tuple[int, int, int]
     worker_id: int
     wrap: Tuple[bool, bool, bool] = (False, False, False)
+    # Multislice (MEGASCALE): which DCN-connected slice this is, out of
+    # how many. Single-slice deployments are (0, 1). The chips/grid
+    # above always describe ONE slice — DCN peers are reached through
+    # the hybrid mesh (mesh.build_hybrid_mesh), never through ICI
+    # neighbor arithmetic.
+    slice_id: int = 0
+    num_slices: int = 1
 
     # -- construction --------------------------------------------------------
 
@@ -79,7 +86,7 @@ class SliceTopology:
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "SliceTopology":
         env = dict(env if env is not None else os.environ)
         accel = env.get("TPU_ACCELERATOR_TYPE", "")
-        worker = int(env.get("TPU_WORKER_ID") or 0)
+        worker = _int_env(env, "TPU_WORKER_ID", 0)
         chips_per_host = _parse_bounds(env.get("TPU_CHIPS_PER_HOST_BOUNDS"), (2, 2, 1))
         host_bounds = _parse_bounds(env.get("TPU_HOST_BOUNDS"), None)
         if host_bounds is not None:
@@ -115,6 +122,11 @@ class SliceTopology:
             grid=grid,  # type: ignore[arg-type]
             worker_id=worker,
             wrap=wrap,  # type: ignore[arg-type]
+            # Multislice runtime env (MEGASCALE_*): absent or junk reads
+            # as the single-slice default — a malformed value must not
+            # take the topology model down with it.
+            slice_id=_int_env(env, "MEGASCALE_SLICE_ID", 0),
+            num_slices=_int_env(env, "MEGASCALE_NUM_SLICES", 1),
         )
 
     @classmethod
@@ -177,10 +189,19 @@ class SliceTopology:
             "workerId": self.worker_id,
             "numChips": self.num_chips,
             "bisectionGbps": self.bisection_gbps(),
+            "sliceId": self.slice_id,
+            "numSlices": self.num_slices,
         }
 
 
 # -- helpers -----------------------------------------------------------------
+
+
+def _int_env(env: Dict[str, str], key: str, default: int) -> int:
+    try:
+        return int(env.get(key) or default)
+    except (TypeError, ValueError):
+        return default
 
 
 def _parse_bounds(value: Optional[str], default):

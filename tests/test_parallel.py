@@ -128,3 +128,72 @@ def test_pallas_burn_matches_jnp_in_interpret_mode():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ok" in r.stdout
+
+
+def test_hybrid_mesh_dcn_outermost():
+    """build_hybrid_mesh groups devices by slice and puts the DCN axis
+    outermost; a gradient-sync collective over ("dcn", "dp") crosses
+    slices and averages everything."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.mesh import build_hybrid_mesh
+
+    devices = jax.devices()
+    assert len(devices) == 8
+    # Virtual CPU devices carry no slice_index; fabricate 2 slices of 4.
+    mesh = build_hybrid_mesh(devices, slice_index_of=lambda d: d.id // 4)
+    assert mesh.axis_names == ("dcn", "dp", "sp", "tp")
+    assert mesh.devices.shape == (2, 1, 2, 2)
+    # Slice grouping: every device in dcn row i belongs to slice i.
+    for i in range(2):
+        assert {d.id // 4 for d in mesh.devices[i].flat} == {i}
+
+    # Cross-slice gradient sync: mean over dcn+dp of per-device values.
+    from jax import shard_map
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    xs = jax.device_put(
+        x, NamedSharding(mesh, P(("dcn", "dp"), None)))
+
+    def sync(v):
+        return jax.lax.pmean(v, ("dcn", "dp"))
+
+    out = jax.jit(shard_map(
+        sync, mesh=mesh, in_specs=P(("dcn", "dp"), None),
+        out_specs=P(("dcn", "dp"), None), check_vma=False,
+    ))(xs)
+    # 8 rows sharded over ("dcn","dp") = 2 shards of 4 rows; pmean
+    # averages the two shards elementwise and every shard gets the mean.
+    expected = np.tile((x[:4] + x[4:]).reshape(4, 1) / 2, (2, 1))
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+    # Ragged slices must error loudly, not build a lying mesh.
+    with pytest.raises(ValueError, match="ragged"):
+        build_hybrid_mesh(devices, slice_index_of=lambda d: 0 if d.id < 3 else 1)
+
+
+def test_hybrid_inner_shape_grid_aligned():
+    """The hybrid mesh's per-slice factoring follows the physical grid
+    when topology + coords are available (every inner-axis step one ICI
+    hop on a 4x4 slice), and only falls back to the generic factoring
+    when it can't know better."""
+    from dpu_operator_tpu.parallel.mesh import axis_sizes, hybrid_inner_shape
+    from dpu_operator_tpu.parallel.topology import SliceTopology
+
+    v5e16 = SliceTopology.from_env({
+        "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+        "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+        "TPU_HOST_BOUNDS": "2,2,1",
+    })
+    assert v5e16.grid == (4, 4, 1)
+    # Grid-aligned: (dp, sp, tp) = (z, y, x) = (1, 4, 4) — NOT the
+    # generic axis_sizes(16) = (4, 2, 2), which strides sp across
+    # non-adjacent chips on a 4x4 grid.
+    assert hybrid_inner_shape(16, v5e16, True) == (1, 4, 4)
+    assert hybrid_inner_shape(16, v5e16, False) == axis_sizes(16)
+    assert hybrid_inner_shape(8, v5e16, True) == axis_sizes(8)  # mismatch
+    assert hybrid_inner_shape(16, None, True) == axis_sizes(16)

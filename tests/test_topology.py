@@ -245,3 +245,28 @@ def test_preferred_allocation_adjacency_on_v5e_16(tmp_root):
             sum(abs(a - b) for a, b in zip(coords, prev)) == 1
             for prev in picked[:i]
         ), (coords, picked[:i])
+
+
+def test_multislice_env_parsed():
+    """MEGASCALE_* env → slice identity; absent or junk values read as
+    the single-slice default instead of crashing topology modeling."""
+    from dpu_operator_tpu.parallel import SliceTopology
+
+    base = {
+        "TPU_ACCELERATOR_TYPE": "v5litepod-8",
+        "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+        "TPU_HOST_BOUNDS": "1,2,1",
+        "TPU_WORKER_ID": "0",
+    }
+    topo = SliceTopology.from_env(dict(base))
+    assert (topo.slice_id, topo.num_slices) == (0, 1)
+
+    topo = SliceTopology.from_env(
+        dict(base, MEGASCALE_SLICE_ID="2", MEGASCALE_NUM_SLICES="4"))
+    assert (topo.slice_id, topo.num_slices) == (2, 4)
+    assert topo.to_dict()["sliceId"] == 2
+    assert topo.to_dict()["numSlices"] == 4
+
+    topo = SliceTopology.from_env(
+        dict(base, MEGASCALE_SLICE_ID="banana", MEGASCALE_NUM_SLICES=""))
+    assert (topo.slice_id, topo.num_slices) == (0, 1)
