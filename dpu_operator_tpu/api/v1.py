@@ -134,6 +134,25 @@ def validate_dpu_operator_config_spec(obj: dict) -> None:
         raise ValidationError(f"spec.logLevel must be one of {LOG_LEVELS}, got {ll!r}")
 
 
+def validate_data_processing_unit_config_spec(obj: dict) -> None:
+    """numEndpoints reaches the daemon's fabric-partition path; junk
+    must be rejected at admission, not crash a reconcile loop."""
+    spec = obj.get("spec", {})
+    ne = spec.get("numEndpoints")
+    if ne is not None:
+        if not isinstance(ne, int) or isinstance(ne, bool) or not 1 <= ne <= 256:
+            raise ValidationError(
+                f"spec.numEndpoints must be an integer in [1, 256], got {ne!r}"
+            )
+    selector = spec.get("dpuSelector", {})
+    if not isinstance(selector, dict) or not all(
+        isinstance(k, str) and isinstance(v2, str) for k, v2 in selector.items()
+    ):
+        raise ValidationError(
+            f"spec.dpuSelector must be a string-to-string map, got {selector!r}"
+        )
+
+
 def validate_service_function_chain_spec(obj: dict) -> None:
     nfs = obj.get("spec", {}).get("networkFunctions", [])
     seen = set()

@@ -31,26 +31,29 @@ log = logging.getLogger(__name__)
 AdmissionHandler = Callable[[dict], Tuple[bool, str, Optional[list]]]
 
 
-def validate_dpu_operator_config(request: dict) -> Tuple[bool, str, Optional[list]]:
-    from . import v1
+def _spec_validator(spec_validate_name: str) -> AdmissionHandler:
+    """Adapt a v1.validate_*_spec function into an admission handler —
+    one adapter so denial-message behavior has a single edit point."""
 
-    obj = request.get("object") or {}
-    try:
-        v1.validate_dpu_operator_config_spec(obj)
-    except v1.ValidationError as e:
-        return False, str(e), None
-    return True, "", None
+    def handler(request: dict) -> Tuple[bool, str, Optional[list]]:
+        from . import v1
+
+        obj = request.get("object") or {}
+        try:
+            getattr(v1, spec_validate_name)(obj)
+        except v1.ValidationError as e:
+            return False, str(e), None
+        return True, "", None
+
+    handler.__name__ = spec_validate_name.replace("_spec", "_handler")
+    return handler
 
 
-def validate_service_function_chain(request: dict) -> Tuple[bool, str, Optional[list]]:
-    from . import v1
-
-    obj = request.get("object") or {}
-    try:
-        v1.validate_service_function_chain_spec(obj)
-    except v1.ValidationError as e:
-        return False, str(e), None
-    return True, "", None
+validate_dpu_operator_config = _spec_validator("validate_dpu_operator_config_spec")
+validate_service_function_chain = _spec_validator(
+    "validate_service_function_chain_spec")
+validate_data_processing_unit_config = _spec_validator(
+    "validate_data_processing_unit_config_spec")
 
 
 class AdmissionWebhook:

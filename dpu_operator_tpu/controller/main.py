@@ -19,6 +19,7 @@ from .. import vars as v
 from ..api import v1
 from ..api.webhook import (
     AdmissionWebhook,
+    validate_data_processing_unit_config,
     validate_dpu_operator_config,
     validate_service_function_chain,
 )
@@ -58,6 +59,19 @@ def build_manager(client, image_manager, namespace: str = v.NAMESPACE) -> Manage
     return mgr
 
 
+# Served admission paths — MUST match the ValidatingWebhookConfiguration
+# (config/webhook/webhook.yaml) and the OLM CSV webhookdefinitions: a
+# path mismatch means every admission request 404s and, with
+# failurePolicy Fail, every CR create in the cluster is rejected. The
+# manifest tier asserts this table against the manifests.
+WEBHOOK_ROUTES = {
+    "/validate-config-tpu-io-v1-dpuoperatorconfig": validate_dpu_operator_config,
+    "/validate-config-tpu-io-v1-servicefunctionchain": validate_service_function_chain,
+    "/validate-config-tpu-io-v1-dataprocessingunitconfig":
+        validate_data_processing_unit_config,
+}
+
+
 def main() -> None:
     logging.basicConfig(
         level=logging.DEBUG if os.environ.get("DPU_LOG_LEVEL", "0") != "0" else logging.INFO
@@ -73,8 +87,8 @@ def main() -> None:
             certfile=os.environ.get("WEBHOOK_CERT"),
             keyfile=os.environ.get("WEBHOOK_KEY"),
         )
-        webhook.register("/validate-dpuoperatorconfig", validate_dpu_operator_config)
-        webhook.register("/validate-sfc", validate_service_function_chain)
+        for path, handler in WEBHOOK_ROUTES.items():
+            webhook.register(path, handler)
         webhook.start()
 
     # Metrics + health endpoints (reference serves metrics on :18090 and

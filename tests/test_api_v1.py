@@ -139,3 +139,39 @@ def test_crd_manifests_parse():
         "ServiceFunctionChain",
         "DataProcessingUnitConfig",
     }
+
+
+def test_dpu_config_spec_validation():
+    """numEndpoints junk is rejected at admission, not in the daemon's
+    fabric-partition path."""
+    import pytest
+
+    from dpu_operator_tpu.api import v1
+
+    ok = v1.new_data_processing_unit_config("t", num_endpoints=8)
+    v1.validate_data_processing_unit_config_spec(ok)  # no raise
+    v1.validate_data_processing_unit_config_spec(
+        v1.new_data_processing_unit_config("t"))  # numEndpoints optional
+
+    for bad_spec in (
+        {"numEndpoints": 0},
+        {"numEndpoints": -4},
+        {"numEndpoints": 1000},
+        {"numEndpoints": "eight"},
+        {"numEndpoints": True},
+        {"dpuSelector": "not-a-map"},
+        {"dpuSelector": {"k": 3}},
+    ):
+        obj = v1.new_data_processing_unit_config("t")
+        obj["spec"].update(bad_spec)
+        with pytest.raises(v1.ValidationError):
+            v1.validate_data_processing_unit_config_spec(obj)
+
+    # The webhook handler surfaces the rejection through the admission
+    # contract.
+    from dpu_operator_tpu.api.webhook import validate_data_processing_unit_config
+
+    bad = v1.new_data_processing_unit_config("t")
+    bad["spec"]["numEndpoints"] = 0
+    allowed, msg, _ = validate_data_processing_unit_config({"object": bad})
+    assert not allowed and "numEndpoints" in msg

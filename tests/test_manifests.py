@@ -109,6 +109,7 @@ def test_csv_is_installable():
     whs = csv["spec"]["webhookdefinitions"]
     assert {w["generateName"] for w in whs} == {
         "vdpuoperatorconfig.kb.io", "vservicefunctionchain.kb.io",
+        "vdataprocessingunitconfig.kb.io",
     }
     # Samples render as alm-examples.
     examples = yaml.safe_load(csv["metadata"]["annotations"]["alm-examples"])
@@ -179,3 +180,42 @@ def test_nad_configs_are_valid_cni_json():
                         for r in ipam.get("routes", []):
                             assert "dst" in r, f"{path}: route without dst"
     assert nads >= 3, f"expected the NAD set, found {nads}"
+
+
+def test_webhook_manifest_paths_match_served_routes():
+    """Every ValidatingWebhookConfiguration path must have a registered
+    handler and vice versa — a mismatch 404s admission requests and,
+    with failurePolicy Fail, rejects every CR create in the cluster
+    (this exact bug shipped once: manifest used kubebuilder-style paths
+    while main() registered short ones)."""
+    import yaml
+
+    from dpu_operator_tpu.controller.main import WEBHOOK_ROUTES
+
+    with open(os.path.join(REPO, "config", "webhook", "webhook.yaml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    vwc = next(d for d in docs if d["kind"] == "ValidatingWebhookConfiguration")
+    manifest_paths = {
+        wh["clientConfig"]["service"]["path"] for wh in vwc["webhooks"]
+    }
+    assert manifest_paths == set(WEBHOOK_ROUTES), (
+        f"manifest {sorted(manifest_paths)} != served {sorted(WEBHOOK_ROUTES)}"
+    )
+    # The OLM CSV duplicates the paths in webhookdefinitions — a typo
+    # there ships the same outage through the bundle install path.
+    with open(os.path.join(
+            REPO, "bundle", "manifests",
+            "tpu-dpu-operator.clusterserviceversion.yaml")) as f:
+        csv = yaml.safe_load(f)
+    csv_paths = {
+        wh["webhookPath"] for wh in csv["spec"]["webhookdefinitions"]
+    }
+    assert csv_paths == set(WEBHOOK_ROUTES), (
+        f"CSV {sorted(csv_paths)} != served {sorted(WEBHOOK_ROUTES)}"
+    )
+    # failurePolicy Fail + a webhook for every validated kind.
+    kinds = {r for wh in vwc["webhooks"] for r in wh["rules"][0]["resources"]}
+    assert kinds == {
+        "dpuoperatorconfigs", "servicefunctionchains",
+        "dataprocessingunitconfigs",
+    }
