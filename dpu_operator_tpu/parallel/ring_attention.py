@@ -1,0 +1,264 @@
+"""Ring attention — sequence-parallel attention over the ICI ring.
+
+The long-context pattern (Liu et al., "Ring Attention with Blockwise
+Transformers"; the sp-axis answer to contexts that do not fit one chip):
+Q, K, V are sharded along the sequence axis; each device keeps its Q
+shard resident and STREAMS the K/V blocks around the ring, folding every
+block into a numerically-stable online softmax (the flash-attention
+recurrence) as it passes through. Peak memory per chip stays O(S/n) while
+attention remains exact over the full sequence — and on the pallas path
+each block's scores/accumulation (MXU work) overlaps the next block's
+RDMA, the same schedule the collective matmul rides.
+
+Both backends share everything shareable:
+  * pallas: `ring_probe._run_ring_stream` — the ONE ring protocol body
+    (slots, credits, MESH addressing) with an online-softmax consumer; K
+    and V circulate concatenated as one [S/n, dk+dv] block so a single
+    buffer/semaphore family carries both.
+  * XLA: the same decomposition with `ppermute`, which XLA's async
+    collective-permute overlaps on TPU.
+
+`causal=True` masks by GLOBAL position (query block row index vs key
+block ring index), so causality holds across shards, not just inside
+them. The accumulators are f32 regardless of input dtype — bf16 inputs
+must not lose the softmax normalization across n ring steps.
+
+No reference-repo analogue (SURVEY §5 "long-context": absent there);
+this completes the sp-axis family: all-gather / reduce-scatter /
+all-to-all move bytes, collective matmul overlaps one matmul, ring
+attention overlaps the full attention recurrence."""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .ring_probe import _axis_collective, _ring_ids, _run_ring_stream
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+_NEG_INF = -1e30  # not -inf: (-inf) - (-inf) would NaN the rescale
+
+
+def _online_update(s, m, l, o, v_blk):
+    """One flash-attention fold: scores s [sq, sk] join running
+    (max m [sq, 1], denom l [sq, 1], accum o [sq, dv]); all f32."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+    o_new = o * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _scores(q, k_blk, scale, causal, my_id, idx, sq, sk):
+    """Scaled q @ k^T with the cross-shard causal mask by GLOBAL
+    position: query row r is global my_id*sq + r, key column c is
+    idx*sk + c."""
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = my_id * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = idx * sk + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    return s
+
+
+# -- pallas kernel -----------------------------------------------------------
+
+
+def _ring_attn_kernel(
+    n_axes,
+    num_devices,
+    causal,
+    d_k,
+    my_id_ref,
+    right_ref,
+    left_ref,
+    q_ref,
+    kv_ref,
+    out_ref,
+    m_scr,
+    l_scr,
+    o_scr,
+    comm_buf,
+    send_sem,
+    recv_sem,
+    ack_sem,
+):
+    """Ring attention over `_run_ring_stream`: the circulated block is
+    the concatenated [sk, dk+dv] K/V shard; consume() folds it into the
+    online softmax (f32 scratch), and the division by the denominator
+    happens once after the ring drains."""
+    sq = q_ref.shape[0]
+    sk = kv_ref.shape[0]
+    scale = 1.0 / math.sqrt(d_k)
+    my_id = my_id_ref[0]
+
+    m_scr[...] = jnp.full_like(m_scr[...], _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr[...])
+    o_scr[...] = jnp.zeros_like(o_scr[...])
+
+    q = q_ref[...].astype(jnp.float32)
+
+    def consume(idx, block):
+        k_blk = block[:, :d_k].astype(jnp.float32)
+        v_blk = block[:, d_k:].astype(jnp.float32)
+        s = _scores(q, k_blk, scale, causal, my_id, idx, sq, sk)
+        # Scratch m/l store the (sq, 1) stats broadcast across lanes;
+        # column 0 is the truth.
+        m = m_scr[...][:, :1]
+        l = l_scr[...][:, :1]
+        m_new, l_new, o_new = _online_update(s, m, l, o_scr[...], v_blk)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        o_scr[...] = o_new
+
+    _run_ring_stream(
+        n_axes, num_devices, consume, my_id_ref, right_ref, left_ref,
+        kv_ref, comm_buf, send_sem, recv_sem, ack_sem,
+    )
+
+    l = l_scr[...][:, :1]
+    out_ref[...] = (o_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+        out_ref.dtype
+    )
+
+
+def _check_qkv(q, k, v) -> None:
+    """Loud shape/dtype contract: a k width that differs from q would
+    slice the packed KV block at the wrong boundary and return garbage
+    that still type-checks."""
+    if k.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"k feature dim {k.shape[1]} != q feature dim {q.shape[1]}")
+    if k.shape[0] != v.shape[0]:
+        raise ValueError(
+            f"k rows {k.shape[0]} != v rows {v.shape[0]} (same shard)")
+
+
+def _pack_kv(k: jax.Array, v: jax.Array) -> jax.Array:
+    """K and V circulate as one block; promote to the WIDER dtype so a
+    mixed-precision cache (bf16 k, f32 v) is never silently quantized."""
+    dtype = jnp.promote_types(k.dtype, v.dtype)
+    return jnp.concatenate([k.astype(dtype), v.astype(dtype)], axis=1)
+
+
+def _pallas_ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis: str, axis_size: int, axis_names: tuple, causal: bool,
+) -> jax.Array:
+    _check_qkv(q, k, v)
+    sq, d_k = q.shape
+    sk, d_v = v.shape
+    kv = _pack_kv(k, v)
+    my_id, right, left = _ring_ids(axis, axis_size, axis_names)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((sq, 128), jnp.float32),   # running max (lane-bcast)
+            pltpu.VMEM((sq, 128), jnp.float32),   # running denom
+            pltpu.VMEM((sq, d_v), jnp.float32),   # running accum
+            pltpu.VMEM((2, sk, d_k + d_v), kv.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _ring_attn_kernel, len(axis_names), axis_size, causal, d_k
+        ),
+        out_shape=jax.ShapeDtypeStruct((sq, d_v), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+    )(
+        my_id.reshape((1,)).astype(jnp.int32),
+        jnp.stack(right).astype(jnp.int32),
+        jnp.stack(left).astype(jnp.int32),
+        q,
+        kv,
+    )
+
+
+# -- XLA path ----------------------------------------------------------------
+
+
+def _xla_ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis: str, axis_size: int, causal: bool,
+) -> jax.Array:
+    _check_qkv(q, k, v)
+    n = axis_size
+    my_id = jax.lax.axis_index(axis)
+    sq, d_k = q.shape
+    sk, d_v = v.shape
+    scale = 1.0 / math.sqrt(d_k)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q.astype(jnp.float32)
+    kv = _pack_kv(k, v)
+
+    def body(step, carry):
+        kv_cur, m, l, o = carry
+        idx = jax.lax.rem(my_id - step + n, n)
+        k_blk = kv_cur[:, :d_k].astype(jnp.float32)
+        v_blk = kv_cur[:, d_k:].astype(jnp.float32)
+        s = _scores(qf, k_blk, scale, causal, my_id, idx, sq, sk)
+        m, l, o = _online_update(s, m, l, o, v_blk)
+        kv_next = jax.lax.cond(
+            step < n - 1,
+            lambda t: jax.lax.ppermute(t, axis, perm),
+            lambda t: t,
+            kv_cur,
+        )
+        return kv_next, m, l, o
+
+    init = (
+        kv,
+        jnp.full((sq, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((sq, 1), jnp.float32),
+        jnp.zeros((sq, d_v), jnp.float32),
+    )
+    _, m, l, o = jax.lax.fori_loop(0, n, body, init)
+    return (o / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    use_pallas: Optional[bool] = None,
+):
+    """jitted fn(q, k, v), each [S, D*] sharded over `axis` rows →
+    exact attention output [S, Dv] sharded the same way, computed by
+    streaming K/V blocks around the ring with an f32 online softmax.
+    `causal=True` masks by global sequence position across shards."""
+    axis_size = mesh.shape[axis]
+
+    def pallas_inner(q, k, v):
+        return _pallas_ring_attention(
+            q, k, v, axis, axis_size, tuple(mesh.axis_names), causal)
+
+    def xla_inner(q, k, v):
+        return _xla_ring_attention(q, k, v, axis, axis_size, causal)
+
+    return _axis_collective(
+        mesh, axis, use_pallas, pallas_inner, xla_inner,
+        out_specs=P(axis, None),
+        in_specs=(P(axis, None), P(axis, None), P(axis, None)),
+    )

@@ -1,0 +1,138 @@
+"""Ring attention (parallel/ring_attention.py) — sequence-parallel exact
+attention streaming K/V around the ring. Proof standard matches the ring
+family: the XLA path against a dense full-attention reference on the
+virtual mesh (causal and unmasked, bf16 and f32), the pallas kernel
+EXECUTED under TPU interpret mode against the XLA path, and AOT Mosaic
+lowering."""
+
+import numpy as np
+import pytest
+
+from virtual_mesh import REPO, run_virtual as _run_virtual
+
+
+def _reference(q, k, v, causal):
+    s = (q.astype(np.float32) @ k.astype(np.float32).T) / np.sqrt(q.shape[1])
+    if causal:
+        sq, sk = s.shape
+        mask = np.arange(sk)[None, :] <= np.arange(sq)[:, None]
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    return (p / p.sum(axis=1, keepdims=True)) @ v.astype(np.float32)
+
+
+def test_xla_ring_attention_matches_dense():
+    """The decomposed ppermute recurrence computes EXACT attention over
+    the full sequence — the online-softmax fold and the cross-shard
+    causal mask (global positions) are the parts worth distrusting."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.ring_attention import make_ring_attention
+
+    for shape, n in (((1, 8, 1), 8), ((2, 4, 1), 4), ((1, 2, 4), 2)):
+        mesh = Mesh(np.array(jax.devices()).reshape(shape),
+                    axis_names=("dp", "sp", "tp"))
+        S, dk, dv = 4 * n, 16, 8
+        q = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (S, dk)))
+        k = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (S, dk)))
+        v = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (S, dv)))
+        sh = NamedSharding(mesh, P("sp", None))
+        args = [jax.device_put(jnp.asarray(a), sh) for a in (q, k, v)]
+        for causal in (False, True):
+            fn = make_ring_attention(mesh, "sp", causal=causal)
+            out = np.asarray(fn(*args))
+            np.testing.assert_allclose(
+                out, _reference(q, k, v, causal), rtol=2e-5, atol=2e-5)
+
+
+def test_xla_ring_attention_bf16_stable():
+    """bf16 inputs keep an f32 softmax: the output must track the f32
+    reference to bf16 resolution even at 8 ring steps."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.ring_attention import make_ring_attention
+
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8, 1),
+                axis_names=("dp", "sp", "tp"))
+    S, dk, dv = 32, 16, 8
+    q = np.asarray(jax.random.normal(jax.random.PRNGKey(3), (S, dk)))
+    k = np.asarray(jax.random.normal(jax.random.PRNGKey(4), (S, dk)))
+    v = np.asarray(jax.random.normal(jax.random.PRNGKey(5), (S, dv)))
+    sh = NamedSharding(mesh, P("sp", None))
+    args = [
+        jax.device_put(jnp.asarray(a).astype(jnp.bfloat16), sh)
+        for a in (q, k, v)
+    ]
+    out = np.asarray(
+        make_ring_attention(mesh, "sp", causal=True)(*args)
+    ).astype(np.float32)
+    # bf16 q/k quantization moves scores before the softmax; compare at
+    # bf16-appropriate tolerance.
+    np.testing.assert_allclose(
+        out, _reference(q, k, v, True), rtol=0.1, atol=0.06)
+
+
+def test_pallas_ring_attention_interpret_mode():
+    """The pallas kernel EXECUTES under TPU interpret mode on the
+    virtual mesh and matches the XLA path — the online-softmax scratch
+    protocol on top of the shared ring stream, causal and unmasked,
+    including the 8-wide max-skew ring."""
+    r = _run_virtual(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "from dpu_operator_tpu.parallel.ring_attention import make_ring_attention\n"
+        "with pltpu.force_tpu_interpret_mode():\n"
+        "    for shape, n in (((1, 8, 1), 8), ((2, 4, 1), 4), ((1, 2, 4), 2)):\n"
+        "        mesh = Mesh(np.array(jax.devices()).reshape(shape),\n"
+        "                    axis_names=('dp', 'sp', 'tp'))\n"
+        "        S, dk, dv = 4 * n, 16, 8\n"
+        "        sh = NamedSharding(mesh, P('sp', None))\n"
+        "        q = jax.device_put(jax.random.normal(jax.random.PRNGKey(0),\n"
+        "            (S, dk)), sh)\n"
+        "        k = jax.device_put(jax.random.normal(jax.random.PRNGKey(1),\n"
+        "            (S, dk)), sh)\n"
+        "        v = jax.device_put(jax.random.normal(jax.random.PRNGKey(2),\n"
+        "            (S, dv)), sh)\n"
+        "        for causal in (False, True):\n"
+        "            ref = np.asarray(make_ring_attention(mesh, 'sp',\n"
+        "                  causal=causal, use_pallas=False)(q, k, v))\n"
+        "            out = np.asarray(make_ring_attention(mesh, 'sp',\n"
+        "                  causal=causal, use_pallas=True)(q, k, v))\n"
+        "            np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)\n"
+        "print('ok')\n" % REPO
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_pallas_ring_attention_aot_lowers_for_tpu():
+    """Mosaic compilation proof for the ring-attention kernel on an
+    8-device TPU topology."""
+    r = _run_virtual(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from dpu_operator_tpu.parallel.ring_attention import make_ring_attention\n"
+        "mesh = Mesh(np.array(jax.devices()).reshape(1, 8, 1),\n"
+        "            axis_names=('dp', 'sp', 'tp'))\n"
+        "sh = NamedSharding(mesh, P('sp', None))\n"
+        "S, dk, dv = 1024, 128, 128\n"
+        "qa = jax.ShapeDtypeStruct((S, dk), jnp.bfloat16, sharding=sh)\n"
+        "ka = jax.ShapeDtypeStruct((S, dk), jnp.bfloat16, sharding=sh)\n"
+        "va = jax.ShapeDtypeStruct((S, dv), jnp.bfloat16, sharding=sh)\n"
+        "for causal in (False, True):\n"
+        "    fn = make_ring_attention(mesh, 'sp', causal=causal,\n"
+        "                             use_pallas=True)\n"
+        "    exp = jax.export.export(fn, platforms=['tpu'])(qa, ka, va)\n"
+        "    assert 'tpu_custom_call' in exp.mlir_module()\n"
+        "print('ok')\n" % REPO
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
