@@ -95,6 +95,10 @@ class DpuOperatorConfigReconciler(Reconciler):
             "Namespace": self._namespace,
             "ImagePullPolicy": self._pull_policy,
             "LogLevel": str(cfg.get("spec", {}).get("logLevel", 0)),
+            # spec.mode forces every node's role (auto|host|dpu) — the
+            # daemon applies it as a detection override (DPU_MODE env,
+            # daemon/main.py).
+            "Mode": str(cfg.get("spec", {}).get("mode", "auto")),
             "CniBinDir": self._pm.cni_host_dir(flavour, fs_mode),
             "ResourceName": v.DPU_RESOURCE_NAME,
             "HostNadName": v.DEFAULT_HOST_NAD_NAME,

@@ -45,6 +45,11 @@ def test_config_reconcile_renders_operands(mgr_and_client):
     tmpl = ds["spec"]["template"]["spec"]
     assert tmpl["nodeSelector"] == {"dpu": "true"}
     assert tmpl["containers"][0]["image"] == "dpu_daemon-mock-image"
+    # spec.mode / spec.logLevel reach the daemon as env (mode defaults
+    # to auto; the daemon applies it as a detection override).
+    env = {e["name"]: e.get("value") for e in tmpl["containers"][0]["env"]}
+    assert env["DPU_MODE"] == "auto"
+    assert env["DPU_LOG_LEVEL"] == "0"
 
     # Both NF NADs (reference ensureNetworkFunctioNAD :327-348).
     for nad_name in ("dpunfcni-conf", v.DEFAULT_HOST_NAD_NAME):

@@ -509,3 +509,24 @@ def test_two_cluster_over_link_local_comm_channel(tmp_root, netns, monkeypatch):
             )
     finally:
         subprocess.run(["ip", "link", "del", host_dev], capture_output=True)
+
+
+def test_mode_override_forces_role():
+    """spec.mode=dpu|host forces every detection's side regardless of
+    what the detector saw (the DPU_MODE env the daemonset renders from
+    the CR; daemon/main.py -> Daemon(mode_override=...))."""
+    from dpu_operator_tpu.daemon.daemon import Daemon
+    from dpu_operator_tpu.platform import DetectedDpu
+
+    det = DetectedDpu(
+        identifier="tpu-x", product_name="TPU v5e", is_dpu_side=False,
+        vendor="tpu", node_name="n0", topology=None,
+    )
+
+    # Daemon not started; only the override logic is under test.
+    for mode, want in (("dpu", True), ("host", False), ("auto", False)):
+        d = Daemon.__new__(Daemon)
+        d._mode_override = mode
+        out = Daemon._apply_mode_override(d, [det])
+        assert out[0].is_dpu_side is want, mode
+        assert out[0].identifier == "tpu-x"
