@@ -75,6 +75,10 @@ class DataProcessingUnitReconciler(Reconciler):
             # sized differently from the bridge they're enslaved to.
             "FabricUplink": os.environ.get("DPU_FABRIC_UPLINK", ""),
             "FabricMtu": os.environ.get("DPU_FABRIC_MTU", ""),
+            # Fabric bandwidth budget: SetNumEndpoints partitions it into
+            # per-endpoint HTB/police shares (tpu_dataplane._apply_share);
+            # unset = shaping off.
+            "FabricGbps": os.environ.get("DPU_FABRIC_GBPS", ""),
         }
         renderer.apply_dir(os.path.join(BINDATA, "vsp", "shared"), variables, owner=dpu)
         renderer.apply_dir(

@@ -128,6 +128,10 @@ def test_dpu_reconciler_launches_and_cleans_vsp_pod(mgr_and_client):
     pod = client.get("v1", "Pod", v.NAMESPACE, pod_name)
     assert pod["spec"]["nodeName"] == "node-a"
     assert pod["spec"]["containers"][0]["image"] == "tpu_vsp-mock-image"
+    # Fabric policy env rendered into the VSP pod (same values the
+    # daemonset gets): uplink/MTU sizing + the endpoint-share budget.
+    env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+    assert {"DPU_FABRIC_UPLINK", "DPU_FABRIC_MTU", "DPU_FABRIC_GBPS"} <= set(env)
 
     client.delete(v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
                   "tpu-v5e-w0-dpu")
