@@ -1,7 +1,8 @@
 # Build/test entry points (counterpart of the reference's Makefile +
 # taskfile.yaml task system).
 
-.PHONY: all native proto test fast-test e2e-test kind-test traffic-flow-tests bench \
+.PHONY: all native proto test fast-test e2e-test kind-test traffic-flow-tests \
+        traffic-flow-matrix bench \
         build-images deploy undeploy clean bundle bundle-check provision provision-dry
 
 IMG_REGISTRY ?= localhost
@@ -35,6 +36,12 @@ kind-test:
 
 traffic-flow-tests:
 	./hack/traffic_flow_tests.sh
+
+# The numbered endpoint-topology matrix (reference test_cases grammar);
+# cluster-plane cases report as skips when run locally.
+traffic-flow-matrix:
+	python -m dpu_operator_tpu.tft hack/cluster-configs/tft-config.yaml \
+	  --case-matrix --cases "1-9,15-19" --duration 2
 
 bench: native
 	python bench.py
