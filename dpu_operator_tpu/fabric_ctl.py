@@ -22,13 +22,15 @@ Commands:
     topology                             slice topology from env/JAX
     ports [--bridge BR]                  bridge port + FDB state dump
     stats [--bridge BR | DEV...] [--rate S]   per-port kernel counters
-    rule-add DEV --pref N --action A [match...]  program a match-action
-                                         flow rule (nf_tables via raw
-                                         netlink) on a port's ingress
-    rule-del DEV PREF                    remove one rule
-    rule-list DEV [--stats]              dump rules as the kernel holds
+    rule-add DEV|--bridge BR --pref N --action A [match...]
+                                         program a match-action flow rule
+                                         (nf_tables via raw netlink) on a
+                                         port's ingress — or on EVERY
+                                         port of a bridge (pipeline scope)
+    rule-del DEV|--bridge BR PREF        remove one rule
+    rule-list DEV|--bridge BR [--stats]  dump rules as the kernel holds
                                          them, with live counters
-    rule-flush DEV                       remove every programmed rule
+    rule-flush DEV|--bridge BR           remove every programmed rule
     watch [--interval S] [--count N]     stream device-inventory changes
     events [--agent-socket P] [--count N]  tail the cp-agent event plane
                                          (health_change / reset frames)
@@ -197,12 +199,14 @@ def _read_sys(path: str, default: str = "") -> str:
 
 
 def _bridge_ports(bridge: str):
-    import os
+    # One bridge-port enumerator for the whole CLI (rule verbs use it
+    # through _rule_devs too); CLI-grade error at this boundary.
+    from .vsp.flow_table import FlowError, bridge_ports
 
-    brif = f"{_SYS_NET}/{bridge}/brif"
-    if not os.path.isdir(brif):
-        raise SystemExit(f"fabric-ctl: {bridge} is not a bridge (no {brif})")
-    return sorted(os.listdir(brif))
+    try:
+        return bridge_ports(bridge)
+    except FlowError as e:
+        raise SystemExit(f"fabric-ctl: {e}") from e
 
 
 def _fdb_by_port(bridge: str):
@@ -339,11 +343,45 @@ def cmd_watch(args, chan):
             remaining -= 1
 
 
+def _rule_devs(args):
+    """The target ports: one netdev, or every port of --bridge
+    (pipeline scope, like a p4rt table that classifies all traffic)."""
+    from .vsp.flow_table import bridge_ports
+
+    if args.dev and args.bridge:
+        raise SystemExit("fabric-ctl: give DEV or --bridge, not both")
+    if args.bridge:
+        devs = bridge_ports(args.bridge)
+        if not devs:
+            raise SystemExit(f"fabric-ctl: bridge {args.bridge} has no ports")
+        return devs
+    if not args.dev:
+        raise SystemExit("fabric-ctl: need DEV or --bridge")
+    return [args.dev]
+
+
+def _bridge_wide(devs, per_dev):
+    """Apply `per_dev(dev) -> outcome` to every port, never stopping
+    mid-bridge: a partial apply with no record of which ports succeeded
+    is unrecoverable for the operator. Returns (outcome map, exit code —
+    1 when any port errored)."""
+    from .vsp.flow_table import FlowError
+
+    results, rc = {}, 0
+    for dev in devs:
+        try:
+            results[dev] = per_dev(dev)
+        except FlowError as e:
+            results[dev] = f"error: {e}"
+            rc = 1
+    return results, rc
+
+
 def cmd_rule_add(args, chan):
     """Program one match-action rule (p4rt-ctl's table-add role; the
     rule model and its nf_tables expression-program translation live in
     vsp/flow_table.py, the raw-netlink codec in cni/nftnl.py)."""
-    from .vsp.flow_table import FlowRule, FlowTable
+    from .vsp.flow_table import FlowError, FlowRule, FlowTable
 
     rule = FlowRule(
         pref=args.pref, action=args.action,
@@ -351,28 +389,79 @@ def cmd_rule_add(args, chan):
         src_ip=args.src_ip, dst_ip=args.dst_ip,
         src_port=args.src_port, dst_port=args.dst_port,
     )
-    FlowTable(args.dev).add(rule)
-    print(json.dumps({"added": {"dev": args.dev, "pref": args.pref,
-                                "action": args.action}}))
+    devs = _rule_devs(args)
+    if not args.bridge:
+        FlowTable(devs[0]).add(rule)
+        print(json.dumps({"added": {"dev": devs[0], "pref": args.pref,
+                                    "action": args.action}}))
+        return
+
+    def add_one(dev):
+        table = FlowTable(dev)
+        try:
+            table.add(rule)
+            return "added"
+        except FlowError as e:
+            if "already programmed" in str(e):
+                existing = [r for r in table.list() if r["pref"] == rule.pref]
+                if existing and existing[0] == rule.spec():
+                    # Identical rule already live (e.g. a retry after a
+                    # partial bridge-wide apply): converged, not an error.
+                    return "unchanged"
+            raise
+
+    results, rc = _bridge_wide(devs, add_one)
+    print(json.dumps({"added": results, "pref": args.pref,
+                      "action": args.action}))
+    return rc
 
 
 def cmd_rule_del(args, chan):
-    from .vsp.flow_table import FlowTable
+    from .vsp.flow_table import FlowError, FlowTable
 
-    FlowTable(args.dev).delete(args.pref)
-    print(json.dumps({"deleted": {"dev": args.dev, "pref": args.pref}}))
+    devs = _rule_devs(args)
+    if not args.bridge:
+        FlowTable(devs[0]).delete(args.pref)
+        print(json.dumps({"deleted": {"dev": devs[0], "pref": args.pref}}))
+        return
+
+    def del_one(dev):
+        try:
+            FlowTable(dev).delete(args.pref)
+            return "deleted"
+        except FlowError as e:
+            if "no rule pref" in str(e):
+                return "absent"  # idempotent at pipeline scope
+            raise
+
+    results, rc = _bridge_wide(devs, del_one)
+    print(json.dumps({"deleted": results, "pref": args.pref}))
+    return rc
 
 
 def cmd_rule_list(args, chan):
     from .vsp.flow_table import FlowTable
 
-    print(json.dumps(FlowTable(args.dev).list(stats=args.stats), indent=2))
+    devs = _rule_devs(args)
+    if not args.bridge:
+        print(json.dumps(FlowTable(devs[0]).list(stats=args.stats), indent=2))
+        return
+    # Bridge scope always maps dev -> rules, even for one port — a
+    # script's parse must not depend on the current port count.
+    print(json.dumps(
+        {d: FlowTable(d).list(stats=args.stats) for d in devs}, indent=2))
 
 
 def cmd_rule_flush(args, chan):
     from .vsp.flow_table import FlowTable
 
-    print(json.dumps({"flushed": FlowTable(args.dev).flush()}))
+    devs = _rule_devs(args)
+    if not args.bridge:
+        print(json.dumps({"flushed": FlowTable(devs[0]).flush()}))
+        return
+    results, rc = _bridge_wide(devs, lambda d: FlowTable(d).flush())
+    print(json.dumps({"flushed": results}))
+    return rc
 
 
 def cmd_events(args, chan):
@@ -433,7 +522,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("watch"); p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--count", type=int, default=None)
     p.set_defaults(fn=cmd_watch)
-    p = sub.add_parser("rule-add"); p.add_argument("dev")
+    p = sub.add_parser("rule-add"); p.add_argument("dev", nargs="?")
+    p.add_argument("--bridge", help="apply to every port of this bridge")
     p.add_argument("--pref", type=int, required=True)
     p.add_argument("--action", required=True,
                    help="drop | accept | redirect:<dev> | mirror:<dev> | police:<mbit>")
@@ -442,12 +532,15 @@ def main(argv=None) -> int:
     p.add_argument("--src-ip"); p.add_argument("--dst-ip")
     p.add_argument("--src-port", type=int); p.add_argument("--dst-port", type=int)
     p.set_defaults(fn=cmd_rule_add, no_chan=True)
-    p = sub.add_parser("rule-del"); p.add_argument("dev")
+    p = sub.add_parser("rule-del"); p.add_argument("dev", nargs="?")
+    p.add_argument("--bridge")
     p.add_argument("pref", type=int); p.set_defaults(fn=cmd_rule_del, no_chan=True)
-    p = sub.add_parser("rule-list"); p.add_argument("dev")
+    p = sub.add_parser("rule-list"); p.add_argument("dev", nargs="?")
+    p.add_argument("--bridge")
     p.add_argument("--stats", action="store_true")
     p.set_defaults(fn=cmd_rule_list, no_chan=True)
-    p = sub.add_parser("rule-flush"); p.add_argument("dev")
+    p = sub.add_parser("rule-flush"); p.add_argument("dev", nargs="?")
+    p.add_argument("--bridge")
     p.set_defaults(fn=cmd_rule_flush, no_chan=True)
     p = sub.add_parser("events"); p.add_argument("--agent-socket", default=None)
     p.add_argument("--count", type=int, default=None)
@@ -456,7 +549,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     chan = None if getattr(args, "no_chan", False) else _channel(args)
     try:
-        args.fn(args, chan)
+        rc = args.fn(args, chan)
+        if rc:
+            return rc
     except grpc.RpcError as e:
         print(json.dumps({"error": e.code().name, "details": e.details()}), file=sys.stderr)
         return 1

@@ -164,6 +164,17 @@ class FlowRule:
         return {k: v for k, v in self.__dict__.items() if v is not None}
 
 
+def bridge_ports(bridge: str) -> List[str]:
+    """Enslaved ports of a bridge (sysfs brif), for bridge-wide rule
+    programming — the pipeline-scope p4rt-ctl tables have."""
+    import os
+
+    brif = f"/sys/class/net/{bridge}/brif"
+    if not os.path.isdir(brif):
+        raise FlowError(f"{bridge} is not a bridge (no {brif})")
+    return sorted(os.listdir(brif))
+
+
 class FlowTable:
     """Rule programming + readback for one netdev's ingress hook."""
 

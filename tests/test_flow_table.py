@@ -335,3 +335,65 @@ def test_foreign_userdata_left_alone(bridged_pair):
     finally:
         with nftnl.Nft() as nft:
             nft.delete_chain(TABLE, host0)  # fails if rules remain
+
+
+def test_bridge_wide_rule_programming(bridged_pair):
+    """--bridge applies the rule to every enslaved port (pipeline scope,
+    like a p4rt table): traffic from EITHER pod matching the rule drops;
+    flush clears all ports."""
+    import io
+    import json as jsonlib
+    from contextlib import redirect_stdout
+
+    from dpu_operator_tpu import fabric_ctl
+
+    (ns0, host0), (ns1, host1) = bridged_pair
+    bridge = "brF" + host0[3:]  # fixture names: fh<i><tag> / brF<tag>
+    assert fabric_ctl.main(
+        ["rule-add", "--bridge", bridge, "--pref", "4", "--action", "drop",
+         "--proto", "tcp", "--dst-port", "7800"]) == 0
+    # Blocked in BOTH directions (rule sits on both ports' ingress).
+    assert not _tcp_reach(ns0, ns1, "10.97.0.2", 7800)
+    assert not _tcp_reach(ns1, ns0, "10.97.0.1", 7800)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fabric_ctl.main(["rule-list", "--bridge", bridge]) == 0
+    per_dev = jsonlib.loads(buf.getvalue())
+    assert set(per_dev) == {host0, host1}
+    assert all(rules[0]["pref"] == 4 for rules in per_dev.values())
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fabric_ctl.main(["rule-flush", "--bridge", bridge]) == 0
+    assert jsonlib.loads(buf.getvalue())["flushed"] == {host0: 1, host1: 1}
+    assert _tcp_reach(ns0, ns1, "10.97.0.2", 7800)
+
+    # Convergence after a partial apply: one port already carries the
+    # identical rule -> bridge-wide add reports unchanged/added (rc 0),
+    # never an unrecoverable mid-bridge abort; delete is idempotent at
+    # pipeline scope (absent ports are fine).
+    assert fabric_ctl.main(
+        ["rule-add", host0, "--pref", "6", "--action", "drop",
+         "--proto", "udp"]) == 0
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fabric_ctl.main(
+            ["rule-add", "--bridge", bridge, "--pref", "6", "--action",
+             "drop", "--proto", "udp"]) == 0
+    outcomes = jsonlib.loads(buf.getvalue())["added"]
+    assert outcomes == {host0: "unchanged", host1: "added"}
+    # Same pref, DIFFERENT spec: a real conflict must surface as error.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fabric_ctl.main(
+            ["rule-add", "--bridge", bridge, "--pref", "6", "--action",
+             "accept"]) == 1
+    outcomes = jsonlib.loads(buf.getvalue())["added"]
+    assert all(o.startswith("error") for o in outcomes.values())
+    fabric_ctl.main(["rule-del", "--bridge", bridge, "6"])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert fabric_ctl.main(["rule-del", "--bridge", bridge, "6"]) == 0
+    assert jsonlib.loads(buf.getvalue())["deleted"] == {
+        host0: "absent", host1: "absent"}
