@@ -41,7 +41,7 @@ traffic-flow-tests:
 # cluster-plane cases report as skips when run locally.
 traffic-flow-matrix:
 	python -m dpu_operator_tpu.tft hack/cluster-configs/tft-config.yaml \
-	  --case-matrix --cases "1-9,15-19" --duration 2
+	  --case-matrix --cases "1-26" --duration 2
 
 bench: native
 	python bench.py

@@ -60,6 +60,17 @@ NLMSG_DONE = 3
 NFPROTO_NETDEV = 5
 NF_NETDEV_INGRESS = 0
 
+# Routed families + hooks — the NAT service plane (kube-proxy analogue)
+# lives in the ip/ip6 families, not netdev: NAT needs conntrack, and
+# conntrack hooks exist only on the routed path.
+NFPROTO_IPV4 = 2
+NFPROTO_IPV6 = 10
+NF_INET_PRE_ROUTING = 0
+NF_INET_LOCAL_IN = 1
+NF_INET_FORWARD = 2
+NF_INET_LOCAL_OUT = 3
+NF_INET_POST_ROUTING = 4
+
 # Attribute ids (uapi/linux/netfilter/nf_tables.h)
 NFTA_TABLE_NAME = 1
 NFTA_CHAIN_TABLE = 1
@@ -111,8 +122,19 @@ NFTA_LIMIT_FLAGS = 5
 NFT_LIMIT_PKT_BYTES = 1
 NFT_LIMIT_F_INV = 1
 
+NFTA_META_DREG = 1
+NFTA_META_KEY = 2
+NFT_META_L4PROTO = 16
+NFTA_NAT_TYPE = 1
+NFTA_NAT_FAMILY = 2
+NFTA_NAT_REG_ADDR_MIN = 3
+NFTA_NAT_REG_PROTO_MIN = 5
+NFT_NAT_SNAT = 0
+NFT_NAT_DNAT = 1
+
 NFT_REG_VERDICT = 0
 NFT_REG_1 = 1
+NFT_REG_2 = 2
 NF_DROP = 0
 NF_ACCEPT = 1
 
@@ -243,6 +265,57 @@ def dup_to(dev: str) -> List[bytes]:
             expr("dup", _attr_be32(NFTA_DUP_SREG_DEV, NFT_REG_1))]
 
 
+def imm_data(value: bytes, dreg: int = NFT_REG_1) -> bytes:
+    """Load raw bytes into a data register (addresses/ports for nat)."""
+    return expr("immediate",
+                _attr_be32(NFTA_IMMEDIATE_DREG, dreg)
+                + _attr_nest(NFTA_IMMEDIATE_DATA,
+                             _attr(NFTA_DATA_VALUE, value)))
+
+
+def meta_l4proto(dreg: int = NFT_REG_1) -> bytes:
+    """reg = layer-4 protocol number — works for ip AND ip6 (where a raw
+    next-header payload read would be wrong under extension headers)."""
+    return expr("meta",
+                _attr_be32(NFTA_META_DREG, dreg)
+                + _attr_be32(NFTA_META_KEY, NFT_META_L4PROTO))
+
+
+def dnat_to(ip: str, port: Optional[int] = None) -> List[bytes]:
+    """DNAT the flow to `ip` (v4 or v6), optionally rewriting the
+    destination port. Port-less DNAT preserves the original port — the
+    clusterIP port==targetPort shape; with a port it is the nodePort
+    remap shape. Must sit in an ip/ip6-family nat chain."""
+    v6 = ":" in ip
+    family = NFPROTO_IPV6 if v6 else NFPROTO_IPV4
+    addr = socket.inet_pton(socket.AF_INET6 if v6 else socket.AF_INET, ip)
+    exprs = [imm_data(addr, NFT_REG_1)]
+    nat_attrs = (_attr_be32(NFTA_NAT_TYPE, NFT_NAT_DNAT)
+                 + _attr_be32(NFTA_NAT_FAMILY, family)
+                 + _attr_be32(NFTA_NAT_REG_ADDR_MIN, NFT_REG_1))
+    if port is not None:
+        exprs.append(imm_data(struct.pack(">H", port), NFT_REG_2))
+        nat_attrs += _attr_be32(NFTA_NAT_REG_PROTO_MIN, NFT_REG_2)
+    exprs.append(expr("nat", nat_attrs))
+    return exprs
+
+
+def snat_to(ip: str) -> List[bytes]:
+    """SNAT the flow's source to `ip` — postrouting chains only."""
+    v6 = ":" in ip
+    family = NFPROTO_IPV6 if v6 else NFPROTO_IPV4
+    addr = socket.inet_pton(socket.AF_INET6 if v6 else socket.AF_INET, ip)
+    return [imm_data(addr, NFT_REG_1),
+            expr("nat", _attr_be32(NFTA_NAT_TYPE, NFT_NAT_SNAT)
+                 + _attr_be32(NFTA_NAT_FAMILY, family)
+                 + _attr_be32(NFTA_NAT_REG_ADDR_MIN, NFT_REG_1))]
+
+
+def masq() -> bytes:
+    """Masquerade — SNAT to the outgoing interface's own address."""
+    return expr("masq", b"")
+
+
 def limit_over_mbit(mbit: float) -> bytes:
     """Matches (continues the rule) only when the flow EXCEEDS the rate —
     pair with a drop verdict for policing (nft 'limit rate over X drop')."""
@@ -311,7 +384,16 @@ class Nft:
 
         pending = set(seqs)
         while pending:
-            data = self._sock.recv(65536)
+            try:
+                data = self._sock.recv(65536)
+            except socket.timeout:
+                # A batch aborted without per-op errors leaves the
+                # skipped ops unacked; surface that as a CLI-grade error
+                # instead of a raw socket.timeout from deep inside.
+                raise NftError(
+                    f"nf_tables batch: no ack for seq(s) "
+                    f"{sorted(pending)} within the socket timeout "
+                    f"(batch likely aborted)", errno_=0) from None
             off = 0
             while off + 16 <= len(data):
                 nlen, ntype, _fl, seq, _pid = struct.unpack_from("IHHII", data, off)
@@ -360,6 +442,22 @@ class Nft:
         except NftError as e:
             if e.errno != 2:  # ENOENT: already gone
                 raise
+
+    def ensure_nat_chain(self, table: str, chain: str, hooknum: int,
+                         priority: int) -> None:
+        """Routed-family (ip/ip6) nat-type hook chain — no device bind;
+        construct the Nft with family=NFPROTO_IPV4/IPV6. Priority
+        convention follows iptables: -100 for dnat hooks (prerouting/
+        output), 100 for snat (postrouting)."""
+        hook = _attr_nest(
+            NFTA_CHAIN_HOOK,
+            _attr_be32(NFTA_HOOK_HOOKNUM, hooknum)
+            + _attr_be32(NFTA_HOOK_PRIORITY, priority & 0xFFFFFFFF))
+        self._transact([(NFT_MSG_NEWCHAIN, NLM_F_CREATE,
+                         _attr_str(NFTA_CHAIN_TABLE, table)
+                         + _attr_str(NFTA_CHAIN_NAME, chain)
+                         + hook
+                         + _attr_str(NFTA_CHAIN_TYPE, "nat"))])
 
     def ensure_ingress_chain(self, table: str, chain: str, dev: str,
                              priority: int = 0) -> None:

@@ -3,9 +3,9 @@
 The reference's config selects cases by number with a range grammar
 (`test_cases: "1"`, "1-9,15-19" — /root/reference/hack/cluster-configs/
 ocp-tft-config.yaml:4-5) against the kubernetes-traffic-flow-tests
-matrix of {pod, host} × {pod, host, clusterIP, nodePort} × {same node,
-different node} endpoints. This module carries that numbering and maps
-each case onto a locally-realisable topology:
+matrix of {pod, host} × {pod, host, clusterIP, nodePort, external} ×
+{same node, different node} endpoints. This module carries that
+numbering and maps EVERY case onto a locally-realisable topology:
 
   * pod endpoints    — a network namespace attached to the fabric bridge
   * host endpoints   — the node's root namespace, addressed on the
@@ -16,9 +16,25 @@ each case onto a locally-realisable topology:
                        two-"node" fabric emulation (same L2 domain, the
                        flat-ICI shape; traffic really crosses
                        bridge A -> uplink -> bridge B)
-  * clusterIP/nodePort/external cases — need a cluster service plane (or
-    an off-fabric external host); reported as SKIPPED with the reason,
-    never silently dropped.
+  * clusterIP/nodePort — a kube-proxy-style NAT service plane programmed
+    through the repo's own raw-netlink nf_tables codec
+    (tft/serviceplane.py over cni/nftnl.py): DNAT on the node's
+    prerouting/output hooks, masquerade on postrouting. The client
+    targets the VIP (or nodeIP:nodePort) and the flow really transits
+    the node's conntrack both ways. v6 flavours ride an ip6-family
+    table over the fabric's ULA prefix.
+  * external         — an off-fabric namespace behind a routed (not
+    bridged) veth on its own subnet; pod egress masquerades through the
+    node, the classic SNAT egress path.
+
+On kernels without nf_tables NAT the service cases degrade to explicit
+SKIPPED rows with the reason (probed once, never silently dropped).
+
+Case 15 (host-to-host-same-node) note: both endpoints are root-netns
+addresses, so the kernel local-routes the flow over loopback — exactly
+what two host-network endpoints on one real node do. The result row is
+tagged `path: local-route` so the number is never mistaken for a bridge
+measurement (the diff-node variant, case 16, crosses the fabric).
 
 The case grammar parser accepts exactly the reference's forms:
 "1", "1,3,17", "1-9,15-19".
@@ -29,42 +45,50 @@ from __future__ import annotations
 import subprocess
 import uuid
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
-# (case id) -> (name, client_kind, server_kind, same_node) or an
-# unsupported-locally reason. Numbering follows the upstream
-# kubernetes-traffic-flow-tests TestCaseType convention the reference
-# selects from ("1-9,15-19" supported there).
-_CLUSTER = "needs a cluster service plane (clusterIP/nodePort) — run on a real cluster via make kind-test"
-_EXTERNAL = "needs an off-fabric external host — covered by tests/test_e2e.py external scenarios"
+# Service-plane address plan: fabric pods/hosts live in 10.94.0.0/24
+# (fd00:5e::/64), services in the 10.96.0.0/16 clusterIP convention,
+# external hosts on their own routed subnet.
+VIP = "10.96.0.10"
+GW_IP = "10.94.0.1"
+GW_IP6 = "fd00:5e::1"
+HOST6 = {"10.94.0.1": "fd00:5e::1", "10.94.0.2": "fd00:5e::2"}
+POD6 = {"10.94.0.11": "fd00:5e::11", "10.94.0.12": "fd00:5e::12"}
+NODEPORT_OFFSET = 10000  # engine port 20xxx <-> nodePort 30xxx
+EXT_NET = "192.168.77"
 
+# (case id) -> (name, client_kind, server_kind, same_node, service).
+# service: None | clusterip | nodeport | nodeport6 | external.
+# Numbering follows the upstream kubernetes-traffic-flow-tests
+# TestCaseType convention the reference selects from.
 CASES = {
-    1: ("pod-to-pod-same-node", "pod", "pod", True),
-    2: ("pod-to-pod-diff-node", "pod", "pod", False),
-    3: ("pod-to-host-same-node", "pod", "host", True),
-    4: ("pod-to-host-diff-node", "pod", "host", False),
-    5: ("pod-to-clusterip-to-pod-same-node", _CLUSTER),
-    6: ("pod-to-clusterip-to-pod-diff-node", _CLUSTER),
-    7: ("pod-to-clusterip-to-host-same-node", _CLUSTER),
-    8: ("pod-to-clusterip-to-host-diff-node", _CLUSTER),
-    9: ("pod-to-nodeport-to-pod-same-node", _CLUSTER),
-    10: ("pod-to-nodeport-to-pod-diff-node", _CLUSTER),
-    11: ("pod-to-nodeport-to-host-same-node", _CLUSTER),
-    12: ("pod-to-nodeport-to-host-diff-node", _CLUSTER),
-    13: ("pod-to-nodeport-to-host-same-node-v6", _CLUSTER),
-    14: ("pod-to-nodeport-to-host-diff-node-v6", _CLUSTER),
-    15: ("host-to-host-same-node", "host", "host", True),
-    16: ("host-to-host-diff-node", "host", "host", False),
-    17: ("host-to-pod-same-node", "host", "pod", True),
-    18: ("host-to-pod-diff-node", "host", "pod", False),
-    19: ("host-to-clusterip-to-pod-same-node", _CLUSTER),
-    20: ("host-to-clusterip-to-pod-diff-node", _CLUSTER),
-    21: ("host-to-clusterip-to-host-same-node", _CLUSTER),
-    22: ("host-to-clusterip-to-host-diff-node", _CLUSTER),
-    23: ("host-to-nodeport-to-pod-same-node", _CLUSTER),
-    24: ("host-to-nodeport-to-pod-diff-node", _CLUSTER),
-    25: ("pod-to-external", _EXTERNAL),
-    26: ("host-to-external", _EXTERNAL),
+    1: ("pod-to-pod-same-node", "pod", "pod", True, None),
+    2: ("pod-to-pod-diff-node", "pod", "pod", False, None),
+    3: ("pod-to-host-same-node", "pod", "host", True, None),
+    4: ("pod-to-host-diff-node", "pod", "host", False, None),
+    5: ("pod-to-clusterip-to-pod-same-node", "pod", "pod", True, "clusterip"),
+    6: ("pod-to-clusterip-to-pod-diff-node", "pod", "pod", False, "clusterip"),
+    7: ("pod-to-clusterip-to-host-same-node", "pod", "host", True, "clusterip"),
+    8: ("pod-to-clusterip-to-host-diff-node", "pod", "host", False, "clusterip"),
+    9: ("pod-to-nodeport-to-pod-same-node", "pod", "pod", True, "nodeport"),
+    10: ("pod-to-nodeport-to-pod-diff-node", "pod", "pod", False, "nodeport"),
+    11: ("pod-to-nodeport-to-host-same-node", "pod", "host", True, "nodeport"),
+    12: ("pod-to-nodeport-to-host-diff-node", "pod", "host", False, "nodeport"),
+    13: ("pod-to-nodeport-to-host-same-node-v6", "pod", "host", True, "nodeport6"),
+    14: ("pod-to-nodeport-to-host-diff-node-v6", "pod", "host", False, "nodeport6"),
+    15: ("host-to-host-same-node", "host", "host", True, None),
+    16: ("host-to-host-diff-node", "host", "host", False, None),
+    17: ("host-to-pod-same-node", "host", "pod", True, None),
+    18: ("host-to-pod-diff-node", "host", "pod", False, None),
+    19: ("host-to-clusterip-to-pod-same-node", "host", "pod", True, "clusterip"),
+    20: ("host-to-clusterip-to-pod-diff-node", "host", "pod", False, "clusterip"),
+    21: ("host-to-clusterip-to-host-same-node", "host", "host", True, "clusterip"),
+    22: ("host-to-clusterip-to-host-diff-node", "host", "host", False, "clusterip"),
+    23: ("host-to-nodeport-to-pod-same-node", "host", "pod", True, "nodeport"),
+    24: ("host-to-nodeport-to-pod-diff-node", "host", "pod", False, "nodeport"),
+    25: ("pod-to-external", "pod", "external", False, "external"),
+    26: ("host-to-external", "host", "external", False, "external"),
 }
 
 
@@ -94,21 +118,69 @@ def parse_cases(spec: str) -> List[int]:
     return [c for c in out if not (c in seen or seen.add(c))]
 
 
+_nat_probe: Dict[bool, Optional[str]] = {}
+
+
+def _nat_unsupported(v6: bool) -> Optional[str]:
+    """One cached kernel probe per family: can we create an ip/ip6 nat
+    chain? Returns the skip reason when we can't (old kernel, missing
+    nf_nat/conntrack, insufficient privilege), else None."""
+    if v6 not in _nat_probe:
+        from ..cni import nftnl as nf
+
+        if v6:
+            import os
+
+            # ip6 nat chains can register even when the host has IPv6
+            # runtime-disabled; the address plan would then fail at
+            # `ip -6 addr add`. Skip honestly instead.
+            if not os.path.exists("/proc/net/if_inet6"):
+                _nat_probe[v6] = ("host has IPv6 runtime-disabled — "
+                                  "v6 cases need an IPv6-capable node")
+                return _nat_probe[v6]
+        probe = "dpusvcprobe6" if v6 else "dpusvcprobe"
+        try:
+            with nf.Nft(family=nf.NFPROTO_IPV6 if v6
+                        else nf.NFPROTO_IPV4) as nft:
+                nft.ensure_table(probe)
+                try:
+                    nft.ensure_nat_chain(
+                        probe, "pr", nf.NF_INET_PRE_ROUTING, -100)
+                finally:
+                    nft.delete_table(probe)
+            _nat_probe[v6] = None
+        except Exception as e:
+            _nat_probe[v6] = (
+                f"kernel/privilege lacks nf_tables {'ip6' if v6 else 'ip'} "
+                f"NAT ({e}) — run on a real cluster via make kind-test")
+    return _nat_probe[v6]
+
+
 def case_reason(case_id: int) -> Optional[str]:
-    """The skip reason for locally-unsupported cases, else None."""
-    entry = CASES[case_id]
-    return entry[1] if len(entry) == 2 else None
+    """The skip reason for cases this environment can't realise, else
+    None. All 26 cases run where nf_tables NAT is available (probed)."""
+    service = CASES[case_id][4]
+    if service in ("clusterip", "nodeport", "external"):
+        return _nat_unsupported(v6=False)
+    if service == "nodeport6":
+        return _nat_unsupported(v6=True)
+    return None
 
 
 @dataclass
 class CaseTopology:
     """Built endpoints for one case: netns of None means the root
-    namespace (host endpoint)."""
+    namespace (host endpoint). Clients dial connect_ip (the service VIP
+    or nodeIP when a service fronts the server) at engine port +
+    port_offset; servers bind server_ip at the engine port."""
     case_id: int
     name: str
     client_netns: Optional[str]
     server_netns: Optional[str]
     server_ip: str
+    connect_ip: Optional[str] = None
+    port_offset: int = 0
+    tags: Dict[str, str] = field(default_factory=dict)
     _cleanups: List[Callable[[], None]] = field(default_factory=list)
 
     def cleanup(self) -> None:
@@ -132,8 +204,22 @@ def _fabric_mtu() -> int:
     return resolve_fabric_mtu()
 
 
+def _sysctl(path: str, value: str, cleanups: List,
+            netns: Optional[str] = None) -> None:
+    """Set a sysctl, restoring the prior value at cleanup (root-netns
+    sysctls are global state the suite must hand back)."""
+    cmd = ["ip", "netns", "exec", netns] if netns else []
+    old = subprocess.run(cmd + ["cat", path], capture_output=True,
+                         text=True).stdout.strip()
+    _run(cmd + ["sh", "-c", f"echo {value} > {path}"])
+    if old and old != value and netns is None:
+        cleanups.append(lambda: subprocess.run(
+            ["sh", "-c", f"echo {old} > {path}"], capture_output=True))
+
+
 def _pod(ns: str, host_if: str, pod_if: str, bridge: str, ip: str,
-         cleanups: List, mtu: int) -> None:
+         cleanups: List, mtu: int, ip6: Optional[str] = None,
+         gw: Optional[str] = None, gw6: Optional[str] = None) -> None:
     _run(["ip", "netns", "add", ns])
     cleanups.append(lambda: subprocess.run(
         ["ip", "netns", "del", ns], capture_output=True))
@@ -144,21 +230,44 @@ def _pod(ns: str, host_if: str, pod_if: str, bridge: str, ip: str,
     _run(["ip", "link", "set", host_if, "up"])
     _run(["ip", "-n", ns, "link", "set", pod_if, "up"])
     _run(["ip", "-n", ns, "addr", "add", f"{ip}/24", "dev", pod_if])
+    if ip6:
+        _run(["ip", "-n", ns, "-6", "addr", "add", f"{ip6}/64",
+              "dev", pod_if, "nodad"])
+    if gw:
+        _run(["ip", "-n", ns, "route", "add", "default", "via", gw])
+        # A router hairpinning a flow back out its ingress interface
+        # emits ICMP redirects; a client that honours one would bypass
+        # the NAT mid-flow. Pods ignore them (netns dies with cleanup).
+        _sysctl("/proc/sys/net/ipv4/conf/all/accept_redirects", "0",
+                cleanups, netns=ns)
+    if gw6:
+        _run(["ip", "-n", ns, "-6", "route", "add", "default", "via", gw6])
+        _sysctl("/proc/sys/net/ipv6/conf/all/accept_redirects", "0",
+                cleanups, netns=ns)
 
 
-def build_case_topology(case_id: int) -> CaseTopology:
-    """Stand up the case's endpoint topology with a unique name tag;
-    raises ValueError for locally-unsupported cases (use case_reason
-    first to report a skip instead)."""
+def build_case_topology(case_id: int, port_base: int = 0,
+                        port_span: int = 0) -> CaseTopology:
+    """Stand up the case's endpoint topology with a unique name tag.
+    NodePort cases program exact per-port DNAT pairs, so callers must
+    pass the engine port range ([port_base, port_base+port_span)) they
+    will run against. Raises ValueError for cases this kernel can't
+    realise (use case_reason first to report a skip instead)."""
+    name, client_kind, server_kind, same_node, service = CASES[case_id]
+    if service in ("nodeport", "nodeport6") and port_base <= 0:
+        # Precondition check before the kernel probe: a caller bug, not
+        # an environment limitation.
+        raise ValueError(
+            f"case {case_id} ({name}) programs exact nodePort DNAT pairs: "
+            f"pass port_base/port_span for the engine ports you will use")
     reason = case_reason(case_id)
     if reason is not None:
         raise ValueError(f"case {case_id} unsupported locally: {reason}")
-    name, client_kind, server_kind, same_node = CASES[case_id]
     tag = uuid.uuid4().hex[:5]
     cleanups: List = []
     try:
         return _build(case_id, name, client_kind, server_kind, same_node,
-                      tag, cleanups)
+                      service, tag, cleanups, port_base, port_span or 1)
     except Exception:
         # A half-built topology must not leak bridges/netns on the host.
         for fn in reversed(cleanups):
@@ -170,8 +279,10 @@ def build_case_topology(case_id: int) -> CaseTopology:
 
 
 def _build(case_id: int, name: str, client_kind: str, server_kind: str,
-           same_node: bool, tag: str, cleanups: List) -> CaseTopology:
+           same_node: bool, service: Optional[str], tag: str,
+           cleanups: List, port_base: int, port_span: int) -> CaseTopology:
     mtu = _fabric_mtu()
+    v6 = service == "nodeport6"
 
     bridge_a = "bta" + tag
     _run(["ip", "link", "add", bridge_a, "mtu", str(mtu), "type", "bridge"])
@@ -179,7 +290,7 @@ def _build(case_id: int, name: str, client_kind: str, server_kind: str,
         ["ip", "link", "del", bridge_a], capture_output=True))
     _run(["ip", "link", "set", bridge_a, "up"])
 
-    if same_node:
+    if same_node or server_kind == "external":
         bridge_b = bridge_a
     else:
         # "Node B" = a second bridge, fabric-linked to node A by a veth
@@ -200,14 +311,40 @@ def _build(case_id: int, name: str, client_kind: str, server_kind: str,
         _run(["ip", "link", "set", up_a, "up"])
         _run(["ip", "link", "set", up_b, "up"])
 
+    service_gw = service is not None and server_kind != "external"
+    pod_gw = GW_IP if service_gw else None
+    pod_gw6 = GW_IP6 if v6 else None
+
     # Address plan: hosts .1/.2, pods .11/.12 — one flat /24, the
-    # flat-ICI L2 shape.
+    # flat-ICI L2 shape. v6 cases add the matching ULA /64.
     endpoints = {}  # role -> (netns or None, ip)
+    host_ips_added = set()
     for role, kind, bridge, host_ip, pod_ip, idx in (
         ("client", client_kind, bridge_a, "10.94.0.1", "10.94.0.11", 0),
         ("server", server_kind, bridge_b, "10.94.0.2", "10.94.0.12", 1),
     ):
-        if kind == "host" and role == "server" and not same_node:
+        if kind == "external":
+            # Off-fabric: a routed (not bridged) veth on its own subnet;
+            # the node forwards + masquerades pod egress toward it.
+            ns = f"tx{idx}{tag}"
+            ext_host, ext_peer = f"xh{idx}{tag}", f"xp{idx}{tag}"
+            _run(["ip", "netns", "add", ns])
+            cleanups.append(lambda n=ns: subprocess.run(
+                ["ip", "netns", "del", n], capture_output=True))
+            _run(["ip", "link", "add", ext_host, "type", "veth",
+                  "peer", "name", ext_peer])
+            cleanups.append(lambda l=ext_host: subprocess.run(
+                ["ip", "link", "del", l], capture_output=True))
+            _run(["ip", "link", "set", ext_peer, "netns", ns])
+            _run(["ip", "addr", "add", f"{EXT_NET}.1/24", "dev", ext_host])
+            _run(["ip", "link", "set", ext_host, "up"])
+            _run(["ip", "-n", ns, "link", "set", ext_peer, "up"])
+            _run(["ip", "-n", ns, "addr", "add", f"{EXT_NET}.2/24",
+                  "dev", ext_peer])
+            _run(["ip", "-n", ns, "route", "add", "default",
+                  "via", f"{EXT_NET}.1"])
+            endpoints[role] = (ns, f"{EXT_NET}.2")
+        elif kind == "host" and role == "server" and not same_node:
             # "Node B's root namespace": a host endpoint in the SAME
             # (test-runner) netns as the client would satisfy the local
             # route table and short-circuit over loopback, never touching
@@ -215,21 +352,30 @@ def _build(case_id: int, name: str, client_kind: str, server_kind: str,
             # model it as one — its fabric interface rides bridge B.
             ns = f"tn{idx}{tag}"
             _pod(ns, f"th{idx}{tag}", f"tp{idx}{tag}", bridge, host_ip,
-                 cleanups, mtu)
-            endpoints[role] = (ns, host_ip)
+                 cleanups, mtu, ip6=HOST6[host_ip] if v6 else None)
+            endpoints[role] = (ns, HOST6[host_ip] if v6 else host_ip)
         elif kind == "host":
             _run(["ip", "addr", "add", f"{host_ip}/24", "dev", bridge])
             cleanups.append(lambda b=bridge, ip=host_ip: subprocess.run(
                 ["ip", "addr", "del", f"{ip}/24", "dev", b],
                 capture_output=True))
-            endpoints[role] = (None, host_ip)
+            host_ips_added.add(host_ip)
+            if v6:
+                _run(["ip", "-6", "addr", "add", f"{HOST6[host_ip]}/64",
+                      "dev", bridge, "nodad"])
+                cleanups.append(lambda b=bridge, ip=HOST6[host_ip]:
+                                subprocess.run(
+                    ["ip", "-6", "addr", "del", f"{ip}/64", "dev", b],
+                    capture_output=True))
+            endpoints[role] = (None, HOST6[host_ip] if v6 else host_ip)
         else:
             ns = f"tc{idx}{tag}"
             _pod(ns, f"th{idx}{tag}", f"tp{idx}{tag}", bridge, pod_ip,
-                 cleanups, mtu)
-            endpoints[role] = (ns, pod_ip)
+                 cleanups, mtu, ip6=POD6[pod_ip] if v6 else None,
+                 gw=pod_gw, gw6=pod_gw6)
+            endpoints[role] = (ns, POD6[pod_ip] if v6 else pod_ip)
 
-    return CaseTopology(
+    topo = CaseTopology(
         case_id=case_id,
         name=name,
         client_netns=endpoints["client"][0],
@@ -237,3 +383,95 @@ def _build(case_id: int, name: str, client_kind: str, server_kind: str,
         server_ip=endpoints["server"][1],
         _cleanups=cleanups,
     )
+    if case_id == 15:
+        topo.tags["path"] = "local-route"  # see module docstring
+    if service is not None:
+        _wire_service(topo, service, client_kind, bridge_a, endpoints,
+                      host_ips_added, cleanups, port_base, port_span)
+    return topo
+
+
+def _wire_service(topo: CaseTopology, service: str, client_kind: str,
+                  bridge_a: str, endpoints: Dict, host_ips_added: set,
+                  cleanups: List, port_base: int, port_span: int) -> None:
+    """The node-side scaffolding every service case shares: gateway
+    address, forwarding, redirect suppression, and the NAT rule set."""
+    from .serviceplane import ServicePlane
+
+    v6 = service == "nodeport6"
+    backend_ip = topo.server_ip
+    tag = bridge_a[3:]
+
+    if service != "external":
+        # The node is the pods' default gateway — give bridge A the
+        # gateway address unless a host endpoint already claimed it.
+        if GW_IP not in host_ips_added:
+            _run(["ip", "addr", "add", f"{GW_IP}/24", "dev", bridge_a])
+            cleanups.append(lambda: subprocess.run(
+                ["ip", "addr", "del", f"{GW_IP}/24", "dev", bridge_a],
+                capture_output=True))
+        if v6:
+            # The node's v6 identity (nodePort target): host endpoints
+            # only ever claim ::2 in the plan, so ::1 is always ours.
+            _run(["ip", "-6", "addr", "add", f"{GW_IP6}/64",
+                  "dev", bridge_a, "nodad"])
+            cleanups.append(lambda: subprocess.run(
+                ["ip", "-6", "addr", "del", f"{GW_IP6}/64", "dev", bridge_a],
+                capture_output=True))
+
+    _sysctl("/proc/sys/net/ipv4/ip_forward", "1", cleanups)
+    _sysctl(f"/proc/sys/net/ipv4/conf/{bridge_a}/send_redirects", "0",
+            cleanups)
+    if v6:
+        _sysctl("/proc/sys/net/ipv6/conf/all/forwarding", "1", cleanups)
+    # Two-"node" emulation artifact: both bridges share ONE root netns,
+    # so with br_netfilter active the routed-then-bridged packet
+    # re-enters the ip prerouting path on bridge B carrying the node's
+    # own source address (post-masquerade) and dies on the martian-
+    # source check. Real clusters never bridge two nodes through one
+    # conntrack domain; the service plane here rides the routed path
+    # only, so bridge-nf-call is not needed — park it for the case.
+    import os
+
+    for knob in ("bridge-nf-call-iptables", "bridge-nf-call-ip6tables"):
+        path = f"/proc/sys/net/bridge/{knob}"
+        if os.path.exists(path):
+            _sysctl(path, "0", cleanups)
+
+    sp = ServicePlane(tag, v6=v6)
+    cleanups.append(sp.close)
+
+    if service == "clusterip":
+        sp.add_clusterip(VIP, backend_ip)
+        topo.connect_ip = VIP
+        if client_kind == "host":
+            # Host clients need an initial route for the VIP (the route
+            # lookup precedes the output-hook DNAT; the kernel reroutes
+            # after the rewrite).
+            _run(["ip", "route", "add", f"{VIP}/32", "dev", bridge_a])
+            cleanups.append(lambda: subprocess.run(
+                ["ip", "route", "del", f"{VIP}/32", "dev", bridge_a],
+                capture_output=True))
+    elif service in ("nodeport", "nodeport6"):
+        node_ip = GW_IP6 if v6 else GW_IP
+        for port in range(port_base, port_base + port_span):
+            sp.add_nodeport(node_ip, port + NODEPORT_OFFSET,
+                            backend_ip, port)
+        if endpoints["server"][0] is not None:
+            sp.add_masquerade_to(backend_ip)
+        topo.connect_ip = node_ip
+        topo.port_offset = NODEPORT_OFFSET
+    elif service == "external":
+        # Egress SNAT: pod traffic leaves the fabric masqueraded as the
+        # node; host traffic is already node-sourced.
+        sp.add_masquerade_to(backend_ip)
+        if client_kind == "pod":
+            # Pods need a way off the fabric subnet.
+            client_ns = endpoints["client"][0]
+            _run(["ip", "addr", "add", f"{GW_IP}/24", "dev", bridge_a])
+            cleanups.append(lambda: subprocess.run(
+                ["ip", "addr", "del", f"{GW_IP}/24", "dev", bridge_a],
+                capture_output=True))
+            _run(["ip", "-n", client_ns, "route", "add", "default",
+                  "via", GW_IP])
+    topo.tags["service"] = service

@@ -36,6 +36,12 @@ UDP_PAYLOAD = 8192
 RR_PAYLOAD = 1
 
 
+def _family(ip: str) -> int:
+    """Dual-stack: the v6 matrix cases (13/14) hand engines ULA
+    addresses."""
+    return socket.AF_INET6 if ":" in ip else socket.AF_INET
+
+
 def _emit(**kw) -> None:
     kw.setdefault("engine", "python")
     print(json.dumps(kw), flush=True)
@@ -62,7 +68,7 @@ def find_pump() -> str | None:
 
 
 def tcp_stream_server(bind_ip: str, port: int, duration: float) -> None:
-    s = socket.socket()
+    s = socket.socket(_family(bind_ip))
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind((bind_ip, port))
     s.listen(1)
@@ -103,7 +109,7 @@ def tcp_stream_client(server_ip: str, port: int, duration: float) -> None:
 
 
 def udp_server(bind_ip: str, port: int, duration: float) -> None:
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s = socket.socket(_family(bind_ip), socket.SOCK_DGRAM)
     s.bind((bind_ip, port))
     s.settimeout(duration + 30)
     total = 0
@@ -130,7 +136,7 @@ def udp_server(bind_ip: str, port: int, duration: float) -> None:
 
 
 def udp_client(server_ip: str, port: int, duration: float) -> None:
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s = socket.socket(_family(server_ip), socket.SOCK_DGRAM)
     payload = b"\x5a" * UDP_PAYLOAD
     end = time.perf_counter() + duration
     total = 0
@@ -146,7 +152,7 @@ def udp_client(server_ip: str, port: int, duration: float) -> None:
 
 
 def tcp_rr_server(bind_ip: str, port: int, duration: float) -> None:
-    s = socket.socket()
+    s = socket.socket(_family(bind_ip))
     s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind((bind_ip, port))
     s.listen(1)

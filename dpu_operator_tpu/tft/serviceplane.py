@@ -1,0 +1,131 @@
+"""Kube-proxy-style service plane over nft NAT — the cluster half of the
+traffic-flow matrix.
+
+The reference's clusterIP/nodePort cases (5-14, 19-24 of the upstream
+kubernetes-traffic-flow-tests numbering; its supported selection
+"1-9,15-19" includes 5-9 — /root/reference/hack/cluster-configs/
+ocp-tft-config.yaml:3-6) ride a real cluster's kube-proxy. This module
+realises the same dataplane locally with the repo's own raw-netlink
+nf_tables codec (cni/nftnl.py): DNAT rules on the node's prerouting
+(pod-originated) and output (host-originated) hooks, plus masquerade on
+postrouting so hairpinned flows stay symmetric through the node's
+conntrack — exactly the rule shapes kube-proxy's iptables/nftables mode
+programs, built here with zero userspace tooling.
+
+Flow anatomy (clusterIP, pod client):
+    pod 10.94.0.11 → VIP 10.96.0.10        (off-subnet → default gw)
+    node prerouting: dnat → backend .12    (addr-only: port==targetPort)
+    node postrouting: masq → src 10.94.0.1 (reply must re-enter conntrack,
+                                            not short-circuit over L2)
+    backend reply → node → de-NAT both ways → pod sees VIP as the peer
+
+NodePort adds the port-rewrite shape: nodeIP:30NNN → backend:20NNN, one
+rule per port pair (the harness's per-connection ports are known at
+topology-build time, so the rules are exact, not wildcards).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ..cni import nftnl as nf
+
+_PROTO_NUM = {"tcp": 6, "udp": 17}
+
+
+class ServicePlane:
+    """One node's service NAT rules, one nft table per instance (per-case
+    tag keeps concurrent topologies disjoint); close() drops the table
+    and every rule with it — cleanup is one transaction."""
+
+    def __init__(self, tag: str, v6: bool = False):
+        self.v6 = v6
+        self.table = ("dpusvc6" if v6 else "dpusvc") + tag
+        self._masqueraded: set = set()
+        self._nft = nf.Nft(
+            family=nf.NFPROTO_IPV6 if v6 else nf.NFPROTO_IPV4)
+        ok = False
+        try:
+            self._nft.ensure_table(self.table)
+            self._nft.ensure_nat_chain(
+                self.table, "prerouting", nf.NF_INET_PRE_ROUTING, -100)
+            self._nft.ensure_nat_chain(
+                self.table, "output", nf.NF_INET_LOCAL_OUT, -100)
+            self._nft.ensure_nat_chain(
+                self.table, "postrouting", nf.NF_INET_POST_ROUTING, 100)
+            ok = True
+        finally:
+            if not ok:
+                # Half-initialised planes must not strand a table (and
+                # best-effort teardown must not mask the real error).
+                try:
+                    self.close()
+                except Exception:
+                    pass
+
+    # -- match helpers --------------------------------------------------------
+
+    def _daddr_match(self, ip: str) -> List[bytes]:
+        import socket as _s
+
+        if self.v6:
+            return [nf.payload_load(nf.NFT_PAYLOAD_NETWORK_HEADER, 24, 16),
+                    nf.cmp_eq(_s.inet_pton(_s.AF_INET6, ip))]
+        return [nf.payload_load(nf.NFT_PAYLOAD_NETWORK_HEADER, 16, 4),
+                nf.cmp_eq(_s.inet_aton(ip))]
+
+    @staticmethod
+    def _l4_match(proto: str, dport: Optional[int]) -> List[bytes]:
+        import struct as _st
+
+        exprs = [nf.meta_l4proto(), nf.cmp_eq(bytes([_PROTO_NUM[proto]]))]
+        if dport is not None:
+            exprs += [nf.payload_load(nf.NFT_PAYLOAD_TRANSPORT_HEADER, 2, 2),
+                      nf.cmp_eq(_st.pack(">H", dport))]
+        return exprs
+
+    def _dnat_rule(self, frontend_ip: str, frontend_port: Optional[int],
+                   backend_ip: str, backend_port: Optional[int],
+                   proto: str) -> None:
+        exprs = (self._l4_match(proto, frontend_port)
+                 + self._daddr_match(frontend_ip)
+                 + nf.dnat_to(backend_ip, backend_port))
+        # Both origination paths: prerouting catches pod/fabric clients,
+        # output catches the node's own (host) clients.
+        for chain in ("prerouting", "output"):
+            self._nft.add_rule(self.table, chain, exprs)
+
+    # -- service shapes -------------------------------------------------------
+
+    def add_clusterip(self, vip: str, backend_ip: str,
+                      protos: Iterable[str] = ("tcp", "udp")) -> None:
+        """VIP → backend, any port (the k8s port==targetPort shape, one
+        rule per protocol like kube-proxy's per-protocol service ports)."""
+        for proto in protos:
+            self._dnat_rule(vip, None, backend_ip, None, proto)
+        self.add_masquerade_to(backend_ip)
+
+    def add_nodeport(self, node_ip: str, node_port: int, backend_ip: str,
+                     backend_port: int,
+                     protos: Iterable[str] = ("tcp", "udp")) -> None:
+        """nodeIP:nodePort → backend:targetPort — the port-rewrite shape."""
+        for proto in protos:
+            self._dnat_rule(node_ip, node_port, backend_ip, backend_port,
+                            proto)
+
+    def add_masquerade_to(self, dest_ip: str) -> None:
+        """Masquerade flows headed to `dest_ip` (post-DNAT daddr): forces
+        replies back through this node's conntrack instead of letting a
+        same-subnet backend answer the client directly with its own
+        (un-de-NATted) address. Idempotent per destination."""
+        if dest_ip in self._masqueraded:
+            return
+        self._masqueraded.add(dest_ip)
+        self._nft.add_rule(self.table, "postrouting",
+                           self._daddr_match(dest_ip) + [nf.masq()])
+
+    def close(self) -> None:
+        try:
+            self._nft.delete_table(self.table)
+        finally:
+            self._nft.close()

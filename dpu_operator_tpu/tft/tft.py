@@ -97,9 +97,13 @@ def run_connection(
     server_ip: str,
     duration: float,
     port: int = BASE_PORT,
+    connect_ip: Optional[str] = None,
+    connect_port: Optional[int] = None,
 ) -> dict:
     """One connection: server engine in the server netns, client engine in
-    the client netns, collect the server-side result line."""
+    the client netns, collect the server-side result line. When a service
+    fronts the server (clusterIP/nodePort cases), the client dials
+    connect_ip/connect_port instead of the server's bind address."""
     eng = [sys.executable, "-m", "dpu_operator_tpu.tft.engine"]
     server = subprocess.Popen(
         _netns_cmd(server_netns, eng + ["server", conn.type, server_ip, str(port), str(duration)]),
@@ -109,7 +113,10 @@ def run_connection(
     )
     time.sleep(0.3)
     client = subprocess.Popen(
-        _netns_cmd(client_netns, eng + ["client", conn.type, server_ip, str(port), str(duration)]),
+        _netns_cmd(client_netns, eng + [
+            "client", conn.type, connect_ip or server_ip,
+            str(connect_port if connect_port is not None else port),
+            str(duration)]),
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
@@ -142,6 +149,8 @@ def _run_test_connections(
     duration_override: Optional[float],
     port: int,
     tags: Optional[Dict] = None,
+    connect_ip: Optional[str] = None,
+    port_offset: int = 0,
 ) -> Tuple[List[dict], int]:
     """One test's connections × instances against one endpoint pair —
     the execution loop run_suite and run_case_matrix share. Returns
@@ -154,7 +163,9 @@ def _run_test_connections(
             d = duration_override if duration_override is not None else t.duration
             log.info("tft: %s%s / %s instance %d (%.1fs)",
                      label, t.name, conn.name, i, d)
-            r = run_connection(conn, server_netns, client_netns, server_ip, d, port)
+            r = run_connection(conn, server_netns, client_netns, server_ip, d,
+                               port, connect_ip=connect_ip,
+                               connect_port=port + port_offset)
             r["test"] = t.name
             if tags:
                 r.update(tags)
@@ -202,12 +213,18 @@ def run_case_matrix(
                     "skipped": reason,
                 })
                 continue
-            topo = build_case_topology(cid)
+            # NodePort cases program exact per-port DNAT pairs, so the
+            # topology gets the engine port range up front.
+            span = sum(c.instances for c in t.connections)
+            topo = build_case_topology(cid, port_base=port + 1,
+                                       port_span=span)
             try:
                 rs, port = _run_test_connections(
                     t, topo.server_netns, topo.client_netns, topo.server_ip,
                     duration_override, port,
-                    tags={"case": cid, "case_name": case_name})
+                    tags={"case": cid, "case_name": case_name, **topo.tags},
+                    connect_ip=topo.connect_ip,
+                    port_offset=topo.port_offset)
                 results.extend(rs)
             finally:
                 topo.cleanup()

@@ -62,21 +62,41 @@ void set_timeout(int fd, double secs) {
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 }
 
-sockaddr_in make_addr(const std::string& ip, int port) {
-    sockaddr_in a{};
-    a.sin_family = AF_INET;
-    a.sin_port = htons(static_cast<uint16_t>(port));
-    if (inet_pton(AF_INET, ip.c_str(), &a.sin_addr) != 1) die("inet_pton");
+// Dual-stack: the v6 matrix cases (nodeport-v6) hand the engines ULA
+// addresses; a literal with a ':' is IPv6.
+struct Addr {
+    sockaddr_storage ss{};
+    socklen_t len = 0;
+    int family = AF_INET;
+};
+
+Addr make_addr(const std::string& ip, int port) {
+    Addr a;
+    if (ip.find(':') != std::string::npos) {
+        auto* sin6 = reinterpret_cast<sockaddr_in6*>(&a.ss);
+        sin6->sin6_family = a.family = AF_INET6;
+        sin6->sin6_port = htons(static_cast<uint16_t>(port));
+        if (inet_pton(AF_INET6, ip.c_str(), &sin6->sin6_addr) != 1)
+            die("inet_pton");
+        a.len = sizeof(sockaddr_in6);
+    } else {
+        auto* sin = reinterpret_cast<sockaddr_in*>(&a.ss);
+        sin->sin_family = a.family = AF_INET;
+        sin->sin_port = htons(static_cast<uint16_t>(port));
+        if (inet_pton(AF_INET, ip.c_str(), &sin->sin_addr) != 1)
+            die("inet_pton");
+        a.len = sizeof(sockaddr_in);
+    }
     return a;
 }
 
 int listen_tcp(const std::string& ip, int port) {
-    int s = socket(AF_INET, SOCK_STREAM, 0);
+    auto addr = make_addr(ip, port);
+    int s = socket(addr.family, SOCK_STREAM, 0);
     if (s < 0) die("socket");
     int one = 1;
     setsockopt(s, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    auto addr = make_addr(ip, port);
-    if (bind(s, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) die("bind");
+    if (bind(s, reinterpret_cast<sockaddr*>(&addr.ss), addr.len) < 0) die("bind");
     if (listen(s, 1) < 0) die("listen");
     return s;
 }
@@ -86,10 +106,10 @@ int listen_tcp(const std::string& ip, int port) {
 int dial_tcp(const std::string& ip, int port, double timeout = 15.0) {
     auto deadline = Clock::now() + std::chrono::duration<double>(timeout);
     for (;;) {
-        int s = socket(AF_INET, SOCK_STREAM, 0);
-        if (s < 0) die("socket");
         auto addr = make_addr(ip, port);
-        if (connect(s, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+        int s = socket(addr.family, SOCK_STREAM, 0);
+        if (s < 0) die("socket");
+        if (connect(s, reinterpret_cast<sockaddr*>(&addr.ss), addr.len) == 0)
             return s;
         close(s);
         if (Clock::now() > deadline) die("connect");
@@ -156,10 +176,10 @@ int tcp_stream_client(const std::string& ip, int port, double duration) {
 // ---- UDP stream (iperf-udp) ------------------------------------------------
 
 int udp_server(const std::string& ip, int port, double duration) {
-    int s = socket(AF_INET, SOCK_DGRAM, 0);
-    if (s < 0) die("socket");
     auto addr = make_addr(ip, port);
-    if (bind(s, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) die("bind");
+    int s = socket(addr.family, SOCK_DGRAM, 0);
+    if (s < 0) die("socket");
+    if (bind(s, reinterpret_cast<sockaddr*>(&addr.ss), addr.len) < 0) die("bind");
     set_timeout(s, duration + 30);
     std::vector<char> buf(kUdpPayload);
     unsigned long long total = 0, pkts = 0;
@@ -191,19 +211,19 @@ int udp_server(const std::string& ip, int port, double duration) {
 }
 
 int udp_client(const std::string& ip, int port, double duration) {
-    int s = socket(AF_INET, SOCK_DGRAM, 0);
-    if (s < 0) die("socket");
     auto addr = make_addr(ip, port);
+    int s = socket(addr.family, SOCK_DGRAM, 0);
+    if (s < 0) die("socket");
     std::vector<char> payload(kUdpPayload, 0x5a);
     auto end = Clock::now() + std::chrono::duration<double>(duration);
     unsigned long long total = 0;
     while (Clock::now() < end) {
         ssize_t n = sendto(s, payload.data(), payload.size(), 0,
-                           reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+                           reinterpret_cast<sockaddr*>(&addr.ss), addr.len);
         if (n > 0) total += static_cast<unsigned long long>(n);
     }
     for (int i = 0; i < 5; i++)
-        sendto(s, "FIN", 3, 0, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+        sendto(s, "FIN", 3, 0, reinterpret_cast<sockaddr*>(&addr.ss), addr.len);
     close(s);
     std::printf(
         "{\"type\": \"udp-client\", \"bytes\": %llu, \"engine\": \"c\"}\n", total);

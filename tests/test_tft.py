@@ -213,10 +213,11 @@ def test_case_table_covers_reference_range():
 
 
 def test_case_matrix_topologies_carry_traffic(netns):
-    """Root tier: the four endpoint-topology shapes actually carry
-    engine traffic — pod/pod same node, pod/pod across the two-bridge
-    fabric, host-to-pod, and host-to-host across nodes (which must NOT
-    short-circuit over loopback: server host lives in node B's netns)."""
+    """Root tier: the endpoint-topology shapes actually carry engine
+    traffic — pod/pod same node, pod/pod across the two-bridge fabric,
+    clusterIP through the NAT service plane, host-to-host across nodes
+    (which must NOT short-circuit over loopback: server host lives in
+    node B's netns), and host-to-pod."""
     from dpu_operator_tpu.tft import ConnectionSpec, TestSpec
     from dpu_operator_tpu.tft.tft import run_case_matrix
 
@@ -228,15 +229,78 @@ def test_case_matrix_topologies_carry_traffic(netns):
     results = run_case_matrix([spec])
     by_case = {r["case"]: r for r in results}
     assert set(by_case) == {1, 2, 5, 16, 17}
-    for cid in (1, 2, 16, 17):
+    for cid in (1, 2, 5, 16, 17):
         assert by_case[cid]["gbps"] > 0, by_case[cid]
         assert by_case[cid]["case_name"]
-    # ClusterIP case: reported as skipped with the reason, not dropped.
-    assert "skipped" in by_case[5] and "service plane" in by_case[5]["skipped"]
+    # The clusterIP case really rode the service plane.
+    assert by_case[5]["service"] == "clusterip"
+    # Case 15 isn't here, but its sibling host-host-diff must have a
+    # netns server (the loopback-short-circuit guard).
     # Nothing leaked: no bta/btb bridges or tc/tn netns remain.
     links = subprocess.run(["ip", "-o", "link"], capture_output=True,
                            text=True).stdout
     assert "bta" not in links and "btb" not in links
+
+
+def test_service_plane_cases_real_nat(netns):
+    """The kube-proxy-analogue NAT plane (VERDICT r3 Next #1): nodePort
+    with real port rewriting (client dials nodeIP:30xxx, server binds
+    backend:20xxx), the v6 flavour through an ip6-family table, and
+    external egress through masquerade — all moving real bytes, with
+    conntrack NAT state to prove the path, and nothing left behind."""
+    from dpu_operator_tpu.tft import ConnectionSpec, TestSpec
+    from dpu_operator_tpu.tft.tft import run_case_matrix
+
+    spec = TestSpec(
+        name="svc", duration=0.5,
+        connections=[ConnectionSpec(name="c", type="iperf-tcp")],
+        test_cases="10,13,25",
+    )
+    results = run_case_matrix([spec], duration_override=0.5)
+    by_case = {r["case"]: r for r in results}
+    assert set(by_case) == {10, 13, 25}
+    assert by_case[10]["gbps"] > 0 and by_case[10]["service"] == "nodeport"
+    assert by_case[13]["gbps"] > 0 and by_case[13]["service"] == "nodeport6"
+    assert by_case[25]["gbps"] > 0 and by_case[25]["service"] == "external"
+    # Cleanup really handed global state back: no leaked nft service
+    # tables in either family, sysctls restored by the topology cleanup.
+    from dpu_operator_tpu.cni.nftnl import (
+        NFPROTO_IPV4, NFPROTO_IPV6, NFTA_TABLE_NAME, Nft, _parse_attrs)
+
+    for fam in (NFPROTO_IPV4, NFPROTO_IPV6):
+        with Nft(family=fam) as n:
+            names = [_parse_attrs(o).get(NFTA_TABLE_NAME, b"")
+                     .rstrip(b"\0").decode() for o in n._dump(1, b"")]
+        assert not [t for t in names if t.startswith("dpusvc")], names
+
+
+def test_service_plane_udp_and_rr(netns):
+    """DNAT must carry all four traffic shapes, not just TCP stream:
+    UDP (separate per-protocol rules, like kube-proxy's) and TCP-RR
+    (many small round-trips through conntrack) over one clusterIP."""
+    from dpu_operator_tpu.tft import ConnectionSpec, TestSpec
+    from dpu_operator_tpu.tft.tft import run_case_matrix
+
+    spec = TestSpec(
+        name="svcmix", duration=0.5,
+        connections=[ConnectionSpec(name="u", type="iperf-udp"),
+                     ConnectionSpec(name="r", type="netperf-tcp-rr")],
+        test_cases="6",
+    )
+    results = run_case_matrix([spec], duration_override=0.5)
+    by_conn = {r["connection"]: r for r in results}
+    assert by_conn["u"]["gbps"] > 0, by_conn["u"]
+    assert by_conn["r"]["tps"] > 0, by_conn["r"]
+
+
+def test_nodeport_requires_port_range():
+    """NodePort cases program exact DNAT port pairs — building one
+    without the engine port range must fail loudly, not silently skip
+    the rewrite."""
+    from dpu_operator_tpu.tft.cases import build_case_topology
+
+    with pytest.raises(ValueError, match="port_base"):
+        build_case_topology(9)
 
 
 def test_empty_case_selection_is_loud():
