@@ -27,6 +27,11 @@ LOG_LEVELS = (0, 1, 2, 3)
 
 # Condition types used on DpuOperatorConfig / DataProcessingUnit status.
 COND_READY = "Ready"
+# Fabric dataplane feature health: False = shaping/flow-table
+# programming degraded (missing tc, rejected qdisc, nf_tables failure);
+# the reason is the VSP-reported cause. Ready stays independent — a
+# fabric that cannot shape still attaches pods.
+COND_FABRIC_SHAPING = "FabricShaping"
 
 
 def new_dpu_operator_config(
@@ -153,6 +158,52 @@ def validate_data_processing_unit_config_spec(obj: dict) -> None:
         )
 
 
+_POLICY_ACTION_RE = None  # compiled lazily below
+
+
+def _validate_nf_policy(nf_name: str, i: int, p: object) -> None:
+    """Admission-time shape check for a networkFunction policy entry —
+    the full match grammar is enforced again at programming time by the
+    VSP's FlowRule.validate; here we reject what would certainly fail
+    there, so the error surfaces at `kubectl apply`, not in a daemon
+    log. Keys are the CR's camelCase (srcIP/dstIP/srcPort/dstPort)."""
+    import re
+
+    global _POLICY_ACTION_RE
+    if _POLICY_ACTION_RE is None:
+        _POLICY_ACTION_RE = re.compile(
+            r"^(drop|accept|redirect:.+|mirror:.+"
+            r"|police:[0-9]+(\.[0-9]+)?)$")
+    where = f"networkFunction {nf_name!r} policies[{i}]"
+    if not isinstance(p, dict):
+        raise ValidationError(f"{where} must be an object")
+    pref = p.get("pref")
+    if not isinstance(pref, int) or not 1 <= pref <= 29999:
+        raise ValidationError(
+            f"{where}.pref must be an integer in [1, 29999] "
+            f"(>= 30000 is reserved for the VSP), got {pref!r}")
+    action = p.get("action")
+    if not isinstance(action, str) or not _POLICY_ACTION_RE.match(action):
+        raise ValidationError(
+            f"{where}.action {action!r} not drop/accept/redirect:<dev>/"
+            f"mirror:<dev>/police:<mbit>")
+    proto = p.get("proto")
+    if proto is not None and proto not in ("tcp", "udp", "icmp", "sctp"):
+        raise ValidationError(
+            f"{where}.proto {proto!r} not tcp/udp/icmp/sctp")
+    for key in ("srcPort", "dstPort"):
+        port = p.get(key)
+        if port is not None and (
+                not isinstance(port, int) or not 0 < port < 65536):
+            raise ValidationError(
+                f"{where}.{key} {port!r} outside [1, 65535]")
+    unknown = set(p) - {"pref", "action", "proto", "srcIP", "dstIP",
+                        "srcPort", "dstPort"}
+    if unknown:
+        raise ValidationError(
+            f"{where} has unknown key(s) {sorted(unknown)}")
+
+
 def validate_service_function_chain_spec(obj: dict) -> None:
     nfs = obj.get("spec", {}).get("networkFunctions", [])
     seen = set()
@@ -162,3 +213,15 @@ def validate_service_function_chain_spec(obj: dict) -> None:
         if nf["name"] in seen:
             raise ValidationError(f"duplicate networkFunction name {nf['name']!r}")
         seen.add(nf["name"])
+        if "transparent" in nf and not isinstance(nf["transparent"], bool):
+            raise ValidationError(
+                f"networkFunction {nf['name']!r}.transparent must be a "
+                f"boolean, got {nf['transparent']!r}")
+        prefs = set()
+        for i, p in enumerate(nf.get("policies") or []):
+            _validate_nf_policy(nf["name"], i, p)
+            if p["pref"] in prefs:
+                raise ValidationError(
+                    f"networkFunction {nf['name']!r} has duplicate "
+                    f"policy pref {p['pref']}")
+            prefs.add(p["pref"])

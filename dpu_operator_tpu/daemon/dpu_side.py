@@ -191,9 +191,53 @@ class DpuSideManager:
             pair = list(macs) if len(macs) == 2 else None
         if pair:
             # Second interface of the NF pod: wire the chain through the VSP
-            # (reference dpusidemanager.go:152-157).
-            self.plugin.create_network_function(pair[0], pair[1])
+            # (reference dpusidemanager.go:152-157), carrying the chain
+            # spec the ServiceFunctionChain CR declared for this NF
+            # (rendered onto the pod as an annotation by the SFC
+            # reconciler; pod identity rides the kubelet's CNI_ARGS).
+            policies, transparent = self._nf_chain_spec(req)
+            self.plugin.create_network_function(
+                pair[0], pair[1], policies=policies, transparent=transparent)
         return result.to_json()
+
+    def _nf_chain_spec(self, req) -> tuple:
+        """(policies, transparent) from the NF pod's chain annotation."""
+        from ..daemon.sfc import NF_POLICY_ANNOTATION
+
+        pod_name = req.args.get("K8S_POD_NAME")
+        pod_ns = req.args.get("K8S_POD_NAMESPACE")
+        if self._client is None or not pod_name:
+            return [], False
+        try:
+            pod = self._client.get("v1", "Pod", pod_ns, pod_name)
+            raw = (pod.get("metadata", {}).get("annotations", {}) or {}).get(
+                NF_POLICY_ANNOTATION)
+            if not raw:
+                return [], False
+            import json as _json
+
+            spec = _json.loads(raw)
+            # Shape-check everything HERE: the annotation is mutable by
+            # anyone with pod-edit rights, and a malformed entry must
+            # degrade to "no policies" with a log line — never fail the
+            # CNI ADD that wires the pod's networking.
+            if not isinstance(spec, dict):
+                raise ValueError("annotation is not a JSON object")
+            policies = spec.get("policies") or []
+            if not isinstance(policies, list):
+                raise ValueError("policies is not a list")
+            for p in policies:
+                if not isinstance(p, dict):
+                    raise ValueError(f"policy entry {p!r} is not an object")
+                int(p.get("pref", 0))
+                str(p.get("action", ""))
+                int(p.get("srcPort") or 0)
+                int(p.get("dstPort") or 0)
+            return policies, bool(spec.get("transparent"))
+        except Exception as e:
+            log.warning("NF chain-spec lookup for %s/%s failed (wiring the "
+                        "chain without policies): %s", pod_ns, pod_name, e)
+            return [], False
 
     def _cni_nf_del(self, req) -> dict:
         mac = self.dataplane.pod_mac(req.container_id, req.ifname)

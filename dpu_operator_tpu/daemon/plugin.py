@@ -63,6 +63,10 @@ class GrpcPlugin(VendorPlugin):
     def __init__(self, socket_path: str):
         self._socket_path = socket_path
         self.last_ping_instance = None
+        # VSP-reported dataplane degradations from the latest heartbeat
+        # (shaping/flow-table failures); the daemon turns these into the
+        # DataProcessingUnit's FabricShaping condition.
+        self.last_ping_degradations: list = []
         self._lock = threading.Lock()
         self._channel: Optional[grpc.Channel] = None
         self._initialized = False
@@ -131,6 +135,7 @@ class GrpcPlugin(VendorPlugin):
                 timeout=timeout,
             )
             self.last_ping_instance = resp.instance_id or None
+            self.last_ping_degradations = list(resp.degradations)
             return bool(resp.healthy)
         except grpc.RpcError:
             with self._lock:
@@ -175,11 +180,19 @@ class GrpcPlugin(VendorPlugin):
 
     # -- network functions ---------------------------------------------------
 
-    def create_network_function(self, input_mac: str, output_mac: str) -> None:
+    def create_network_function(self, input_mac: str, output_mac: str,
+                                policies=None,
+                                transparent: bool = False) -> None:
         stub = services.NetworkFunctionStub(self._ensure_channel())
-        stub.CreateNetworkFunction(
-            pb.NFRequest(input=input_mac, output=output_mac), timeout=self.RPC_TIMEOUT
-        )
+        req = pb.NFRequest(input=input_mac, output=output_mac,
+                           transparent=transparent)
+        for p in policies or []:
+            req.policies.add(
+                pref=int(p.get("pref", 0)), action=p.get("action", ""),
+                proto=p.get("proto", ""), src_ip=p.get("srcIP", ""),
+                dst_ip=p.get("dstIP", ""), src_port=int(p.get("srcPort", 0)),
+                dst_port=int(p.get("dstPort", 0)))
+        stub.CreateNetworkFunction(req, timeout=self.RPC_TIMEOUT)
 
     def delete_network_function(self, input_mac: str, output_mac: str) -> None:
         stub = services.NetworkFunctionStub(self._ensure_channel())

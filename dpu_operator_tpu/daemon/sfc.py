@@ -25,20 +25,34 @@ log = logging.getLogger(__name__)
 
 RECHECK_INTERVAL = 60.0
 
+# SFC-declared chain spec (match-action policies + transparent mode)
+# rides the NF pod as an annotation so the DPU-side daemon can hand it
+# to the VSP at CreateNetworkFunction time (the CNI request identifies
+# the pod; the pod carries the spec).
+NF_POLICY_ANNOTATION = "dpu.config.tpu.io/flow-policies"
 
-def network_function_pod(name: str, image: str, node_selector: dict) -> dict:
+
+def network_function_pod(name: str, image: str, node_selector: dict,
+                         policies: Optional[list] = None,
+                         transparent: bool = False) -> dict:
     """The NF pod shape (reference networkFunctionPod, sfc.go:35-76):
     two attachments of the NF NAD so the DPU-side CNI pairs the MACs and
     calls CreateNetworkFunction on the second ADD."""
+    import json
+
+    annotations = {
+        "k8s.v1.cni.cncf.io/networks": f"{v.NF_NAD_NAME}, {v.NF_NAD_NAME}",
+    }
+    if policies or transparent:
+        annotations[NF_POLICY_ANNOTATION] = json.dumps(
+            {"policies": policies or [], "transparent": bool(transparent)})
     return {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {
             "name": name,
             "namespace": v.NAMESPACE,
-            "annotations": {
-                "k8s.v1.cni.cncf.io/networks": f"{v.NF_NAD_NAME}, {v.NF_NAD_NAME}",
-            },
+            "annotations": annotations,
             "labels": {"app.kubernetes.io/component": "network-function"},
         },
         "spec": {
@@ -99,16 +113,32 @@ class SfcNodeReconciler(Reconciler):
         return Result()
 
     def _ensure_nf_pod(self, sfc: dict, nf: dict, selector: dict) -> None:
-        pod = network_function_pod(nf["name"], nf["image"], selector)
+        pod = network_function_pod(nf["name"], nf["image"], selector,
+                                   policies=nf.get("policies"),
+                                   transparent=bool(nf.get("transparent")))
         set_owner(pod, sfc)
         existing = self._client.get_or_none("v1", "Pod", v.NAMESPACE, nf["name"])
         if existing is None:
             log.info("sfc %s: creating NF pod %s", name_of(sfc), nf["name"])
             self._client.create(pod)
             return
-        # Converge mutable fields (reference updates the whole pod,
-        # sfc.go:88-95; we keep the narrower image/annotation convergence
-        # since pod specs are mostly immutable on a real apiserver).
+        # Chain-spec (policies/transparent) changes RECREATE the pod:
+        # the annotation is consumed at CNI ADD time only, so patching
+        # it on a live pod would show a converged spec in kubectl while
+        # the dataplane still runs the old rules — recreating forces the
+        # CNI DEL/ADD cycle that actually re-programs the VSP.
+        want_ann = pod["metadata"]["annotations"].get(NF_POLICY_ANNOTATION)
+        have_ann = (existing["metadata"].get("annotations") or {}).get(
+            NF_POLICY_ANNOTATION)
+        if have_ann != want_ann:
+            log.info("sfc %s: chain spec for NF %s changed; recreating "
+                     "pod so the dataplane is re-programmed",
+                     name_of(sfc), nf["name"])
+            self._client.delete("v1", "Pod", v.NAMESPACE, nf["name"])
+            self._client.create(pod)
+            return
+        # Image converges in place (mutable on a real apiserver,
+        # reference updates the whole pod, sfc.go:88-95).
         spec_image = existing["spec"]["containers"][0].get("image")
         if spec_image != nf["image"]:
             existing["spec"]["containers"][0]["image"] = nf["image"]

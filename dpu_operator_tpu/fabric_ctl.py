@@ -135,10 +135,38 @@ def cmd_del_port(args, chan):
 
 def cmd_add_nf(args, chan):
     stub = services.NetworkFunctionStub(chan)
-    stub.CreateNetworkFunction(
-        pb.NFRequest(input=args.mac0, output=args.mac1), timeout=30
-    )
-    print(json.dumps({"chained": [args.mac0, args.mac1]}))
+    req = pb.NFRequest(input=args.mac0, output=args.mac1,
+                       transparent=bool(getattr(args, "transparent", False)))
+    for spec in getattr(args, "policy", None) or []:
+        try:
+            p = json.loads(spec)
+            if not isinstance(p, dict):
+                raise ValueError("not a JSON object")
+            req.policies.add(
+                pref=int(p.get("pref", 0)), action=p.get("action", ""),
+                proto=p.get("proto", ""), src_ip=p.get("srcIP", ""),
+                dst_ip=p.get("dstIP", ""), src_port=int(p.get("srcPort", 0)),
+                dst_port=int(p.get("dstPort", 0)))
+        except (ValueError, TypeError) as e:
+            print(json.dumps({"error": f"bad --policy {spec!r}: {e}"}))
+            return 1
+    # The VSP deliberately degrades (not fails) when flow programming
+    # breaks, so the CNI ADD path never loses a pod over a policy typo.
+    # An interactive operator deserves the opposite: compare the VSP's
+    # degradation set across the call and fail loudly on anything new.
+    hb = services.HeartbeatStub(chan)
+    before = set(hb.Ping(pb.PingRequest(sender_id="fabric-ctl"),
+                         timeout=10).degradations)
+    stub.CreateNetworkFunction(req, timeout=30)
+    after = set(hb.Ping(pb.PingRequest(sender_id="fabric-ctl"),
+                        timeout=10).degradations)
+    new = sorted(after - before)
+    if new:
+        print(json.dumps({"chained": [args.mac0, args.mac1],
+                          "degraded": new}))
+        return 1
+    print(json.dumps({"chained": [args.mac0, args.mac1],
+                      "policies": len(req.policies)}))
 
 
 def cmd_del_nf(args, chan):
@@ -503,6 +531,12 @@ def main(argv=None) -> int:
     p.add_argument("bridges", nargs="*"); p.set_defaults(fn=cmd_add_port)
     p = sub.add_parser("del-port"); p.add_argument("name"); p.set_defaults(fn=cmd_del_port)
     p = sub.add_parser("add-nf"); p.add_argument("mac0"); p.add_argument("mac1")
+    p.add_argument("--policy", action="append", metavar="JSON",
+                   help='flow policy, e.g. \'{"pref": 10, "action": '
+                        '"police:200", "proto": "tcp"}\' (repeatable)')
+    p.add_argument("--transparent", action="store_true",
+                   help="bump-in-the-wire chain: steer ALL workload "
+                        "traffic through the NF pair")
     p.set_defaults(fn=cmd_add_nf)
     p = sub.add_parser("del-nf"); p.add_argument("mac0"); p.add_argument("mac1")
     p.set_defaults(fn=cmd_del_nf)

@@ -37,6 +37,7 @@ import logging
 import re
 import socket as socketlib
 import struct
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -152,10 +153,13 @@ class FlowRule:
             exprs.append(n.verdict(n.NF_DROP))
         elif kind == "accept":
             exprs.append(n.verdict(n.NF_ACCEPT))
-        elif kind == "redirect":
-            exprs += n.fwd_to(arg)
-        elif kind == "mirror":
-            exprs += n.dup_to(arg)  # continues: tap, not teleport
+        elif kind in ("redirect", "mirror"):
+            try:
+                exprs += n.fwd_to(arg) if kind == "redirect" else n.dup_to(arg)
+            except OSError as e:
+                # if_nametoindex on a vanished/typo'd device: a
+                # CLI-grade error, not a raw OSError traceback.
+                raise FlowError(f"{kind} target: no such netdev {arg!r}") from e
         elif kind == "police":
             exprs += [n.limit_over_mbit(float(arg)), n.verdict(n.NF_DROP)]
         return exprs
@@ -176,7 +180,16 @@ def bridge_ports(bridge: str) -> List[str]:
 
 
 class FlowTable:
-    """Rule programming + readback for one netdev's ingress hook."""
+    """Rule programming + readback for one netdev's ingress hook.
+
+    add() is read-then-insert across two netlink transactions; the
+    process-wide lock below serializes concurrent adds from the
+    AUTOMATED path (VSP port attach + NF wiring run on gRPC worker
+    threads). A concurrent `fabric-ctl` in another process can still
+    interleave — that is the operator racing their own operator, the
+    same exposure `nft` CLI batches have."""
+
+    _add_lock = threading.Lock()
 
     def __init__(self, dev: str):
         self.dev = dev
@@ -205,7 +218,7 @@ class FlowTable:
 
     def add(self, rule: FlowRule) -> None:
         exprs = rule.to_nft_exprs()  # validates first
-        with nftnl.Nft() as nft:
+        with self._add_lock, nftnl.Nft() as nft:
             existing = self._our_rules(nft)
             if any(r["pref"] == rule.pref for r in existing):
                 raise FlowError(
@@ -229,6 +242,18 @@ class FlowTable:
             if not match:
                 raise FlowError(f"no rule pref {pref} on {self.dev}")
             nft.delete_rule(TABLE, self._chain(), match[0]["handle"])
+
+    def delete_many(self, prefs) -> int:
+        """Delete our rules matching `prefs` in ONE dump + ONE atomic
+        transaction (the NF-teardown path removes several rules per
+        port; per-pref delete() would re-dump the chain each time).
+        Missing prefs are not an error — teardown must be idempotent."""
+        want = set(prefs)
+        with self._add_lock, nftnl.Nft() as nft:
+            handles = [r["handle"] for r in self._our_rules(nft)
+                       if r["pref"] in want]
+            nft.delete_rules(TABLE, self._chain(), handles)
+            return len(handles)
 
     def flush(self) -> int:
         """Remove every rule WE programmed (foreign rules survive); the

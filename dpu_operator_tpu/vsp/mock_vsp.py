@@ -39,6 +39,7 @@ class MockVsp(
         self.bridge_ports: List[str] = []
         self.network_functions: List[Tuple[str, str]] = []
         self.fail_bridge_port = False  # failure injection (rollback tests)
+        self.degradations: List[str] = []  # injectable dataplane state
 
     # LifeCycle
     def Init(self, request, context):
@@ -69,7 +70,10 @@ class MockVsp(
 
     # Heartbeat
     def Ping(self, request, context):
-        return pb.PingResponse(healthy=True, instance_id=self._instance_id)
+        with self._lock:
+            degradations = list(self.degradations)
+        return pb.PingResponse(healthy=True, instance_id=self._instance_id,
+                               degradations=degradations)
 
     # NetworkFunction
     def CreateNetworkFunction(self, request, context):

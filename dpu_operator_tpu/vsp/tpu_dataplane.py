@@ -8,9 +8,21 @@ DPU_FABRIC_UPLINK); without hardware the DebugDataplane no-ops and
 records, exactly like Marvell's debug-dp (debug-dp/debugdp.go) — keeping
 the zero-hardware test tier first-class (SURVEY §7 hard part (a)).
 
-Linux-bridge based: no OVS dependency in the image. NF chaining uses
-hairpin mode + static fdb pinning of the chained MACs, the linux-bridge
-equivalent of the reference's OVS NF flow rules (marvell main.go:515-588)."""
+Linux-bridge based: no OVS dependency in the image. NF chaining is
+nft-fwd steering on the chain ingress (the netdev-hook flow table,
+vsp/flow_table.py) with hairpin mode + static fdb pinning of the chained
+MACs as the delivery fallback — together the linux-bridge equivalent of
+the reference's OVS NF flow rules (marvell main.go:515-588). The flow
+table is programmed from THIS automated path, not just fabric-ctl:
+every attached port gets a baseline counter rule (live per-port flow
+stats, the per-port rule sets intel p4rtclient.go:612-939 programs at
+port creation), and CR-declared policies ride CreateNetworkFunction.
+
+Degradation is STATE, not just a log line: `shaping_state` and
+`flow_state` hold "ok" or a reason string; the daemon surfaces them as
+a DataProcessingUnit condition (FabricShaping) so a minimal node image
+without tc, or a kernel without nf_tables, is visible in `kubectl get`
+rather than silently unshaped/uncounted."""
 
 from __future__ import annotations
 
@@ -21,6 +33,13 @@ from typing import Dict, List, Optional, Tuple
 log = logging.getLogger(__name__)
 
 BRIDGE_NAME = "br-fabric"
+
+# Rule prefs reserved for the VSP's own automated-path rules; CR/user
+# policies must stay below (validated at the VSP boundary).
+NF_STEER_PREF = 30000
+SHARE_POLICE_PREF = 31000  # nft fallback for the endpoint share
+BASELINE_PREF = 32000  # == flow_table.MAX_PREF: tail catch-all counter
+POLICY_PREF_MAX = NF_STEER_PREF - 1
 
 
 class DataplaneError(RuntimeError):
@@ -76,6 +95,29 @@ class TpuFabricDataplane:
             fabric_gbps = float(env) if env else None
         self.fabric_gbps = fabric_gbps
         self.endpoint_count: Optional[int] = None
+        # Degradation state for the CR condition (FabricShaping), keyed
+        # by what is degraded so a later SUCCESS on the same thing
+        # clears it — the condition must be able to recover when the
+        # admin installs tc or the transient error passes, not latch
+        # the first failure forever.
+        self._shaping_issues: Dict[str, str] = {}
+        self._flow_issues: Dict[str, str] = {}
+        # Active flow-steered NF chain state — everything wire programmed
+        # is RECORDED so teardown removes exactly that and nothing else
+        # (operator rules added via fabric-ctl on the same ports survive).
+        self._nf_flow_ports: Optional[Tuple[str, str]] = None
+        self._nf_flow_macs: Optional[Tuple[str, str]] = None
+        self._nf_transparent: bool = False
+        self._nf_flow_rules: List[Tuple[str, int]] = []   # (dev, pref)
+        self._nf_fdb_pins: List[Tuple[str, str]] = []     # (mac, dev)
+
+    @property
+    def shaping_state(self) -> str:
+        return "; ".join(self._shaping_issues.values()) or "ok"
+
+    @property
+    def flow_state(self) -> str:
+        return "; ".join(self._flow_issues.values()) or "ok"
 
     def ensure_bridge(self) -> None:
         try:
@@ -145,13 +187,57 @@ class TpuFabricDataplane:
         # peer with no error. The pinned bridge MTU (ensure_bridge) keeps
         # a small port from clamping anyone else.
         self.ports[netdev] = mac
+        self._apply_share_with_fallback(netdev)
+        # Per-port baseline counter rule — live flow stats for every
+        # fabric port from the moment it attaches (`fabric-ctl rule-list
+        # <port> --stats`), the per-port rule set the reference VSPs
+        # program at port creation (p4rtclient.go:612-699).
         try:
-            self._apply_share(netdev)
+            from .flow_table import FlowError, FlowRule, FlowTable
+
+            try:
+                FlowTable(netdev).add(
+                    FlowRule(pref=BASELINE_PREF, action="accept"))
+            except FlowError as e:
+                # Idempotent re-attach: the baseline from a previous
+                # attach of this port is the desired state, not an error.
+                if "already programmed" not in str(e):
+                    raise
+            self._flow_issues.pop(f"baseline:{netdev}", None)
         except Exception as e:
-            # Shaping is an enhancement on top of the attach — a missing
-            # tc binary or rejected qdisc must degrade to unshaped, not
-            # fail the pod attach after the veth is already enslaved.
-            log.warning("endpoint share on %s failed: %s", netdev, e)
+            self._flow_issues[f"baseline:{netdev}"] = (
+                f"baseline flow rule on {netdev} failed: {e}")
+            log.warning("%s", self._flow_issues[f"baseline:{netdev}"])
+        # A port attached while an NF chain is live joins its workload
+        # side immediately (marvell re-programs vf flows on attach).
+        if self._nf_flow_ports and netdev not in self._nf_flow_ports:
+            try:
+                from .flow_table import FlowRule, FlowTable
+
+                port_in, port_out = self._nf_flow_ports
+                if self._nf_transparent:
+                    FlowTable(netdev).add(FlowRule(
+                        pref=NF_STEER_PREF, action=f"redirect:{port_in}"))
+                    self._nf_flow_rules.append((netdev, NF_STEER_PREF))
+                    if mac:
+                        _run(["bridge", "fdb", "replace", mac, "dev",
+                              netdev, "master", "static"])
+                        self._nf_fdb_pins.append((mac, netdev))
+                else:
+                    mac_in, mac_out = self._nf_flow_macs
+                    FlowTable(netdev).add(FlowRule(
+                        pref=NF_STEER_PREF, dst_mac=mac_in,
+                        action=f"redirect:{port_in}"))
+                    self._nf_flow_rules.append((netdev, NF_STEER_PREF))
+                    FlowTable(netdev).add(FlowRule(
+                        pref=NF_STEER_PREF + 1, dst_mac=mac_out,
+                        action=f"redirect:{port_out}"))
+                    self._nf_flow_rules.append((netdev, NF_STEER_PREF + 1))
+                self._flow_issues.pop(f"nf-late:{netdev}", None)
+            except Exception as e:
+                self._flow_issues[f"nf-late:{netdev}"] = (
+                    f"NF steer for late-attached {netdev} failed: {e}")
+                log.warning("%s", self._flow_issues[f"nf-late:{netdev}"])
 
     def partition_endpoints(self, count: int) -> None:
         """Apply the per-endpoint bandwidth share implied by `count` to
@@ -160,10 +246,59 @@ class TpuFabricDataplane:
         if self.fabric_gbps is None:
             return
         for port in list(self.ports):
+            self._apply_share_with_fallback(port)
+
+    def _apply_share_with_fallback(self, port: str) -> None:
+        """HTB+police via tc; when the node image has no tc (or the
+        qdisc is rejected), fall back to an nft limit-expr police rule
+        on the port's ingress — the binary-free path, enforcing the
+        pod→fabric direction so one endpoint still cannot starve the
+        others. Either failure mode is recorded in shaping_state (the
+        daemon turns it into the FabricShaping CR condition); the attach
+        itself never fails over shaping."""
+        try:
+            self._apply_share(port)
+        except Exception as e:
             try:
-                self._apply_share(port)
-            except Exception as e:
-                log.warning("endpoint share on %s failed: %s", port, e)
+                applied = self._apply_share_nft(port)
+            except Exception as e2:
+                self._shaping_issues[port] = (
+                    f"endpoint share on {port} failed: {e}; "
+                    f"nft fallback failed too: {e2}")
+                log.warning("%s", self._shaping_issues[port])
+                return
+            if applied:
+                self._shaping_issues[port] = (
+                    f"HTB unavailable on {port} ({e}); nft ingress "
+                    f"police fallback active — egress toward the pod is "
+                    f"unshaped")
+                log.warning("%s", self._shaping_issues[port])
+        else:
+            # HTB landed: the degradation (if any) is over, and a stale
+            # nft fallback cap from a previous failure must not keep
+            # policing under the new HTB rate.
+            if self._shaping_issues.pop(port, None) is not None:
+                try:
+                    from .flow_table import FlowTable
+
+                    FlowTable(port).delete_many([SHARE_POLICE_PREF])
+                except Exception as e:
+                    log.debug("stale nft share cleanup on %s: %s", port, e)
+
+    def _apply_share_nft(self, port: str) -> bool:
+        """nft `limit rate over <share> drop` on the port's ingress
+        hook (pure netlink, no binaries). Returns False when there is
+        no budget/partition to enforce."""
+        if self.fabric_gbps is None or not self.endpoint_count:
+            return False
+        from .flow_table import FlowRule, FlowTable
+
+        share_mbit = max(1, int(self.fabric_gbps * 1000 / self.endpoint_count))
+        ft = FlowTable(port)
+        ft.delete_many([SHARE_POLICE_PREF])  # repartition replaces
+        ft.add(FlowRule(pref=SHARE_POLICE_PREF,
+                        action=f"police:{share_mbit}"))
+        return True
 
     def _apply_share(self, port: str) -> None:
         """Both directions of a bridge port get the endpoint's slice of
@@ -207,28 +342,232 @@ class TpuFabricDataplane:
     def detach_port(self, netdev: str) -> None:
         from ..cni import netlink as nl
 
+        # Rules die with the port: flush the flow chain BEFORE releasing
+        # the netdev (after detach the chain would linger until the veth
+        # itself is deleted).
+        try:
+            from .flow_table import FlowTable
+
+            FlowTable(netdev).flush()
+        except Exception as e:
+            log.debug("flow flush on detach %s: %s", netdev, e)
         try:
             nl.set_master(netdev, None)
         except nl.NetlinkError as e:
             log.debug("detach %s: %s", netdev, e)
         self.ports.pop(netdev, None)
+        # The flush above removed any NF rules this port carried — keep
+        # the chain-teardown records accurate, and a gone port can no
+        # longer be degraded.
+        self._nf_flow_rules = [
+            (d, p) for d, p in self._nf_flow_rules if d != netdev]
+        self._nf_fdb_pins = [
+            (m, d) for m, d in self._nf_fdb_pins if d != netdev]
+        self._shaping_issues.pop(netdev, None)
+        self._flow_issues.pop(f"baseline:{netdev}", None)
+        self._flow_issues.pop(f"nf-late:{netdev}", None)
 
-    def wire_network_function(self, mac_in: str, mac_out: str) -> None:
-        """Chain two NF ports: hairpin on both (traffic may re-enter the
-        port it arrived on) + static fdb entries pinning the MACs."""
-        for mac in (mac_in, mac_out):
-            port = self._port_by_mac(mac)
+    def wire_network_function(self, mac_in: str, mac_out: str,
+                              policies: Optional[List[Dict]] = None,
+                              transparent: bool = False) -> None:
+        """Chain two NF ports, mirroring the reference's OVS-flow NF
+        wiring (marvell AddNetworkFunction, main.go:526-588: vf→inpPort
+        / inpPort→vf flows on the workload side, outPort↔RPM flows on
+        the uplink side — input faces workloads, output faces fabric).
+
+        Endpoint mode (default — the reference e2e pod↔NF/external↔NF
+        shape, where the NF terminates traffic addressed to it):
+
+          1. hairpin + static fdb pinning of the NF MACs (delivery
+             works from any port, managed or not);
+          2. dst-MAC fwd rules on every workload port's ingress — the
+             flow-table expression of "traffic for the NF goes to the
+             NF", counted and inspectable via `fabric-ctl rule-list`,
+             removed with the NF (the chaining now verifiably rides the
+             flow engine alongside FDB);
+          3. CR-declared policies on both NF ports' ingress.
+
+        Transparent mode (bump-in-the-wire, `transparent: true` on the
+        CR entry): additionally steers ALL workload-port traffic into
+        the NF input with match-all fwd rules, pins workload MACs, and
+        flood/learning-isolates the NF bridge ports — an L2 forwarder
+        between two ports of ONE bridge loops on broadcast otherwise
+        (the reference never meets this: its inpPort/outPort live on
+        separate pipeline segments).
+
+        One active flow-programmed chain at a time (the reference's
+        single NfName store has the same shape); a second wire while
+        one is active records flow_state degradation and rides the
+        hairpin/FDB layer only.
+        """
+        port_in = self._port_by_mac(mac_in)
+        port_out = self._port_by_mac(mac_out)
+        for mac, port in ((mac_in, port_in), (mac_out, port_out)):
             if port is None:
                 continue
             _run(["bridge", "link", "set", "dev", port, "hairpin", "on"])
             _run(
                 ["bridge", "fdb", "replace", mac, "dev", port, "master", "static"]
             )
+        if port_in and port_out:
+            try:
+                self._program_nf_flows(mac_in, mac_out, port_in, port_out,
+                                       policies or [], transparent)
+                self._flow_issues.pop("nf", None)
+            except Exception as e:
+                self._flow_issues["nf"] = (
+                    f"NF flow programming {port_in}->{port_out} failed: {e}")
+                log.warning("%s", self._flow_issues["nf"])
+        elif policies or transparent:
+            # A chain the CR asked to steer/police but nothing to hang
+            # it on is a degradation, not a silent drop — especially
+            # transparent mode, where the workload traffic now BYPASSES
+            # the NF it was promised to cross.
+            self._flow_issues["nf"] = (
+                f"NF chain spec for {mac_in}->{mac_out} not programmed: "
+                f"ports not attached")
+            log.warning("%s", self._flow_issues["nf"])
         self.nf_pairs.append((mac_in, mac_out))
 
+    def _program_nf_flows(self, mac_in: str, mac_out: str, port_in: str,
+                          port_out: str, policies: List[Dict],
+                          transparent: bool) -> None:
+        from .flow_table import FlowRule, FlowTable
+
+        if self._nf_flow_macs is not None:
+            raise DataplaneError(
+                f"flow-steered chain already active on {self._nf_flow_ports}")
+        # Validate every rule BEFORE programming any: a half-applied
+        # policy set is worse than a rejected one.
+        rules = []
+        for p in policies:
+            pref = int(p.get("pref", 0))
+            if not 1 <= pref <= POLICY_PREF_MAX:
+                raise DataplaneError(
+                    f"policy pref {pref} outside [1, {POLICY_PREF_MAX}]")
+            rule = FlowRule(
+                pref=pref, action=p["action"],
+                proto=p.get("proto") or None,
+                src_ip=p.get("src_ip") or None,
+                dst_ip=p.get("dst_ip") or None,
+                src_port=int(p["src_port"]) if p.get("src_port") else None,
+                dst_port=int(p["dst_port"]) if p.get("dst_port") else None,
+            )
+            rule.validate()
+            rules.append(rule)
+        # Record state FIRST so a mid-programming failure can roll back
+        # exactly what was applied (a half-steered fabric with no owner
+        # is the worst outcome: traffic blackholed into a dead NF).
+        self._nf_flow_ports = (port_in, port_out)
+        self._nf_flow_macs = (mac_in, mac_out)
+        self._nf_transparent = transparent
+        self._nf_flow_rules = []
+        self._nf_fdb_pins = []
+        try:
+            if transparent:
+                # NF ports must not feed the bridge's learning or
+                # receive floods: frames the NF emits carry OTHER
+                # endpoints' MACs — learned on an NF port they would
+                # redirect deliveries back into the NF; flooded into
+                # one they loop through the forwarder. The marvell flow
+                # set avoids this with explicit per-VF delivery rules
+                # (inpPort→vf by MAC); here: learning/flood off +
+                # static FDB.
+                for port in (port_in, port_out):
+                    _run(["bridge", "link", "set", "dev", port, "learning",
+                          "off", "flood", "off", "mcast_flood", "off"])
+                    subprocess.run(["bridge", "link", "set", "dev", port,
+                                    "bcast_flood", "off"],
+                                   capture_output=True)
+            # Workload side (marvell vf→inpPort / inpPort→vf): in
+            # transparent mode funnel everything into the NF input and
+            # pin workload MACs (delivery without learning); in endpoint
+            # mode, fwd only NF-addressed frames — the flow-table
+            # expression of FDB delivery, counted and chain-scoped.
+            for port, mac in self.ports.items():
+                if port in (port_in, port_out):
+                    continue
+                if transparent:
+                    FlowTable(port).add(FlowRule(
+                        pref=NF_STEER_PREF, action=f"redirect:{port_in}"))
+                    self._nf_flow_rules.append((port, NF_STEER_PREF))
+                    if mac:
+                        _run(["bridge", "fdb", "replace", mac, "dev", port,
+                              "master", "static"])
+                        self._nf_fdb_pins.append((mac, port))
+                else:
+                    FlowTable(port).add(FlowRule(
+                        pref=NF_STEER_PREF, dst_mac=mac_in,
+                        action=f"redirect:{port_in}"))
+                    self._nf_flow_rules.append((port, NF_STEER_PREF))
+                    FlowTable(port).add(FlowRule(
+                        pref=NF_STEER_PREF + 1, dst_mac=mac_out,
+                        action=f"redirect:{port_out}"))
+                    self._nf_flow_rules.append((port, NF_STEER_PREF + 1))
+            # Fabric side (marvell outPort↔RPM): NF output pairs with
+            # the uplink, both directions.
+            if self.uplink:
+                FlowTable(self.uplink).add(FlowRule(
+                    pref=NF_STEER_PREF,
+                    dst_mac=None if transparent else mac_out,
+                    action=f"redirect:{port_out}"))
+                self._nf_flow_rules.append((self.uplink, NF_STEER_PREF))
+                if transparent:
+                    FlowTable(port_out).add(FlowRule(
+                        pref=NF_STEER_PREF, action=f"redirect:{self.uplink}"))
+                    self._nf_flow_rules.append((port_out, NF_STEER_PREF))
+            for rule in rules:
+                FlowTable(port_in).add(rule)
+                self._nf_flow_rules.append((port_in, rule.pref))
+                FlowTable(port_out).add(rule)
+                self._nf_flow_rules.append((port_out, rule.pref))
+        except Exception:
+            self._teardown_nf_flows()
+            raise
+
+    def _teardown_nf_flows(self) -> None:
+        """Remove exactly what _program_nf_flows recorded — tolerant of
+        vanished netdevs (a detached port took its chain with it) and
+        never touching rules the operator added via fabric-ctl."""
+        from .flow_table import FlowTable
+
+        by_dev: Dict[str, List[int]] = {}
+        for dev, pref in self._nf_flow_rules:
+            by_dev.setdefault(dev, []).append(pref)
+        for dev, prefs in by_dev.items():
+            try:
+                FlowTable(dev).delete_many(prefs)
+            except Exception as e:
+                log.debug("NF flow removal on %s: %s", dev, e)
+        for mac, dev in self._nf_fdb_pins:
+            subprocess.run(["bridge", "fdb", "del", mac, "dev", dev,
+                            "master"], capture_output=True)
+        if self._nf_flow_ports and self._nf_transparent:
+            for port in self._nf_flow_ports:
+                subprocess.run(["bridge", "link", "set", "dev", port,
+                                "learning", "on", "flood", "on",
+                                "mcast_flood", "on"], capture_output=True)
+                subprocess.run(["bridge", "link", "set", "dev", port,
+                                "bcast_flood", "on"], capture_output=True)
+        self._nf_flow_ports = None
+        self._nf_flow_macs = None
+        self._nf_transparent = False
+        self._nf_flow_rules = []
+        self._nf_fdb_pins = []
+        self._flow_issues.pop("nf", None)
+        for key in [k for k in self._flow_issues if k.startswith("nf-late:")]:
+            self._flow_issues.pop(key, None)
+
     def unwire_network_function(self, mac_in: str, mac_out: str) -> None:
-        for mac in (mac_in, mac_out):
-            port = self._port_by_mac(mac)
+        # Keyed by MAC, not by current port resolution: the chain must
+        # tear down even when one of its ports was already detached (CNI
+        # DEL ordering) — otherwise stale steering rules would outlive
+        # the NF and block every future chain.
+        if self._nf_flow_macs == (mac_in, mac_out):
+            self._teardown_nf_flows()
+        port_in = self._port_by_mac(mac_in)
+        port_out = self._port_by_mac(mac_out)
+        for mac, port in ((mac_in, port_in), (mac_out, port_out)):
             if port is None:
                 continue
             try:
@@ -256,7 +595,10 @@ class DebugDataplane:
         self.uplink = uplink
         self.ports: Dict[str, str] = {}
         self.nf_pairs: List[Tuple[str, str]] = []
+        self.nf_policies: List[Dict] = []
         self.endpoint_count: Optional[int] = None
+        self.shaping_state: str = "ok"
+        self.flow_state: str = "ok"
 
     def ensure_bridge(self) -> None:
         log.info("debug-dp: ensure_bridge(%s)", self.bridge)
@@ -270,8 +612,12 @@ class DebugDataplane:
     def detach_port(self, netdev: str) -> None:
         self.ports.pop(netdev, None)
 
-    def wire_network_function(self, mac_in: str, mac_out: str) -> None:
+    def wire_network_function(self, mac_in: str, mac_out: str,
+                              policies: Optional[List[Dict]] = None,
+                              transparent: bool = False) -> None:
         self.nf_pairs.append((mac_in, mac_out))
+        self.nf_policies.extend(policies or [])
+        self.nf_transparent = transparent
 
     def unwire_network_function(self, mac_in: str, mac_out: str) -> None:
         try:

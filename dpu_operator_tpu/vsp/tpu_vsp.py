@@ -194,7 +194,15 @@ class TpuVsp(
             except Exception:
                 log.warning("cp-agent unreachable; reporting unhealthy")
                 healthy = False
-        return pb.PingResponse(healthy=healthy, instance_id=instance_id)
+        with self._lock:
+            dp = self._dataplane
+        degradations = [
+            s for s in (getattr(dp, "shaping_state", "ok"),
+                        getattr(dp, "flow_state", "ok"))
+            if s != "ok"
+        ] if dp is not None else []
+        return pb.PingResponse(healthy=healthy, instance_id=instance_id,
+                               degradations=degradations)
 
     def _chip_health(self, n_local: int) -> Dict[int, bool]:
         """Cache reads only — the caches are fed by background threads
@@ -350,7 +358,18 @@ class TpuVsp(
         with self._lock:
             dp = self._dataplane
         if dp is not None:
-            dp.wire_network_function(request.input, request.output)
+            # CR-declared policies ride the same automated path as the
+            # chain itself (reference VSPs program their flow engines
+            # from CreateNetworkFunction: marvell main.go:515-588).
+            policies = [
+                {"pref": p.pref, "action": p.action, "proto": p.proto,
+                 "src_ip": p.src_ip, "dst_ip": p.dst_ip,
+                 "src_port": p.src_port, "dst_port": p.dst_port}
+                for p in request.policies
+            ]
+            dp.wire_network_function(request.input, request.output,
+                                     policies=policies,
+                                     transparent=request.transparent)
         return empty_pb2.Empty()
 
     def DeleteNetworkFunction(self, request, context):

@@ -109,6 +109,56 @@ def test_daemon_detects_tpu_and_syncs_cr(cluster_client, tmp_root):
         vsp_server.stop()
 
 
+def test_fabric_shaping_degradation_surfaces_as_cr_condition(
+        cluster_client, tmp_root):
+    """VERDICT r3 Next #5: when the VSP's dataplane cannot program
+    shaping/flow rules (no tc binary, rejected qdisc, nf_tables
+    failure), the DataProcessingUnit CR carries FabricShaping=False
+    with the reason — and recovers to True when the VSP reports clean
+    again. The degradation rides the heartbeat (PingResponse
+    .degradations), so it needs no extra RPC or poll loop."""
+    platform = FakePlatform(
+        product="Google Cloud TPU", node="tpu-node-0", env=TPU_ENV
+    )
+    vsp = MockVsp(opi_port=free_port())
+    vsp_server = VspServer(vsp, tmp_root)
+    vsp_server.start()
+    daemon = Daemon(
+        cluster_client,
+        platform,
+        path_manager=tmp_root,
+        tick_interval=0.05,
+        register_device_plugin=False,
+    )
+    daemon.start()
+    cr_name = "tpu-v5litepod-8-w0-dpu"
+
+    def condition():
+        cr = cluster_client.get_or_none(
+            v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT, v.NAMESPACE,
+            cr_name)
+        return get_condition(cr, v1.COND_FABRIC_SHAPING) if cr else None
+
+    try:
+        assert wait_for(lambda: (condition() or {}).get("status") == "True"), \
+            "healthy fabric never reported FabricShaping=True"
+
+        vsp.degradations = ["endpoint share on ep0 failed: tc not found"]
+        assert wait_for(
+            lambda: (condition() or {}).get("status") == "False"), \
+            "degradation never reached the CR condition"
+        cond = condition()
+        assert cond["reason"] == "Degraded"
+        assert "tc not found" in cond["message"]
+
+        vsp.degradations = []
+        assert wait_for(lambda: (condition() or {}).get("status") == "True"), \
+            "condition never recovered after the VSP reported clean"
+    finally:
+        daemon.stop()
+        vsp_server.stop()
+
+
 def test_daemon_rejects_multiple_dpus(cluster_client, tmp_root):
     """More than one detected DPU is an error (reference daemon.go:135-143)."""
     from dpu_operator_tpu.platform import DetectedDpu, FakeTpuDetector

@@ -1,0 +1,403 @@
+"""NF chain wiring through the AUTOMATED path (VERDICT r3 Next #2).
+
+The reference's VSPs program their match-action engines from the
+CNI/NF path, not a CLI: marvell installs OVS flows in
+CreateBridgePort/AddNetworkFunction (main.go:372-449, 515-588), intel
+builds P4 rule sets per port/VF/NF (p4rtclient.go:612-939). These tests
+pin the same property onto the TPU VSP: ports get baseline counter
+rules at attach, NF wiring programs steering + CR-declared policies,
+rules appear/disappear with port and NF lifecycle, and a `police:`
+policy measurably caps a real traffic flow through a real (userspace)
+network function."""
+
+import json
+import subprocess
+import textwrap
+import time
+import uuid
+
+import pytest
+
+from dpu_operator_tpu.vsp.flow_table import FlowTable
+from dpu_operator_tpu.vsp.tpu_dataplane import (
+    BASELINE_PREF, NF_STEER_PREF, DebugDataplane, TpuFabricDataplane)
+
+
+# -- unit tier ---------------------------------------------------------------
+
+
+def test_vsp_passes_policies_to_dataplane():
+    """CreateNetworkFunction carries FlowPolicy entries through the gRPC
+    contract into the dataplane — the CR's policy surface reaches the
+    engine without any CLI in the path."""
+    from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+    dp = DebugDataplane()
+    vsp = TpuVsp(dataplane=dp)
+    req = pb.NFRequest(input="02:00:00:00:00:01", output="02:00:00:00:00:02",
+                       transparent=True)
+    req.policies.add(pref=10, action="police:200", proto="tcp")
+    vsp.CreateNetworkFunction(req, None)
+    assert dp.nf_pairs == [("02:00:00:00:00:01", "02:00:00:00:00:02")]
+    assert dp.nf_policies and dp.nf_policies[0]["action"] == "police:200"
+    assert dp.nf_policies[0]["pref"] == 10
+    assert dp.nf_transparent is True
+
+
+def test_sfc_policies_render_to_pod_annotation():
+    """The SFC reconciler rides policies from the CR to the NF pod as an
+    annotation the DPU-side daemon reads back at CNI time."""
+    from dpu_operator_tpu.daemon.sfc import (
+        NF_POLICY_ANNOTATION, network_function_pod)
+
+    policies = [{"pref": 5, "action": "police:100", "proto": "udp"}]
+    pod = network_function_pod("fw", "img", {}, policies=policies,
+                               transparent=True)
+    spec = json.loads(pod["metadata"]["annotations"][NF_POLICY_ANNOTATION])
+    assert spec == {"policies": policies, "transparent": True}
+    # No chain spec -> no annotation (don't ship empty surface).
+    pod = network_function_pod("fw", "img", {})
+    assert NF_POLICY_ANNOTATION not in pod["metadata"]["annotations"]
+
+
+def test_sfc_reconciler_converges_policy_annotation():
+    from dpu_operator_tpu.api import v1
+    from dpu_operator_tpu.daemon.sfc import (
+        NF_POLICY_ANNOTATION, SfcNodeReconciler)
+    from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster, Request
+    from dpu_operator_tpu import vars as v
+
+    client = InMemoryClient(InMemoryCluster())
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "n1", "labels": {}}})
+    sfc = v1.new_service_function_chain(
+        "chain", network_functions=[
+            {"name": "fw", "image": "img",
+             "policies": [{"pref": 3, "action": "drop", "proto": "icmp"}]}])
+    client.create(sfc)
+    rec = SfcNodeReconciler(client, "n1")
+    rec.reconcile(Request(v.NAMESPACE, "chain"))
+    pod = client.get("v1", "Pod", v.NAMESPACE, "fw")
+    assert json.loads(
+        pod["metadata"]["annotations"][NF_POLICY_ANNOTATION]
+    )["policies"][0]["action"] == "drop"
+    # CR policy change converges onto the existing pod.
+    sfc["spec"]["networkFunctions"][0]["policies"] = [
+        {"pref": 3, "action": "police:50", "proto": "tcp"}]
+    client.update(sfc)
+    rec.reconcile(Request(v.NAMESPACE, "chain"))
+    pod = client.get("v1", "Pod", v.NAMESPACE, "fw")
+    assert json.loads(
+        pod["metadata"]["annotations"][NF_POLICY_ANNOTATION]
+    )["policies"][0]["action"] == "police:50"
+
+
+def test_sfc_policy_validation():
+    """Bad policies die at admission (`kubectl apply`), not in a daemon
+    log: pref collisions with the VSP's reserved range, junk actions,
+    unknown keys."""
+    from dpu_operator_tpu.api import v1
+
+    def chain(policies):
+        return v1.new_service_function_chain(
+            "c", network_functions=[
+                {"name": "fw", "image": "img", "policies": policies}])
+
+    v1.validate_service_function_chain_spec(
+        chain([{"pref": 10, "action": "police:200", "proto": "tcp"}]))
+    for bad in (
+        [{"pref": 30000, "action": "drop"}],          # reserved range
+        [{"pref": 0, "action": "drop"}],
+        [{"pref": 1, "action": "teleport"}],
+        [{"pref": 1, "action": "drop", "proto": "gre"}],
+        [{"pref": 1, "action": "drop", "dstPort": 0}],
+        [{"pref": 1, "action": "drop", "banana": 1}],  # unknown key
+        [{"pref": 1, "action": "drop"}, {"pref": 1, "action": "accept"}],
+    ):
+        with pytest.raises(v1.ValidationError):
+            v1.validate_service_function_chain_spec(chain(bad))
+
+
+# -- root tier ---------------------------------------------------------------
+
+
+def _sh(*args):
+    subprocess.run(args, check=True, capture_output=True)
+
+
+def _mk_pod(ns, host_if, bridge, ip, mac=None):
+    _sh("ip", "netns", "add", ns)
+    _sh("ip", "link", "add", host_if, "type", "veth",
+        "peer", "name", "eth0", "netns", ns)
+    if mac:
+        _sh("ip", "-n", ns, "link", "set", "eth0", "address", mac)
+    _sh("ip", "-n", ns, "link", "set", "eth0", "up")
+    _sh("ip", "-n", ns, "link", "set", "lo", "up")
+    if ip:
+        _sh("ip", "-n", ns, "addr", "add", f"{ip}/24", "dev", "eth0")
+    # The chain's NF re-injects frames from a raw socket: veth TX
+    # checksum offload would hand it frames with UNFILLED L4 checksums,
+    # which the far stack then rightly drops. Real NF pods face real
+    # NICs (checksums complete on the wire); emulate that by completing
+    # checksums at the workload edge. TSO/GSO likewise: a userspace NF
+    # sees wire-sized frames, not 64 KB superframes.
+    _sh("ip", "netns", "exec", ns, "ethtool", "-K", "eth0",
+        "tx", "off", "tso", "off", "gso", "off", "gro", "off")
+
+
+_L2_FORWARDER = textwrap.dedent("""
+    import select, socket
+    ETH_P_ALL = 3
+    socks = []
+    for dev in ("eth0", "eth1"):
+        s = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
+                          socket.htons(ETH_P_ALL))
+        s.bind((dev, 0))
+        socks.append(s)
+    a, b = socks
+    peer = {a.fileno(): b, b.fileno(): a}
+    by_fd = {s.fileno(): s for s in socks}
+    while True:
+        r, _, _ = select.select(socks, [], [], 30)
+        if not r:
+            break
+        for s in r:
+            data, addr = s.recvfrom(65535)
+            if addr[2] == socket.PACKET_OUTGOING:
+                continue  # our own transmissions echoed back
+            peer[s.fileno()].send(data)
+""")
+
+
+@pytest.fixture
+def nf_chain_topology(netns):
+    """A fabric bridge with two workload pods and a REAL network
+    function: a netns with two interfaces joined by a userspace L2
+    forwarder (the bump-in-the-wire every SFC assumes)."""
+    tag = uuid.uuid4().hex[:5]
+    bridge = "brC" + tag
+    nsa, nsb, nsn = "nfa" + tag, "nfb" + tag, "nfn" + tag
+    wa, wb = "wa" + tag, "wb" + tag
+    nfi, nfo = "ni" + tag, "no" + tag
+    mac_a, mac_b = "02:aa:00:00:00:01", "02:aa:00:00:00:02"
+    mac_i, mac_o = "02:bb:00:00:00:01", "02:bb:00:00:00:02"
+    fwd = None
+    try:
+        _sh("ip", "link", "add", bridge, "type", "bridge")
+        _sh("ip", "link", "set", bridge, "up")
+        _mk_pod(nsa, wa, bridge, "10.95.0.1", mac_a)
+        _mk_pod(nsb, wb, bridge, "10.95.0.2", mac_b)
+        # NF pod: two interfaces, no IPs, forwarder between them.
+        _sh("ip", "netns", "add", nsn)
+        _sh("ip", "link", "add", nfi, "type", "veth",
+            "peer", "name", "eth0", "netns", nsn)
+        _sh("ip", "link", "add", nfo, "type", "veth",
+            "peer", "name", "eth1", "netns", nsn)
+        _sh("ip", "-n", nsn, "link", "set", "eth0", "address", mac_i)
+        _sh("ip", "-n", nsn, "link", "set", "eth1", "address", mac_o)
+        for dev in ("eth0", "eth1"):
+            _sh("ip", "-n", nsn, "link", "set", dev, "up")
+            _sh("ip", "-n", nsn, "link", "set", dev, "promisc", "on")
+        fwd = subprocess.Popen(
+            ["ip", "netns", "exec", nsn, "python", "-c", _L2_FORWARDER])
+
+        dp = TpuFabricDataplane(bridge=bridge)
+        dp.ensure_bridge()
+        for port, mac in ((wa, mac_a), (wb, mac_b),
+                          (nfi, mac_i), (nfo, mac_o)):
+            dp.attach_port(port, mac)
+        yield {"dp": dp, "bridge": bridge, "nsa": nsa, "nsb": nsb,
+               "wa": wa, "wb": wb, "nfi": nfi, "nfo": nfo,
+               "mac_i": mac_i, "mac_o": mac_o}
+    finally:
+        if fwd is not None:
+            fwd.kill()
+        for dev in (nfi, nfo, bridge):
+            subprocess.run(["ip", "link", "del", dev], capture_output=True)
+        for ns in (nsa, nsb, nsn):
+            subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_attach_programs_baseline_counter(netns):
+    """Port attach installs the per-port baseline counter rule; traffic
+    moves its counters; detach flushes the chain (rule lifecycle ==
+    port lifecycle, the reference's per-port rule set shape)."""
+    tag = uuid.uuid4().hex[:5]
+    bridge, ns, host_if = "brB" + tag, "nsB" + tag, "pb" + tag
+    try:
+        _sh("ip", "link", "add", bridge, "type", "bridge")
+        _sh("ip", "link", "set", bridge, "up")
+        _sh("ip", "addr", "add", "10.95.1.1/24", "dev", bridge)
+        _mk_pod(ns, host_if, bridge, "10.95.1.2")
+        dp = TpuFabricDataplane(bridge=bridge)
+        dp.ensure_bridge()
+        dp.attach_port(host_if, "02:cc:00:00:00:01")
+        assert dp.flow_state == "ok", dp.flow_state
+
+        rules = FlowTable(host_if).list(stats=True)
+        assert [r["pref"] for r in rules] == [BASELINE_PREF]
+        before = rules[0]["packets"]
+        # Idempotent re-attach: no duplicate baseline, still ok.
+        dp.attach_port(host_if, "02:cc:00:00:00:01")
+        assert dp.flow_state == "ok"
+        assert len(FlowTable(host_if).list()) == 1
+
+        # Traffic from the pod moves the counter.
+        subprocess.run(
+            ["ip", "netns", "exec", ns, "python", "-c",
+             "import socket; s=socket.socket(socket.AF_INET,"
+             "socket.SOCK_DGRAM); [s.sendto(b'x'*512, ('10.95.1.1', 9)) "
+             "for _ in range(50)]"], check=True, capture_output=True)
+        time.sleep(0.2)
+        after = FlowTable(host_if).list(stats=True)[0]["packets"]
+        assert after >= before + 50
+
+        dp.detach_port(host_if)
+        assert FlowTable(host_if).list() == []
+    finally:
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_missing_tc_degrades_shaping_state_not_attach(netns, tmp_path,
+                                                      monkeypatch):
+    """Yank tc from PATH (the minimal-node-image scenario the repo's own
+    nftnl design argument invokes): the pod attach must still succeed,
+    the flow table (pure netlink) must still program, and the failure
+    must be RECORDED in shaping_state — the string the VSP heartbeats to
+    the daemon for the FabricShaping CR condition — not just logged."""
+    import shutil
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    # ethtool is only for the test's own pod helper, not the dataplane.
+    for tool in ("ip", "bridge", "ethtool"):
+        (bindir / tool).symlink_to(shutil.which(tool))
+    monkeypatch.setenv("PATH", str(bindir))
+    assert shutil.which("tc") is None
+
+    from dpu_operator_tpu.tft import ConnectionSpec
+    from dpu_operator_tpu.tft.tft import run_connection
+    from dpu_operator_tpu.vsp.tpu_dataplane import SHARE_POLICE_PREF
+
+    tag = uuid.uuid4().hex[:5]
+    bridge, ns, host_if = "brT" + tag, "nsT" + tag, "pt" + tag
+    try:
+        _sh("ip", "link", "add", bridge, "type", "bridge")
+        _sh("ip", "link", "set", bridge, "up")
+        _sh("ip", "addr", "add", "10.95.2.1/24", "dev", bridge)
+        _mk_pod(ns, host_if, bridge, "10.95.2.2")
+        dp = TpuFabricDataplane(bridge=bridge, fabric_gbps=2.0)
+        dp.ensure_bridge()
+        dp.partition_endpoints(4)
+        dp.attach_port(host_if, "02:dd:00:00:00:01")
+        # The attach itself landed...
+        assert host_if in dp.ports
+        # ...the netlink-only flow table programmed the baseline AND the
+        # nft police fallback for the 2.0/4 = 0.5 Gb/s share...
+        prefs = {r["pref"] for r in FlowTable(host_if).list()}
+        assert prefs == {SHARE_POLICE_PREF, BASELINE_PREF}
+        # ...the degradation is state (heartbeated to the CR condition),
+        # naming the active fallback...
+        assert "nft ingress police fallback" in dp.shaping_state
+        # ...and the fallback has a MEASURED dataplane effect: pod→host
+        # throughput capped at ~the endpoint share, not line rate.
+        r = run_connection(ConnectionSpec(name="cap", type="iperf-tcp"),
+                           None, ns, "10.95.2.1", duration=1.2, port=15311)
+        assert float(r["gbps"]) < 1.0, (
+            f"nft police share let {r['gbps']} Gb/s through a 0.5 share")
+    finally:
+        subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+
+
+def test_nf_wiring_programs_and_removes_rules(nf_chain_topology):
+    """NF lifecycle == rule lifecycle (transparent mode): wiring
+    installs workload steering + policies; unwiring removes them and
+    leaves the baselines."""
+    t = nf_chain_topology
+    dp = t["dp"]
+    dp.wire_network_function(
+        t["mac_i"], t["mac_o"], transparent=True,
+        policies=[{"pref": 10, "action": "police:100", "proto": "tcp"}])
+    assert dp.flow_state == "ok", dp.flow_state
+
+    # Workload ports: baseline + steer into the NF input.
+    for port in (t["wa"], t["wb"]):
+        prefs = {r["pref"]: r for r in FlowTable(port).list()}
+        assert set(prefs) == {NF_STEER_PREF, BASELINE_PREF}
+        assert prefs[NF_STEER_PREF]["action"] == f"redirect:{t['nfi']}"
+    # NF ports: baseline + the CR policy.
+    for port in (t["nfi"], t["nfo"]):
+        prefs = {r["pref"] for r in FlowTable(port).list()}
+        assert prefs == {10, BASELINE_PREF}
+
+    dp.unwire_network_function(t["mac_i"], t["mac_o"])
+    for port in (t["wa"], t["wb"], t["nfi"], t["nfo"]):
+        assert [r["pref"] for r in FlowTable(port).list()] == [BASELINE_PREF]
+
+
+def test_endpoint_nf_wiring_uses_dst_mac_fwd_rules(nf_chain_topology):
+    """Endpoint mode (the default, matching the reference e2e pod↔NF
+    shape): chaining rides dst-MAC fwd rules on the workload ports —
+    NF-bound traffic is flow-steered and counted, everything else is
+    untouched, and no bridge-port isolation happens (an endpoint NF
+    must stay reachable by ARP from unmanaged ports)."""
+    t = nf_chain_topology
+    dp = t["dp"]
+    dp.wire_network_function(t["mac_i"], t["mac_o"])
+    assert dp.flow_state == "ok", dp.flow_state
+    for port in (t["wa"], t["wb"]):
+        rules = {r["pref"]: r for r in FlowTable(port).list()}
+        assert set(rules) == {NF_STEER_PREF, NF_STEER_PREF + 1,
+                              BASELINE_PREF}
+        assert rules[NF_STEER_PREF]["dst_mac"] == t["mac_i"]
+        assert rules[NF_STEER_PREF + 1]["dst_mac"] == t["mac_o"]
+    # NF ports keep flooding enabled in endpoint mode.
+    out = subprocess.run(["bridge", "-d", "link", "show", "dev", t["nfi"]],
+                         capture_output=True, text=True).stdout
+    assert "flood on" in out, out
+    dp.unwire_network_function(t["mac_i"], t["mac_o"])
+    for port in (t["wa"], t["wb"]):
+        assert [r["pref"] for r in FlowTable(port).list()] == [BASELINE_PREF]
+
+
+@pytest.mark.slow
+def test_cr_police_policy_caps_chain_traffic(nf_chain_topology):
+    """The VERDICT's done-criterion: a CR-declared police: policy
+    measurably caps a traffic flow riding the chain — and the steering
+    rule counters prove the bytes really crossed the NF."""
+    from dpu_operator_tpu.tft import ConnectionSpec
+    from dpu_operator_tpu.tft.tft import run_connection
+
+    t = nf_chain_topology
+    dp = t["dp"]
+    conn = ConnectionSpec(name="cap", type="iperf-tcp")
+
+    def measure(port):
+        r = run_connection(conn, t["nsb"], t["nsa"], "10.95.0.2",
+                           duration=1.2, port=port)
+        return float(r["gbps"])
+
+    # Uncapped through the NF first: proves the userspace forwarder
+    # carries real traffic before we attribute the cap to the policy.
+    dp.wire_network_function(t["mac_i"], t["mac_o"], transparent=True)
+    assert dp.flow_state == "ok", dp.flow_state
+    uncapped = measure(15301)
+    assert uncapped > 0.1, f"chain carries no traffic ({uncapped} Gb/s)"
+    steer = {r["pref"]: r for r in
+             FlowTable(t["wa"]).list(stats=True)}[NF_STEER_PREF]
+    assert steer["packets"] > 0, "traffic did not ride the steering rule"
+    dp.unwire_network_function(t["mac_i"], t["mac_o"])
+
+    # Same chain, now with a 100 Mbit police policy from the CR surface.
+    dp.wire_network_function(
+        t["mac_i"], t["mac_o"], transparent=True,
+        policies=[{"pref": 10, "action": "police:100", "proto": "tcp"}])
+    assert dp.flow_state == "ok", dp.flow_state
+    capped = measure(15302)
+    # Generous windows (TCP vs policer is bursty) that still cleanly
+    # separate: 100 Mbit cap on a >100 Mbit/s chain.
+    assert capped < 0.6 * uncapped, (uncapped, capped)
+    assert capped < 0.35, f"police:100 let {capped} Gb/s through"
