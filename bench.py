@@ -344,6 +344,7 @@ def bench_virtual_ring() -> dict:
         "rs = [measure_ring_bandwidth(m, axis='sp') for _ in range(3)]\n"
         "gbps = statistics.median(r['effective_gbps'] for r in rs)\n"
         "print(json.dumps({'virtual_ring_gbps': round(gbps, 2),"
+        " 'virtual_ring_platform': 'cpu-virtual',"  # NOT a TPU number
         " 'virtual_ring_axis_size': rs[0]['axis_size']}))\n" % repo
     )
     try:
@@ -361,11 +362,124 @@ def bench_virtual_ring() -> dict:
         return {}
 
 
+def bench_pod_context() -> dict:
+    """The operator plane and the compute plane in ONE workload context
+    (VERDICT r3 Next #3): allocate a fabric endpoint through the real
+    device plugin, then run a workload that (a) streams bytes from
+    inside a pod netns over its fabric veth and (b) executes a jax op on
+    the chip under the granted TPU_* env. The chip half runs in the
+    root netns here because the axon tunnel binds root-ns loopback — on
+    a real TPU-VM the chip is a char device and netns-independent, which
+    is exactly what tests/test_e2e.py's pod-context scenario pins."""
+    if not _can_use_netns():
+        return {}
+    from dpu_operator_tpu.daemon.device_plugin import DevicePlugin
+    from dpu_operator_tpu.dpu_api import services
+    from dpu_operator_tpu.dpu_api.gen import kubelet_deviceplugin_pb2 as kdp
+    from dpu_operator_tpu.parallel.topology import SliceTopology
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+    import grpc
+
+    out: dict = {}
+    root = tempfile.mkdtemp(prefix="dpu-bp-")
+    pm = PathManager(root=root)
+    ns = "benchpc-" + uuid.uuid4().hex[:6]
+    veth = "bpc" + uuid.uuid4().hex[:6]
+    server = plugin_dp = None
+    try:
+        topo = SliceTopology.from_env(
+            {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0"})
+        vsp = TpuVsp(topology=topo)
+        server = VspServer(vsp, pm)
+        server.start()
+        plugin_dp = DevicePlugin(
+            GrpcPlugin(pm.vendor_plugin_socket()), pm, poll_interval=0.2)
+        plugin_dp.start()
+        channel = grpc.insecure_channel(
+            f"unix://{pm.device_plugin_socket()}")
+        stub = services.DevicePluginStub(channel)
+        next(iter(stub.ListAndWatch(kdp.Empty())))
+        req = kdp.AllocateRequest()
+        req.container_requests.add().devices_ids.extend(["tpu0-ep0"])
+        cresp = stub.Allocate(req).container_responses[0]
+        granted_env = dict(cresp.envs)
+
+        # Fabric half: stream from inside the pod netns over its veth.
+        subprocess.run(["ip", "netns", "add", ns], check=True)
+        subprocess.run(["ip", "link", "add", veth, "type", "veth",
+                        "peer", "name", "net1", "netns", ns], check=True)
+        subprocess.run(["ip", "addr", "add", "10.93.0.1/24", "dev", veth],
+                       check=True)
+        subprocess.run(["ip", "link", "set", veth, "up"], check=True)
+        subprocess.run(["ip", "-n", ns, "addr", "add", "10.93.0.2/24",
+                        "dev", "net1"], check=True)
+        subprocess.run(["ip", "-n", ns, "link", "set", "net1", "up"],
+                       check=True)
+        srv_sock = socket.socket()
+        srv_sock.bind(("10.93.0.1", 0))
+        srv_sock.listen(1)
+        srv_sock.settimeout(20)
+        port = srv_sock.getsockname()[1]
+        env = dict(os.environ)
+        env.update(granted_env)
+        wl = subprocess.Popen(
+            ["ip", "netns", "exec", ns, sys.executable, "-c",
+             "import os, socket\n"
+             "assert os.environ['TPU_VISIBLE_DEVICES']\n"
+             f"s = socket.create_connection(('10.93.0.1', {port}), timeout=15)\n"
+             "s.sendall(b'x' * (1 << 20))\n"
+             "s.close()\n"], env=env)
+        conn, _ = srv_sock.accept()
+        got = 0
+        while True:
+            d = conn.recv(1 << 16)
+            if not d:
+                break
+            got += len(d)
+        stream_ok = (wl.wait(timeout=30) == 0) and got == (1 << 20)
+
+        # Chip half: a jax op under the granted env.
+        chip_ok = False
+        if _tunnel_alive():
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import os, jax, jax.numpy as jnp\n"
+                 "assert os.environ['TPU_VISIBLE_DEVICES'] == '0'\n"
+                 "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+                 "v = float((x @ x).sum())\n"
+                 "assert v == 256 * 256 * 256, v\n"
+                 "print('chip-ok', jax.devices()[0])\n"],
+                capture_output=True, text=True, timeout=300, env=env)
+            chip_ok = r.returncode == 0
+            if not chip_ok:
+                out["pod_context_chip_error"] = r.stderr[-200:]
+        else:
+            out["pod_context_chip_error"] = "axon tunnel down"
+        out["pod_context_chip_access"] = bool(stream_ok and chip_ok)
+        out["pod_context_granted_env"] = sorted(granted_env)
+        print(f"pod-context: stream_ok={stream_ok} chip_ok={chip_ok} "
+              f"env={sorted(granted_env)}", file=sys.stderr)
+    except Exception as e:
+        out["pod_context_chip_access"] = False
+        out["pod_context_chip_error"] = str(e)[:200]
+    finally:
+        if plugin_dp is not None:
+            plugin_dp.stop()
+        if server is not None:
+            server.stop()
+        subprocess.run(["ip", "link", "del", veth], capture_output=True)
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     metrics: dict = {}
     metrics.update(bench_pod_attach())
     metrics.update(bench_fabric_throughput())
     metrics.update(bench_virtual_ring())
+    metrics.update(bench_pod_context())
     metrics.update(bench_tpu())
 
     # One JSON line per secondary metric (driver tail keeps them visible).
@@ -391,6 +505,25 @@ def main() -> int:
         if key in metrics:
             print(json.dumps({"metric": key, "value": metrics[key], "unit": unit}))
 
+    # Perf gates (VERDICT r3 Next #4): the public story is "XLA for
+    # isolated matmuls, pallas for chains (+~8%)" — these assertions
+    # keep the claim, the number, and the artifact in agreement so the
+    # chain win can't silently rot. 0.93 on the isolated matmul is the
+    # measured boundary-cost floor plus session breathing room.
+    rc = 0
+    gates = {}
+    bp, bj = metrics.get("burn_pallas_tflops"), metrics.get("burn_jnp_tflops")
+    if bp is not None and bj is not None:
+        gates["burn_pallas_ge_jnp"] = bool(bp >= bj)
+    mp, mj = metrics.get("mxu_pallas_tflops"), metrics.get("mxu_jnp_tflops")
+    if mp is not None and mj is not None:
+        gates["mxu_pallas_ge_093_jnp"] = bool(mp >= 0.93 * mj)
+    if gates:
+        metrics["perf_gates"] = gates
+        if not all(gates.values()):
+            rc = 1
+            print(f"PERF GATE FAILED: {gates}", file=sys.stderr)
+
     p50 = metrics.get("pod_attach_p50_ms")
     print(
         json.dumps(
@@ -403,7 +536,7 @@ def main() -> int:
             }
         )
     )
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -191,6 +191,17 @@ def _validate_nf_policy(nf_name: str, i: int, p: object) -> None:
     if proto is not None and proto not in ("tcp", "udp", "icmp", "sctp"):
         raise ValidationError(
             f"{where}.proto {proto!r} not tcp/udp/icmp/sctp")
+    for key in ("srcIP", "dstIP"):
+        cidr = p.get(key)
+        if cidr is not None:
+            import ipaddress
+
+            try:
+                net = ipaddress.ip_network(str(cidr), strict=False)
+                if net.version != 4:
+                    raise ValueError("only IPv4 matches supported")
+            except ValueError as e:
+                raise ValidationError(f"{where}.{key} {cidr!r}: {e}") from None
     for key in ("srcPort", "dstPort"):
         port = p.get(key)
         if port is not None and (

@@ -230,9 +230,13 @@ class DpuSideManager:
                 if not isinstance(p, dict):
                     raise ValueError(f"policy entry {p!r} is not an object")
                 int(p.get("pref", 0))
-                str(p.get("action", ""))
                 int(p.get("srcPort") or 0)
                 int(p.get("dstPort") or 0)
+                for key in ("action", "proto", "srcIP", "dstIP"):
+                    val = p.get(key)
+                    if val is not None and not isinstance(val, str):
+                        raise ValueError(
+                            f"policy {key} must be a string, got {val!r}")
             return policies, bool(spec.get("transparent"))
         except Exception as e:
             log.warning("NF chain-spec lookup for %s/%s failed (wiring the "

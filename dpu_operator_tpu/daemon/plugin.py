@@ -140,6 +140,9 @@ class GrpcPlugin(VendorPlugin):
         except grpc.RpcError:
             with self._lock:
                 self._initialized = False
+            # No live heartbeat = no knowledge: a dead VSP must not keep
+            # publishing its pre-crash degradation snapshot.
+            self.last_ping_degradations = []
             return False
 
     def try_init(self, dpu_mode: bool, identifier: str) -> Optional[Tuple[str, int]]:
@@ -187,11 +190,15 @@ class GrpcPlugin(VendorPlugin):
         req = pb.NFRequest(input=input_mac, output=output_mac,
                            transparent=transparent)
         for p in policies or []:
+            # `or ""` (not a .get default): a key present with value
+            # None must not reach protobuf as None.
             req.policies.add(
-                pref=int(p.get("pref", 0)), action=p.get("action", ""),
-                proto=p.get("proto", ""), src_ip=p.get("srcIP", ""),
-                dst_ip=p.get("dstIP", ""), src_port=int(p.get("srcPort", 0)),
-                dst_port=int(p.get("dstPort", 0)))
+                pref=int(p.get("pref") or 0), action=str(p.get("action") or ""),
+                proto=str(p.get("proto") or ""),
+                src_ip=str(p.get("srcIP") or ""),
+                dst_ip=str(p.get("dstIP") or ""),
+                src_port=int(p.get("srcPort") or 0),
+                dst_port=int(p.get("dstPort") or 0))
         stub.CreateNetworkFunction(req, timeout=self.RPC_TIMEOUT)
 
     def delete_network_function(self, input_mac: str, output_mac: str) -> None:

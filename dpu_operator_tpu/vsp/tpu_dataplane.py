@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import subprocess
+import threading
 from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
@@ -37,6 +38,7 @@ BRIDGE_NAME = "br-fabric"
 # Rule prefs reserved for the VSP's own automated-path rules; CR/user
 # policies must stay below (validated at the VSP boundary).
 NF_STEER_PREF = 30000
+NF_UPLINK_PREF = 30900  # transparent-chain catch-all toward the uplink
 SHARE_POLICE_PREF = 31000  # nft fallback for the endpoint share
 BASELINE_PREF = 32000  # == flow_table.MAX_PREF: tail catch-all counter
 POLICY_PREF_MAX = NF_STEER_PREF - 1
@@ -110,6 +112,10 @@ class TpuFabricDataplane:
         self._nf_transparent: bool = False
         self._nf_flow_rules: List[Tuple[str, int]] = []   # (dev, pref)
         self._nf_fdb_pins: List[Tuple[str, str]] = []     # (mac, dev)
+        self._nf_ew_next_pref: int = NF_STEER_PREF + 1
+        # Chain state is mutated from gRPC worker threads (attach vs
+        # wire vs unwire can interleave) — one lock, not per-field.
+        self._nf_lock = threading.Lock()
 
     @property
     def shaping_state(self) -> str:
@@ -210,34 +216,40 @@ class TpuFabricDataplane:
             log.warning("%s", self._flow_issues[f"baseline:{netdev}"])
         # A port attached while an NF chain is live joins its workload
         # side immediately (marvell re-programs vf flows on attach).
-        if self._nf_flow_ports and netdev not in self._nf_flow_ports:
-            try:
-                from .flow_table import FlowRule, FlowTable
+        # Under the chain lock: an unwire racing this attach must either
+        # see the rule in the records (and remove it) or not at all.
+        with self._nf_lock:
+            if self._nf_flow_ports and netdev not in self._nf_flow_ports:
+                try:
+                    from .flow_table import FlowRule, FlowTable
 
-                port_in, port_out = self._nf_flow_ports
-                if self._nf_transparent:
-                    FlowTable(netdev).add(FlowRule(
-                        pref=NF_STEER_PREF, action=f"redirect:{port_in}"))
-                    self._nf_flow_rules.append((netdev, NF_STEER_PREF))
-                    if mac:
-                        _run(["bridge", "fdb", "replace", mac, "dev",
-                              netdev, "master", "static"])
-                        self._nf_fdb_pins.append((mac, netdev))
-                else:
-                    mac_in, mac_out = self._nf_flow_macs
-                    FlowTable(netdev).add(FlowRule(
-                        pref=NF_STEER_PREF, dst_mac=mac_in,
-                        action=f"redirect:{port_in}"))
-                    self._nf_flow_rules.append((netdev, NF_STEER_PREF))
-                    FlowTable(netdev).add(FlowRule(
-                        pref=NF_STEER_PREF + 1, dst_mac=mac_out,
-                        action=f"redirect:{port_out}"))
-                    self._nf_flow_rules.append((netdev, NF_STEER_PREF + 1))
-                self._flow_issues.pop(f"nf-late:{netdev}", None)
-            except Exception as e:
-                self._flow_issues[f"nf-late:{netdev}"] = (
-                    f"NF steer for late-attached {netdev} failed: {e}")
-                log.warning("%s", self._flow_issues[f"nf-late:{netdev}"])
+                    port_in, port_out = self._nf_flow_ports
+                    if self._nf_transparent:
+                        FlowTable(netdev).add(FlowRule(
+                            pref=NF_STEER_PREF, action=f"redirect:{port_in}"))
+                        self._nf_flow_rules.append((netdev, NF_STEER_PREF))
+                        if mac:
+                            _run(["bridge", "fdb", "replace", mac, "dev",
+                                  netdev, "master", "static"])
+                            self._nf_fdb_pins.append((mac, netdev))
+                            if self.uplink:
+                                self._add_eastwest_accept(port_out, mac)
+                    else:
+                        mac_in, mac_out = self._nf_flow_macs
+                        FlowTable(netdev).add(FlowRule(
+                            pref=NF_STEER_PREF, dst_mac=mac_in,
+                            action=f"redirect:{port_in}"))
+                        self._nf_flow_rules.append((netdev, NF_STEER_PREF))
+                        FlowTable(netdev).add(FlowRule(
+                            pref=NF_STEER_PREF + 1, dst_mac=mac_out,
+                            action=f"redirect:{port_out}"))
+                        self._nf_flow_rules.append(
+                            (netdev, NF_STEER_PREF + 1))
+                    self._flow_issues.pop(f"nf-late:{netdev}", None)
+                except Exception as e:
+                    self._flow_issues[f"nf-late:{netdev}"] = (
+                        f"NF steer for late-attached {netdev} failed: {e}")
+                    log.warning("%s", self._flow_issues[f"nf-late:{netdev}"])
 
     def partition_endpoints(self, count: int) -> None:
         """Apply the per-endpoint bandwidth share implied by `count` to
@@ -359,10 +371,11 @@ class TpuFabricDataplane:
         # The flush above removed any NF rules this port carried — keep
         # the chain-teardown records accurate, and a gone port can no
         # longer be degraded.
-        self._nf_flow_rules = [
-            (d, p) for d, p in self._nf_flow_rules if d != netdev]
-        self._nf_fdb_pins = [
-            (m, d) for m, d in self._nf_fdb_pins if d != netdev]
+        with self._nf_lock:
+            self._nf_flow_rules = [
+                (d, p) for d, p in self._nf_flow_rules if d != netdev]
+            self._nf_fdb_pins = [
+                (m, d) for m, d in self._nf_fdb_pins if d != netdev]
         self._shaping_issues.pop(netdev, None)
         self._flow_issues.pop(f"baseline:{netdev}", None)
         self._flow_issues.pop(f"nf-late:{netdev}", None)
@@ -409,24 +422,29 @@ class TpuFabricDataplane:
             _run(
                 ["bridge", "fdb", "replace", mac, "dev", port, "master", "static"]
             )
+        issue_key = f"nf:{mac_in}->{mac_out}"  # per-chain: one chain's
+        # failure must not be cleared (or masked) by another's lifecycle
         if port_in and port_out:
-            try:
-                self._program_nf_flows(mac_in, mac_out, port_in, port_out,
-                                       policies or [], transparent)
-                self._flow_issues.pop("nf", None)
-            except Exception as e:
-                self._flow_issues["nf"] = (
-                    f"NF flow programming {port_in}->{port_out} failed: {e}")
-                log.warning("%s", self._flow_issues["nf"])
+            with self._nf_lock:
+                try:
+                    self._program_nf_flows(mac_in, mac_out, port_in,
+                                           port_out, policies or [],
+                                           transparent)
+                    self._flow_issues.pop(issue_key, None)
+                except Exception as e:
+                    self._flow_issues[issue_key] = (
+                        f"NF flow programming {port_in}->{port_out} "
+                        f"failed: {e}")
+                    log.warning("%s", self._flow_issues[issue_key])
         elif policies or transparent:
             # A chain the CR asked to steer/police but nothing to hang
             # it on is a degradation, not a silent drop — especially
             # transparent mode, where the workload traffic now BYPASSES
             # the NF it was promised to cross.
-            self._flow_issues["nf"] = (
+            self._flow_issues[issue_key] = (
                 f"NF chain spec for {mac_in}->{mac_out} not programmed: "
                 f"ports not attached")
-            log.warning("%s", self._flow_issues["nf"])
+            log.warning("%s", self._flow_issues[issue_key])
         self.nf_pairs.append((mac_in, mac_out))
 
     def _program_nf_flows(self, mac_in: str, mac_out: str, port_in: str,
@@ -513,9 +531,23 @@ class TpuFabricDataplane:
                     action=f"redirect:{port_out}"))
                 self._nf_flow_rules.append((self.uplink, NF_STEER_PREF))
                 if transparent:
+                    # East-west traffic the NF emits must stay on the
+                    # fabric: frames for local workload MACs (and the
+                    # v4 broadcast that carries their ARP) accept into
+                    # normal bridge delivery BEFORE the catch-all
+                    # uplink redirect — otherwise pod→pod traffic
+                    # through the chain would exit the uplink and
+                    # blackhole. (Exact-MAC matches only: multicast-
+                    # dependent protocols ride the uplink in this mode.)
+                    self._nf_ew_next_pref = NF_STEER_PREF + 1
+                    self._add_eastwest_accept(port_out, "ff:ff:ff:ff:ff:ff")
+                    for port, mac in self.ports.items():
+                        if mac and port not in (port_in, port_out):
+                            self._add_eastwest_accept(port_out, mac)
                     FlowTable(port_out).add(FlowRule(
-                        pref=NF_STEER_PREF, action=f"redirect:{self.uplink}"))
-                    self._nf_flow_rules.append((port_out, NF_STEER_PREF))
+                        pref=NF_UPLINK_PREF,
+                        action=f"redirect:{self.uplink}"))
+                    self._nf_flow_rules.append((port_out, NF_UPLINK_PREF))
             for rule in rules:
                 FlowTable(port_in).add(rule)
                 self._nf_flow_rules.append((port_in, rule.pref))
@@ -525,10 +557,24 @@ class TpuFabricDataplane:
             self._teardown_nf_flows()
             raise
 
+    def _add_eastwest_accept(self, port_out: str, mac: str) -> None:
+        """dst-MAC accept on the NF output port, evaluated before the
+        transparent chain's catch-all uplink redirect (_nf_lock held)."""
+        from .flow_table import FlowRule, FlowTable
+
+        pref = self._nf_ew_next_pref
+        if pref >= NF_UPLINK_PREF:
+            raise DataplaneError("east-west accept prefs exhausted")
+        self._nf_ew_next_pref += 1
+        FlowTable(port_out).add(FlowRule(pref=pref, dst_mac=mac,
+                                         action="accept"))
+        self._nf_flow_rules.append((port_out, pref))
+
     def _teardown_nf_flows(self) -> None:
         """Remove exactly what _program_nf_flows recorded — tolerant of
-        vanished netdevs (a detached port took its chain with it) and
-        never touching rules the operator added via fabric-ctl."""
+        vanished netdevs (a detached port took its chain with it),
+        never touching rules the operator added via fabric-ctl;
+        _nf_lock held by the caller."""
         from .flow_table import FlowTable
 
         by_dev: Dict[str, List[int]] = {}
@@ -549,12 +595,14 @@ class TpuFabricDataplane:
                                 "mcast_flood", "on"], capture_output=True)
                 subprocess.run(["bridge", "link", "set", "dev", port,
                                 "bcast_flood", "on"], capture_output=True)
+        if self._nf_flow_macs:
+            self._flow_issues.pop(
+                f"nf:{self._nf_flow_macs[0]}->{self._nf_flow_macs[1]}", None)
         self._nf_flow_ports = None
         self._nf_flow_macs = None
         self._nf_transparent = False
         self._nf_flow_rules = []
         self._nf_fdb_pins = []
-        self._flow_issues.pop("nf", None)
         for key in [k for k in self._flow_issues if k.startswith("nf-late:")]:
             self._flow_issues.pop(key, None)
 
@@ -563,8 +611,12 @@ class TpuFabricDataplane:
         # tear down even when one of its ports was already detached (CNI
         # DEL ordering) — otherwise stale steering rules would outlive
         # the NF and block every future chain.
-        if self._nf_flow_macs == (mac_in, mac_out):
-            self._teardown_nf_flows()
+        with self._nf_lock:
+            if self._nf_flow_macs == (mac_in, mac_out):
+                self._teardown_nf_flows()
+            # This chain is gone either way — its degradation (e.g. a
+            # rejected second chain) goes with it.
+            self._flow_issues.pop(f"nf:{mac_in}->{mac_out}", None)
         port_in = self._port_by_mac(mac_in)
         port_out = self._port_by_mac(mac_out)
         for mac, port in ((mac_in, port_in), (mac_out, port_out)):

@@ -163,6 +163,8 @@ class TpuVsp(
             d.topology.coords = chip.coords_str
             d.topology.numa_node = chip.numa_node
             d.topology.worker_id = topo.worker_id
+            d.topology.slice_id = topo.slice_id
+            d.topology.num_slices = topo.num_slices
             for n in topo.neighbors(chip):
                 d.topology.links.add(neighbor=n.coords_str, gbps=400)
         return resp

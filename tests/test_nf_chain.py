@@ -363,6 +363,54 @@ def test_endpoint_nf_wiring_uses_dst_mac_fwd_rules(nf_chain_topology):
         assert [r["pref"] for r in FlowTable(port).list()] == [BASELINE_PREF]
 
 
+def test_transparent_chain_with_uplink_keeps_eastwest(nf_chain_topology):
+    """With an uplink configured, the transparent chain's catch-all
+    redirect toward the fabric must NOT swallow east-west traffic:
+    frames for local workload MACs (and the ARP broadcast) accept into
+    normal delivery before the uplink redirect — pod→pod through the
+    chain still works, and the rule order proves why."""
+    from dpu_operator_tpu.tft import ConnectionSpec
+    from dpu_operator_tpu.tft.tft import run_connection
+    from dpu_operator_tpu.vsp.tpu_dataplane import NF_UPLINK_PREF
+
+    t = nf_chain_topology
+    dp = t["dp"]
+    tag = t["bridge"][3:]
+    up, upp = "ul" + tag, "up" + tag
+    try:
+        _sh("ip", "link", "add", up, "type", "veth", "peer", "name", upp)
+        _sh("ip", "link", "set", up, "master", t["bridge"])
+        _sh("ip", "link", "set", up, "up")
+        _sh("ip", "link", "set", upp, "up")
+        dp.uplink = up
+        dp.wire_network_function(t["mac_i"], t["mac_o"], transparent=True)
+        assert dp.flow_state == "ok", dp.flow_state
+
+        # Rule order on the NF output: east-west accepts (broadcast +
+        # both workload MACs) strictly before the uplink catch-all.
+        rules = FlowTable(t["nfo"]).list()
+        prefs = [r["pref"] for r in rules]
+        accepts = [r for r in rules if r["action"] == "accept"
+                   and r["pref"] < NF_UPLINK_PREF and "dst_mac" in r]
+        assert {r["dst_mac"] for r in accepts} >= {
+            "ff:ff:ff:ff:ff:ff", "02:aa:00:00:00:01", "02:aa:00:00:00:02"}
+        assert NF_UPLINK_PREF in prefs
+        assert prefs.index(NF_UPLINK_PREF) > max(
+            prefs.index(r["pref"]) for r in accepts)
+
+        # And the traffic proof: pod→pod through the chain still flows.
+        r = run_connection(ConnectionSpec(name="ew", type="iperf-tcp"),
+                           t["nsb"], t["nsa"], "10.95.0.2",
+                           duration=1.0, port=15321)
+        assert float(r["gbps"]) > 0.05, r
+        dp.unwire_network_function(t["mac_i"], t["mac_o"])
+        assert [x["pref"] for x in FlowTable(t["nfo"]).list()] == \
+            [BASELINE_PREF]
+    finally:
+        dp.uplink = None
+        subprocess.run(["ip", "link", "del", up], capture_output=True)
+
+
 @pytest.mark.slow
 def test_cr_police_policy_caps_chain_traffic(nf_chain_topology):
     """The VERDICT's done-criterion: a CR-declared police: policy
