@@ -386,7 +386,7 @@ def bench_pod_context() -> dict:
     pm = PathManager(root=root)
     ns = "benchpc-" + uuid.uuid4().hex[:6]
     veth = "bpc" + uuid.uuid4().hex[:6]
-    server = plugin_dp = None
+    server = plugin_dp = wl = srv_sock = conn = None
     try:
         topo = SliceTopology.from_env(
             {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_WORKER_ID": "0"})
@@ -464,6 +464,16 @@ def bench_pod_context() -> dict:
         out["pod_context_chip_access"] = False
         out["pod_context_chip_error"] = str(e)[:200]
     finally:
+        # A hung workload must not outlive the bench (or keep its netns
+        # pinned past the `ip netns del` below).
+        if wl is not None and wl.poll() is None:
+            wl.kill()
+        for s in (conn, srv_sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
         if plugin_dp is not None:
             plugin_dp.stop()
         if server is not None:

@@ -217,13 +217,11 @@ class DevicePlugin(services.DevicePluginServicer):
             cresp.envs["TPU_CHIP_COORDS"] = ";".join(
                 chips[n].topology.coords for n in ordered
             )
-            cresp.envs["TPU_WORKER_ID"] = str(
-                chips[ordered[0]].topology.worker_id
-            )
+            first = chips[ordered[0]].topology
+            cresp.envs["TPU_WORKER_ID"] = str(first.worker_id)
             # Multislice identity (VERDICT r3 Weak #5: SliceTopology
             # carries MEGASCALE_* but pods couldn't learn their slice
             # without scraping GCE metadata themselves).
-            first = chips[ordered[0]].topology
             cresp.envs["TPU_SLICE_ID"] = str(first.slice_id)
             cresp.envs["TPU_NUM_SLICES"] = str(max(1, first.num_slices))
         return resp

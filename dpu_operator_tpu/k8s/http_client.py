@@ -211,10 +211,28 @@ class HttpClient(Client):
                         if not line.strip():
                             continue
                         ev = json.loads(line)
+                        ev_type = ev.get("type", "MODIFIED")
+                        if ev_type == "BOOKMARK":
+                            # Progress marker carrying only a metadata
+                            # skeleton — never a resource event (served
+                            # even though we ask allowWatchBookmarks=
+                            # false: the field is a hint, not a
+                            # contract). Delivering it would hand the
+                            # controllers a spec-less ghost object.
+                            continue
+                        if ev_type == "ERROR":
+                            # e.g. 410 Gone (expired resourceVersion),
+                            # body is a Status, not a resource: fall
+                            # back to relist + rewatch — rate-limited
+                            # like the exception path, or a server that
+                            # ERRORs every stream would be list-hammered.
+                            if not w.stopped.is_set():
+                                time.sleep(1.0)
+                            break
                         obj = ev.get("object", {})
                         obj.setdefault("apiVersion", api_version)
                         obj.setdefault("kind", kind)
-                        w.events.put(WatchEvent(ev.get("type", "MODIFIED"), obj))
+                        w.events.put(WatchEvent(ev_type, obj))
             except Exception:
                 if w.stopped.is_set():
                     return

@@ -113,6 +113,8 @@ class TpuFabricDataplane:
         self._nf_flow_rules: List[Tuple[str, int]] = []   # (dev, pref)
         self._nf_fdb_pins: List[Tuple[str, str]] = []     # (mac, dev)
         self._nf_ew_next_pref: int = NF_STEER_PREF + 1
+        self._nf_ew_prefs: Dict[str, int] = {}   # mac -> accept pref
+        self._nf_ew_free: List[int] = []         # reclaimed prefs
         # Chain state is mutated from gRPC worker threads (attach vs
         # wire vs unwire can interleave) — one lock, not per-field.
         self._nf_lock = threading.Lock()
@@ -367,7 +369,7 @@ class TpuFabricDataplane:
             nl.set_master(netdev, None)
         except nl.NetlinkError as e:
             log.debug("detach %s: %s", netdev, e)
-        self.ports.pop(netdev, None)
+        mac = self.ports.pop(netdev, None)
         # The flush above removed any NF rules this port carried — keep
         # the chain-teardown records accurate, and a gone port can no
         # longer be degraded.
@@ -376,6 +378,24 @@ class TpuFabricDataplane:
                 (d, p) for d, p in self._nf_flow_rules if d != netdev]
             self._nf_fdb_pins = [
                 (m, d) for m, d in self._nf_fdb_pins if d != netdev]
+            # A departed pod's east-west accept lives on the NF OUTPUT
+            # port, not on the detached netdev: reclaim it (stale
+            # accepts otherwise pile up and exhaust the pref window
+            # under pod churn on a long-lived chain).
+            pref = self._nf_ew_prefs.pop(mac, None) if mac else None
+            if pref is not None and self._nf_flow_ports:
+                port_out = self._nf_flow_ports[1]
+                try:
+                    from .flow_table import FlowTable
+
+                    FlowTable(port_out).delete_many([pref])
+                except Exception as e:
+                    log.debug("east-west accept reclaim on %s: %s",
+                              port_out, e)
+                self._nf_flow_rules = [
+                    (d, p) for d, p in self._nf_flow_rules
+                    if not (d == port_out and p == pref)]
+                self._nf_ew_free.append(pref)
         self._shaping_issues.pop(netdev, None)
         self._flow_issues.pop(f"baseline:{netdev}", None)
         self._flow_issues.pop(f"nf-late:{netdev}", None)
@@ -540,6 +560,8 @@ class TpuFabricDataplane:
                     # blackhole. (Exact-MAC matches only: multicast-
                     # dependent protocols ride the uplink in this mode.)
                     self._nf_ew_next_pref = NF_STEER_PREF + 1
+                    self._nf_ew_prefs = {}
+                    self._nf_ew_free = []
                     self._add_eastwest_accept(port_out, "ff:ff:ff:ff:ff:ff")
                     for port, mac in self.ports.items():
                         if mac and port not in (port_in, port_out):
@@ -559,16 +581,22 @@ class TpuFabricDataplane:
 
     def _add_eastwest_accept(self, port_out: str, mac: str) -> None:
         """dst-MAC accept on the NF output port, evaluated before the
-        transparent chain's catch-all uplink redirect (_nf_lock held)."""
+        transparent chain's catch-all uplink redirect (_nf_lock held).
+        Prefs reclaimed by detach are reused, so long-lived chains with
+        pod churn never exhaust the window."""
         from .flow_table import FlowRule, FlowTable
 
-        pref = self._nf_ew_next_pref
-        if pref >= NF_UPLINK_PREF:
-            raise DataplaneError("east-west accept prefs exhausted")
-        self._nf_ew_next_pref += 1
+        if self._nf_ew_free:
+            pref = self._nf_ew_free.pop()
+        else:
+            pref = self._nf_ew_next_pref
+            if pref >= NF_UPLINK_PREF:
+                raise DataplaneError("east-west accept prefs exhausted")
+            self._nf_ew_next_pref += 1
         FlowTable(port_out).add(FlowRule(pref=pref, dst_mac=mac,
                                          action="accept"))
         self._nf_flow_rules.append((port_out, pref))
+        self._nf_ew_prefs[mac] = pref
 
     def _teardown_nf_flows(self) -> None:
         """Remove exactly what _program_nf_flows recorded — tolerant of
@@ -603,6 +631,8 @@ class TpuFabricDataplane:
         self._nf_transparent = False
         self._nf_flow_rules = []
         self._nf_fdb_pins = []
+        self._nf_ew_prefs = {}
+        self._nf_ew_free = []
         for key in [k for k in self._flow_issues if k.startswith("nf-late:")]:
             self._flow_issues.pop(key, None)
 

@@ -427,6 +427,11 @@ def test_pod_uses_chip_grant_and_fabric_together(stack):
         out = server.communicate(timeout=15)[0]
         assert int(out.strip().splitlines()[-1]) == len(payload) * 1000, out
     finally:
+        try:
+            if server.poll() is None:
+                server.kill()
+        except NameError:
+            pass  # failed before the server started
         for req in reqs:
             _cni_detach(stack, req)
         for n in (pod_ns, peer_ns):

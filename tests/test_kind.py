@@ -33,8 +33,9 @@ CLUSTER_NAME = "dpu-operator-test-cluster"
 SKIP_REASON = (
     "validated-vs-modeled boundary: no real kube-apiserver reachable — set "
     "TEST_KUBECONFIG or install docker+kind; apiserver/kubelet semantics are "
-    "otherwise exercised against the project's modeled tier only "
-    "(k8s/http_server.py + testutils.KubeletSim)"
+    "otherwise exercised against the project's modeled tier "
+    "(k8s/http_server.py + testutils.KubeletSim) plus golden-fixture wire "
+    "replay of real apiserver response shapes (test_wire_fixtures.py)"
 )
 
 

@@ -1,0 +1,227 @@
+"""Golden-fixture kube-apiserver wire replay (VERDICT r3 Next #6).
+
+The suite's two test_kind.py skips name the boundary honestly: real
+kube-apiserver semantics are validated against this repo's OWN model
+(`k8s/http_server.py`). These tests shrink that trust gap from the
+other side: canned apiserver RESPONSE BODIES — the exact envelope the
+real server speaks — are replayed through a dumb fixture HTTP server
+into the production `HttpClient`, asserting the client and the
+controllers behave the same as on the modeled tier.
+
+Fixture provenance: this container has no cluster to capture from
+(zero egress), so the fixtures in tests/fixtures/k8s_wire/ are AUTHORED
+byte-shape-faithful to the upstream apimachinery wire contract — the
+`Status` failure envelope (kind/status/message/reason/details/code),
+newline-delimited watch framing with BOOKMARK metadata-skeleton and
+ERROR(410 Expired) frames, and a full server-shaped Pod carrying
+managedFields / ownerReferences / creationTimestamp / qosClass — i.e.
+fields and frames this repo's model server NEVER emits, which is
+exactly what makes the replay worth running. Anyone with a real
+cluster can re-capture them with `kubectl get --raw` / a watch curl and
+drop them in; the tests only read the files.
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dpu_operator_tpu.k8s.http_client import HttpClient
+from dpu_operator_tpu.k8s.store import AlreadyExists, Conflict, NotFound
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "k8s_wire")
+
+
+def _load(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return json.load(f)
+
+
+class FixtureApiServer:
+    """Replays canned (method, path-suffix) → (code, body) exchanges,
+    plus one newline-framed watch stream, exactly as a real apiserver
+    would put them on the wire. Records every request for assertions."""
+
+    def __init__(self):
+        self.routes = {}  # (method, path contains) -> (code, dict body)
+        self.watch = None  # (list_response, [frames])
+        self.requests = []
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body_bytes, chunked=False):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if chunked:
+                    self.send_header("Transfer-Encoding", "chunked")
+                else:
+                    self.send_header("Content-Length", str(len(body_bytes)))
+                self.end_headers()
+                if chunked:
+                    for line in body_bytes:
+                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                        self.wfile.flush()
+                        time.sleep(0.01)
+                    self.wfile.write(b"0\r\n\r\n")
+                else:
+                    self.wfile.write(body_bytes)
+
+            def _handle(self, method):
+                srv.requests.append((method, self.path))
+                if "watch=1" in self.path and srv.watch is not None:
+                    frames = [
+                        (json.dumps(fr) + "\n").encode()
+                        for fr in srv.watch[1]
+                    ]
+                    return self._reply(200, frames, chunked=True)
+                for (m, frag), (code, body) in srv.routes.items():
+                    if m == method and frag in self.path:
+                        return self._reply(code, json.dumps(body).encode())
+                if method == "GET" and srv.watch is not None:
+                    return self._reply(
+                        200, json.dumps(srv.watch[0]).encode())
+                self._reply(404, json.dumps(
+                    {"kind": "Status", "status": "Failure",
+                     "reason": "NotFound", "code": 404}).encode())
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self._handle("POST")
+
+            def do_PUT(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self._handle("PUT")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def fixture_server():
+    s = FixtureApiServer()
+    yield s
+    s.stop()
+
+
+def test_conflict_status_body_classifies_as_conflict(fixture_server):
+    """A real 409 Conflict Status body (reason: Conflict, the
+    'object has been modified' message) must raise Conflict — the
+    retry-with-fresh-read signal — NOT AlreadyExists."""
+    fixture_server.routes[("PUT", "/dataprocessingunits/")] = (
+        409, _load("status_conflict_put.json"))
+    client = HttpClient(fixture_server.url)
+    with pytest.raises(Conflict):
+        client.update({
+            "apiVersion": "config.tpu.io/v1",
+            "kind": "DataProcessingUnit",
+            "metadata": {"name": "tpu-v5litepod-8-w0-dpu",
+                         "namespace": "dpu-operator-system"},
+        })
+
+
+def test_already_exists_status_body_classifies(fixture_server):
+    """The OTHER 409: reason AlreadyExists on POST → AlreadyExists (the
+    create-race signal the controllers treat as success-if-converged)."""
+    fixture_server.routes[("POST", "/pods")] = (
+        409, _load("status_already_exists_post.json"))
+    client = HttpClient(fixture_server.url)
+    with pytest.raises(AlreadyExists):
+        client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nf-fw", "namespace": "x"},
+        })
+
+
+def test_not_found_status_body(fixture_server):
+    fixture_server.routes[("GET", "/pods/vanished")] = (
+        404, _load("status_not_found_get.json"))
+    client = HttpClient(fixture_server.url)
+    with pytest.raises(NotFound):
+        client.get("v1", "Pod", "x", "vanished")
+
+
+def test_full_server_shaped_pod_flows_through_daemon_logic(fixture_server):
+    """A Pod exactly as a real apiserver returns it — managedFields,
+    ownerReferences, creationTimestamp, qosClass, the whole envelope —
+    must flow through the client AND the daemon's NF chain-spec reader
+    without choking on fields the modeled tier never emits."""
+    pod = _load("pod_full_server_shape.json")
+    fixture_server.routes[("GET", "/pods/nf-fw")] = (200, pod)
+    client = HttpClient(fixture_server.url)
+    got = client.get("v1", "Pod", "dpu-operator-system", "nf-fw")
+    assert got["metadata"]["managedFields"][1]["subresource"] == "status"
+
+    # The dpu-side daemon's annotation reader consumes it as-is.
+    from dpu_operator_tpu.cni.types import CniRequest
+    from dpu_operator_tpu.daemon.dpu_side import DpuSideManager
+
+    mgr = object.__new__(DpuSideManager)  # only _client/_nf_chain_spec used
+    mgr._client = client
+    req = CniRequest(
+        command="ADD", container_id="c1", netns="/proc/self/ns/net",
+        ifname="net1",
+        args={"K8S_POD_NAME": "nf-fw",
+              "K8S_POD_NAMESPACE": "dpu-operator-system"})
+    policies, transparent = mgr._nf_chain_spec(req)
+    assert policies == [{"pref": 10, "action": "police:200", "proto": "tcp"}]
+    assert transparent is False
+
+
+def test_watch_stream_bookmark_and_error_frames(fixture_server):
+    """The real watch wire: newline-framed events over chunked
+    encoding, including a BOOKMARK (metadata skeleton — must NOT be
+    delivered as a resource event) and a terminal ERROR Status frame
+    (410 Expired — must trigger relist, not surface as an object). The
+    client must deliver exactly the real resource events, then relist."""
+    wf = _load("watch_stream_dpus.json")
+    fixture_server.watch = (wf["list_response"], wf["watch_frames"])
+    client = HttpClient(fixture_server.url)
+    w = client.watch("config.tpu.io/v1", "DataProcessingUnit",
+                     "dpu-operator-system")
+    try:
+        seen = []
+        deadline = time.monotonic() + 10
+        # initial-list ADDED + MODIFIED + ADDED from the stream; then
+        # the ERROR frame forces a relist, whose ADDED re-delivery we
+        # use as proof the loop survived the Status frame.
+        while time.monotonic() < deadline and len(seen) < 4:
+            try:
+                ev = w.events.get(timeout=1.0)
+            except Exception:
+                continue
+            seen.append(ev)
+        types_names = [
+            (ev.type, ev.object.get("metadata", {}).get("name")) for ev in seen
+        ]
+        assert ("ADDED", "tpu-v5litepod-8-w0-dpu") in types_names
+        assert ("MODIFIED", "tpu-v5litepod-8-w0-dpu") in types_names
+        assert ("ADDED", "tpu-v5litepod-8-w1-dpu") in types_names
+        # No ghost events: nothing with an empty name (the BOOKMARK
+        # skeleton) and no Status object ever surfaced.
+        for ev in seen:
+            assert ev.object.get("metadata", {}).get("name"), ev.obj
+            assert ev.object.get("kind") != "Status", ev.obj
+        # The relist after ERROR really happened: >= 2 plain GETs.
+        lists = [p for (m, p) in fixture_server.requests
+                 if m == "GET" and "watch=1" not in p]
+        assert len(lists) >= 2, fixture_server.requests
+    finally:
+        client.stop_watch(w)
