@@ -186,19 +186,22 @@ class HttpClient(Client):
     def _watch_loop(self, w: _HttpWatcher, api_version, kind, namespace) -> None:
         import time
 
+        rv: Optional[str] = None  # None = must (re)list before watching
         while not w.stopped.is_set():
             try:
-                listing = self._request(
-                    "GET", self._resource_url(api_version, kind, namespace, None)
-                )
-                rv = listing.get("metadata", {}).get("resourceVersion", "")
-                for item in listing.get("items", []):
-                    item.setdefault("apiVersion", api_version)
-                    item.setdefault("kind", kind)
-                    w.events.put(WatchEvent("ADDED", item))
+                if rv is None:
+                    listing = self._request(
+                        "GET",
+                        self._resource_url(api_version, kind, namespace, None),
+                    )
+                    rv = listing.get("metadata", {}).get("resourceVersion", "")
+                    for item in listing.get("items", []):
+                        item.setdefault("apiVersion", api_version)
+                        item.setdefault("kind", kind)
+                        w.events.put(WatchEvent("ADDED", item))
                 url = (
                     self._resource_url(api_version, kind, namespace, None)
-                    + f"?watch=1&resourceVersion={rv}&allowWatchBookmarks=false"
+                    + f"?watch=1&resourceVersion={rv}&allowWatchBookmarks=true"
                 )
                 req = urllib.request.Request(url)
                 req.add_header("Accept", "application/json")
@@ -212,13 +215,17 @@ class HttpClient(Client):
                             continue
                         ev = json.loads(line)
                         ev_type = ev.get("type", "MODIFIED")
+                        obj = ev.get("object", {})
                         if ev_type == "BOOKMARK":
                             # Progress marker carrying only a metadata
-                            # skeleton — never a resource event (served
-                            # even though we ask allowWatchBookmarks=
-                            # false: the field is a hint, not a
-                            # contract). Delivering it would hand the
-                            # controllers a spec-less ghost object.
+                            # skeleton — never delivered as a resource
+                            # event (it would hand the controllers a
+                            # spec-less ghost object), but its
+                            # resourceVersion lets the next watch RESUME
+                            # instead of relisting the world. This is
+                            # what bookmarks exist for.
+                            rv = obj.get("metadata", {}).get(
+                                "resourceVersion") or rv
                             continue
                         if ev_type == "ERROR":
                             # e.g. 410 Gone (expired resourceVersion),
@@ -226,16 +233,26 @@ class HttpClient(Client):
                             # back to relist + rewatch — rate-limited
                             # like the exception path, or a server that
                             # ERRORs every stream would be list-hammered.
+                            rv = None
                             if not w.stopped.is_set():
                                 time.sleep(1.0)
                             break
-                        obj = ev.get("object", {})
                         obj.setdefault("apiVersion", api_version)
                         obj.setdefault("kind", kind)
+                        rv = obj.get("metadata", {}).get(
+                            "resourceVersion") or rv
                         w.events.put(WatchEvent(ev_type, obj))
+                # Clean stream end: re-watch from the last seen RV (rv
+                # stays set) — no duplicate-ADDED storm through the
+                # controllers on every idle-timeout reconnect. Small
+                # pause so a proxy that closes every stream immediately
+                # cannot drive an unthrottled hot request loop.
+                if not w.stopped.is_set():
+                    time.sleep(0.2)
             except Exception:
                 if w.stopped.is_set():
                     return
+                rv = None
                 time.sleep(2.0)  # relist + rewatch
 
 

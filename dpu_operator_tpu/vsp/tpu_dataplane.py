@@ -194,7 +194,8 @@ class TpuFabricDataplane:
         # independently, and oversized frames then vanish at the smaller
         # peer with no error. The pinned bridge MTU (ensure_bridge) keeps
         # a small port from clamping anyone else.
-        self.ports[netdev] = mac
+        with self._nf_lock:  # _program_nf_flows iterates ports under it
+            self.ports[netdev] = mac
         self._apply_share_with_fallback(netdev)
         # Per-port baseline counter rule — live flow stats for every
         # fabric port from the moment it attaches (`fabric-ctl rule-list
@@ -369,11 +370,12 @@ class TpuFabricDataplane:
             nl.set_master(netdev, None)
         except nl.NetlinkError as e:
             log.debug("detach %s: %s", netdev, e)
-        mac = self.ports.pop(netdev, None)
         # The flush above removed any NF rules this port carried — keep
         # the chain-teardown records accurate, and a gone port can no
-        # longer be degraded.
+        # longer be degraded. ports itself mutates under the chain lock:
+        # _program_nf_flows iterates it there.
         with self._nf_lock:
+            mac = self.ports.pop(netdev, None)
             self._nf_flow_rules = [
                 (d, p) for d, p in self._nf_flow_rules if d != netdev]
             self._nf_fdb_pins = [
@@ -381,7 +383,10 @@ class TpuFabricDataplane:
             # A departed pod's east-west accept lives on the NF OUTPUT
             # port, not on the detached netdev: reclaim it (stale
             # accepts otherwise pile up and exhaust the pref window
-            # under pod churn on a long-lived chain).
+            # under pod churn on a long-lived chain). The pref is only
+            # freed for reuse when the kernel delete actually landed —
+            # recycling an occupied pref would reject the next pod's
+            # accept and blackhole its east-west traffic.
             pref = self._nf_ew_prefs.pop(mac, None) if mac else None
             if pref is not None and self._nf_flow_ports:
                 port_out = self._nf_flow_ports[1]
@@ -392,10 +397,11 @@ class TpuFabricDataplane:
                 except Exception as e:
                     log.debug("east-west accept reclaim on %s: %s",
                               port_out, e)
-                self._nf_flow_rules = [
-                    (d, p) for d, p in self._nf_flow_rules
-                    if not (d == port_out and p == pref)]
-                self._nf_ew_free.append(pref)
+                else:
+                    self._nf_flow_rules = [
+                        (d, p) for d, p in self._nf_flow_rules
+                        if not (d == port_out and p == pref)]
+                    self._nf_ew_free.append(pref)
         self._shaping_issues.pop(netdev, None)
         self._flow_issues.pop(f"baseline:{netdev}", None)
         self._flow_issues.pop(f"nf-late:{netdev}", None)

@@ -117,10 +117,11 @@ def test_watch_request_and_event_wire_shape(recording_stack):
         if e["method"] == "GET" and e["query"].get("watch") in ("1", "true")
     )
     # watch=1 parses true under kube's strconv.ParseBool; resume point and
-    # bookmark opt-out ride the documented query params.
+    # bookmark opt-in ride the documented query params (the client resumes
+    # from bookmark RVs instead of relisting — test_wire_fixtures.py).
     assert watch_req["path"] == "/api/v1/nodes"
     assert "resourceVersion" in watch_req["query"]
-    assert watch_req["query"]["allowWatchBookmarks"] == "false"
+    assert watch_req["query"]["allowWatchBookmarks"] == "true"
 
     # Raw wire: watch events are newline-delimited JSON {type, object}
     # exactly as a real apiserver streams them.

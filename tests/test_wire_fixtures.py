@@ -185,6 +185,41 @@ def test_full_server_shaped_pod_flows_through_daemon_logic(fixture_server):
     assert transparent is False
 
 
+def test_watch_resumes_from_bookmark_rv(fixture_server):
+    """Bookmarks exist so clients can RESUME: after a clean stream end,
+    the next watch request must carry the bookmark's resourceVersion —
+    and no second LIST should happen (no duplicate-ADDED storm through
+    the controllers on every idle-timeout reconnect)."""
+    wf = _load("watch_stream_dpus.json")
+    frames = [fr for fr in wf["watch_frames"] if fr["type"] != "ERROR"]
+    fixture_server.watch = (wf["list_response"], frames)
+    client = HttpClient(fixture_server.url)
+    w = client.watch("config.tpu.io/v1", "DataProcessingUnit",
+                     "dpu-operator-system")
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            watches = [p for (m, p) in fixture_server.requests
+                       if "watch=1" in p]
+            if len(watches) >= 2:
+                break
+            time.sleep(0.05)
+        watches = [p for (m, p) in fixture_server.requests if "watch=1" in p]
+        assert len(watches) >= 2, fixture_server.requests
+        # First watch starts from the LIST's rv; the reconnect resumes
+        # from the LAST event's rv (the ADDED at 482911 postdates the
+        # 482910 bookmark) — never from scratch.
+        assert "resourceVersion=482900" in watches[0]
+        assert "resourceVersion=482911" in watches[1]
+        assert "allowWatchBookmarks=true" in watches[0]
+        lists = [p for (m, p) in fixture_server.requests
+                 if "watch=1" not in p]
+        assert len(lists) == 1, f"relist happened despite clean resume: " \
+            f"{fixture_server.requests}"
+    finally:
+        client.stop_watch(w)
+
+
 def test_watch_stream_bookmark_and_error_frames(fixture_server):
     """The real watch wire: newline-framed events over chunked
     encoding, including a BOOKMARK (metadata skeleton — must NOT be
@@ -217,8 +252,8 @@ def test_watch_stream_bookmark_and_error_frames(fixture_server):
         # No ghost events: nothing with an empty name (the BOOKMARK
         # skeleton) and no Status object ever surfaced.
         for ev in seen:
-            assert ev.object.get("metadata", {}).get("name"), ev.obj
-            assert ev.object.get("kind") != "Status", ev.obj
+            assert ev.object.get("metadata", {}).get("name"), ev.object
+            assert ev.object.get("kind") != "Status", ev.object
         # The relist after ERROR really happened: >= 2 plain GETs.
         lists = [p for (m, p) in fixture_server.requests
                  if m == "GET" and "watch=1" not in p]
