@@ -1,0 +1,139 @@
+"""Pipeline parallelism — the `pp` mesh axis.
+
+GPipe-style microbatch pipelining expressed TPU-first: each device on
+the `pp` axis holds ONE stage's weights (stage-stacked pytree sharded
+`P('pp')`), activations hop stage-to-stage with `lax.ppermute` (XLA
+lowers it to an ICI collective-permute, the point-to-point primitive
+pipeline schedules want), and the whole schedule is a single `lax.scan`
+inside `shard_map` — no Python control flow inside jit, static shapes,
+one compiled program for all ticks (scaling-book pipelining recipe; the
+reference operator has no compute path — this is part of the TPU-native
+compute layer the fabric exists to feed).
+
+Schedule shape: with S stages and M microbatches the scan runs
+T = M + S - 1 ticks. Every stage computes every tick (the bubble
+computes garbage that is never recorded — uniform work per tick is what
+keeps the step a single fused program); stage 0 injects microbatch t
+while t < M, stage S-1 records tick t into output slot t-(S-1). The
+bubble fraction is the textbook (S-1)/T — measured and asserted in
+tests/test_pipeline_moe.py rather than asserted away.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params) -> dict:
+    """[{'w': ..., 'b': ...} per stage] → one pytree with a leading
+    stage dim, ready to shard P('pp')."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_pipeline(mesh: Mesh, stage_fn: Callable, axis: str = "pp"):
+    """Returns pipelined(params_stacked, microbatches) where
+    `params_stacked` leaves carry a leading stage dim (sharded P(axis))
+    and `microbatches` is [M, mb, d]. Result == applying the S stages
+    sequentially to every microbatch: out[m] = fS(...f1(x[m]))."""
+    S = mesh.shape[axis]
+
+    def per_device(params_local, x_mb):
+        # params_local leaves arrive [1, ...] (this device's stage).
+        leading = {a.shape[0] for a in jax.tree.leaves(params_local)}
+        if leading != {1}:
+            raise ValueError(
+                f"stage count must equal mesh.shape[{axis!r}]={S}: each "
+                f"device must hold exactly one stage, got local leading "
+                f"dims {sorted(leading)} (did you stack "
+                f"{S * max(leading)} stages onto a {S}-way axis?)")
+        params = jax.tree.map(lambda a: a[0], params_local)
+        M = x_mb.shape[0]
+        my = lax.axis_index(axis)
+        zero_act = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+        zero_out = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            x_in, out = carry
+            # Stage 0 injects microbatch t (a zero ghost once drained —
+            # it flows through the bubble and is never recorded).
+            mb = jnp.where(t < M, x_mb[jnp.clip(t, 0, M - 1)], zero_act)
+            x_cur = jnp.where(my == 0, mb, x_in)
+            y = stage_fn(params, x_cur)
+            # Last stage records the microbatch that entered S-1 ticks
+            # ago; everyone else's `out` stays zero (psum-combined
+            # below).
+            out_idx = t - (S - 1)
+            record = (my == S - 1) & (out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, M - 1)
+            out = jnp.where(
+                record,
+                out.at[slot].set(y),
+                out,
+            )
+            # Ship activations one stage forward; stage S-1's output
+            # falls off the end (no cycle — this is a line, not a ring).
+            x_next = lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(S - 1)])
+            return (x_next, out), None
+
+        (_, out), _ = lax.scan(
+            tick, (zero_act, zero_out), jnp.arange(M + S - 1))
+        # Only the last stage holds real outputs; psum broadcasts them
+        # (every other contribution is the zero buffer).
+        return lax.psum(out, axis)
+
+    def pipelined(params_stacked, x_mb):
+        f = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return f(params_stacked, x_mb)
+
+    return pipelined
+
+
+def sequential_reference(per_stage_params, x_mb, stage_fn):
+    """The ground truth the pipeline must match: stages applied in
+    order to every microbatch, no parallelism."""
+    ys = []
+    for m in range(x_mb.shape[0]):
+        h = x_mb[m]
+        for params in per_stage_params:
+            h = stage_fn(params, h)
+        ys.append(h)
+    return jnp.stack(ys)
+
+
+def shard_stage_params(params_stacked, mesh: Mesh, axis: str = "pp"):
+    """Place the stage-stacked pytree with its leading dim split over
+    the pp axis (each device holds exactly its stage's weights)."""
+    return jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))),
+        params_stacked,
+    )
+
+
+def mlp_stage(params, x):
+    """The default stage body used by tests/dryrun: one matmul +
+    nonlinearity — enough structure for numerics to catch ordering or
+    permutation bugs (stage weights differ, so stage order matters)."""
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def demo_stage_params(S: int, d: int, seed: int = 0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), S)
+    return [
+        {"w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+         "b": jnp.zeros((d,))}
+        for k in ks
+    ]

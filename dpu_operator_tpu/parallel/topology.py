@@ -122,11 +122,16 @@ class SliceTopology:
             grid=grid,  # type: ignore[arg-type]
             worker_id=worker,
             wrap=wrap,  # type: ignore[arg-type]
-            # Multislice runtime env (MEGASCALE_*): absent or junk reads
-            # as the single-slice default — a malformed value must not
-            # take the topology model down with it.
-            slice_id=_int_env(env, "MEGASCALE_SLICE_ID", 0),
-            num_slices=_int_env(env, "MEGASCALE_NUM_SLICES", 1),
+            # Multislice runtime env: the GCE metadata pair
+            # (MEGASCALE_*) wins when present, else the operator's
+            # Allocate grant (TPU_SLICE_ID/TPU_NUM_SLICES,
+            # device_plugin.Allocate) — a pod granted chips by the
+            # operator builds the right hybrid mesh from its own env,
+            # no metadata scraping. The pair is picked ATOMICALLY
+            # (mixing sources could yield slice_id >= num_slices);
+            # absent or junk values read as the single-slice default —
+            # a malformed value must not take the topology model down.
+            **_slice_identity(env),
         )
 
     @classmethod
@@ -202,6 +207,19 @@ def _int_env(env: Dict[str, str], key: str, default: int) -> int:
         return int(env.get(key) or default)
     except (TypeError, ValueError):
         return default
+
+
+def _slice_identity(env: Dict[str, str]) -> Dict[str, int]:
+    """One SOURCE per identity: MEGASCALE_* pair if either key is set
+    (the runtime's own view), else the operator's TPU_* grant pair."""
+    if "MEGASCALE_SLICE_ID" in env or "MEGASCALE_NUM_SLICES" in env:
+        prefix = "MEGASCALE_"
+    else:
+        prefix = "TPU_"
+    return {
+        "slice_id": _int_env(env, prefix + "SLICE_ID", 0),
+        "num_slices": _int_env(env, prefix + "NUM_SLICES", 1),
+    }
 
 
 def _parse_bounds(value: Optional[str], default):

@@ -148,7 +148,7 @@ def test_cp_agent_pushes_health_change_events(native_binaries, tmp_root):
     open(os.path.join(devdir, "accel1"), "w").close()
     cfg = os.path.join(tmp_root.root, "agent.cfg")
     with open(cfg, "w") as f:
-        f.write("expected_chips = 2\nrescan_ms = 100\n")
+        f.write("expected_chips = 2\nrescan_ms = 10000\n")
     sock = tmp_root.cp_agent_socket()
     proc = _start_agent(native_binaries, tmp_root.root, sock, config=cfg)
     try:
@@ -167,7 +167,11 @@ def test_cp_agent_pushes_health_change_events(native_binaries, tmp_root):
         assert ev["event"] == "health_change"
         assert ev["chips"] == {0: True, 1: False}
         assert ev["healthy"] is False
-        assert latency < 1.0, f"event took {latency:.2f}s"
+        # The claim is "pushed, not polled": the poll fallback above is
+        # 10 s, so anything well under it proves the inotify path (the
+        # old 1.0 s bound with a 100 ms rescan neither discriminated
+        # push from poll nor survived full-suite CPU contention).
+        assert latency < 1.8, f"event took {latency:.2f}s"
         events.close()
 
         stats = client.stats()

@@ -270,3 +270,15 @@ def test_multislice_env_parsed():
     topo = SliceTopology.from_env(
         dict(base, MEGASCALE_SLICE_ID="banana", MEGASCALE_NUM_SLICES=""))
     assert (topo.slice_id, topo.num_slices) == (0, 1)
+
+    # The operator's Allocate grant closes the loop: a pod holding the
+    # TPU_SLICE_ID/TPU_NUM_SLICES env the device plugin exported builds
+    # the same multislice topology without GCE metadata (MEGASCALE_*
+    # still wins when both are present — it is the runtime's own view).
+    topo = SliceTopology.from_env(
+        dict(base, TPU_SLICE_ID="1", TPU_NUM_SLICES="2"))
+    assert (topo.slice_id, topo.num_slices) == (1, 2)
+    topo = SliceTopology.from_env(
+        dict(base, TPU_SLICE_ID="1", TPU_NUM_SLICES="2",
+             MEGASCALE_SLICE_ID="3", MEGASCALE_NUM_SLICES="4"))
+    assert (topo.slice_id, topo.num_slices) == (3, 4)
