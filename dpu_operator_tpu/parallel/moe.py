@@ -29,12 +29,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
     """Returns moe(x, router_w, w1_stacked, w2_stacked):
-      x          [tokens, d]  (replicated per ep shard here; dp/sp axes
-                  compose outside)
+      x          [tokens, d]  — SHARDED over the ep axis (each shard
+                  routes its own tokens; dp/sp axes compose outside).
+                  tokens must divide by the axis size.
       router_w   [d, E]       (replicated)
       w1_stacked [E, d, h], w2_stacked [E, h, d]  (sharded P(axis))
-    Output [tokens, d]: gate * expert_{argmax}(token), zeros for tokens
-    past expert capacity."""
+    Output [tokens, d], sharded like x: gate * expert_{argmax}(token),
+    zeros for tokens past expert capacity (capacity is per SOURCE
+    shard: each shard may send up to C tokens to each expert — the
+    Switch formulation on an expert-parallel mesh)."""
     E = mesh.shape[axis]
 
     def per_device(x, router_w, w1_local, w2_local):
@@ -49,7 +52,7 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
                 f"tokens routed past the mesh would silently drop")
         w1 = w1_local[0]  # this device's expert
         w2 = w2_local[0]
-        t, d = x.shape
+        t, d = x.shape  # t = LOCAL tokens (x arrives P(axis)-sharded)
         C = int(np.ceil(t / E * capacity_factor))
 
         logits = x @ router_w                      # [t, E]
@@ -80,8 +83,8 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
         f = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(), P(), P(axis), P(axis)),
-            out_specs=P(),
+            in_specs=(P(axis), P(), P(axis), P(axis)),
+            out_specs=P(axis),
             check_vma=False,
         )
         return f(x, router_w, w1_stacked, w2_stacked)

@@ -210,16 +210,29 @@ def _int_env(env: Dict[str, str], key: str, default: int) -> int:
 
 
 def _slice_identity(env: Dict[str, str]) -> Dict[str, int]:
-    """One SOURCE per identity: MEGASCALE_* pair if either key is set
-    (the runtime's own view), else the operator's TPU_* grant pair."""
-    if "MEGASCALE_SLICE_ID" in env or "MEGASCALE_NUM_SLICES" in env:
-        prefix = "MEGASCALE_"
-    else:
-        prefix = "TPU_"
-    return {
-        "slice_id": _int_env(env, prefix + "SLICE_ID", 0),
-        "num_slices": _int_env(env, prefix + "NUM_SLICES", 1),
-    }
+    """One SOURCE per identity, and only a VALID one: the MEGASCALE_*
+    pair (the runtime's own view) wins when it parses to a consistent
+    identity, else the operator's TPU_* grant pair, else single-slice.
+    Validity means 0 <= slice_id < num_slices — a junk metadata value
+    must neither mask a valid operator grant nor produce the
+    out-of-range identity this function exists to prevent."""
+    def _parse_pair(prefix):
+        raw_sid = env.get(prefix + "SLICE_ID")
+        raw_n = env.get(prefix + "NUM_SLICES")
+        if raw_sid is None and raw_n is None:
+            return None  # source absent
+        try:
+            sid = int(raw_sid) if raw_sid is not None else 0
+            n = int(raw_n) if raw_n is not None else 1
+        except (TypeError, ValueError):
+            return None  # a SET key that doesn't parse poisons the pair
+        return (sid, n) if 0 <= sid < n else None
+
+    for prefix in ("MEGASCALE_", "TPU_"):
+        pair = _parse_pair(prefix)
+        if pair is not None:
+            return {"slice_id": pair[0], "num_slices": pair[1]}
+    return {"slice_id": 0, "num_slices": 1}
 
 
 def _parse_bounds(value: Optional[str], default):

@@ -140,8 +140,9 @@ def test_cp_agent_config_application(native_binaries, tmp_root):
 
 def test_cp_agent_pushes_health_change_events(native_binaries, tmp_root):
     """The event loop: removing a chip node produces a pushed
-    health_change frame on a subscribed connection within 1 s — no
-    client poll involved (octep PERST-event analogue)."""
+    health_change frame on a subscribed connection well before the poll
+    fallback (parked at 10 s here) could have noticed — proving the
+    inotify push path, not a rescan (octep PERST-event analogue)."""
     devdir = os.path.join(tmp_root.root, "dev")
     os.makedirs(devdir, exist_ok=True)
     open(os.path.join(devdir, "accel0"), "w").close()

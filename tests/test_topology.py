@@ -282,3 +282,11 @@ def test_multislice_env_parsed():
         dict(base, TPU_SLICE_ID="1", TPU_NUM_SLICES="2",
              MEGASCALE_SLICE_ID="3", MEGASCALE_NUM_SLICES="4"))
     assert (topo.slice_id, topo.num_slices) == (3, 4)
+    # Junk metadata must not MASK a valid operator grant, and a
+    # one-sided pair must not produce slice_id >= num_slices.
+    topo = SliceTopology.from_env(
+        dict(base, TPU_SLICE_ID="1", TPU_NUM_SLICES="2",
+             MEGASCALE_NUM_SLICES="banana"))
+    assert (topo.slice_id, topo.num_slices) == (1, 2)
+    topo = SliceTopology.from_env(dict(base, TPU_SLICE_ID="1"))
+    assert (topo.slice_id, topo.num_slices) == (0, 1)
