@@ -27,6 +27,37 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def switch_moe_local(y, router_w, w1, w2, *, axis: str,
+                     capacity_factor: float):
+    """The per-device Switch block on LOCAL tokens — the shared body of
+    make_moe and the five-axis training step (train_step._stage_fn), so
+    the subtle bucketing math exists exactly once. Must run inside a
+    shard_map over `axis`; w1/w2 are THIS device's expert ([d,h]/[h,d]),
+    router_w is [d, E] with E == the axis size."""
+    E = router_w.shape[1]
+    rows, d = y.shape
+    C = int(np.ceil(rows / E * capacity_factor))
+    logits = y @ router_w
+    gate = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(gate, axis=-1)
+    gval = jnp.max(gate, axis=-1)
+    onehot = jax.nn.one_hot(expert, E, dtype=y.dtype)
+    # Position of each token within its expert's bucket.
+    pos = jnp.cumsum(onehot, axis=0) - onehot
+    pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+    keep = (pos_tok < C).astype(y.dtype)
+    # Scatter tokens into dispatch buckets [E, C, d]; bucket e goes to
+    # device e, and we receive one bucket from every source shard.
+    disp = jnp.zeros((E, C, d), y.dtype).at[
+        expert, jnp.clip(pos_tok, 0, C - 1)].add(y * keep[:, None])
+    recv = lax.all_to_all(disp, axis, 0, 0, tiled=True)
+    h = jax.nn.relu(recv.reshape(E * C, d) @ w1) @ w2
+    # Send results home; back[e] = expert e's outputs for MY tokens.
+    back = lax.all_to_all(h.reshape(E, C, d), axis, 0, 0, tiled=True)
+    yy = back[expert, jnp.clip(pos_tok, 0, C - 1)]
+    return yy * (gval * keep)[:, None]
+
+
 def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
     """Returns moe(x, router_w, w1_stacked, w2_stacked):
       x          [tokens, d]  — SHARDED over the ep axis (each shard
@@ -50,34 +81,9 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
             raise ValueError(
                 f"router width {router_w.shape[1]} != {E} experts — "
                 f"tokens routed past the mesh would silently drop")
-        w1 = w1_local[0]  # this device's expert
-        w2 = w2_local[0]
-        t, d = x.shape  # t = LOCAL tokens (x arrives P(axis)-sharded)
-        C = int(np.ceil(t / E * capacity_factor))
-
-        logits = x @ router_w                      # [t, E]
-        gate = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(gate, axis=-1)         # [t]
-        gval = jnp.max(gate, axis=-1)              # [t]
-        onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)      # [t, E]
-        # Position of each token within its expert's bucket.
-        pos = jnp.cumsum(onehot, axis=0) - onehot              # [t, E]
-        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [t]
-        keep = (pos_tok < C).astype(x.dtype)                   # [t]
-
-        # Scatter tokens into dispatch buckets [E, C, d].
-        disp = jnp.zeros((E, C, d), x.dtype).at[
-            expert, jnp.clip(pos_tok, 0, C - 1)
-        ].add(x * keep[:, None])
-        # Exchange: bucket e goes to device e; we receive one bucket
-        # from every source shard → [E(src), C, d] of OUR expert's work.
-        recv = lax.all_to_all(disp, axis, 0, 0, tiled=True)
-        h = jax.nn.relu(recv.reshape(E * C, d) @ w1) @ w2
-        # Send results home; back[e] = expert e's outputs for MY tokens.
-        back = lax.all_to_all(
-            h.reshape(E, C, d), axis, 0, 0, tiled=True)
-        y = back[expert, jnp.clip(pos_tok, 0, C - 1)]          # [t, d]
-        return y * (gval * keep)[:, None]
+        return switch_moe_local(
+            x, router_w, w1_local[0], w2_local[0], axis=axis,
+            capacity_factor=capacity_factor)
 
     def moe(x, router_w, w1_stacked, w2_stacked):
         f = shard_map(

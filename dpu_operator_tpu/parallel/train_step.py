@@ -1,0 +1,226 @@
+"""The five-axis training step: dp × pp × sp × tp × ep in ONE program.
+
+This is the integration point of the parallel layer — the driver's
+multichip contract ("jit your FULL training step over real
+tp/pp/dp/sp/ep shardings") realised as a single `shard_map` over a
+5-axis mesh, differentiated end-to-end and verified against a dense
+single-device reference:
+
+  dp  — batch sharding; the dp/sp gradient sync falls out of
+        shard_map's AD: params are REPLICATED along dp/sp (their specs
+        omit those axes), and the transpose of a replicated input is
+        the psum of per-device cotangents over the omitted axes — the
+        gradient test below proves the sync is exact, not approximate;
+  pp  — GPipe microbatch pipeline (pipeline.py's scan/ppermute
+        schedule) over the model's stages;
+  sp  — sequence sharding of activations; the stages here are
+        token-local (MLP + MoE), so sp composes exactly like extra
+        data parallelism — the ring-attention module owns the
+        cross-token case;
+  tp  — each stage's dense layer column/row-sharded: y = relu(x@W1)@W2
+        with W1 split on columns, W2 on rows, one psum closing the
+        contraction (the Megatron pairing);
+  ep  — a Switch MoE block per stage (the capacity-bucketed all_to_all
+        dispatch of moe.py, inlined so the stage differentiates as one
+        body), experts sharded one-per-device. Within a (dp, sp) data
+        shard the activations are replicated across tp/ep — correct,
+        with redundant ep-side compute the standalone moe.py avoids by
+        token-sharding; the integration point favours one simple
+        x-spec over maximal efficiency.
+
+Everything — ppermute hops, tp psums, ep all_to_alls, the scan — is
+differentiated by jax.grad through shard_map; the test asserts loss
+AND gradients match the dense reference, which is the only evidence
+that matters for a training step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+def init_params(S: int, d: int, h: int, E: int, seed: int = 0) -> Dict:
+    """Stage-stacked params: dense tp pair + router + ep experts per
+    stage. Leading dim S shards over pp; w1 cols / w2 rows over tp;
+    experts over ep."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    return {
+        "w1": jax.random.normal(ks[0], (S, d, h)) / np.sqrt(d),
+        "w2": jax.random.normal(ks[1], (S, h, d)) / np.sqrt(h),
+        "router": jax.random.normal(ks[2], (S, d, E)) / np.sqrt(d),
+        "moe_w1": jax.random.normal(ks[3], (S, E, d, h)) / np.sqrt(d),
+        "moe_w2": jax.random.normal(ks[4], (S, E, h, d)) / np.sqrt(h),
+    }
+
+
+def param_specs() -> Dict:
+    return {
+        "w1": P("pp", None, "tp"),
+        "w2": P("pp", "tp", None),
+        "router": P("pp", None, None),
+        "moe_w1": P("pp", "ep", None, None),
+        "moe_w2": P("pp", "ep", None, None),
+    }
+
+
+def shard_params(params: Dict, mesh: Mesh) -> Dict:
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, param_specs()[k]))
+        for k, v in params.items()
+    }
+
+
+def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
+              capacity_factor: float):
+    """One pipeline stage on LOCAL shards: Megatron-paired dense block
+    (w1 column-sharded, w2 row-sharded, psum closes the contraction)
+    then a Switch MoE over the ep axis (moe.switch_moe_local — the ONE
+    copy of the bucketing math). x: [rows_local, d]."""
+    from .moe import switch_moe_local
+
+    if p["moe_w1"].shape[0] != 1 or p["moe_w2"].shape[0] != 1:
+        raise ValueError(
+            f"expert count must equal the ep axis size {E}: each device "
+            f"hosts one expert, got a local chunk of "
+            f"{p['moe_w1'].shape[0]}")
+    if p["router"].shape[1] != E:
+        raise ValueError(
+            f"router width {p['router'].shape[1]} != {E} experts — "
+            f"tokens routed past the mesh would silently drop")
+    h = jax.nn.relu(x @ p["w1"])            # [rows, h/tp] local columns
+    dense = lax.psum(h @ p["w2"], tp_axis)  # row-sharded w2 → psum
+    y = jnp.tanh(dense)
+    moe_out = switch_moe_local(
+        y, p["router"], p["moe_w1"][0], p["moe_w2"][0], axis=ep_axis,
+        capacity_factor=capacity_factor)
+    return y + moe_out  # residual keeps gradients flowing past drops
+
+
+def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
+                    lr: float = 0.05):
+    """Returns train_step(params, x, target) -> (loss, new_params).
+    x/target: [M, mb, seq, d] microbatches, mb sharded over dp and seq
+    over sp. One full forward (pipelined), one full backward (grad
+    through every collective, dp/sp sync via the replicated-input
+    transpose), one SGD update — the complete step, jitted as one
+    program."""
+    S = mesh.shape["pp"]
+    E = mesh.shape["ep"]
+
+    def per_device(params_local, x_loc, tgt_loc):
+        p = jax.tree.map(lambda a: a[0], params_local)  # my stage
+        M = x_loc.shape[0]
+        rows = x_loc.shape[1] * x_loc.shape[2]
+        d = x_loc.shape[3]
+        x_mb = x_loc.reshape(M, rows, d)
+        tgt_mb = tgt_loc.reshape(M, rows, d)
+        my = lax.axis_index("pp")
+
+        def stage(pp_params, x):
+            return _stage_fn(pp_params, x, E=E, tp_axis="tp",
+                             ep_axis="ep", capacity_factor=capacity_factor)
+
+        zero_act = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+        zero_out = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            x_in, out = carry
+            mb = jnp.where(t < M, x_mb[jnp.clip(t, 0, M - 1)], zero_act)
+            x_cur = jnp.where(my == 0, mb, x_in)
+            y = stage(p, x_cur)
+            out_idx = t - (S - 1)
+            record = (my == S - 1) & (out_idx >= 0)
+            out = jnp.where(record,
+                            out.at[jnp.clip(out_idx, 0, M - 1)].set(y),
+                            out)
+            x_next = lax.ppermute(y, "pp",
+                                  [(i, i + 1) for i in range(S - 1)])
+            return (x_next, out), None
+
+        (_, out), _ = lax.scan(tick, (zero_act, zero_out),
+                               jnp.arange(M + S - 1))
+        # Mean over the GLOBAL batch. Only the last stage holds real
+        # outputs — reduce to a SCALAR there and fold pp into the one
+        # scalar psum, instead of broadcasting the full [M, rows, d]
+        # tensor across the pp axis (and its equally large transpose in
+        # the backward pass) just to share a number.
+        n_global = rows * M * mesh.shape["dp"] * mesh.shape["sp"]
+        local = jnp.sum((out - tgt_mb) ** 2) / n_global / d
+        local = jnp.where(my == S - 1, local, 0.0)
+        return lax.psum(local, ("pp", "dp", "sp"))
+
+    x_spec = P(None, "dp", "sp", None)
+
+    def loss_fn(params, x, tgt):
+        f = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(param_specs(), x_spec, x_spec),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return f(params, x, tgt)
+
+    @jax.jit
+    def train_step(params, x, tgt):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, tgt)
+        new_params = jax.tree.map(lambda p_, g: p_ - lr * g, params, grads)
+        return loss, new_params
+
+    return train_step, loss_fn
+
+
+def dense_loss_reference(params: Dict, x, tgt,
+                         capacity_factor: float = 4.0,
+                         shards: Dict[str, int] = None):
+    """Single-device ground truth of the SAME math, shard-faithfully:
+    the per-(dp,sp) shard MoE capacity and per-source bucketing are
+    reproduced so the comparison is exact, not merely approximate."""
+    S, E = params["router"].shape[0], params["router"].shape[2]
+    dp = (shards or {}).get("dp", 1)
+    sp = (shards or {}).get("sp", 1)
+    M, mb, seq, d = x.shape
+    # Split into the same (dp, sp) shards the mesh uses.
+    losses = []
+    for di in range(dp):
+        for si in range(sp):
+            xs = x[:, di * (mb // dp):(di + 1) * (mb // dp),
+                   si * (seq // sp):(si + 1) * (seq // sp)]
+            ts = tgt[:, di * (mb // dp):(di + 1) * (mb // dp),
+                     si * (seq // sp):(si + 1) * (seq // sp)]
+            rows = xs.shape[1] * xs.shape[2]
+            C = int(np.ceil(rows / E * capacity_factor))
+            for m in range(M):
+                h = xs[m].reshape(rows, d)
+                t_ = ts[m].reshape(rows, d)
+                for s in range(S):
+                    p = {k: v[s] for k, v in params.items()}
+                    dense = jnp.tanh(
+                        jax.nn.relu(h @ p["w1"]) @ p["w2"])
+                    logits = dense @ p["router"]
+                    gate = jax.nn.softmax(logits, axis=-1)
+                    expert = jnp.argmax(gate, axis=-1)
+                    gval = jnp.max(gate, axis=-1)
+                    onehot = jax.nn.one_hot(expert, E)
+                    pos = jnp.cumsum(onehot, axis=0) - onehot
+                    pos_tok = jnp.sum(pos * onehot, -1).astype(jnp.int32)
+                    keep = (pos_tok < C).astype(dense.dtype)
+                    eo = jnp.stack([
+                        jax.nn.relu(dense @ p["moe_w1"][e]) @ p["moe_w2"][e]
+                        for e in range(E)
+                    ])  # [E, rows, d]
+                    moe = jnp.take_along_axis(
+                        eo, expert[None, :, None], axis=0)[0]
+                    h = dense + moe * (gval * keep)[:, None]
+                losses.append(jnp.sum((h - t_) ** 2))
+    n_global = M * mb * seq
+    return sum(losses) / n_global / d

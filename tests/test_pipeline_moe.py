@@ -86,7 +86,9 @@ def test_moe_matches_dense_reference():
     router_w, w1, w2 = demo_moe_params(E, d, h)
     x = jax.random.normal(jax.random.PRNGKey(7), (t, d))
 
-    moe = make_moe(mesh, capacity_factor=float(E))  # capacity == t
+    # capacity_factor=E gives C = t_local per (source shard, expert)
+    # pair — every local token fits even if all route to one expert.
+    moe = make_moe(mesh, capacity_factor=float(E))
     out = np.asarray(jax.jit(moe)(
         x, router_w,
         shard_expert_params(w1, mesh), shard_expert_params(w2, mesh)))

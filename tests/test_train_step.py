@@ -1,0 +1,89 @@
+"""The five-axis training step (parallel/train_step.py): loss AND
+gradients must match a dense single-device reference of the same math —
+the only evidence that a distributed training step is actually the
+training step it claims to be. Covers two mesh factorings so every
+axis is exercised with size > 1 somewhere."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+def _mesh(shape):
+    from jax.sharding import Mesh
+
+    n = int(np.prod([s for s in shape.values()]))
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]).reshape(*shape.values()),
+                tuple(shape.keys()))
+
+
+@pytest.mark.parametrize("shape", [
+    {"dp": 2, "pp": 2, "sp": 1, "tp": 1, "ep": 2},
+    {"dp": 1, "pp": 2, "sp": 1, "tp": 2, "ep": 2},
+    {"dp": 1, "pp": 1, "sp": 2, "tp": 2, "ep": 2},
+])
+def test_five_axis_step_matches_dense_reference(shape):
+    from dpu_operator_tpu.parallel.train_step import (
+        dense_loss_reference, init_params, make_train_step, shard_params)
+
+    mesh = _mesh(shape)
+    S, E = shape["pp"], shape["ep"]
+    d, h = 8, 16
+    M, mb, seq = 3, 4 * shape["dp"], 2 * shape["sp"]
+    cf = float(E)  # capacity >= local tokens: no drops, exact compare
+
+    params = init_params(S, d, h, E, seed=3)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, seq, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (M, mb, seq, d))
+
+    train_step, loss_fn = make_train_step(mesh, capacity_factor=cf,
+                                          lr=0.05)
+    sharded = shard_params(params, mesh)
+
+    # Forward: distributed loss == dense reference loss.
+    loss = float(loss_fn(sharded, x, tgt))
+    ref_loss = float(dense_loss_reference(
+        params, x, tgt, capacity_factor=cf, shards=shape))
+    np.testing.assert_allclose(loss, ref_loss, rtol=2e-5)
+
+    # Backward: every gradient leaf == dense reference gradient. This
+    # is where wrong collective transposes (missing dp sync, bad
+    # all_to_all transpose) show up.
+    grads = jax.grad(loss_fn)(sharded, x, tgt)
+    ref_grads = jax.grad(
+        lambda p: dense_loss_reference(p, x, tgt, capacity_factor=cf,
+                                       shards=shape))(params)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[key]), np.asarray(ref_grads[key]),
+            rtol=5e-4, atol=1e-6, err_msg=key)
+
+    # And the STEP steps: one update lowers the loss.
+    loss1, new_params = train_step(sharded, x, tgt)
+    loss2 = float(loss_fn(new_params, x, tgt))
+    assert loss2 < float(loss1), (loss1, loss2)
+
+
+def test_five_axis_step_capacity_drops_still_train():
+    """With real capacity pressure (drops happening) the step must stay
+    finite and still descend — drops zero some expert outputs, they
+    must not poison gradients with NaNs."""
+    from dpu_operator_tpu.parallel.train_step import (
+        init_params, make_train_step, shard_params)
+
+    shape = {"dp": 2, "pp": 2, "sp": 1, "tp": 1, "ep": 2}
+    mesh = _mesh(shape)
+    params = shard_params(init_params(2, 8, 16, 2, seed=9), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 2, 8))
+    tgt = jax.random.normal(jax.random.PRNGKey(5), (2, 8, 2, 8))
+    train_step, loss_fn = make_train_step(mesh, capacity_factor=0.5,
+                                          lr=0.01)
+    loss1, new_params = train_step(params, x, tgt)
+    loss2, _ = train_step(new_params, x, tgt)
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss1)
