@@ -277,6 +277,30 @@ def bench_fabric_throughput() -> dict:
     finally:
         if topo is not None:
             topo.cleanup()
+
+    # Service plane: case 6 (pod→clusterIP→pod across two "nodes") —
+    # the DNAT+conntrack path through tft/serviceplane.py, recorded so
+    # the artifact proves the NAT plane moves real bytes, not just the
+    # flat-L2 case.
+    svc = None
+    try:
+        port = _free_port()
+        svc = build_case_topology(6, port_base=port, port_span=2)
+        r = run_connection(
+            ConnectionSpec(name="bench", type="iperf-tcp"),
+            svc.server_netns, svc.client_netns, svc.server_ip,
+            duration=1.5, port=port + 1,
+            connect_ip=svc.connect_ip,
+            connect_port=port + 1 + svc.port_offset,
+        )
+        out["fabric_clusterip_tcp_gbps"] = r.get("gbps")
+        print(f"service plane (case-6 clusterIP): "
+              f"tcp {r.get('gbps')} Gbps", file=sys.stderr)
+    except Exception as e:
+        out["fabric_clusterip_error"] = str(e)[:200]
+    finally:
+        if svc is not None:
+            svc.cleanup()
     return out
 
 
@@ -510,6 +534,7 @@ def main() -> int:
         "fabric_tcp_gbps": "Gb/s",
         "fabric_udp_gbps": "Gb/s",
         "fabric_tcp_rr_tps": "transactions/s",
+        "fabric_clusterip_tcp_gbps": "Gb/s",
     }
     for key, unit in units.items():
         if key in metrics:

@@ -114,7 +114,9 @@ def main(argv=None) -> int:
                     help="run the numbered endpoint-topology case matrix "
                          "(config test_cases / --cases selection)")
     ap.add_argument("--cases", default=None,
-                    help='case selection override, e.g. "1-9,15-19"')
+                    help='case selection override, e.g. "1-26" (all '
+                         'cases run locally, service plane included) or '
+                         'the reference\'s "1-9,15-19"')
     ap.add_argument("--server-netns")
     ap.add_argument("--client-netns")
     ap.add_argument("--server-ip")

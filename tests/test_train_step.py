@@ -1,7 +1,7 @@
 """The five-axis training step (parallel/train_step.py): loss AND
 gradients must match a dense single-device reference of the same math —
 the only evidence that a distributed training step is actually the
-training step it claims to be. Covers two mesh factorings so every
+training step it claims to be. Covers three mesh factorings so every
 axis is exercised with size > 1 somewhere."""
 
 import numpy as np
