@@ -28,47 +28,70 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def switch_moe_local(y, router_w, w1, w2, *, axis: str,
-                     capacity_factor: float):
-    """The per-device Switch block on LOCAL tokens — the shared body of
+                     capacity_factor: float, top_k: int = 1):
+    """The per-device MoE block on LOCAL tokens — the shared body of
     make_moe and the five-axis training step (train_step._stage_fn), so
     the subtle bucketing math exists exactly once. Must run inside a
     shard_map over `axis`; w1/w2 are THIS device's expert ([d,h]/[h,d]),
-    router_w is [d, E] with E == the axis size."""
+    router_w is [d, E] with E == the axis size.
+
+    top_k=1 is Switch; top_k=2 is the classic MoE shape. Ranks are
+    handled as ONE concatenated assignment stream [k*rows] in priority
+    order (all rank-0 assignments bucket before any rank-1), so the
+    same cumsum/capacity/scatter math covers every k and lower ranks
+    lose bucket slots first under pressure. Gates are renormalized over
+    the chosen k (the standard top-k formulation)."""
     E = router_w.shape[1]
     rows, d = y.shape
-    C = int(np.ceil(rows / E * capacity_factor))
+    # top_k multiplies the assignment count, so expected load per
+    # expert is k*rows/E — capacity scales with it (the ST-MoE
+    # convention), keeping capacity_factor's meaning ("slack over a
+    # perfectly balanced router") independent of k.
+    C = int(np.ceil(top_k * rows / E * capacity_factor))
     logits = y @ router_w
-    gate = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(gate, axis=-1)
-    gval = jnp.max(gate, axis=-1)
-    onehot = jax.nn.one_hot(expert, E, dtype=y.dtype)
-    # Position of each token within its expert's bucket.
+    gate = jax.nn.softmax(logits, axis=-1)             # [rows, E]
+    gvals, experts = lax.top_k(gate, top_k)            # [rows, k] each
+    if top_k > 1:
+        # Renormalize over the chosen experts (k>1 convention); k=1
+        # keeps the raw gate — Switch scales by router confidence.
+        gvals = gvals / jnp.sum(gvals, axis=-1, keepdims=True)
+    # Priority-ordered assignment stream: rank r of token i sits at
+    # r*rows + i — transpose-then-flatten puts every rank-0 first.
+    expert_all = experts.T.reshape(-1)                 # [k*rows]
+    gate_all = gvals.T.reshape(-1)
+    tok_all = jnp.tile(jnp.arange(rows), top_k)
+    onehot = jax.nn.one_hot(expert_all, E, dtype=y.dtype)
+    # Position of each assignment within its expert's bucket.
     pos = jnp.cumsum(onehot, axis=0) - onehot
-    pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
-    keep = (pos_tok < C).astype(y.dtype)
-    # Scatter tokens into dispatch buckets [E, C, d]; bucket e goes to
-    # device e, and we receive one bucket from every source shard.
+    pos_a = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+    keep = (pos_a < C).astype(y.dtype)
+    slot = jnp.clip(pos_a, 0, C - 1)
+    # Scatter assignments into dispatch buckets [E, C, d]; bucket e
+    # goes to device e, and we receive one from every source shard.
     disp = jnp.zeros((E, C, d), y.dtype).at[
-        expert, jnp.clip(pos_tok, 0, C - 1)].add(y * keep[:, None])
+        expert_all, slot].add(y[tok_all] * keep[:, None])
     recv = lax.all_to_all(disp, axis, 0, 0, tiled=True)
     h = jax.nn.relu(recv.reshape(E * C, d) @ w1) @ w2
-    # Send results home; back[e] = expert e's outputs for MY tokens.
+    # Send results home; back[e] = expert e's outputs for MY buckets.
     back = lax.all_to_all(h.reshape(E, C, d), axis, 0, 0, tiled=True)
-    yy = back[expert, jnp.clip(pos_tok, 0, C - 1)]
-    return yy * (gval * keep)[:, None]
+    contrib = back[expert_all, slot] * (gate_all * keep)[:, None]
+    return jnp.zeros_like(y).at[tok_all].add(contrib)
 
 
-def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
+def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0,
+             top_k: int = 1):
     """Returns moe(x, router_w, w1_stacked, w2_stacked):
       x          [tokens, d]  — SHARDED over the ep axis (each shard
                   routes its own tokens; dp/sp axes compose outside).
                   tokens must divide by the axis size.
       router_w   [d, E]       (replicated)
       w1_stacked [E, d, h], w2_stacked [E, h, d]  (sharded P(axis))
-    Output [tokens, d], sharded like x: gate * expert_{argmax}(token),
-    zeros for tokens past expert capacity (capacity is per SOURCE
-    shard: each shard may send up to C tokens to each expert — the
-    Switch formulation on an expert-parallel mesh)."""
+    Output [tokens, d], sharded like x. top_k=1 (Switch): raw-gate ×
+    the argmax expert. top_k>1: renormalized-gate sum over the token's
+    k best experts, with rank-0 assignments winning bucket slots first
+    under capacity pressure. Capacity is per SOURCE shard and scales
+    with k (each shard may send up to C = ceil(k·t_local/E·cf)
+    assignments to each expert); dropped assignments contribute zero."""
     E = mesh.shape[axis]
 
     def per_device(x, router_w, w1_local, w2_local):
@@ -83,7 +106,7 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
                 f"tokens routed past the mesh would silently drop")
         return switch_moe_local(
             x, router_w, w1_local[0], w2_local[0], axis=axis,
-            capacity_factor=capacity_factor)
+            capacity_factor=capacity_factor, top_k=top_k)
 
     def moe(x, router_w, w1_stacked, w2_stacked):
         f = shard_map(
@@ -98,19 +121,23 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0):
     return moe
 
 
-def dense_reference(x, router_w, w1_stacked, w2_stacked):
+def dense_reference(x, router_w, w1_stacked, w2_stacked, top_k: int = 1):
     """Ground truth with capacity = ∞ and every expert computed
-    densely: y[i] = gate[i] * FFN_{argmax expert}(x[i])."""
+    densely: y[i] = Σ_{e in top-k} renorm_gate[i,e] * FFN_e(x[i])."""
     logits = x @ router_w
     gate = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(gate, axis=-1)
-    gval = jnp.max(gate, axis=-1)
+    gvals, experts = lax.top_k(gate, top_k)         # [t, k]
+    if top_k > 1:
+        gvals = gvals / jnp.sum(gvals, axis=-1, keepdims=True)
     # [E, t, d]: every expert applied to every token.
     h = jax.nn.relu(jnp.einsum("td,edh->eth", x, w1_stacked))
     all_out = jnp.einsum("eth,ehd->etd", h, w2_stacked)
-    y = jnp.take_along_axis(
-        all_out, expert[None, :, None], axis=0)[0]  # [t, d]
-    return y * gval[:, None]
+    y = jnp.zeros_like(x)
+    for r in range(top_k):
+        yr = jnp.take_along_axis(
+            all_out, experts[None, :, r, None], axis=0)[0]  # [t, d]
+        y = y + yr * gvals[:, r, None]
+    return y
 
 
 def shard_expert_params(w_stacked, mesh: Mesh, axis: str = "ep"):

@@ -115,8 +115,10 @@ def main(argv=None) -> int:
                          "(config test_cases / --cases selection)")
     ap.add_argument("--cases", default=None,
                     help='case selection override, e.g. "1-26" (all '
-                         'cases run locally, service plane included) or '
-                         'the reference\'s "1-9,15-19"')
+                         'cases run locally where the kernel offers '
+                         'nf_tables NAT; service cases skip with the '
+                         'probe reason otherwise) or the reference\'s '
+                         '"1-9,15-19"')
     ap.add_argument("--server-netns")
     ap.add_argument("--client-netns")
     ap.add_argument("--server-ip")

@@ -96,6 +96,69 @@ def test_moe_matches_dense_reference():
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
+def test_moe_top2_matches_dense_reference():
+    """top_k=2 (the classic MoE shape): each token's output is the
+    renormalized-gate sum of its two best experts — must equal the
+    dense reference at full capacity. Distinct expert weights make a
+    rank mix-up or a wrong renormalization numerically loud."""
+    from dpu_operator_tpu.parallel.moe import (
+        dense_reference, demo_moe_params, make_moe, shard_expert_params)
+
+    mesh = _mesh([("ep", 4)])
+    E, t, d, h = 4, 32, 16, 32
+    router_w, w1, w2 = demo_moe_params(E, d, h, seed=13)
+    x = jax.random.normal(jax.random.PRNGKey(17), (t, d))
+
+    # Capacity ≥ 2x local tokens: both ranks of every token fit.
+    moe = make_moe(mesh, capacity_factor=2.0 * E, top_k=2)
+    out = np.asarray(jax.jit(moe)(
+        x, router_w,
+        shard_expert_params(w1, mesh), shard_expert_params(w2, mesh)))
+    ref = np.asarray(dense_reference(x, router_w, w1, w2, top_k=2))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_top2_rank_priority_under_pressure():
+    """Under capacity pressure, rank-0 assignments MUST win bucket
+    slots over rank-1 ones (the priority-ordered assignment stream):
+    with capacity sized exactly to the rank-0 load, every token keeps
+    its primary expert's contribution whenever primaries are evenly
+    spread."""
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpu_operator_tpu.parallel.moe import switch_moe_local
+
+    mesh = _mesh([("ep", 2)])
+    d, h, t = 8, 16, 8
+    k1, k2, k3, kx = jax.random.split(jax.random.PRNGKey(23), 4)
+    # Router engineered so primaries split evenly: tokens alternate
+    # preference between the two experts.
+    router_w = jnp.stack([jnp.ones(d), -jnp.ones(d)], axis=1) * 0.5
+    w1 = jax.random.normal(k1, (2, d, h)) / np.sqrt(d)
+    w2 = jax.random.normal(k2, (2, h, d)) / np.sqrt(h)
+    signs = jnp.where(jnp.arange(t) % 2 == 0, 1.0, -1.0)
+    x = jnp.abs(jax.random.normal(kx, (t, d))) * signs[:, None]
+
+    def per_device(xl, rw, w1l, w2l):
+        # cf=0.5 with k=2: C = ceil(2*4/2*0.5) = 2 — exactly the
+        # rank-0 load, zero slack for rank-1.
+        return switch_moe_local(xl, rw, w1l[0], w2l[0], axis="ep",
+                                capacity_factor=0.5, top_k=2)
+
+    out = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=P("ep"), check_vma=False,
+    )(x, router_w,
+      jax.device_put(w1, NamedSharding(mesh, P("ep"))),
+      jax.device_put(w2, NamedSharding(mesh, P("ep"))))
+    # Every token's primary fits (2 primaries per expert per shard,
+    # C = ceil(4/2*1.0) = 2), so no row may be all-zero.
+    assert not np.any(np.all(np.asarray(out) == 0, axis=1))
+
+
 def test_moe_capacity_drops_are_exact():
     """Over-capacity tokens drop to ZERO output (the Switch contract) —
     and only those: with capacity 1 per expert, each expert serves its
