@@ -19,7 +19,7 @@ from .. import vars as v
 from ..api import v1
 from ..k8s import Client, Reconciler, Request, Result
 from ..k8s.objects import name_of, set_owner
-from ..k8s.store import NotFound
+from ..k8s.store import AlreadyExists, NotFound
 
 log = logging.getLogger(__name__)
 
@@ -108,11 +108,16 @@ class SfcNodeReconciler(Reconciler):
         if not self._matches_node(selector):
             return Result()
 
+        requeue = None
         for nf in sfc.get("spec", {}).get("networkFunctions", []):
-            self._ensure_nf_pod(sfc, nf, selector)
-        return Result()
+            r = self._ensure_nf_pod(sfc, nf, selector)
+            if r is not None and r.requeue_after is not None:
+                requeue = (r.requeue_after if requeue is None
+                           else min(requeue, r.requeue_after))
+        return Result(requeue_after=requeue)
 
-    def _ensure_nf_pod(self, sfc: dict, nf: dict, selector: dict) -> None:
+    def _ensure_nf_pod(self, sfc: dict, nf: dict,
+                       selector: dict) -> Optional[Result]:
         pod = network_function_pod(nf["name"], nf["image"], selector,
                                    policies=nf.get("policies"),
                                    transparent=bool(nf.get("transparent")))
@@ -120,8 +125,15 @@ class SfcNodeReconciler(Reconciler):
         existing = self._client.get_or_none("v1", "Pod", v.NAMESPACE, nf["name"])
         if existing is None:
             log.info("sfc %s: creating NF pod %s", name_of(sfc), nf["name"])
-            self._client.create(pod)
-            return
+            try:
+                self._client.create(pod)
+            except AlreadyExists:
+                # A prior recreate's delete is still draining (real
+                # apiservers delete gracefully: the object lingers with
+                # deletionTimestamp). Requeue until it's gone rather
+                # than tripping the generic error backoff.
+                return Result(requeue_after=2.0)
+            return None
         # Chain-spec (policies/transparent) changes RECREATE the pod:
         # the annotation is consumed at CNI ADD time only, so patching
         # it on a live pod would show a converged spec in kubectl while
@@ -135,14 +147,20 @@ class SfcNodeReconciler(Reconciler):
                      "pod so the dataplane is re-programmed",
                      name_of(sfc), nf["name"])
             self._client.delete("v1", "Pod", v.NAMESPACE, nf["name"])
-            self._client.create(pod)
-            return
+            try:
+                self._client.create(pod)
+            except AlreadyExists:
+                # Graceful deletion in flight — the old pod still
+                # occupies the name. Come back once it's drained.
+                return Result(requeue_after=2.0)
+            return None
         # Image converges in place (mutable on a real apiserver,
         # reference updates the whole pod, sfc.go:88-95).
         spec_image = existing["spec"]["containers"][0].get("image")
         if spec_image != nf["image"]:
             existing["spec"]["containers"][0]["image"] = nf["image"]
             self._client.update(existing)
+        return None
 
 
 def setup_sfc_controller(manager, client: Client, node_name: str):

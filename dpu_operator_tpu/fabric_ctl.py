@@ -160,11 +160,24 @@ def cmd_add_nf(args, chan):
     stub.CreateNetworkFunction(req, timeout=30)
     after = set(hb.Ping(pb.PingRequest(sender_id="fabric-ctl"),
                         timeout=10).degradations)
+    # Attribute by the VSP's per-chain reason prefix: only degradations
+    # tagged with THIS chain's key fail the call; anything else that
+    # surfaced concurrently (e.g. a racing pod attach's baseline-rule
+    # failure on another port) is reported but not blamed on this add.
+    chain_tag = f"[nf:{args.mac0}->{args.mac1}]"
     new = sorted(after - before)
-    if new:
+    mine = [d for d in new if chain_tag in d]
+    unrelated = [d for d in new if chain_tag not in d]
+    if mine:
         print(json.dumps({"chained": [args.mac0, args.mac1],
-                          "degraded": new}))
+                          "degraded": mine,
+                          "unrelated_degradations": unrelated}))
         return 1
+    if unrelated:
+        print(json.dumps({"chained": [args.mac0, args.mac1],
+                          "policies": len(req.policies),
+                          "unrelated_degradations": unrelated}))
+        return
     print(json.dumps({"chained": [args.mac0, args.mac1],
                       "policies": len(req.policies)}))
 

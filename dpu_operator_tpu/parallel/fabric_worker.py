@@ -1,0 +1,258 @@
+"""Multi-process JAX worker that runs REAL collectives over the
+operator-built pod fabric.
+
+This is the workload the whole operator exists to carry (the reference
+proves its dataplane with iperf over the DPU NAD,
+hack/traffic_flow_tests.sh:12-27, and pod↔pod traffic in e2e,
+e2e_test/e2e_test.go:439-456 — here the traffic class is elevated to
+the TPU-native one): one copy of this process runs inside EACH
+operator-attached pod network namespace, the copies rendezvous with
+`jax.distributed.initialize` across the fabric addresses the CNI
+handed out, and execute
+
+  * a cross-process `psum` (ring allreduce on the gloo CPU collectives
+    backend — the same collective family XLA emits on ICI), verified
+    elementwise and timed for bandwidth;
+  * a 2-worker data-parallel slice of the five-axis training step
+    (train_step.make_train_step with dp spanning the two processes),
+    loss checked against the dense single-device reference and
+    asserted to descend.
+
+Every byte of the rendezvous, the allreduce and the train step's
+gradient sync transits the fabric bridge the VSP built — the caller
+(tests/test_e2e.py, bench.py) asserts that from the per-port baseline
+flow-table counters.
+
+CPU backend by process design: the one real chip rides the axon tunnel
+bound to root-netns loopback, unreachable from a pod netns — and the
+POINT here is the fabric, not the MXU. The same program shape runs
+unchanged on a multi-host TPU slice (backend selection is the only
+difference), where initialize() picks up the slice topology instead.
+
+Protocol: prints exactly one JSON object on stdout; rc 0 iff every
+check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _pin_cpu_backend(bind_ip: str | None) -> None:
+    """Force the CPU backend with gloo cross-process collectives.
+
+    Env vars are too late here: the axon sitecustomize imports jax at
+    interpreter start pinned to the tunnelled chip, so only a config
+    update can redirect this process (same move as tests/conftest.py).
+    gloo advertises the machine hostname by default, which in a pod
+    netns resolves to 127.0.0.1 (/etc/hosts) — unreachable from the
+    peer pod — so the fabric address must be injected explicitly.
+    """
+    # A harness (tests/conftest.py, the driver's dryrun) may have
+    # exported a virtual-device XLA flag; this process must host
+    # exactly ONE device so the collective has no in-process shortcut —
+    # every byte is forced onto the fabric. The flag is only read at
+    # backend init, so scrubbing it here (post-import, pre-devices())
+    # still works.
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if bind_ip:
+        from jax._src.lib import xla_client
+
+        orig = xla_client._xla.make_gloo_tcp_collectives
+
+        def patched(distributed_client, hostname=None, interface=None):
+            return orig(distributed_client=distributed_client,
+                        hostname=bind_ip)
+
+        xla_client._xla.make_gloo_tcp_collectives = patched
+
+
+def _open_granted_devices(devices: list[str]) -> list[str]:
+    """Open every granted device node rw — the chip-grant half of the
+    composition (the AllocateResponse mounts must actually be usable
+    from inside the pod)."""
+    opened = []
+    for d in devices:
+        fd = os.open(d, os.O_RDWR)
+        os.close(fd)
+        opened.append(d)
+    return opened
+
+
+def _psum_bench(mesh, payload_mb: float, iters: int):
+    """Timed cross-process allreduce of a payload_mb-MiB shard per
+    process; returns (ok, elapsed_s, algo_gbps, moved_bytes_min).
+
+    algo bandwidth uses the ring-allreduce wire cost 2(n-1)/n · D per
+    process; moved_bytes_min is a LOWER bound on what each process must
+    have pushed through its fabric port (one reduce step's worth), for
+    the caller's counter assertion."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    pid = jax.process_index()
+    elems = int(payload_mb * (1 << 20) // 4)
+    local = np.full((elems,), float(pid + 1), np.float32)
+    sh = NamedSharding(mesh, P("dp"))
+    arr = jax.make_array_from_single_device_arrays(
+        (elems * n,), sh, [jax.device_put(local, jax.local_devices()[0])])
+
+    f = jax.jit(shard_map(
+        lambda a: jax.lax.psum(a, "dp"), mesh=mesh,
+        in_specs=P("dp"), out_specs=P("dp"), check_vma=False))
+    out = f(arr)  # warmup + correctness: every element == Σ (i+1)
+    want = float(n * (n + 1) / 2)
+    got = np.asarray(
+        [s.data for s in out.addressable_shards][0])
+    ok = bool(np.all(got == want))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(arr)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    bytes_payload = elems * 4
+    wire = 2 * (n - 1) / n * bytes_payload * iters
+    gbps = wire * 8 / elapsed / 1e9
+    return ok, elapsed, gbps, bytes_payload // n
+
+
+def _train_slice(mesh):
+    """A 2-worker dp slice of the five-axis training step: dp spans the
+    processes, the other axes are singleton (a 1-stage, 1-expert model —
+    the program is the same; only the factoring shrinks). The loss psum
+    and every gradient's dp sync cross the fabric. Returns (losses,
+    matches_dense, descends)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from .train_step import (dense_loss_reference, init_params,
+                             make_train_step, shard_params)
+
+    n = mesh.devices.size
+    devs = list(mesh.devices.flat)
+    tmesh = Mesh(np.array(devs).reshape(n, 1, 1, 1, 1),
+                 ("dp", "pp", "sp", "tp", "ep"))
+    M, mb, seq, d, h = 2, 2 * n, 4, 8, 16
+    params = init_params(S=1, d=d, h=h, E=1, seed=3)
+    rng = np.random.RandomState(7)
+    x = rng.randn(M, mb, seq, d).astype(np.float32)
+    tgt = np.tanh(x[..., ::-1].copy())
+
+    cf = 4.0
+    step, loss_fn = make_train_step(tmesh, capacity_factor=cf)
+    sparams = shard_params(params, tmesh)
+    # Build global batch arrays from per-process local shards along mb.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    xsh = NamedSharding(tmesh, P(None, "dp", "sp", None))
+    pid = jax.process_index()
+    mb_loc = mb // n
+    mk = lambda full: jax.make_array_from_single_device_arrays(
+        full.shape, xsh,
+        [jax.device_put(full[:, pid * mb_loc:(pid + 1) * mb_loc],
+                        jax.local_devices()[0])])
+    xg, tg = mk(x), mk(tgt)
+
+    ref0 = dense_loss_reference(params, x, tgt, capacity_factor=cf,
+                                shards={"dp": n, "sp": 1})
+    losses = []
+    p = sparams
+    for _ in range(3):
+        loss, p = step(p, xg, tg)
+        losses.append(float(loss))
+    matches = bool(np.isclose(losses[0], ref0, rtol=1e-4))
+    descends = losses[-1] < losses[0]
+    return losses, matches, descends
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--coordinator", required=True,
+                    help="ip:port of process 0 on the FABRIC network")
+    ap.add_argument("--bind-ip", default=None,
+                    help="this pod's fabric address (gloo advertises it)")
+    ap.add_argument("--payload-mb", type=float, default=8.0)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--devices", default="",
+                    help="comma-separated granted device nodes to open rw")
+    ap.add_argument("--skip-train-step", action="store_true")
+    args = ap.parse_args(argv)
+
+    def trace(msg):  # progress to stderr so a hang is attributable
+        print(f"fabric-worker[{args.process_id}] {msg}",
+              file=sys.stderr, flush=True)
+
+    _pin_cpu_backend(args.bind_ip)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    trace(f"initializing distributed, coordinator={args.coordinator}")
+    jax.distributed.initialize(coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+    trace("distributed up; querying devices")
+    result = {
+        "process_id": args.process_id,
+        "process_count": jax.process_count(),
+        "n_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }
+    opened = _open_granted_devices(
+        [d for d in args.devices.split(",") if d])
+    result["devices_opened"] = opened
+    granted_env = {k: v for k, v in os.environ.items()
+                   if k.startswith("TPU_") and k in (
+                       "TPU_VISIBLE_DEVICES", "TPU_WORKER_ID",
+                       "TPU_SLICE_ID", "TPU_NUM_SLICES")}
+    result["granted_env"] = granted_env
+
+    ok = (result["process_count"] == args.num_processes
+          and result["n_devices"] == args.num_processes
+          and result["platform"] == "cpu")
+
+    trace(f"devices={result['n_devices']} platform={result['platform']}; "
+          f"running psum bench")
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    psum_ok, elapsed, gbps, moved_min = _psum_bench(
+        mesh, args.payload_mb, args.iters)
+    trace("psum bench done; running train-step slice")
+    result.update(psum_ok=psum_ok, allreduce_elapsed_s=round(elapsed, 4),
+                  fabric_jax_allreduce_gbps=round(gbps, 3),
+                  min_port_bytes=moved_min)
+    ok = ok and psum_ok
+
+    if not args.skip_train_step:
+        losses, matches, descends = _train_slice(mesh)
+        result.update(train_losses=[round(l, 6) for l in losses],
+                      train_matches_dense=matches,
+                      train_loss_descends=descends)
+        ok = ok and matches and descends
+
+    result["ok"] = ok
+    print(json.dumps(result), flush=True)
+    jax.distributed.shutdown()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

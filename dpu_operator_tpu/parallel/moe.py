@@ -93,6 +93,10 @@ def make_moe(mesh: Mesh, axis: str = "ep", capacity_factor: float = 2.0,
     with k (each shard may send up to C = ceil(k·t_local/E·cf)
     assignments to each expert); dropped assignments contribute zero."""
     E = mesh.shape[axis]
+    if not 1 <= top_k <= E:
+        raise ValueError(
+            f"top_k={top_k} must be in [1, {E}] (the {axis!r} axis size): "
+            f"a token cannot be routed to more experts than exist")
 
     def per_device(x, router_w, w1_local, w2_local):
         if w1_local.shape[0] != 1 or w2_local.shape[0] != 1:

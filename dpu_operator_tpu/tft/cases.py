@@ -209,8 +209,16 @@ def _sysctl(path: str, value: str, cleanups: List,
     """Set a sysctl, restoring the prior value at cleanup (root-netns
     sysctls are global state the suite must hand back)."""
     cmd = ["ip", "netns", "exec", netns] if netns else []
-    old = subprocess.run(cmd + ["cat", path], capture_output=True,
-                         text=True).stdout.strip()
+    pre = subprocess.run(cmd + ["cat", path], capture_output=True, text=True)
+    old = pre.stdout.strip()
+    if netns is None and (pre.returncode != 0 or not old):
+        # Without the prior value we cannot register a restore, and a
+        # root-netns knob (ip_forward, bridge-nf-call-*) left flipped
+        # outlives the suite. Refuse rather than silently leak state.
+        raise RuntimeError(
+            f"cannot read {path} before changing it "
+            f"(rc={pre.returncode}, stderr={pre.stderr.strip()!r}); "
+            f"refusing to set a root-netns sysctl with no restore value")
     _run(cmd + ["sh", "-c", f"echo {value} > {path}"])
     if old and old != value and netns is None:
         cleanups.append(lambda: subprocess.run(

@@ -215,7 +215,8 @@ class TpuFabricDataplane:
             self._flow_issues.pop(f"baseline:{netdev}", None)
         except Exception as e:
             self._flow_issues[f"baseline:{netdev}"] = (
-                f"baseline flow rule on {netdev} failed: {e}")
+                f"[baseline:{netdev}] baseline flow rule on {netdev} "
+                f"failed: {e}")
             log.warning("%s", self._flow_issues[f"baseline:{netdev}"])
         # A port attached while an NF chain is live joins its workload
         # side immediately (marvell re-programs vf flows on attach).
@@ -251,7 +252,8 @@ class TpuFabricDataplane:
                     self._flow_issues.pop(f"nf-late:{netdev}", None)
                 except Exception as e:
                     self._flow_issues[f"nf-late:{netdev}"] = (
-                        f"NF steer for late-attached {netdev} failed: {e}")
+                        f"[nf-late:{netdev}] NF steer for late-attached "
+                        f"{netdev} failed: {e}")
                     log.warning("%s", self._flow_issues[f"nf-late:{netdev}"])
 
     def partition_endpoints(self, count: int) -> None:
@@ -459,8 +461,8 @@ class TpuFabricDataplane:
                     self._flow_issues.pop(issue_key, None)
                 except Exception as e:
                     self._flow_issues[issue_key] = (
-                        f"NF flow programming {port_in}->{port_out} "
-                        f"failed: {e}")
+                        f"[{issue_key}] NF flow programming "
+                        f"{port_in}->{port_out} failed: {e}")
                     log.warning("%s", self._flow_issues[issue_key])
         elif policies or transparent:
             # A chain the CR asked to steer/police but nothing to hang
@@ -468,8 +470,8 @@ class TpuFabricDataplane:
             # transparent mode, where the workload traffic now BYPASSES
             # the NF it was promised to cross.
             self._flow_issues[issue_key] = (
-                f"NF chain spec for {mac_in}->{mac_out} not programmed: "
-                f"ports not attached")
+                f"[{issue_key}] NF chain spec for {mac_in}->{mac_out} "
+                f"not programmed: ports not attached")
             log.warning("%s", self._flow_issues[issue_key])
         self.nf_pairs.append((mac_in, mac_out))
 

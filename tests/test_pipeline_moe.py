@@ -159,6 +159,21 @@ def test_moe_top2_rank_priority_under_pressure():
     assert not np.any(np.all(np.asarray(out) == 0, axis=1))
 
 
+def test_moe_top_k_out_of_range_rejected_clearly():
+    """top_k beyond the ep axis (or < 1) must fail with a clear
+    ValueError at make_moe time, not an opaque XLA shape error from
+    lax.top_k deep inside the traced program."""
+    import pytest
+
+    from dpu_operator_tpu.parallel.moe import make_moe
+
+    mesh = _mesh([("ep", 2)])
+    with pytest.raises(ValueError, match="top_k=3"):
+        make_moe(mesh, top_k=3)
+    with pytest.raises(ValueError, match="top_k=0"):
+        make_moe(mesh, top_k=0)
+
+
 def test_moe_capacity_drops_are_exact():
     """Over-capacity tokens drop to ZERO output (the Switch contract) —
     and only those: with capacity 1 per expert, each expert serves its
