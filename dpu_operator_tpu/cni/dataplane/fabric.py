@@ -81,8 +81,17 @@ class FabricDataplane:
         """(allocator, routes) for this request: the NAD's own `ipam`
         config when present, the daemon-level default otherwise."""
         conf = (req.config or {}).get("ipam") or {}
-        from ..ipam import KNOWN_IPAM_KEYS
+        from ..ipam import KNOWN_IPAM_KEYS, DelegatedIpam
 
+        itype = conf.get("type")
+        if itype and itype != "host-local":
+            # Foreign `ipam.type` → exec-delegate to the cluster's own
+            # plugin (reference sriov.go:426-487). Its config grammar
+            # belongs to that plugin — no key validation here. Not
+            # cached: the wrapper holds no state (the binary is resolved
+            # per exec), and req.config carries per-pod fields that
+            # would grow a cache without bound.
+            return DelegatedIpam(req.config), []
         unknown = set(conf) - KNOWN_IPAM_KEYS
         if unknown:
             # A typo'd key silently falling back to defaults is the worst
@@ -145,7 +154,11 @@ class FabricDataplane:
                 nl.move_link_to_netns(tmp_if, netns)
                 nl.rename_link(tmp_if, req.ifname, netns)
             ipam, routes = self._ipam_for(req)
-            cidr, gateway = ipam.allocate(owner)
+            if getattr(ipam, "delegated", False):
+                cidr, gateway, routes = ipam.allocate_delegated(
+                    owner, req.netns)
+            else:
+                cidr, gateway = ipam.allocate(owner)
             nl.add_addr(req.ifname, cidr, netns)
             nl.set_up(req.ifname, netns)
             nl.set_up(host_if)
@@ -212,7 +225,19 @@ class FabricDataplane:
         unique doomed name synchronously, destroy it in the background."""
         state = self._store.load(req.container_id, req.ifname)
         if state is None:
-            # DEL must be idempotent per CNI spec.
+            # DEL must be idempotent per CNI spec. But a DELEGATED
+            # plugin's lease lives in ITS (often cluster-wide) store,
+            # which our stale-lease GC cannot reach — if the daemon died
+            # between the plugin's ADD and our state save, skipping the
+            # plugin DEL here would leak the address forever. IPAM DELs
+            # are idempotent by spec, so exec it unconditionally.
+            try:
+                ipam = self._ipam_for(req)[0]
+                if getattr(ipam, "delegated", False):
+                    ipam.release(f"{req.container_id}/{req.ifname}")
+            except IpamError as e:
+                log.warning("delegated ipam release on stateless DEL "
+                            "failed: %s", e)
             return {}, False
         host_if = state.get("hostIf", "")
         if host_if and nl.link_exists(host_if):
@@ -231,9 +256,16 @@ class FabricDataplane:
                 nl.delete_link(host_if)
         # CNI guarantees DEL carries the same config as ADD, so the same
         # NAD-level allocator is resolved for the release.
-        self._ipam_for(req)[0].release(
-            state.get("owner", f"{req.container_id}/{req.ifname}")
-        )
+        try:
+            self._ipam_for(req)[0].release(
+                state.get("owner", f"{req.container_id}/{req.ifname}")
+            )
+        except IpamError as e:
+            # A delegated plugin's DEL can fail (binary gone, its store
+            # unreachable); DEL stays idempotent — the interface is
+            # already torn down, so log and continue rather than wedge
+            # the pod's teardown.
+            log.warning("ipam release failed on DEL: %s", e)
         self._store.delete(req.container_id, req.ifname)
         return {}, True
 

@@ -147,3 +147,115 @@ class HostLocalIpam:
                     released += len(leases) - len(kept)
                     HostLocalIpam._save_locked(f, kept)
         return released
+
+
+class DelegatedIpam:
+    """Exec-delegation to a named external CNI IPAM plugin (whereabouts,
+    dhcp, static, …) — the reference's env-passing delegation
+    (sriov.go:426-487) for NADs whose `ipam.type` is not the native
+    host-local grammar, so a cluster-wide IPAM keeps working when a user
+    switches to this framework.
+
+    Deliberate departure from the reference: it serializes every CNI
+    request under one process-global mutex to protect this exec
+    (cniserver.go:97-121); here each request execs its own subprocess
+    with per-request env, so requests for different pods still run
+    concurrently — the external plugin owns its own store locking (the
+    CNI spec requires it to)."""
+
+    delegated = True
+
+    def __init__(self, net_conf: dict, search_path: Optional[str] = None):
+        ipam_conf = (net_conf or {}).get("ipam") or {}
+        self.type = ipam_conf.get("type") or ""
+        if not self.type or "/" in self.type or self.type.startswith("."):
+            # The type names the binary; a path-ish value must never be
+            # execed (CNI spec: plugins are found via CNI_PATH only).
+            raise IpamError(f"bad delegated ipam type {self.type!r}")
+        self._conf = net_conf
+        self._path = search_path or os.environ.get("CNI_PATH", "/opt/cni/bin")
+        # HostLocalIpam API parity so dataplane GC/state plumbing that
+        # introspects `state_dir` keeps working (delegated leases live
+        # in the plugin's own store; there is nothing for our GC to do).
+        self.state_dir = None
+
+    def _binary(self) -> str:
+        for d in self._path.split(":"):
+            if not d:
+                continue
+            cand = os.path.join(d, self.type)
+            if os.path.isfile(cand) and os.access(cand, os.X_OK):
+                return cand
+        raise IpamError(
+            f"delegated ipam plugin {self.type!r} not found in CNI_PATH "
+            f"{self._path!r}")
+
+    def _exec(self, command: str, container_id: str, netns: str,
+              ifname: str) -> str:
+        import subprocess
+
+        env = dict(os.environ)
+        env.update({
+            "CNI_COMMAND": command,
+            "CNI_CONTAINERID": container_id,
+            "CNI_NETNS": netns or "",
+            "CNI_IFNAME": ifname,
+            "CNI_PATH": self._path,
+        })
+        try:
+            r = subprocess.run(
+                [self._binary()], input=json.dumps(self._conf),
+                capture_output=True, text=True, env=env, timeout=60)
+        except subprocess.TimeoutExpired as e:
+            raise IpamError(
+                f"delegated ipam {self.type} {command} timed out") from e
+        if r.returncode != 0:
+            # stderr IS the plugin's error contract — propagate it, not
+            # just the exit code.
+            detail = (r.stderr.strip() or r.stdout.strip())[:500]
+            raise IpamError(
+                f"delegated ipam {self.type} {command} failed "
+                f"rc={r.returncode}: {detail}")
+        return r.stdout
+
+    @staticmethod
+    def _split_owner(owner: str) -> Tuple[str, str]:
+        cid, _, ifname = owner.partition("/")
+        return cid, ifname
+
+    def allocate_delegated(self, owner: str, netns: str):
+        """ADD through the plugin. Returns (cidr, gateway, routes) —
+        routes in the host-local dict grammar ({dst, gw}) the dataplane
+        already programs."""
+        cid, ifname = self._split_owner(owner)
+        out = self._exec("ADD", cid, netns, ifname)
+        try:
+            res = json.loads(out or "{}")
+        except ValueError as e:
+            raise IpamError(
+                f"delegated ipam {self.type} returned non-JSON: "
+                f"{out[:200]!r}") from e
+        ips = res.get("ips") or []
+        if not ips or not ips[0].get("address"):
+            raise IpamError(
+                f"delegated ipam {self.type} returned no ips: {res!r}")
+        if len(ips) > 1:
+            # The fabric plumbs one address per attachment today; a
+            # dual-stack delegated result has recorded leases for ALL of
+            # them — say what is being dropped instead of hiding it.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "delegated ipam %s returned %d ips; only %s is plumbed "
+                "(dual-stack delegated results are not yet supported)",
+                self.type, len(ips), ips[0]["address"])
+        routes = [r for r in (res.get("routes") or [])
+                  if isinstance(r, dict) and r.get("dst")]
+        return ips[0]["address"], ips[0].get("gateway"), routes
+
+    def release(self, owner: str) -> None:
+        """DEL through the plugin. CNI DELs are idempotent/best-effort;
+        a failure raises so the caller decides (the dataplane's DEL path
+        logs and continues, matching its host-local behavior)."""
+        cid, ifname = self._split_owner(owner)
+        self._exec("DEL", cid, "", ifname)

@@ -164,6 +164,107 @@ def test_ipam_range_start_end_exclude(tmp_path):
                       range_start="10.99.0.1")
 
 
+FAKE_IPAM = """#!/bin/sh
+# Fake external CNI IPAM plugin: records its invocation env + stdin,
+# answers ADD with a canned CNI result, DEL with nothing.
+echo "cmd=$CNI_COMMAND cid=$CNI_CONTAINERID ifname=$CNI_IFNAME netns=$CNI_NETNS" >> "$IPAM_LOG"
+cat >> "$IPAM_LOG.stdin"
+if [ "$CNI_COMMAND" = "ADD" ]; then
+  printf '{"ips":[{"address":"10.91.0.7/24","gateway":"10.91.0.1"}],"routes":[{"dst":"192.168.91.0/24","gw":"10.91.0.1"}]}'
+fi
+"""
+
+
+def _delegated_req(ns, tmp_path, ipam_type="whereabouts"):
+    req = _req(ns)
+    req.config["ipam"] = {"type": ipam_type,
+                          "range": "10.91.0.0/24"}  # foreign grammar
+    return req
+
+
+def test_delegated_ipam_execs_external_plugin(dataplane, pod_ns, tmp_path,
+                                              monkeypatch):
+    """A NAD whose ipam.type is not the native grammar must be delegated
+    to the named CNI IPAM binary via per-request env + config-on-stdin
+    (reference sriov.go:426-487): its result addresses/routes are
+    plumbed, and DEL invokes the plugin again for release."""
+    import json as _json
+    import os as _os
+
+    bindir = tmp_path / "cnibin"
+    bindir.mkdir()
+    plug = bindir / "whereabouts"
+    plug.write_text(FAKE_IPAM)
+    plug.chmod(0o755)
+    log = tmp_path / "ipam.log"
+    monkeypatch.setenv("CNI_PATH", str(bindir))
+    monkeypatch.setenv("IPAM_LOG", str(log))
+
+    req = _delegated_req(pod_ns, tmp_path)
+    result = dataplane.cmd_add(req)
+    assert result.ips[0]["address"] == "10.91.0.7/24"
+    # The plugin, not our allocator, owns the lease: no native lease file.
+    assert not list((tmp_path / "ipam").glob("ipam-10.91*")), (
+        "native allocator touched a delegated range")
+    # Address + plugin-returned route are really in the pod netns.
+    out = subprocess.run(
+        ["ip", "-n", pod_ns, "-j", "addr", "show", "dev", "net1"],
+        capture_output=True, text=True, check=True).stdout
+    assert "10.91.0.7" in out
+    routes = subprocess.run(
+        ["ip", "-n", pod_ns, "route"], capture_output=True, text=True,
+        check=True).stdout
+    assert "192.168.91.0/24" in routes
+    # Env-passing protocol: ADD seen with our container identifiers, and
+    # the FULL net conf (incl. the foreign ipam grammar) on stdin.
+    entries = log.read_text().strip().splitlines()
+    assert entries[0].startswith(f"cmd=ADD cid={req.container_id} "
+                                 f"ifname=net1")
+    stdin_conf = _json.loads((tmp_path / "ipam.log.stdin").read_text())
+    assert stdin_conf["ipam"]["range"] == "10.91.0.0/24"
+
+    dataplane.cmd_del(_del_with_conf(req))
+    entries = log.read_text().strip().splitlines()
+    assert any(e.startswith(f"cmd=DEL cid={req.container_id}")
+               for e in entries), entries
+
+
+def _del_with_conf(add_req):
+    return CniRequest(command="DEL", container_id=add_req.container_id,
+                      netns=add_req.netns, ifname=add_req.ifname,
+                      config=add_req.config)
+
+
+def test_delegated_ipam_failure_propagates_stderr(dataplane, pod_ns,
+                                                  tmp_path, monkeypatch):
+    """A failing external plugin must surface ITS error text (stderr is
+    the CNI plugin error contract), and the ADD must roll back clean."""
+    bindir = tmp_path / "cnibin"
+    bindir.mkdir()
+    plug = bindir / "whereabouts"
+    plug.write_text("#!/bin/sh\necho 'range 10.91.0.0/24 exhausted' >&2\n"
+                    "exit 3\n")
+    plug.chmod(0o755)
+    monkeypatch.setenv("CNI_PATH", str(bindir))
+
+    req = _delegated_req(pod_ns, tmp_path)
+    with pytest.raises(CniError, match="range 10.91.0.0/24 exhausted"):
+        dataplane.cmd_add(req)
+    # Rollback: no half-plumbed interface left in the pod.
+    out = subprocess.run(
+        ["ip", "-n", pod_ns, "link", "show", "dev", "net1"],
+        capture_output=True, text=True).returncode
+    assert out != 0, "net1 left behind after failed delegated ADD"
+
+
+def test_delegated_ipam_missing_binary_is_clear(dataplane, pod_ns,
+                                                tmp_path, monkeypatch):
+    monkeypatch.setenv("CNI_PATH", str(tmp_path / "empty"))
+    req = _delegated_req(pod_ns, tmp_path, ipam_type="dhcp")
+    with pytest.raises(CniError, match="not found in CNI_PATH"):
+        dataplane.cmd_add(req)
+
+
 def test_nad_level_ipam_config_drives_allocation(dataplane, pod_ns):
     """A NetworkAttachmentDefinition carrying its own `ipam` section
     (subnet + rangeStart + routes) allocates from THAT range — not the
