@@ -235,9 +235,12 @@ class FabricDataplane:
                 ipam = self._ipam_for(req)[0]
                 if getattr(ipam, "delegated", False):
                     ipam.release(f"{req.container_id}/{req.ifname}")
-            except IpamError as e:
-                log.warning("delegated ipam release on stateless DEL "
-                            "failed: %s", e)
+            except (IpamError, ValueError) as e:
+                # ValueError: a malformed NAD ipam.subnet raises from
+                # ipaddress inside _ipam_for — a bad config must not
+                # break DEL idempotency (the pod would wedge in
+                # Terminating on every kubelet retry).
+                log.warning("ipam release on stateless DEL failed: %s", e)
             return {}, False
         host_if = state.get("hostIf", "")
         if host_if and nl.link_exists(host_if):

@@ -1,0 +1,464 @@
+"""1F1B (and interleaved-1F1B) pipeline schedules — the `pp` axis,
+training-shaped.
+
+`pipeline.py` is the GPipe form: forward scan, AD generates the
+backward, which means forward-all-then-backward-all — every microbatch's
+activations live until its backward runs, an O(M) stash. 1F1B is the
+standard next rung (what any real pp training shape uses): each stage
+starts a microbatch's backward as soon as it can, capping in-flight
+microbatches per device at O(S) regardless of M; the interleaved variant
+(v chunks per device, Megatron-style) additionally divides the bubble by
+v. Neither changes the math — gradients must equal sequential AD, and
+the tests assert exactly that.
+
+TPU-first shape, same discipline as pipeline.py:
+  * the SCHEDULE is static — a greedy 1F1B list-scheduler (backward
+    preferred, in-flight forwards capped) runs at trace time in numpy
+    and emits integer instruction tables; the device program is one
+    `lax.scan` over those tables inside `shard_map`, with `ppermute`
+    rings moving activations forward and cotangents backward. No
+    data-dependent control flow; every buffer statically sized by the
+    scheduler's measured high-water mark.
+  * the backward needs each stage's VJP at the stash's input — residuals
+    are REMATERIALIZED (stash the input, re-run the stage forward under
+    `jax.vjp` at B time), the standard memory/FLOPs trade on TPU where
+    HBM, not MXU, is the scarce resource.
+  * bubble is accounted from the schedule table itself (idle slots over
+    total slots, F and B each one slot) — the schedule-theoretic number,
+    independent of this executor's masked-compute implementation.
+
+The reference operator has no compute path (SURVEY §2.5); this module is
+part of the TPU-native compute layer mandated by the template, next to
+pipeline.py/moe.py/train_step.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+@dataclass
+class Schedule:
+    """Static instruction tables, [T, n] int32 unless noted. Local chunk
+    slot s ∈ [0, v); global chunk j = s * n + d for device d (round-robin
+    chunk placement — what makes the interleaved ring work)."""
+
+    n: int
+    v: int
+    M: int
+    T: int
+    op: np.ndarray          # IDLE/FWD/BWD
+    s: np.ndarray           # local chunk slot of the unit
+    m: np.ndarray           # microbatch of the unit
+    fin_k: np.ndarray       # F: fwd_in slot to read (-1 → read x[m] directly)
+    stash_k: np.ndarray     # F: stash slot to write; B: slot to read
+    bin_k: np.ndarray       # B: bwd_in slot to read; F@last chunk: slot to
+                            #    WRITE the loss cotangent
+    # What lands in MY buffers after this tick's ppermutes:
+    frecv_valid: np.ndarray
+    frecv_s: np.ndarray
+    frecv_k: np.ndarray
+    brecv_valid: np.ndarray
+    brecv_s: np.ndarray
+    brecv_k: np.ndarray
+    Kf: int                 # fwd_in slots per chunk (high-water)
+    Kb: int                 # bwd_in slots per chunk
+    Ks: int                 # stash slots per chunk
+    bubble: float           # idle fraction of the T·n slot grid
+    max_inflight: np.ndarray  # per-device peak outstanding microbatches
+
+    @property
+    def stages(self) -> int:
+        return self.n * self.v
+
+
+class _SlotPool:
+    """Tracks buffer-slot allocation during scheduling so the executor's
+    arrays can be sized to the true high-water mark."""
+
+    def __init__(self):
+        self.free: Dict[Tuple, List[int]] = {}
+        self.size: Dict[Tuple, int] = {}
+        self.held: Dict[Tuple, int] = {}
+
+    def alloc(self, key: Tuple) -> int:
+        pool = self.free.setdefault(key, [])
+        if pool:
+            return pool.pop()
+        k = self.size.get(key, 0)
+        self.size[key] = k + 1
+        return k
+
+    def release(self, key: Tuple, k: int) -> None:
+        self.free.setdefault(key, []).append(k)
+
+    def high_water(self) -> int:
+        return max(self.size.values(), default=1)
+
+
+def build_schedule(n: int, M: int, v: int = 1) -> Schedule:
+    """Greedy 1F1B list-scheduler: forward while the device's
+    outstanding microbatches are under the cap W_d = (v-1)·n + (n-d),
+    backward otherwise — the classic warmup/steady/cooldown timeline.
+    The cap is what makes it 1F1B: the stash stays O(S) regardless of M
+    (peak in-flight == W_d, asserted in tests), and in steady state
+    every F admission forces a B drain, i.e. strict alternation. For
+    v=1 this reproduces the textbook schedule exactly (bubble ==
+    GPipe's (n-1)/(M+n-1), memory better); for v>1 the same rule over
+    round-robin chunks yields a Megatron-family interleaved schedule
+    whose measured bubble beats v=1 (e.g. n=4 M=8: 0.20 vs 0.27; the
+    tests assert the inequality from the emitted table, not a formula)."""
+    if n < 1 or M < 1 or v < 1:
+        raise ValueError(f"need n,M,v >= 1, got n={n} M={M} v={v}")
+    S = n * v
+    dev_of = lambda j: j % n
+    slot_of = lambda j: j // n
+
+    f_done = {}  # (j, m) -> tick
+    b_done = {}
+    outstanding = [0] * n
+    peak = [0] * n
+    W = [(v - 1) * n + (n - d) for d in range(n)]
+
+    fwd_pool, bwd_pool, stash_pool = _SlotPool(), _SlotPool(), _SlotPool()
+    fwd_slot = {}    # (j, m) -> fwd_in slot at consumer
+    bwd_slot = {}    # (j, m) -> bwd_in slot at consumer
+    stash_slot = {}  # (j, m) -> stash slot at owner
+
+    rows_op, rows_s, rows_m = [], [], []
+    rows_fin, rows_stash, rows_bin = [], [], []
+    rows_fv, rows_fs, rows_fk = [], [], []
+    rows_bv, rows_bs, rows_bk = [], [], []
+
+    t = 0
+    total_units = 2 * S * M
+    done_units = 0
+    while done_units < total_units:
+        if t > 4 * total_units + 16:
+            raise RuntimeError("scheduler livelock — dependency bug")
+        op_r = [IDLE] * n
+        s_r = [0] * n
+        m_r = [0] * n
+        fin_r = [0] * n
+        stash_r = [0] * n
+        bin_r = [0] * n
+        fv_r, fs_r, fk_r = [0] * n, [0] * n, [0] * n
+        bv_r, bs_r, bk_r = [0] * n, [0] * n, [0] * n
+
+        chosen: List[Tuple] = [None] * n
+        for d in range(n):
+            f_cands = []
+            b_cands = []
+            for sl in range(v):
+                j = sl * n + d
+                for m in range(M):
+                    if (j, m) not in f_done:
+                        if j == 0 or f_done.get((j - 1, m), t) < t:
+                            f_cands.append((m, j))
+                    elif (j, m) not in b_done and f_done[(j, m)] < t:
+                        if j == S - 1 or b_done.get((j + 1, m), t) < t:
+                            b_cands.append((m, -j))
+            # Forward while under the in-flight cap (fills the chunk
+            # waves tightly — what buys the interleaved bubble win);
+            # backward otherwise (drains the stash). FIFO by microbatch,
+            # deepest chunk first among backwards.
+            if f_cands and outstanding[d] < W[d]:
+                m, j = min(f_cands)
+                chosen[d] = (FWD, j, m)
+            elif b_cands:
+                m, negj = min(b_cands)
+                chosen[d] = (BWD, -negj, m)
+
+        for d in range(n):
+            unit = chosen[d]
+            if unit is None:
+                continue
+            op, j, m = unit
+            sl = slot_of(j)
+            op_r[d], s_r[d], m_r[d] = op, sl, m
+            done_units += 1
+            if op == FWD:
+                f_done[(j, m)] = t
+                outstanding[d] += 1
+                peak[d] = max(peak[d], outstanding[d])
+                if j == 0:
+                    fin_r[d] = -1
+                else:
+                    k = fwd_slot.pop((j, m))
+                    fin_r[d] = k
+                    fwd_pool.release((d, sl), k)
+                stash_r[d] = stash_pool.alloc((d, sl))
+                stash_slot[(j, m)] = stash_r[d]
+                if j == S - 1:
+                    # Loss cotangent is produced HERE and parked in my
+                    # own bwd_in until this chunk's backward runs.
+                    k = bwd_pool.alloc((d, sl))
+                    bwd_slot[(j, m)] = k
+                    bin_r[d] = k
+                else:
+                    # Output ships to the next chunk's device this tick.
+                    nd, ns = dev_of(j + 1), slot_of(j + 1)
+                    k = fwd_pool.alloc((nd, ns))
+                    fwd_slot[(j + 1, m)] = k
+                    fv_r[nd], fs_r[nd], fk_r[nd] = 1, ns, k
+            else:
+                b_done[(j, m)] = t
+                outstanding[d] -= 1
+                k = bwd_slot.pop((j, m))
+                bin_r[d] = k
+                bwd_pool.release((d, sl), k)
+                ks = stash_slot.pop((j, m))
+                stash_r[d] = ks
+                stash_pool.release((d, sl), ks)
+                if j > 0:
+                    nd, ns = dev_of(j - 1), slot_of(j - 1)
+                    k = bwd_pool.alloc((nd, ns))
+                    bwd_slot[(j - 1, m)] = k
+                    bv_r[nd], bs_r[nd], bk_r[nd] = 1, ns, k
+
+        rows_op.append(op_r)
+        rows_s.append(s_r)
+        rows_m.append(m_r)
+        rows_fin.append(fin_r)
+        rows_stash.append(stash_r)
+        rows_bin.append(bin_r)
+        rows_fv.append(fv_r)
+        rows_fs.append(fs_r)
+        rows_fk.append(fk_r)
+        rows_bv.append(bv_r)
+        rows_bs.append(bs_r)
+        rows_bk.append(bk_r)
+        t += 1
+
+    T = t
+    op = np.array(rows_op, np.int32)
+    bubble = float((op == IDLE).sum()) / (T * n)
+    return Schedule(
+        n=n, v=v, M=M, T=T,
+        op=op,
+        s=np.array(rows_s, np.int32),
+        m=np.array(rows_m, np.int32),
+        fin_k=np.array(rows_fin, np.int32),
+        stash_k=np.array(rows_stash, np.int32),
+        bin_k=np.array(rows_bin, np.int32),
+        frecv_valid=np.array(rows_fv, np.int32),
+        frecv_s=np.array(rows_fs, np.int32),
+        frecv_k=np.array(rows_fk, np.int32),
+        brecv_valid=np.array(rows_bv, np.int32),
+        brecv_s=np.array(rows_bs, np.int32),
+        brecv_k=np.array(rows_bk, np.int32),
+        Kf=fwd_pool.high_water(),
+        Kb=bwd_pool.high_water(),
+        Ks=stash_pool.high_water(),
+        bubble=bubble,
+        max_inflight=np.array(peak, np.int32),
+    )
+
+
+def gpipe_bubble(n: int, M: int) -> float:
+    """GPipe's schedule-theoretic bubble with the same slot accounting
+    (F and B one slot each, forward-all then backward-all): (n-1) idle
+    slots per device per phase over M + n - 1 slots of phase timeline —
+    the textbook (S-1)/(M+S-1) pipeline.py's docstring cites."""
+    return (n - 1) / (M + n - 1)
+
+
+def interleave_order(n: int, v: int) -> np.ndarray:
+    """THE round-robin chunk placement, in one place: position d·v + s
+    of a stacked leading dim holds global chunk s·n + d, so P('pp')
+    block-sharding gives device d chunks {d, n+d, …} — the layout
+    run_schedule's chunk addressing (j = s·n + my) assumes. Every
+    interleave/uninterleave helper derives from this array."""
+    return np.array([s * n + d for d in range(n) for s in range(v)])
+
+
+def interleave_stack(per_stage_params, n: int, v: int):
+    """Stack per-stage pytrees in interleave_order."""
+    S = n * v
+    if len(per_stage_params) != S:
+        raise ValueError(f"need {S} stages for n={n} v={v}, "
+                         f"got {len(per_stage_params)}")
+    order = interleave_order(n, v)
+    return jax.tree.map(
+        lambda *xs: jnp.stack([xs[j] for j in order]), *per_stage_params)
+
+
+def uninterleave(stacked, n: int, v: int):
+    """Inverse of interleave_order on a stacked leading dim (used to
+    compare pipeline grads against the natural-order sequential
+    reference)."""
+    inv = np.argsort(interleave_order(n, v))
+    return jax.tree.map(lambda a: a[inv], stacked)
+
+
+def run_schedule(sched: Schedule, stage_fn: Callable, params_local,
+                 x_mb, tgt_mb, *, axis: str, norm: float,
+                 cot_scale: float = 1.0):
+    """Execute a 1F1B schedule INSIDE an already-entered shard_map
+    context: one lax.scan over the instruction tables, activations
+    ppermuted forward, cotangents backward, backwards rematerialized
+    under jax.vjp, gradients accumulated per local chunk.
+
+    Shared by make_1f1b (pp-only mesh) and train_step's 1F1B mode
+    (5-axis mesh, stage_fn carrying tp/ep collectives — jax.vjp
+    differentiates those the same way shard_map's AD would).
+
+    x_mb/tgt_mb: [M, rows, d] LOCAL shards. norm: the global loss
+    normalizer (the caller knows how many data shards exist).
+    cot_scale scales the injected loss cotangent WITHOUT touching the
+    reported loss: on a mesh whose extra axes redundantly replicate
+    this computation (train_step's tp/ep), the caller's psum over those
+    axes would multiply every gradient by the replica count — 1/R here
+    is the same division shard_map's replicated-output transpose
+    applies (measured leaf-by-leaf against dense-reference AD in
+    tests/test_train_step.py). Returns (grads_local [v, ...],
+    loss_local) — loss is nonzero only on the device hosting the last
+    chunk; the caller psums it."""
+    n, v, S = sched.n, sched.v, sched.stages
+    tb = {k: jnp.asarray(getattr(sched, k)) for k in
+          ("op", "s", "m", "fin_k", "stash_k", "bin_k",
+           "frecv_valid", "frecv_s", "frecv_k",
+           "brecv_valid", "brecv_s", "brecv_k")}
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    my = lax.axis_index(axis)
+    rows, dm = x_mb.shape[1], x_mb.shape[2]
+    fwd_in = jnp.zeros((v, sched.Kf, rows, dm), x_mb.dtype)
+    bwd_in = jnp.zeros((v, sched.Kb, rows, dm), x_mb.dtype)
+    stash = jnp.zeros((v, sched.Ks, rows, dm), x_mb.dtype)
+    grads0 = jax.tree.map(jnp.zeros_like, params_local)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, stash, grads, loss = carry
+        op = tb["op"][t, my]
+        s = tb["s"][t, my]
+        m = tb["m"][t, my]
+        fin_k = tb["fin_k"][t, my]
+        stash_k = tb["stash_k"][t, my]
+        bin_k = tb["bin_k"][t, my]
+        p_s = jax.tree.map(lambda a: a[s], params_local)
+
+        # ---- forward unit (masked) ----
+        x_direct = x_mb[m]
+        x_buf = fwd_in[s, jnp.maximum(fin_k, 0)]
+        x_f = jnp.where(fin_k < 0, x_direct, x_buf)
+        y = stage_fn(p_s, x_f)
+        is_f = op == FWD
+        is_last_chunk = is_f & (s == v - 1) & (my == n - 1)
+        mb_loss = jnp.sum((y - tgt_mb[m]) ** 2) / norm
+        loss = loss + jnp.where(is_last_chunk, mb_loss, 0.0)
+        loss_cot = 2.0 * cot_scale * (y - tgt_mb[m]) / norm
+        # Stash the INPUT for rematerialized backward.
+        stash = jnp.where(is_f, stash.at[s, stash_k].set(x_f), stash)
+        # Park the loss cotangent (last chunk only).
+        bwd_in = jnp.where(
+            is_last_chunk, bwd_in.at[s, bin_k].set(loss_cot), bwd_in)
+
+        # ---- backward unit (masked; rematerialize + VJP) ----
+        xb = stash[s, stash_k]
+        cot = bwd_in[s, bin_k]
+        _, vjp = jax.vjp(stage_fn, p_s, xb)
+        dp, dx = vjp(cot)
+        is_b = op == BWD
+        gmask = jnp.where(is_b, 1.0, 0.0).astype(x_mb.dtype)
+        grads = jax.tree.map(
+            lambda g, dpl: g.at[s].add(dpl * gmask), grads, dp)
+
+        # ---- ship: activations forward, cotangents backward ----
+        fsend = jnp.where(is_f & ((s * n + my) < S - 1), y,
+                          jnp.zeros_like(y))
+        bsend = jnp.where(is_b & ((s * n + my) > 0), dx,
+                          jnp.zeros_like(dx))
+        fgot = lax.ppermute(fsend, axis, fwd_perm)
+        bgot = lax.ppermute(bsend, axis, bwd_perm)
+        fv = tb["frecv_valid"][t, my]
+        fwd_in = jnp.where(
+            fv > 0,
+            fwd_in.at[tb["frecv_s"][t, my], tb["frecv_k"][t, my]]
+            .set(fgot),
+            fwd_in)
+        bv = tb["brecv_valid"][t, my]
+        bwd_in = jnp.where(
+            bv > 0,
+            bwd_in.at[tb["brecv_s"][t, my], tb["brecv_k"][t, my]]
+            .set(bgot),
+            bwd_in)
+        return (fwd_in, bwd_in, stash, grads, loss), None
+
+    (_, _, _, grads, loss), _ = lax.scan(
+        tick, (fwd_in, bwd_in, stash, grads0, jnp.float32(0.0)),
+        jnp.arange(sched.T))
+    return grads, loss
+
+
+def make_1f1b(mesh: Mesh, stage_fn: Callable, axis: str = "pp",
+              v: int = 1, M: int = None):
+    """Returns step(params_stacked, x_mb, tgt_mb) -> (loss, grads).
+
+    params_stacked: leading dim n·v in interleave_stack order, sharded
+    P(axis). x_mb/tgt_mb: [M, rows, d], replicated. loss: mean-squared
+    error over every microbatch (scalar, replicated). grads: same
+    layout/sharding as params_stacked — exactly what an optimizer in the
+    same interleaved layout consumes.
+
+    The full 1F1B timeline — warmup forwards, strict steady-state
+    alternation, cooldown backwards, cotangents hopping the reverse
+    ring — is a single scan over the static instruction tables of
+    build_schedule(n, M, v)."""
+    n = mesh.shape[axis]
+    if M is None:
+        raise ValueError("M (microbatch count) is static — pass it")
+    sched = build_schedule(n, M, v)
+
+    def per_device(params_local, x_mb, tgt_mb):
+        leading = {a.shape[0] for a in jax.tree.leaves(params_local)}
+        if leading != {v}:
+            raise ValueError(
+                f"each device must hold v={v} chunks (stacked leading "
+                f"dim {n * v} over a {n}-way {axis!r} axis), got local "
+                f"leading dims {sorted(leading)}")
+        rows, dm = x_mb.shape[1], x_mb.shape[2]
+        grads, loss = run_schedule(
+            sched, stage_fn, params_local, x_mb, tgt_mb,
+            axis=axis, norm=float(M * rows * dm))
+        # Loss lives on the last device only; share the scalar.
+        return grads, lax.psum(loss, axis)
+
+    def step(params_stacked, x_mb, tgt_mb):
+        f = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P()),
+            check_vma=False,
+        )
+        grads, loss = f(params_stacked, x_mb, tgt_mb)
+        return loss, grads
+
+    step.schedule = sched
+    return step
+
+
+def sequential_loss(per_stage_params, x_mb, tgt_mb, stage_fn):
+    """Ground truth: stages in natural order on every microbatch, MSE
+    averaged over everything — jax.grad of THIS must equal the 1F1B
+    pipeline's hand-scheduled gradients."""
+    M, rows, dm = x_mb.shape
+    total = 0.0
+    for m in range(M):
+        h = x_mb[m]
+        for p in per_stage_params:
+            h = stage_fn(p, h)
+        total = total + jnp.sum((h - tgt_mb[m]) ** 2)
+    return total / (M * rows * dm)

@@ -105,6 +105,121 @@ def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
     return y + moe_out  # residual keeps gradients flowing past drops
 
 
+def interleave_params(params: Dict, pp: int, v: int) -> Dict:
+    """Reorder the stage-stacked leading dim (S = pp·v) so P('pp')
+    block-sharding realises the round-robin chunk placement the 1F1B
+    interleaved schedule needs (pipeline_1f1b.interleave_order, applied
+    to already-stacked leaves)."""
+    from .pipeline_1f1b import interleave_order
+
+    return jax.tree.map(lambda a: a[interleave_order(pp, v)], params)
+
+
+def uninterleave_params(params: Dict, pp: int, v: int) -> Dict:
+    from .pipeline_1f1b import interleave_order
+
+    inv = np.argsort(interleave_order(pp, v))
+    return jax.tree.map(lambda a: a[inv], params)
+
+
+def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
+                         lr: float = 0.05, M: int = None, v: int = 1):
+    """The five-axis training step with a HAND-SCHEDULED 1F1B pipeline
+    instead of GPipe+AD: same mesh, same stage math (_stage_fn with its
+    tp psum and ep all_to_all — jax.vjp differentiates those inside the
+    schedule executor), same loss/gradients as make_train_step and the
+    dense reference, but the pp dimension runs pipeline_1f1b's
+    instruction tables: in-flight activations bounded by the warmup
+    depth instead of the microbatch count, and v>1 interleaves chunks
+    to shrink the bubble.
+
+    Params: stage-stacked with leading dim S = pp·v in
+    interleave_params order (v=1 is the natural order). x/target:
+    [M, mb, seq, d] as in make_train_step.
+
+    Gradient sync is explicit here (the AD transpose that make_train_
+    step leans on does not see our masked scan): each leaf is psummed
+    over exactly the non-pp axes its spec omits — the same sums
+    shard_map's transpose would insert."""
+    from .pipeline_1f1b import build_schedule, run_schedule
+
+    pp = mesh.shape["pp"]
+    E = mesh.shape["ep"]
+    if M is None:
+        raise ValueError("M (microbatch count) is static — pass it")
+    sched = build_schedule(pp, M, v)
+
+    specs = param_specs()
+    non_pp = [a for a in AXES if a != "pp"]
+
+    def _axes_in(spec) -> set:
+        out = set()
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                out.update(entry)
+            else:
+                out.add(entry)
+        return out
+
+    sync_axes = {k: tuple(a for a in non_pp if a not in _axes_in(spec))
+                 for k, spec in specs.items()}
+
+    def per_device(params_local, x_loc, tgt_loc):
+        if jax.tree.leaves(params_local)[0].shape[0] != v:
+            raise ValueError(
+                f"each device must hold v={v} pipeline chunks "
+                f"(stacked leading dim {pp * v} over a {pp}-way pp "
+                f"axis), got "
+                f"{jax.tree.leaves(params_local)[0].shape[0]}")
+        Mx = x_loc.shape[0]
+        rows = x_loc.shape[1] * x_loc.shape[2]
+        d = x_loc.shape[3]
+        x_mb = x_loc.reshape(Mx, rows, d)
+        tgt_mb = tgt_loc.reshape(Mx, rows, d)
+
+        def stage(pp_params, x):
+            return _stage_fn(pp_params, x, E=E, tp_axis="tp",
+                             ep_axis="ep", capacity_factor=capacity_factor)
+
+        # Same normalizer as make_train_step: mean over the GLOBAL
+        # batch and the feature dim.
+        norm = float(rows * M * mesh.shape["dp"] * mesh.shape["sp"] * d)
+        # tp/ep replicate the stage compute within a data shard; the
+        # psum below would count every replica, so the cotangent carries
+        # the 1/R the AD transpose would apply (uniform across leaves —
+        # verified empirically against dense-reference gradients).
+        replicas = float(mesh.shape["tp"] * mesh.shape["ep"])
+        grads, loss = run_schedule(
+            sched, stage, params_local, x_mb, tgt_mb,
+            axis="pp", norm=norm, cot_scale=1.0 / replicas)
+        # Explicit grad sync: per leaf, the axes its spec omits (the
+        # sums the AD transpose inserts for replicated inputs).
+        grads = {k: lax.psum(g, sync_axes[k]) if sync_axes[k] else g
+                 for k, g in grads.items()}
+        loss = lax.psum(loss, ("pp", "dp", "sp"))
+        new_params = jax.tree.map(lambda p_, g: p_ - lr * g,
+                                  params_local, grads)
+        return loss, new_params
+
+    x_spec = P(None, "dp", "sp", None)
+
+    @jax.jit
+    def train_step(params, x, tgt):
+        f = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(param_specs(), x_spec, x_spec),
+            out_specs=(P(), param_specs()),
+            check_vma=False,
+        )
+        return f(params, x, tgt)
+
+    train_step.schedule = sched
+    return train_step
+
+
 def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
                     lr: float = 0.05):
     """Returns train_step(params, x, target) -> (loss, new_params).

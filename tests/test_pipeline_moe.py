@@ -265,3 +265,97 @@ def test_moe_composes_with_dp_axis():
                          shard_expert_params(w2, mesh, axis="ep")))
     ref = np.asarray(dense_reference(x, router_w, w1, w2))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+# -- 1F1B / interleaved pipeline schedules ------------------------------------
+
+
+def _1f1b_setup(n, M, v, d=12, rows=6, seed=11):
+    from dpu_operator_tpu.parallel.pipeline import demo_stage_params, mlp_stage
+    from dpu_operator_tpu.parallel.pipeline_1f1b import interleave_stack
+    from dpu_operator_tpu.parallel.pipeline import shard_stage_params
+
+    mesh = _mesh([("pp", n)])
+    per_stage = demo_stage_params(n * v, d, seed=seed)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed + 1))
+    x = jax.random.normal(k1, (M, rows, d))
+    tgt = jax.random.normal(k2, (M, rows, d))
+    stacked = shard_stage_params(interleave_stack(per_stage, n, v), mesh)
+    return mesh, per_stage, x, tgt, stacked, mlp_stage
+
+
+@pytest.mark.parametrize("n,M,v", [(4, 6, 1), (4, 8, 2), (2, 5, 3)])
+def test_1f1b_gradients_match_sequential_ad(n, M, v):
+    """The hand-scheduled 1F1B backward (rematerialize + VJP, cotangent
+    ring, static instruction tables) must produce the SAME loss and the
+    SAME gradients as jax.grad of the sequential reference — for the
+    classic v=1 schedule and interleaved v>1."""
+    from dpu_operator_tpu.parallel.pipeline_1f1b import (
+        make_1f1b, sequential_loss, uninterleave)
+
+    mesh, per_stage, x, tgt, stacked, stage_fn = _1f1b_setup(n, M, v)
+    step = jax.jit(make_1f1b(mesh, stage_fn, v=v, M=M))
+    loss, grads = step(stacked, x, tgt)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda ps: sequential_loss(ps, x, tgt, stage_fn))(per_stage)
+    assert np.isclose(float(loss), float(ref_loss), rtol=1e-5), (
+        float(loss), float(ref_loss))
+    # Pipeline grads come back stage-stacked in interleaved layout.
+    got = uninterleave(jax.tree.map(np.asarray, grads), n, v)
+    for i, ref in enumerate(ref_grads):
+        for key in ref:
+            np.testing.assert_allclose(
+                got[key][i], np.asarray(ref[key]), rtol=2e-4, atol=1e-6,
+                err_msg=f"grad mismatch at stage {i} key {key}")
+
+
+def test_1f1b_memory_is_bounded_by_depth_not_microbatches():
+    """THE 1F1B property: peak in-flight microbatches per device is the
+    warmup depth W_d = (v-1)n + (n-d), independent of M — GPipe's AD
+    backward stashes all M. Asserted from the scheduler's measured
+    high-water marks, for a deep M."""
+    from dpu_operator_tpu.parallel.pipeline_1f1b import build_schedule
+
+    n = 4
+    for M in (8, 32, 128):
+        s = build_schedule(n, M, v=1)
+        assert s.max_inflight.tolist() == [4, 3, 2, 1], (
+            M, s.max_inflight.tolist())
+        assert s.Ks <= 4, (M, s.Ks)  # stash slots, not O(M)
+
+
+def test_1f1b_bubble_matches_gpipe_and_interleaved_beats_it():
+    """Schedule accounting from the emitted tables: v=1 1F1B has
+    exactly GPipe's bubble (its win is memory, the textbook result);
+    interleaved v=2 must measurably beat it on the same (n, M·v) work."""
+    from dpu_operator_tpu.parallel.pipeline_1f1b import (
+        build_schedule, gpipe_bubble)
+
+    n, M = 4, 8
+    s1 = build_schedule(n, M, v=1)
+    assert np.isclose(s1.bubble, gpipe_bubble(n, M)), (
+        s1.bubble, gpipe_bubble(n, M))
+    s2 = build_schedule(n, M, v=2)
+    assert s2.bubble < s1.bubble, (s2.bubble, s1.bubble)
+    # And deeper interleaving keeps helping on bigger M.
+    s4 = build_schedule(n, 16, v=4)
+    assert s4.bubble < build_schedule(n, 16, v=1).bubble
+
+
+def test_1f1b_rejects_wrong_chunk_count():
+    from dpu_operator_tpu.parallel.pipeline_1f1b import make_1f1b
+
+    mesh = _mesh([("pp", 2)])
+    from dpu_operator_tpu.parallel.pipeline import (
+        demo_stage_params, mlp_stage, shard_stage_params,
+        stack_stage_params)
+
+    # 4 stages stacked onto a 2-way axis with v=1 → each device sees 2
+    # chunks where the schedule expects 1.
+    stacked = shard_stage_params(
+        stack_stage_params(demo_stage_params(4, 8)), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8))
+    step = make_1f1b(mesh, mlp_stage, v=1, M=2)
+    with pytest.raises(ValueError, match="v=1"):
+        step(stacked, x, x)
