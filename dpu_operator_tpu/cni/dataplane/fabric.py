@@ -263,11 +263,12 @@ class FabricDataplane:
             self._ipam_for(req)[0].release(
                 state.get("owner", f"{req.container_id}/{req.ifname}")
             )
-        except IpamError as e:
+        except (IpamError, ValueError) as e:
             # A delegated plugin's DEL can fail (binary gone, its store
-            # unreachable); DEL stays idempotent — the interface is
-            # already torn down, so log and continue rather than wedge
-            # the pod's teardown.
+            # unreachable), and a NAD edited to a malformed ipam.subnet
+            # raises ValueError from _ipam_for; DEL stays idempotent —
+            # the interface is already torn down, so log and continue
+            # rather than wedge the pod in Terminating.
             log.warning("ipam release failed on DEL: %s", e)
         self._store.delete(req.container_id, req.ifname)
         return {}, True

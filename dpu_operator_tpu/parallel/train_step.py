@@ -22,11 +22,17 @@ single-device reference:
         contraction (the Megatron pairing);
   ep  — a Switch MoE block per stage (the capacity-bucketed all_to_all
         dispatch of moe.py, inlined so the stage differentiates as one
-        body), experts sharded one-per-device. Within a (dp, sp) data
-        shard the activations are replicated across tp/ep — correct,
-        with redundant ep-side compute the standalone moe.py avoids by
-        token-sharding; the integration point favours one simple
-        x-spec over maximal efficiency.
+        body), experts sharded one-per-device. Activations are
+        TOKEN-SHARDED over ep (the sequence dim splits over ("sp",
+        "ep")) exactly as standalone moe.py prescribes: each ep device
+        routes its own distinct tokens, so the all_to_all dispatch
+        carries no duplicates and no device computes another's rows.
+        (Rounds ≤4 replicated activations across ep — every ep device
+        computed every token; `token_shard_ep=False` keeps that program
+        for comparison, and the dryrun measures the step-time gap.)
+        Within a token shard activations still replicate across tp —
+        the Megatron pairing: matmul FLOPs are weight-sharded, only
+        the elementwise glue is redundant.
 
 Everything — ppermute hops, tp psums, ep all_to_alls, the scan — is
 differentiated by jax.grad through shard_map; the test asserts loss
@@ -116,14 +122,14 @@ def interleave_params(params: Dict, pp: int, v: int) -> Dict:
 
 
 def uninterleave_params(params: Dict, pp: int, v: int) -> Dict:
-    from .pipeline_1f1b import interleave_order
+    from .pipeline_1f1b import uninterleave
 
-    inv = np.argsort(interleave_order(pp, v))
-    return jax.tree.map(lambda a: a[inv], params)
+    return uninterleave(params, pp, v)
 
 
 def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
-                         lr: float = 0.05, M: int = None, v: int = 1):
+                         lr: float = 0.05, M: int = None, v: int = 1,
+                         token_shard_ep: bool = True):
     """The five-axis training step with a HAND-SCHEDULED 1F1B pipeline
     instead of GPipe+AD: same mesh, same stage math (_stage_fn with its
     tp psum and ep all_to_all — jax.vjp differentiates those inside the
@@ -173,6 +179,10 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
                 f"(stacked leading dim {pp * v} over a {pp}-way pp "
                 f"axis), got "
                 f"{jax.tree.leaves(params_local)[0].shape[0]}")
+        if x_loc.shape[0] != M:
+            raise ValueError(
+                f"x carries {x_loc.shape[0]} microbatches but the "
+                f"schedule was built for M={M}")
         Mx = x_loc.shape[0]
         rows = x_loc.shape[1] * x_loc.shape[2]
         d = x_loc.shape[3]
@@ -185,12 +195,16 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
 
         # Same normalizer as make_train_step: mean over the GLOBAL
         # batch and the feature dim.
-        norm = float(rows * M * mesh.shape["dp"] * mesh.shape["sp"] * d)
-        # tp/ep replicate the stage compute within a data shard; the
+        data_shards = mesh.shape["dp"] * mesh.shape["sp"] * (
+            mesh.shape["ep"] if token_shard_ep else 1)
+        norm = float(rows * M * data_shards * d)
+        # Axes that REPLICATE the stage compute (vs shard data): the
         # psum below would count every replica, so the cotangent carries
         # the 1/R the AD transpose would apply (uniform across leaves —
-        # verified empirically against dense-reference gradients).
-        replicas = float(mesh.shape["tp"] * mesh.shape["ep"])
+        # verified empirically against dense-reference gradients). With
+        # token-sharded ep, only tp replicates.
+        replicas = float(mesh.shape["tp"] * (
+            1 if token_shard_ep else mesh.shape["ep"]))
         grads, loss = run_schedule(
             sched, stage, params_local, x_mb, tgt_mb,
             axis="pp", norm=norm, cot_scale=1.0 / replicas)
@@ -198,12 +212,14 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
         # sums the AD transpose inserts for replicated inputs).
         grads = {k: lax.psum(g, sync_axes[k]) if sync_axes[k] else g
                  for k, g in grads.items()}
-        loss = lax.psum(loss, ("pp", "dp", "sp"))
+        loss = lax.psum(loss, ("pp", "dp", "sp", "ep")
+                        if token_shard_ep else ("pp", "dp", "sp"))
         new_params = jax.tree.map(lambda p_, g: p_ - lr * g,
                                   params_local, grads)
         return loss, new_params
 
-    x_spec = P(None, "dp", "sp", None)
+    x_spec = (P(None, "dp", ("sp", "ep"), None) if token_shard_ep
+              else P(None, "dp", "sp", None))
 
     @jax.jit
     def train_step(params, x, tgt):
@@ -221,13 +237,17 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
 
 
 def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
-                    lr: float = 0.05):
+                    lr: float = 0.05, token_shard_ep: bool = True):
     """Returns train_step(params, x, target) -> (loss, new_params).
     x/target: [M, mb, seq, d] microbatches, mb sharded over dp and seq
-    over sp. One full forward (pipelined), one full backward (grad
-    through every collective, dp/sp sync via the replicated-input
-    transpose), one SGD update — the complete step, jitted as one
-    program."""
+    over ("sp", "ep") — every ep device owns DISTINCT tokens, so the
+    MoE dispatch carries no duplicate rows and the dense block does
+    1/ep of the per-shard FLOPs (the moe.py token-sharding, now at the
+    integration point; token_shard_ep=False keeps the old replicated
+    program for comparison). One full forward (pipelined), one full
+    backward (grad through every collective, dp/sp/ep sync via the
+    replicated-input transpose), one SGD update — the complete step,
+    jitted as one program."""
     S = mesh.shape["pp"]
     E = mesh.shape["ep"]
 
@@ -268,12 +288,17 @@ def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
         # scalar psum, instead of broadcasting the full [M, rows, d]
         # tensor across the pp axis (and its equally large transpose in
         # the backward pass) just to share a number.
-        n_global = rows * M * mesh.shape["dp"] * mesh.shape["sp"]
+        shards = mesh.shape["dp"] * mesh.shape["sp"] * (
+            mesh.shape["ep"] if token_shard_ep else 1)
+        n_global = rows * M * shards
         local = jnp.sum((out - tgt_mb) ** 2) / n_global / d
         local = jnp.where(my == S - 1, local, 0.0)
-        return lax.psum(local, ("pp", "dp", "sp"))
+        loss_axes = (("pp", "dp", "sp", "ep") if token_shard_ep
+                     else ("pp", "dp", "sp"))
+        return lax.psum(local, loss_axes)
 
-    x_spec = P(None, "dp", "sp", None)
+    x_spec = (P(None, "dp", ("sp", "ep"), None) if token_shard_ep
+              else P(None, "dp", "sp", None))
 
     def loss_fn(params, x, tgt):
         f = shard_map(
@@ -296,22 +321,27 @@ def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
 
 def dense_loss_reference(params: Dict, x, tgt,
                          capacity_factor: float = 4.0,
-                         shards: Dict[str, int] = None):
+                         shards: Dict[str, int] = None,
+                         token_shard_ep: bool = True):
     """Single-device ground truth of the SAME math, shard-faithfully:
-    the per-(dp,sp) shard MoE capacity and per-source bucketing are
-    reproduced so the comparison is exact, not merely approximate."""
+    the per-shard MoE capacity and per-source bucketing are reproduced
+    so the comparison is exact, not merely approximate. With
+    token_shard_ep (the production layout) the sequence dim splits over
+    sp·ep pieces, sp-major — each ep source buckets its own distinct
+    tokens, mirroring the ("sp", "ep") x-spec."""
     S, E = params["router"].shape[0], params["router"].shape[2]
     dp = (shards or {}).get("dp", 1)
     sp = (shards or {}).get("sp", 1)
+    seq_cuts = sp * ((shards or {}).get("ep", 1) if token_shard_ep else 1)
     M, mb, seq, d = x.shape
-    # Split into the same (dp, sp) shards the mesh uses.
+    # Split into the same shards the mesh uses.
     losses = []
     for di in range(dp):
-        for si in range(sp):
+        for si in range(seq_cuts):
             xs = x[:, di * (mb // dp):(di + 1) * (mb // dp),
-                   si * (seq // sp):(si + 1) * (seq // sp)]
+                   si * (seq // seq_cuts):(si + 1) * (seq // seq_cuts)]
             ts = tgt[:, di * (mb // dp):(di + 1) * (mb // dp),
-                     si * (seq // sp):(si + 1) * (seq // sp)]
+                     si * (seq // seq_cuts):(si + 1) * (seq // seq_cuts)]
             rows = xs.shape[1] * xs.shape[2]
             C = int(np.ceil(rows / E * capacity_factor))
             for m in range(M):

@@ -139,3 +139,37 @@ def test_five_axis_1f1b_step_matches_dense_reference(shape, v):
     # And the step descends.
     loss2, _ = step(new_params, x, tgt)
     assert float(loss2) < float(loss), (loss, loss2)
+
+
+def test_replicated_ep_compat_path_still_exact():
+    """token_shard_ep=False keeps the rounds-<=4 replicated program —
+    still gradient-exact against its own dense reference (the dryrun
+    uses the pair to measure what the token sharding buys)."""
+    from dpu_operator_tpu.parallel.train_step import (
+        dense_loss_reference, init_params, make_train_step, shard_params)
+
+    shape = {"dp": 1, "pp": 2, "sp": 1, "tp": 2, "ep": 2}
+    mesh = _mesh(shape)
+    d, h = 8, 16
+    M, mb, seq = 3, 4, 2
+    cf = float(shape["ep"])
+    params = init_params(shape["pp"], d, h, shape["ep"], seed=9)
+    x = jax.random.normal(jax.random.PRNGKey(8), (M, mb, seq, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(9), (M, mb, seq, d))
+
+    _, loss_fn = make_train_step(mesh, capacity_factor=cf,
+                                 token_shard_ep=False)
+    sharded = shard_params(params, mesh)
+    loss = float(loss_fn(sharded, x, tgt))
+    ref = float(dense_loss_reference(params, x, tgt, capacity_factor=cf,
+                                     shards=shape, token_shard_ep=False))
+    np.testing.assert_allclose(loss, ref, rtol=2e-5)
+    grads = jax.grad(loss_fn)(sharded, x, tgt)
+    ref_grads = jax.grad(
+        lambda p: dense_loss_reference(p, x, tgt, capacity_factor=cf,
+                                       shards=shape,
+                                       token_shard_ep=False))(params)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[key]), np.asarray(ref_grads[key]),
+            rtol=5e-4, atol=1e-6, err_msg=key)
