@@ -325,6 +325,12 @@ def run_schedule(sched: Schedule, stage_fn: Callable, params_local,
     loss_local) — loss is nonzero only on the device hosting the last
     chunk; the caller psums it."""
     n, v, S = sched.n, sched.v, sched.stages
+    if x_mb.shape[0] != sched.M:
+        # The schedule is baked for M microbatches; a clamped gather
+        # would silently train on duplicated/missing data.
+        raise ValueError(
+            f"x carries {x_mb.shape[0]} microbatches but the schedule "
+            f"was built for M={sched.M}")
     tb = {k: jnp.asarray(getattr(sched, k)) for k in
           ("op", "s", "m", "fin_k", "stash_k", "bin_k",
            "frecv_valid", "frecv_s", "frecv_k",
@@ -428,12 +434,6 @@ def make_1f1b(mesh: Mesh, stage_fn: Callable, axis: str = "pp",
                 f"each device must hold v={v} chunks (stacked leading "
                 f"dim {n * v} over a {n}-way {axis!r} axis), got local "
                 f"leading dims {sorted(leading)}")
-        if x_mb.shape[0] != M:
-            # The schedule is baked for M microbatches; a clamped
-            # gather would silently train on duplicated/missing data.
-            raise ValueError(
-                f"x carries {x_mb.shape[0]} microbatches but the "
-                f"schedule was built for M={M}")
         rows, dm = x_mb.shape[1], x_mb.shape[2]
         grads, loss = run_schedule(
             sched, stage_fn, params_local, x_mb, tgt_mb,

@@ -179,15 +179,12 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
                 f"(stacked leading dim {pp * v} over a {pp}-way pp "
                 f"axis), got "
                 f"{jax.tree.leaves(params_local)[0].shape[0]}")
-        if x_loc.shape[0] != M:
-            raise ValueError(
-                f"x carries {x_loc.shape[0]} microbatches but the "
-                f"schedule was built for M={M}")
-        Mx = x_loc.shape[0]
         rows = x_loc.shape[1] * x_loc.shape[2]
         d = x_loc.shape[3]
-        x_mb = x_loc.reshape(Mx, rows, d)
-        tgt_mb = tgt_loc.reshape(Mx, rows, d)
+        # run_schedule rejects a microbatch count differing from the
+        # schedule's static M.
+        x_mb = x_loc.reshape(x_loc.shape[0], rows, d)
+        tgt_mb = tgt_loc.reshape(x_loc.shape[0], rows, d)
 
         def stage(pp_params, x):
             return _stage_fn(pp_params, x, E=E, tp_axis="tp",

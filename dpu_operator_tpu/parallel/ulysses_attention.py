@@ -1,0 +1,154 @@
+"""Ulysses-style sequence parallelism — the all-to-all twin of ring
+attention.
+
+DeepSpeed-Ulysses' decomposition of long-context attention: instead of
+streaming K/V blocks around the ring (ring_attention.py), two
+all-to-alls re-shard the problem. Q/K/V arrive SEQUENCE-sharded
+[S/n, H, D]; the first all-to-all trades the sequence sharding for HEAD
+sharding, so each device holds the FULL sequence for H/n heads and runs
+plain exact attention locally (softmax over the whole sequence — causal
+masking is ordinary tril, global by construction); the second all-to-all
+trades back. Communication is 3 head-sharded exchanges in and 1 out,
+each moving S·H·D/n² per device pair — vs the ring's n hops of S/n
+blocks — and the local attention is one big MXU-friendly batched matmul
+instead of n folds.
+
+Which twin wins is a topology/shape question (heads available to split
+vs sequence length vs ICI bisection); a complete sp layer offers both,
+which is why this module exists next to ring_attention.py rather than
+replacing it (VERDICT r4 Next #7; no reference-repo analogue — the
+reference has no compute path, SURVEY §5).
+
+The exchanges ride ring_probe's collective family: the same
+`_pallas_all_to_all` remote-DMA kernel `make_all_to_all` wraps (RDMAs
+riding the torus on real multi-chip meshes), `lax.all_to_all` under XLA
+elsewhere, selected by `_axis_collective`'s shared detection. Softmax
+accumulates in f32 regardless of input dtype, matching ring attention's
+numerics so the two are interchangeable."""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .ring_probe import _axis_collective, _pallas_all_to_all
+
+try:  # pragma: no cover - mirrored from ring_attention
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _heads_to_rows(x):
+    """[S_loc, H, D] → [H, S_loc·D]: head-major rows, the 2D block
+    layout ring_probe's all-to-all exchanges (chunk i of the row dim =
+    head group i)."""
+    s, h, d = x.shape
+    return jnp.transpose(x, (1, 0, 2)).reshape(h, s * d)
+
+
+def _seq_to_head_shard(x2, n, s_loc, d):
+    """Post-exchange reshape: row chunk j arrived from device j and
+    carries MY head group's rows of ITS sequence shard — stack the
+    source shards in ring order to reconstruct the full sequence.
+    [H, S_loc·D] → [H/n, n·S_loc, D]."""
+    h = x2.shape[0]
+    return (x2.reshape(n, h // n, s_loc, d)
+            .transpose(1, 0, 2, 3)
+            .reshape(h // n, n * s_loc, d))
+
+
+def _full_attention(qh, kh, vh, causal: bool):
+    """Exact per-head attention over the full sequence, f32 softmax.
+    qh/kh: [h_loc, S, Dk], vh: [h_loc, S, Dv] → [h_loc, S, Dv]."""
+    S = qh.shape[1]
+    scale = 1.0 / math.sqrt(qh.shape[2])
+    s = jnp.einsum("hqd,hkd->hqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p, vh.astype(jnp.float32))
+
+
+def _ulysses_body(q, k, v, *, a2a, n: int, causal: bool):
+    """The per-device program: exchange → attend → exchange back."""
+    s_loc, H, dk = q.shape
+    dv = v.shape[2]
+    if H % n != 0:
+        raise ValueError(
+            f"Ulysses needs heads to split over the axis: H={H} "
+            f"not divisible by {n} (use ring attention below {n} heads)")
+    if k.shape != q.shape:
+        raise ValueError(f"k shape {k.shape} != q shape {q.shape}")
+    if v.shape[:2] != q.shape[:2]:
+        raise ValueError(
+            f"v leading dims {v.shape[:2]} != q's {q.shape[:2]}")
+    h_loc = H // n
+
+    qh = _seq_to_head_shard(a2a(_heads_to_rows(q)), n, s_loc, dk)
+    kh = _seq_to_head_shard(a2a(_heads_to_rows(k)), n, s_loc, dk)
+    vh = _seq_to_head_shard(a2a(_heads_to_rows(v)), n, s_loc, dv)
+
+    out = _full_attention(qh, kh, vh, causal)  # [h_loc, S, Dv] f32
+
+    # Inverse exchange: send sequence chunk j of my head group to
+    # device j; receive my sequence chunk of every head group, which
+    # stacks (group-major) back into the original H order.
+    x2 = (out.astype(q.dtype)
+          .reshape(h_loc, n, s_loc, dv)
+          .transpose(1, 0, 2, 3)
+          .reshape(H, s_loc * dv))
+    y = a2a(x2)
+    return (y.reshape(n, h_loc, s_loc, dv)
+            .transpose(2, 0, 1, 3)
+            .reshape(s_loc, H, dv))
+
+
+def make_ulysses_attention(
+    mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    use_pallas: Optional[bool] = None,
+):
+    """jitted fn(q, k, v), each [S, H, D*] with S sharded over `axis` →
+    exact multi-head attention [S, H, Dv], sharded the same way.
+    Requires H % axis_size == 0 (the head split IS the parallelism).
+    `causal=True` masks by global position — trivially, since each
+    device sees the whole sequence after the exchange."""
+    n = mesh.shape[axis]
+
+    def pallas_inner(q, k, v):
+        a2a = functools.partial(
+            _pallas_all_to_all, axis=axis, axis_size=n,
+            axis_names=tuple(mesh.axis_names))
+        return _ulysses_body(q, k, v, a2a=a2a, n=n, causal=causal)
+
+    def xla_inner(q, k, v):
+        def a2a(x2):
+            return jax.lax.all_to_all(
+                x2, axis, split_axis=0, concat_axis=0, tiled=True)
+        return _ulysses_body(q, k, v, a2a=a2a, n=n, causal=causal)
+
+    return _axis_collective(
+        mesh, axis, use_pallas, pallas_inner, xla_inner,
+        out_specs=P(axis, None, None),
+        in_specs=(P(axis, None, None), P(axis, None, None),
+                  P(axis, None, None)),
+    )
+
+
+def dense_attention_reference(q, k, v, causal: bool = False):
+    """Single-device ground truth: plain multi-head attention on the
+    full [S, H, D] arrays, f32 softmax — what both sp decompositions
+    (ring and Ulysses) must reproduce exactly."""
+    out = _full_attention(
+        jnp.transpose(q, (1, 0, 2)), jnp.transpose(k, (1, 0, 2)),
+        jnp.transpose(v, (1, 0, 2)), causal)
+    return jnp.transpose(out, (1, 0, 2)).astype(q.dtype)
