@@ -1,7 +1,7 @@
 # Build/test entry points (counterpart of the reference's Makefile +
 # taskfile.yaml task system).
 
-.PHONY: all native proto test fast-test e2e-test kind-test traffic-flow-tests \
+.PHONY: all native proto test fast-test e2e-test kind-test kind-lane traffic-flow-tests \
         traffic-flow-matrix bench \
         build-images deploy undeploy clean bundle bundle-check provision provision-dry
 
@@ -33,6 +33,13 @@ e2e-test:
 # (internal/testutils/kindcluster.go).
 kind-test:
 	python -m pytest tests/test_kind.py -q -rs
+
+# Artifact-producing variant: same tier, but the outcome is recorded as
+# KIND_r{N}.json next to the BENCH/MULTICHIP round artifacts — pass/fail
+# counts when a real apiserver is reachable, the honest skip reason when
+# not. Required CI lane wherever TEST_KUBECONFIG or docker exists.
+kind-lane:
+	python scripts/kind_lane.py
 
 traffic-flow-tests:
 	./hack/traffic_flow_tests.sh

@@ -64,7 +64,7 @@ def test_bench_json_contract():
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True,
         text=True,
-        timeout=300,
+        timeout=480,  # jax-over-fabric adds two worker startups (~50 s)
         cwd=REPO,
         env=env,
     )
@@ -197,3 +197,38 @@ def test_hybrid_inner_shape_grid_aligned():
     assert hybrid_inner_shape(16, v5e16, False) == axis_sizes(16)
     assert hybrid_inner_shape(8, v5e16, True) == axis_sizes(8)  # mismatch
     assert hybrid_inner_shape(16, None, True) == axis_sizes(16)
+
+
+def test_bench_operator_gates_trip_on_regression():
+    """VERDICT r4 Next #2's 'done' condition: a genuine operator-path
+    regression makes the bench fail (rc=1 comes from evaluate_gates
+    returning a False gate). Healthy sessions inside the measured noise
+    band pass; metrics with no artifact history get no gate at all."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    history = {"fabric_tcp_gbps": [18.9, 20.9],
+               "fabric_tcp_rr_tps": [139053.0, 152447.0],
+               "pod_attach_p50_ms": [3.758, 3.567, 4.594]}
+    # Healthy session (r4's own numbers): all gates true.
+    healthy = {"fabric_tcp_gbps": 18.9, "fabric_tcp_rr_tps": 152447.6,
+               "pod_attach_p50_ms": 4.594}
+    gates = bench.evaluate_gates(dict(healthy), history)
+    assert gates and all(gates.values()), gates
+    # Regressions: each metric tripping alone.
+    for key, bad in (("fabric_tcp_gbps", 10.0),
+                     ("fabric_tcp_rr_tps", 90000.0),
+                     ("pod_attach_p50_ms", 9.0)):
+        m = dict(healthy)
+        m[key] = bad
+        gates = bench.evaluate_gates(m, history)
+        assert not all(gates.values()), (key, gates)
+    # No history → no operator gates.
+    assert bench.evaluate_gates(dict(healthy), {}) == {}
+    # The real artifact files parse into usable history.
+    real = bench._artifact_history()
+    assert real.get("fabric_tcp_gbps") and real.get("pod_attach_p50_ms")

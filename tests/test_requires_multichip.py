@@ -1,0 +1,162 @@
+"""The multi-chip debt ledger (VERDICT r4 Next #8).
+
+Everything here runs the pallas RDMA collectives on REAL multi-chip ICI
+— no interpret mode, no virtual devices. This environment has ONE chip,
+so these tests skip with an honest reason; on the first multi-chip
+environment they are the FIRST thing to run (`pytest -m
+requires_multichip`), because interpret-mode semaphore/credit semantics
+are not Mosaic hardware semantics and every claim the README makes
+about the kernels' multi-chip behavior is bounded by exactly this
+suite's status.
+
+What interpret mode + AOT lowering + single-chip runs HAVE shown (the
+per-module test files): protocol correctness against XLA, Mosaic
+compilability for a TPU target, and single-device execution. What only
+this suite can show: real RDMA timing/ordering, semaphore waits against
+actual DMA completion, credit backpressure under real link latency.
+
+Each test runs in a subprocess with the default (axon/TPU) platform —
+the in-process test session is pinned to the virtual CPU mesh by
+conftest and must stay that way.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@functools.lru_cache(maxsize=1)
+def _real_tpu_chip_count() -> int:
+    """Count REAL TPU chips in a subprocess (the in-process jax is
+    pinned to CPU; and when the axon tunnel is down, an in-process
+    devices() call can block forever — the subprocess carries the
+    timeout). Cached and called LAZILY from inside the tests, never at
+    collection time — a down tunnel must not stall every unrelated
+    pytest run for the probe timeout."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "print(sum(1 for d in ds if d.platform != 'cpu'))"],
+            capture_output=True, text=True, timeout=120,
+            env={k: v for k, v in os.environ.items()
+                 if k not in ("JAX_PLATFORMS", "XLA_FLAGS")},
+        )
+        return int(r.stdout.strip().splitlines()[-1]) if r.returncode == 0 else 0
+    except Exception:
+        return 0
+
+
+multichip = pytest.mark.requires_multichip
+
+
+def _skip_unless_multichip() -> None:
+    chips = _real_tpu_chip_count()
+    if chips < 2:
+        pytest.skip(
+            f"needs >=2 REAL TPU chips for live-ICI pallas collectives, "
+            f"have {chips}; interpret-mode equivalence is already "
+            f"covered by the per-module tests")
+
+
+def _run_on_chips(body: str) -> dict:
+    """Run `body` (prints one JSON line) on the real chips."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", body], capture_output=True, text=True,
+        timeout=600, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-3000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+_PRELUDE = """
+import json
+import jax, numpy as np
+devs = [d for d in jax.devices() if d.platform != "cpu"]
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(devs).reshape(1, len(devs), 1), ("dp", "sp", "tp"))
+n = len(devs)
+"""
+
+
+@multichip
+def test_pallas_ring_collectives_live_ici():
+    """all-gather / reduce-scatter / all-to-all: pallas RDMA == XLA on
+    real links."""
+    _skip_unless_multichip()
+    out = _run_on_chips(_PRELUDE + """
+from dpu_operator_tpu.parallel.ring_probe import (
+    make_all_to_all, make_ring_all_gather, make_ring_reduce_scatter)
+import jax.numpy as jnp
+x = jax.device_put(jnp.arange(8 * n * 128, dtype=jnp.float32).reshape(-1, 128),
+                   NamedSharding(mesh, P("sp", None)))
+ok = True
+for mk in (make_ring_all_gather, make_ring_reduce_scatter, make_all_to_all):
+    a = np.asarray(mk(mesh, "sp", use_pallas=True)(x))
+    b = np.asarray(mk(mesh, "sp", use_pallas=False)(x))
+    ok = ok and np.allclose(a, b, rtol=1e-5, atol=1e-5)
+print(json.dumps({"ok": bool(ok)}))
+""")
+    assert out["ok"]
+
+
+@multichip
+def test_pallas_ring_attention_live_ici():
+    _skip_unless_multichip()
+    out = _run_on_chips(_PRELUDE + """
+from dpu_operator_tpu.parallel.ring_attention import make_ring_attention
+import jax.numpy as jnp
+S = 8 * n
+sh = NamedSharding(mesh, P("sp", None))
+q, k, v = (jax.device_put(jax.random.normal(jax.random.PRNGKey(i), (S, 128)), sh)
+           for i in range(3))
+a = np.asarray(make_ring_attention(mesh, "sp", causal=True, use_pallas=True)(q, k, v))
+b = np.asarray(make_ring_attention(mesh, "sp", causal=True, use_pallas=False)(q, k, v))
+print(json.dumps({"ok": bool(np.allclose(a, b, rtol=2e-5, atol=2e-5))}))
+""")
+    assert out["ok"]
+
+
+@multichip
+def test_pallas_ulysses_attention_live_ici():
+    _skip_unless_multichip()
+    out = _run_on_chips(_PRELUDE + """
+from dpu_operator_tpu.parallel.ulysses_attention import make_ulysses_attention
+import jax.numpy as jnp
+S, H = 8 * n, 2 * n
+sh = NamedSharding(mesh, P("sp", None, None))
+q, k, v = (jax.device_put(jax.random.normal(jax.random.PRNGKey(i), (S, H, 128)), sh)
+           for i in range(3))
+a = np.asarray(make_ulysses_attention(mesh, "sp", causal=True, use_pallas=True)(q, k, v))
+b = np.asarray(make_ulysses_attention(mesh, "sp", causal=True, use_pallas=False)(q, k, v))
+print(json.dumps({"ok": bool(np.allclose(a, b, rtol=2e-5, atol=2e-5))}))
+""")
+    assert out["ok"]
+
+
+@multichip
+def test_pallas_collective_matmul_live_ici():
+    _skip_unless_multichip()
+    out = _run_on_chips(_PRELUDE + """
+from dpu_operator_tpu.parallel.collective_matmul import (
+    make_allgather_matmul, make_matmul_reduce_scatter)
+import jax.numpy as jnp
+tp_mesh = Mesh(np.array(devs).reshape(1, 1, len(devs)), ("dp", "sp", "tp"))
+tp = len(devs)
+x = jax.device_put(jnp.arange(2 * tp * 128, dtype=jnp.float32).reshape(-1, 128) / 100.0,
+                   NamedSharding(tp_mesh, P("tp", None)))
+w = jax.device_put(jnp.arange(128 * 4 * tp, dtype=jnp.float32).reshape(128, -1) / 100.0,
+                   NamedSharding(tp_mesh, P(None, "tp")))
+a = np.asarray(make_allgather_matmul(tp_mesh, "tp", use_pallas=True)(x, w))
+b = np.asarray(make_allgather_matmul(tp_mesh, "tp", use_pallas=False)(x, w))
+print(json.dumps({"ok": bool(np.allclose(a, b, rtol=1e-4, atol=1e-4))}))
+""")
+    assert out["ok"]
