@@ -723,8 +723,17 @@ def main() -> int:
     if gates:
         metrics["perf_gates"] = gates
         if not all(gates.values()):
-            rc = 1
-            print(f"PERF GATE FAILED: {gates}", file=sys.stderr)
+            # DPU_BENCH_ADVISORY_GATES: report gate verdicts but keep
+            # rc 0 — for bench runs sharing the machine with a test
+            # suite, where throughput dips measure the NEIGHBORS, not a
+            # regression. The driver's standalone run (a quiet machine)
+            # never sets it, so real regressions still fail the round.
+            if os.environ.get("DPU_BENCH_ADVISORY_GATES") == "1":
+                print(f"PERF GATE failed (advisory mode): {gates}",
+                      file=sys.stderr)
+            else:
+                rc = 1
+                print(f"PERF GATE FAILED: {gates}", file=sys.stderr)
 
     p50 = metrics.get("pod_attach_p50_ms")
     print(

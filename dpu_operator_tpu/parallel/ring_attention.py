@@ -238,6 +238,28 @@ def _xla_ring_attention(
     return (o / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
 
 
+def xla_ring_attention_batched(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis, axis_size: int, causal: bool,
+) -> jax.Array:
+    """Batched form of the XLA ring recurrence for use INSIDE another
+    shard_map (train_step's attention block): q/k/v [B, S_loc, D*] —
+    independent sequences per batch element, one shared K/V ring.
+    `axis` may be a single mesh axis or a TUPLE of axes (the train
+    step's token dim shards over ("sp", "ep"); the flattened index
+    order equals the PartitionSpec's sp-major order, so global
+    causality holds across the combined ring). A vmap over the ONE
+    recurrence (_xla_ring_attention) — ppermute/axis_index have
+    batching rules, so the masking/online-softmax math exists exactly
+    once. Differentiable: static fori_loop bounds, so jax.grad flows
+    through the ppermutes (train_step's backward relies on it)."""
+    if k.shape[2] != q.shape[2] or k.shape[:2] != q.shape[:2]:
+        raise ValueError(f"k shape {k.shape} incompatible with q {q.shape}")
+    return jax.vmap(functools.partial(
+        _xla_ring_attention, axis=axis, axis_size=axis_size,
+        causal=causal))(q, k, v)
+
+
 def make_ring_attention(
     mesh,
     axis: str = "sp",

@@ -13,10 +13,13 @@ single-device reference:
         gradient test below proves the sync is exact, not approximate;
   pp  — GPipe microbatch pipeline (pipeline.py's scan/ppermute
         schedule) over the model's stages;
-  sp  — sequence sharding of activations; the stages here are
-        token-local (MLP + MoE), so sp composes exactly like extra
-        data parallelism — the ring-attention module owns the
-        cross-token case;
+  sp  — sequence sharding of activations. With attention=True every
+        stage opens with CAUSAL RING ATTENTION over the token axes
+        (ring_attention.xla_ring_attention_batched on the flattened
+        ("sp","ep") ring), so sp is a real cross-token axis inside the
+        integrated program — K/V blocks stream between shards and the
+        causal mask is global. Without attention the stages are
+        token-local and sp composes like extra data parallelism;
   tp  — each stage's dense layer column/row-sharded: y = relu(x@W1)@W2
         with W1 split on columns, W2 on rows, one psum closing the
         contraction (the Megatron pairing);
@@ -54,44 +57,64 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXES = ("dp", "pp", "sp", "tp", "ep")
 
 
-def init_params(S: int, d: int, h: int, E: int, seed: int = 0) -> Dict:
+def init_params(S: int, d: int, h: int, E: int, seed: int = 0,
+                attention: bool = False) -> Dict:
     """Stage-stacked params: dense tp pair + router + ep experts per
     stage. Leading dim S shards over pp; w1 cols / w2 rows over tp;
-    experts over ep."""
-    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
-    return {
+    experts over ep. attention=True adds single-head q/k/v projections
+    per stage (replicated) — the stage then opens with causal ring
+    attention over the token axes, making sp a REAL cross-token axis
+    in the integrated program."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 8)
+    params = {
         "w1": jax.random.normal(ks[0], (S, d, h)) / np.sqrt(d),
         "w2": jax.random.normal(ks[1], (S, h, d)) / np.sqrt(h),
         "router": jax.random.normal(ks[2], (S, d, E)) / np.sqrt(d),
         "moe_w1": jax.random.normal(ks[3], (S, E, d, h)) / np.sqrt(d),
         "moe_w2": jax.random.normal(ks[4], (S, E, h, d)) / np.sqrt(h),
     }
+    if attention:
+        for i, name in enumerate(("wq", "wk", "wv")):
+            params[name] = jax.random.normal(
+                ks[5 + i], (S, d, d)) / np.sqrt(d)
+    return params
 
 
-def param_specs() -> Dict:
-    return {
+def param_specs(attention: bool = False) -> Dict:
+    specs = {
         "w1": P("pp", None, "tp"),
         "w2": P("pp", "tp", None),
         "router": P("pp", None, None),
         "moe_w1": P("pp", "ep", None, None),
         "moe_w2": P("pp", "ep", None, None),
     }
+    if attention:
+        specs.update({name: P("pp", None, None)
+                      for name in ("wq", "wk", "wv")})
+    return specs
 
 
 def shard_params(params: Dict, mesh: Mesh) -> Dict:
+    specs = param_specs(attention="wq" in params)
     return {
-        k: jax.device_put(v, NamedSharding(mesh, param_specs()[k]))
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
     }
 
 
 def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
-              capacity_factor: float):
-    """One pipeline stage on LOCAL shards: Megatron-paired dense block
-    (w1 column-sharded, w2 row-sharded, psum closes the contraction)
-    then a Switch MoE over the ep axis (moe.switch_moe_local — the ONE
-    copy of the bucketing math). x: [rows_local, d]."""
+              capacity_factor: float, seq_shape=None, attn_axes=None,
+              attn_ring: int = 1):
+    """One pipeline stage on LOCAL shards: optional causal ring
+    attention over the token axes (when p carries wq/wk/wv — the
+    cross-token block that makes sp real in the integrated program),
+    then the Megatron-paired dense block (w1 column-sharded, w2
+    row-sharded, psum closes the contraction), then a Switch MoE over
+    the ep axis (moe.switch_moe_local — the ONE copy of the bucketing
+    math). x: [rows_local, d]; seq_shape = (mb_loc, seq_loc) un-flattens
+    it for attention (scores must never mix batch elements)."""
     from .moe import switch_moe_local
+    from .ring_attention import xla_ring_attention_batched
 
     if p["moe_w1"].shape[0] != 1 or p["moe_w2"].shape[0] != 1:
         raise ValueError(
@@ -102,6 +125,17 @@ def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
         raise ValueError(
             f"router width {p['router'].shape[1]} != {E} experts — "
             f"tokens routed past the mesh would silently drop")
+    if "wq" in p:
+        if seq_shape is None:
+            raise ValueError(
+                "attention params present but no seq_shape — the stage "
+                "cannot know where batch elements begin and end")
+        mb_loc, seq_loc = seq_shape
+        xr = x.reshape(mb_loc, seq_loc, x.shape[1])
+        attn = xla_ring_attention_batched(
+            xr @ p["wq"], xr @ p["wk"], xr @ p["wv"],
+            attn_axes, attn_ring, True)
+        x = (xr + attn).reshape(x.shape)  # pre-norm-style residual
     h = jax.nn.relu(x @ p["w1"])            # [rows, h/tp] local columns
     dense = lax.psum(h @ p["w2"], tp_axis)  # row-sharded w2 → psum
     y = jnp.tanh(dense)
@@ -129,7 +163,8 @@ def uninterleave_params(params: Dict, pp: int, v: int) -> Dict:
 
 def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
                          lr: float = 0.05, M: int = None, v: int = 1,
-                         token_shard_ep: bool = True):
+                         token_shard_ep: bool = True,
+                         attention: bool = False):
     """The five-axis training step with a HAND-SCHEDULED 1F1B pipeline
     instead of GPipe+AD: same mesh, same stage math (_stage_fn with its
     tp psum and ep all_to_all — jax.vjp differentiates those inside the
@@ -154,8 +189,11 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
     if M is None:
         raise ValueError("M (microbatch count) is static — pass it")
     sched = build_schedule(pp, M, v)
+    attn_axes = ("sp", "ep") if token_shard_ep else "sp"
+    attn_ring = mesh.shape["sp"] * (
+        mesh.shape["ep"] if token_shard_ep else 1)
 
-    specs = param_specs()
+    specs = param_specs(attention)
     non_pp = [a for a in AXES if a != "pp"]
 
     def _axes_in(spec) -> set:
@@ -181,6 +219,7 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
                 f"{jax.tree.leaves(params_local)[0].shape[0]}")
         rows = x_loc.shape[1] * x_loc.shape[2]
         d = x_loc.shape[3]
+        seq_shape = (x_loc.shape[1], x_loc.shape[2])
         # run_schedule rejects a microbatch count differing from the
         # schedule's static M.
         x_mb = x_loc.reshape(x_loc.shape[0], rows, d)
@@ -188,7 +227,10 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
 
         def stage(pp_params, x):
             return _stage_fn(pp_params, x, E=E, tp_axis="tp",
-                             ep_axis="ep", capacity_factor=capacity_factor)
+                             ep_axis="ep",
+                             capacity_factor=capacity_factor,
+                             seq_shape=seq_shape, attn_axes=attn_axes,
+                             attn_ring=attn_ring)
 
         # Same normalizer as make_train_step: mean over the GLOBAL
         # batch and the feature dim.
@@ -223,8 +265,8 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
         f = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(param_specs(), x_spec, x_spec),
-            out_specs=(P(), param_specs()),
+            in_specs=(specs, x_spec, x_spec),
+            out_specs=(P(), specs),
             check_vma=False,
         )
         return f(params, x, tgt)
@@ -234,32 +276,44 @@ def make_train_step_1f1b(mesh: Mesh, capacity_factor: float = 4.0,
 
 
 def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
-                    lr: float = 0.05, token_shard_ep: bool = True):
+                    lr: float = 0.05, token_shard_ep: bool = True,
+                    attention: bool = False):
     """Returns train_step(params, x, target) -> (loss, new_params).
     x/target: [M, mb, seq, d] microbatches, mb sharded over dp and seq
     over ("sp", "ep") — every ep device owns DISTINCT tokens, so the
     MoE dispatch carries no duplicate rows and the dense block does
     1/ep of the per-shard FLOPs (the moe.py token-sharding, now at the
     integration point; token_shard_ep=False keeps the old replicated
-    program for comparison). One full forward (pipelined), one full
-    backward (grad through every collective, dp/sp/ep sync via the
+    program for comparison). attention=True (params from
+    init_params(attention=True)) opens every stage with causal ring
+    attention over the token axes — sp (and ep when token-sharded)
+    become REAL cross-token axes, the K/V blocks streaming around the
+    combined ring. One full forward (pipelined), one full backward
+    (grad through every collective, dp/sp/ep sync via the
     replicated-input transpose), one SGD update — the complete step,
     jitted as one program."""
     S = mesh.shape["pp"]
     E = mesh.shape["ep"]
+    attn_axes = ("sp", "ep") if token_shard_ep else "sp"
+    attn_ring = mesh.shape["sp"] * (
+        mesh.shape["ep"] if token_shard_ep else 1)
 
     def per_device(params_local, x_loc, tgt_loc):
         p = jax.tree.map(lambda a: a[0], params_local)  # my stage
         M = x_loc.shape[0]
         rows = x_loc.shape[1] * x_loc.shape[2]
         d = x_loc.shape[3]
+        seq_shape = (x_loc.shape[1], x_loc.shape[2])
         x_mb = x_loc.reshape(M, rows, d)
         tgt_mb = tgt_loc.reshape(M, rows, d)
         my = lax.axis_index("pp")
 
         def stage(pp_params, x):
             return _stage_fn(pp_params, x, E=E, tp_axis="tp",
-                             ep_axis="ep", capacity_factor=capacity_factor)
+                             ep_axis="ep",
+                             capacity_factor=capacity_factor,
+                             seq_shape=seq_shape, attn_axes=attn_axes,
+                             attn_ring=attn_ring)
 
         zero_act = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
         zero_out = jnp.zeros_like(x_mb)
@@ -297,11 +351,13 @@ def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
     x_spec = (P(None, "dp", ("sp", "ep"), None) if token_shard_ep
               else P(None, "dp", "sp", None))
 
+    specs = param_specs(attention)
+
     def loss_fn(params, x, tgt):
         f = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(param_specs(), x_spec, x_spec),
+            in_specs=(specs, x_spec, x_spec),
             out_specs=P(),
             check_vma=False,
         )
@@ -316,6 +372,35 @@ def make_train_step(mesh: Mesh, capacity_factor: float = 4.0,
     return train_step, loss_fn
 
 
+def _dense_causal_attention(h, wq, wk, wv):
+    """Full-sequence single-head causal attention, per batch element —
+    the dense twin of the batched ring recurrence. h: [mb, seq, d]."""
+    q, k, v = h @ wq, h @ wk, h @ wv
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(q.shape[2])
+    mask = jnp.tril(jnp.ones((h.shape[1], h.shape[1]), bool))
+    s = jnp.where(mask[None], s, -1e30)
+    return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def _dense_moe_piece(h, p, E: int, C: int):
+    """Dense (non-distributed) twin of one seq piece's Megatron block +
+    Switch MoE with per-source capacity C. h: [rows, d]."""
+    dense = jnp.tanh(jax.nn.relu(h @ p["w1"]) @ p["w2"])
+    gate = jax.nn.softmax(dense @ p["router"], axis=-1)
+    expert = jnp.argmax(gate, axis=-1)
+    gval = jnp.max(gate, axis=-1)
+    onehot = jax.nn.one_hot(expert, E)
+    pos = jnp.cumsum(onehot, axis=0) - onehot
+    pos_tok = jnp.sum(pos * onehot, -1).astype(jnp.int32)
+    keep = (pos_tok < C).astype(dense.dtype)
+    eo = jnp.stack([
+        jax.nn.relu(dense @ p["moe_w1"][e]) @ p["moe_w2"][e]
+        for e in range(E)
+    ])  # [E, rows, d]
+    moe = jnp.take_along_axis(eo, expert[None, :, None], axis=0)[0]
+    return dense + moe * (gval * keep)[:, None]
+
+
 def dense_loss_reference(params: Dict, x, tgt,
                          capacity_factor: float = 4.0,
                          shards: Dict[str, int] = None,
@@ -325,44 +410,37 @@ def dense_loss_reference(params: Dict, x, tgt,
     so the comparison is exact, not merely approximate. With
     token_shard_ep (the production layout) the sequence dim splits over
     sp·ep pieces, sp-major — each ep source buckets its own distinct
-    tokens, mirroring the ("sp", "ep") x-spec."""
+    tokens, mirroring the ("sp", "ep") x-spec. Params carrying wq/wk/wv
+    open every stage with full-sequence causal attention (the dense
+    twin of the distributed program's ring over the token axes), so the
+    stage loop carries the WHOLE sequence and only the MoE bucketing
+    happens per seq piece."""
     S, E = params["router"].shape[0], params["router"].shape[2]
     dp = (shards or {}).get("dp", 1)
     sp = (shards or {}).get("sp", 1)
     seq_cuts = sp * ((shards or {}).get("ep", 1) if token_shard_ep else 1)
     M, mb, seq, d = x.shape
-    # Split into the same shards the mesh uses.
+    attention = "wq" in params
+    mb_loc = mb // dp
+    piece = seq // seq_cuts
+    rows = mb_loc * piece
+    C = int(np.ceil(rows / E * capacity_factor))
     losses = []
     for di in range(dp):
-        for si in range(seq_cuts):
-            xs = x[:, di * (mb // dp):(di + 1) * (mb // dp),
-                   si * (seq // seq_cuts):(si + 1) * (seq // seq_cuts)]
-            ts = tgt[:, di * (mb // dp):(di + 1) * (mb // dp),
-                     si * (seq // seq_cuts):(si + 1) * (seq // seq_cuts)]
-            rows = xs.shape[1] * xs.shape[2]
-            C = int(np.ceil(rows / E * capacity_factor))
-            for m in range(M):
-                h = xs[m].reshape(rows, d)
-                t_ = ts[m].reshape(rows, d)
-                for s in range(S):
-                    p = {k: v[s] for k, v in params.items()}
-                    dense = jnp.tanh(
-                        jax.nn.relu(h @ p["w1"]) @ p["w2"])
-                    logits = dense @ p["router"]
-                    gate = jax.nn.softmax(logits, axis=-1)
-                    expert = jnp.argmax(gate, axis=-1)
-                    gval = jnp.max(gate, axis=-1)
-                    onehot = jax.nn.one_hot(expert, E)
-                    pos = jnp.cumsum(onehot, axis=0) - onehot
-                    pos_tok = jnp.sum(pos * onehot, -1).astype(jnp.int32)
-                    keep = (pos_tok < C).astype(dense.dtype)
-                    eo = jnp.stack([
-                        jax.nn.relu(dense @ p["moe_w1"][e]) @ p["moe_w2"][e]
-                        for e in range(E)
-                    ])  # [E, rows, d]
-                    moe = jnp.take_along_axis(
-                        eo, expert[None, :, None], axis=0)[0]
-                    h = dense + moe * (gval * keep)[:, None]
-                losses.append(jnp.sum((h - t_) ** 2))
+        for m in range(M):
+            hm = x[m, di * mb_loc:(di + 1) * mb_loc]   # [mb_loc, seq, d]
+            tm = tgt[m, di * mb_loc:(di + 1) * mb_loc]
+            for s in range(S):
+                p = {k: v[s] for k, v in params.items()}
+                if attention:
+                    hm = hm + _dense_causal_attention(
+                        hm, p["wq"], p["wk"], p["wv"])
+                pieces = []
+                for si in range(seq_cuts):
+                    hs = hm[:, si * piece:(si + 1) * piece].reshape(rows, d)
+                    out = _dense_moe_piece(hs, p, E, C)
+                    pieces.append(out.reshape(mb_loc, piece, d))
+                hm = jnp.concatenate(pieces, axis=1)
+            losses.append(jnp.sum((hm - tm) ** 2))
     n_global = M * mb * seq
     return sum(losses) / n_global / d

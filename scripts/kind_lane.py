@@ -73,12 +73,16 @@ def main() -> int:
         m = re.search(rf"(\d+) {kind}", out_text)
         return int(m.group(1)) if m else 0
 
-    passed, failed, skipped = (count(k) for k in
-                               ("passed", "failed", "skipped"))
+    passed, failed, skipped, errors = (
+        count(k) for k in ("passed", "failed", "skipped", "error"))
     # "Met a real apiserver" is about EXECUTION, not outcome — a failing
     # real run still ran (and must be visible as such).
     ran_real = (passed + failed) > 0
-    infra_absent = passed == 0 and failed == 0 and skipped > 0
+    # Only a CLEAN pytest exit counts as an honest infra skip:
+    # collection/fixture errors exit nonzero and must not be laundered
+    # into "skipped because no cluster".
+    infra_absent = (rc == 0 and passed == 0 and failed == 0
+                    and skipped > 0)
     skip_reason = None
     if infra_absent:
         m = re.search(r"SKIPPED \[\d+\] [^:]+:\d+: (.+)", out_text)
@@ -90,13 +94,14 @@ def main() -> int:
         "lane": "kind",
         "cmd": " ".join(cmd),
         "rc": rc,
-        "ok": bool((ran_real and failed == 0 and not timed_out)
-                   or infra_absent),
+        "ok": bool(rc == 0 and (ran_real or infra_absent)
+                   and not timed_out),
         "ran_against_real_apiserver": bool(ran_real),
         "skipped": bool(infra_absent),
         "timed_out": timed_out,
         "passed": passed,
         "failed": failed,
+        "errors": errors,
         "skipped_count": skipped,
         **({"skip_reason": skip_reason} if skip_reason else {}),
         "tail": tail,

@@ -60,6 +60,12 @@ def test_bench_json_contract():
     # The on-chip section legitimately takes many minutes through the
     # tunnel; the contract under test is the JSON shape, not chip perf.
     env["DPU_BENCH_SKIP_TPU"] = "1"
+    # Gate verdicts are advisory here: this bench run shares the machine
+    # with the rest of the suite, so a throughput dip measures the
+    # neighbors. The trip-on-regression behavior is unit-tested in
+    # test_bench_operator_gates_trip_on_regression; the driver's
+    # standalone run keeps gates fatal.
+    env["DPU_BENCH_ADVISORY_GATES"] = "1"
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True,

@@ -157,6 +157,15 @@ w = jax.device_put(jnp.arange(128 * 4 * tp, dtype=jnp.float32).reshape(128, -1) 
                    NamedSharding(tp_mesh, P(None, "tp")))
 a = np.asarray(make_allgather_matmul(tp_mesh, "tp", use_pallas=True)(x, w))
 b = np.asarray(make_allgather_matmul(tp_mesh, "tp", use_pallas=False)(x, w))
-print(json.dumps({"ok": bool(np.allclose(a, b, rtol=1e-4, atol=1e-4))}))
+x2 = jax.device_put(jnp.arange(2 * tp * 4 * tp, dtype=jnp.float32)
+                    .reshape(2 * tp, -1) / 100.0,
+                    NamedSharding(tp_mesh, P(None, "tp")))
+w2 = jax.device_put(jnp.arange(4 * tp * 128, dtype=jnp.float32)
+                    .reshape(-1, 128) / 100.0,
+                    NamedSharding(tp_mesh, P("tp", None)))
+c = np.asarray(make_matmul_reduce_scatter(tp_mesh, "tp", use_pallas=True)(x2, w2))
+d = np.asarray(make_matmul_reduce_scatter(tp_mesh, "tp", use_pallas=False)(x2, w2))
+print(json.dumps({"ok": bool(np.allclose(a, b, rtol=1e-4, atol=1e-4)
+                             and np.allclose(c, d, rtol=1e-4, atol=1e-4))}))
 """)
     assert out["ok"]
