@@ -174,6 +174,19 @@ def test_nad_configs_are_valid_cni_json():
                     assert conf.get("cniVersion"), path
                     ipam = conf.get("ipam")
                     if ipam:
+                        itype = ipam.get("type")
+                        if itype and itype != "host-local":
+                            # Delegated to an external CNI IPAM plugin
+                            # (fabric._ipam_for): ITS grammar, not ours —
+                            # only the exec-safety rule applies, and the
+                            # RUNTIME predicate is the authority (the
+                            # ctor raises on a type the dpu-cni would
+                            # refuse to exec at pod-attach time).
+                            from dpu_operator_tpu.cni.ipam import (
+                                DelegatedIpam)
+
+                            DelegatedIpam(conf)  # raises IpamError if bad
+                            continue
                         unknown = set(ipam) - KNOWN_IPAM_KEYS
                         assert not unknown, f"{path}: unknown ipam keys {unknown}"
                         assert "subnet" in ipam, f"{path}: ipam without subnet"

@@ -261,3 +261,69 @@ def test_five_axis_1f1b_step_with_attention_matches_dense():
 
     loss2, _ = step(new_params, x, tgt)
     assert float(loss2) < float(loss), (loss, loss2)
+
+
+def test_attention_with_replicated_ep_and_interleaved_1f1b():
+    """The two shipped-but-otherwise-uncovered attention combinations:
+    (a) token_shard_ep=False — the ring runs over sp alone and ep
+    replicates the attention compute; (b) the 1F1B variant with v=2
+    interleaved chunks — attention params slice per chunk inside the
+    masked executor. Both must stay gradient-exact vs dense."""
+    from dpu_operator_tpu.parallel.train_step import (
+        dense_loss_reference, init_params, interleave_params,
+        make_train_step, make_train_step_1f1b, shard_params,
+        uninterleave_params)
+
+    # (a) replicated-ep attention, GPipe path.
+    shape = {"dp": 1, "pp": 1, "sp": 2, "tp": 1, "ep": 2}
+    mesh = _mesh(shape)
+    d, h = 8, 16
+    M, mb, seq = 2, 2, 4 * shape["sp"]
+    cf = float(shape["ep"])
+    params = init_params(1, d, h, shape["ep"], seed=21, attention=True)
+    x = jax.random.normal(jax.random.PRNGKey(22), (M, mb, seq, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(23), (M, mb, seq, d))
+    _, loss_fn = make_train_step(mesh, capacity_factor=cf,
+                                 token_shard_ep=False, attention=True)
+    sharded = shard_params(params, mesh)
+    loss = float(loss_fn(sharded, x, tgt))
+    ref = float(dense_loss_reference(params, x, tgt, capacity_factor=cf,
+                                     shards=shape, token_shard_ep=False))
+    np.testing.assert_allclose(loss, ref, rtol=2e-5)
+    grads = jax.grad(loss_fn)(sharded, x, tgt)
+    ref_grads = jax.grad(
+        lambda p: dense_loss_reference(p, x, tgt, capacity_factor=cf,
+                                       shards=shape,
+                                       token_shard_ep=False))(params)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[key]), np.asarray(ref_grads[key]),
+            rtol=1e-3, atol=1e-6, err_msg=f"replicated-ep {key}")
+
+    # (b) attention under interleaved 1F1B (v=2), token-sharded ep.
+    shape2 = {"dp": 1, "pp": 2, "sp": 1, "tp": 1, "ep": 2}
+    mesh2 = _mesh(shape2)
+    pp, v = shape2["pp"], 2
+    M2, mb2, seq2 = 4, 2, 4 * shape2["ep"]
+    params2 = init_params(pp * v, d, h, shape2["ep"], seed=25,
+                          attention=True)
+    x2 = jax.random.normal(jax.random.PRNGKey(26), (M2, mb2, seq2, d))
+    t2 = jax.random.normal(jax.random.PRNGKey(27), (M2, mb2, seq2, d))
+    step = make_train_step_1f1b(mesh2, capacity_factor=cf, lr=0.05,
+                                M=M2, v=v, attention=True)
+    sh2 = shard_params(interleave_params(params2, pp, v), mesh2)
+    loss2, newp2 = step(sh2, x2, t2)
+    ref2 = float(dense_loss_reference(params2, x2, t2, capacity_factor=cf,
+                                      shards=shape2))
+    np.testing.assert_allclose(float(loss2), ref2, rtol=2e-5)
+    ref_g2 = jax.grad(
+        lambda p: dense_loss_reference(p, x2, t2, capacity_factor=cf,
+                                       shards=shape2))(params2)
+    inter = interleave_params(params2, pp, v)
+    implied = uninterleave_params(
+        {k: (np.asarray(inter[k]) - np.asarray(newp2[k])) / 0.05
+         for k in params2}, pp, v)
+    for key in params2:
+        np.testing.assert_allclose(
+            implied[key], np.asarray(ref_g2[key]),
+            rtol=1e-3, atol=1e-6, err_msg=f"1f1b-v2 {key}")
