@@ -159,7 +159,8 @@ def test_nad_configs_are_valid_cni_json():
     it carries an `ipam` section — uses only keys the fabric dataplane's
     host-local grammar understands (a typo'd key would silently fall back
     to defaults in production)."""
-    from dpu_operator_tpu.cni.ipam import KNOWN_IPAM_KEYS
+    from dpu_operator_tpu.cni.ipam import (DelegatedIpam,
+                                       KNOWN_IPAM_KEYS)
 
     nads = 0
     for pattern in ("dpu_operator_tpu/controller/bindata/**/*.yaml",
@@ -182,9 +183,6 @@ def test_nad_configs_are_valid_cni_json():
                             # RUNTIME predicate is the authority (the
                             # ctor raises on a type the dpu-cni would
                             # refuse to exec at pod-attach time).
-                            from dpu_operator_tpu.cni.ipam import (
-                                DelegatedIpam)
-
                             DelegatedIpam(conf)  # raises IpamError if bad
                             continue
                         unknown = set(ipam) - KNOWN_IPAM_KEYS
