@@ -133,11 +133,34 @@ class FabricDataplane:
         owner = f"{req.container_id}/{req.ifname}"
 
         # Idempotent re-ADD: kubelet retries after timeouts.
-        if nl.link_exists(req.ifname, netns) and nl.link_exists(host_if):
-            state = self._store.load(req.container_id, req.ifname)
-            if state:
-                nl.release_named_netns(netns, netns_created)
-                return self._result_from_state(state)
+        if nl.link_exists(req.ifname, netns):
+            if nl.link_exists(host_if):
+                state = self._store.load(req.container_id, req.ifname)
+                if state:
+                    nl.release_named_netns(netns, netns_created)
+                    return self._result_from_state(state)
+            # Name taken in the pod netns but this is NOT our recorded
+            # attachment: a crash window left a plumbed-but-unrecorded
+            # interface (state save happens after plumbing), and no DEL
+            # can ever reach it — the stateless DEL path has no record
+            # to act on. Fail THIS ADD explicitly (the rename step
+            # below cannot be trusted to catch it: pre-4.10-era kernels
+            # rename INTO a duplicate name without EEXIST, observed on
+            # 4.4) — but reclaim the orphan first, as the old
+            # EEXIST+rollback path did implicitly, so the kubelet's
+            # retry finds a clean netns instead of wedging forever.
+            # CNI scopes ifname to this attachment within this netns,
+            # so the name is ours to reclaim.
+            for name, ns in ((req.ifname, netns), (host_if, None)):
+                try:
+                    nl.delete_link(name, ns)
+                except nl.NetlinkError:
+                    pass
+            nl.release_named_netns(netns, netns_created)
+            raise CniError(
+                f"{req.ifname} already existed in {req.netns} without "
+                f"recorded state (crashed prior ADD?); reclaimed — retry "
+                f"will re-plumb")
 
         try:
             mtu = req.config.get("mtu") or self._resolve_default_mtu()
@@ -196,7 +219,7 @@ class FabricDataplane:
             except Exception:
                 rollback_ipam = self._ipam
             self._rollback(host_if, tmp_if, req.ifname, netns, owner,
-                           rollback_ipam)
+                           rollback_ipam, release_netns=req.netns or "")
             nl.release_named_netns(netns, netns_created)
             raise CniError(f"fabric ADD failed: {e}") from e
 
@@ -234,12 +257,17 @@ class FabricDataplane:
             try:
                 ipam = self._ipam_for(req)[0]
                 if getattr(ipam, "delegated", False):
-                    ipam.release(f"{req.container_id}/{req.ifname}")
-            except (IpamError, ValueError) as e:
+                    ipam.release(f"{req.container_id}/{req.ifname}",
+                                 netns=req.netns or "")
+            except (IpamError, ValueError, OSError) as e:
                 # ValueError: a malformed NAD ipam.subnet raises from
                 # ipaddress inside _ipam_for — a bad config must not
                 # break DEL idempotency (the pod would wedge in
-                # Terminating on every kubelet retry).
+                # Terminating on every kubelet retry). OSError: belt
+                # and braces under the same guarantee — _exec wraps
+                # exec-time OSErrors in IpamError, but any filesystem
+                # error reaching here (binary probe, future edits) must
+                # not break DEL either.
                 log.warning("ipam release on stateless DEL failed: %s", e)
             return {}, False
         host_if = state.get("hostIf", "")
@@ -260,15 +288,24 @@ class FabricDataplane:
         # CNI guarantees DEL carries the same config as ADD, so the same
         # NAD-level allocator is resolved for the release.
         try:
-            self._ipam_for(req)[0].release(
-                state.get("owner", f"{req.container_id}/{req.ifname}")
-            )
-        except (IpamError, ValueError) as e:
+            ipam = self._ipam_for(req)[0]
+            owner_key = state.get("owner",
+                                  f"{req.container_id}/{req.ifname}")
+            if getattr(ipam, "delegated", False):
+                # Stateful DEL knows the attachment's netns — hand it
+                # to the plugin (dhcp-style plugins key lease identity
+                # on CNI_NETNS; "" would leak the lease).
+                ipam.release(owner_key,
+                             netns=state.get("netns") or req.netns or "")
+            else:
+                ipam.release(owner_key)
+        except (IpamError, ValueError, OSError) as e:
             # A delegated plugin's DEL can fail (binary gone, its store
-            # unreachable), and a NAD edited to a malformed ipam.subnet
-            # raises ValueError from _ipam_for; DEL stays idempotent —
-            # the interface is already torn down, so log and continue
-            # rather than wedge the pod in Terminating.
+            # unreachable, exec-time OSError on a corrupt binary that
+            # passed the X_OK probe), and a NAD edited to a malformed
+            # ipam.subnet raises ValueError from _ipam_for; DEL stays
+            # idempotent — the interface is already torn down, so log
+            # and continue rather than wedge the pod in Terminating.
             log.warning("ipam release failed on DEL: %s", e)
         self._store.delete(req.container_id, req.ifname)
         return {}, True
@@ -366,13 +403,21 @@ class FabricDataplane:
         return result
 
     def _rollback(self, host_if: str, tmp_if: str, ifname: str, netns: str,
-                  owner: str, ipam: Optional[HostLocalIpam] = None) -> None:
+                  owner: str, ipam: Optional[HostLocalIpam] = None,
+                  release_netns: str = "") -> None:
         for name, ns in ((tmp_if, netns), (ifname, netns), (tmp_if, None), (host_if, None)):
             try:
                 nl.delete_link(name, ns)
             except nl.NetlinkError:
                 pass
         try:
-            (ipam or self._ipam).release(owner)
+            target = ipam or self._ipam
+            if getattr(target, "delegated", False):
+                # Same contract as the DEL paths: a dhcp-style plugin
+                # keys the lease on CNI_NETNS — a rollback release with
+                # "" would leak the lease the failed ADD just took.
+                target.release(owner, netns=release_netns)
+            else:
+                target.release(owner)
         except Exception:
             pass

@@ -209,6 +209,16 @@ class DelegatedIpam:
         except subprocess.TimeoutExpired as e:
             raise IpamError(
                 f"delegated ipam {self.type} {command} timed out") from e
+        except OSError as e:
+            # A binary that passes the isfile/X_OK probe can still fail
+            # to exec (ENOEXEC on a corrupt file, EACCES on a
+            # mis-permissioned one). Re-raise inside the IPAM error
+            # contract: the DEL paths in dataplane/fabric.py catch
+            # IpamError to stay idempotent — a raw OSError there would
+            # wedge the pod in Terminating on every kubelet retry.
+            raise IpamError(
+                f"delegated ipam {self.type} {command} exec failed: "
+                f"{e}") from e
         if r.returncode != 0:
             # stderr IS the plugin's error contract — propagate it, not
             # just the exit code.
@@ -253,9 +263,16 @@ class DelegatedIpam:
                   if isinstance(r, dict) and r.get("dst")]
         return ips[0]["address"], ips[0].get("gateway"), routes
 
-    def release(self, owner: str) -> None:
+    def release(self, owner: str, netns: str = "") -> None:
         """DEL through the plugin. CNI DELs are idempotent/best-effort;
         a failure raises so the caller decides (the dataplane's DEL path
-        logs and continues, matching its host-local behavior)."""
+        logs and continues, matching its host-local behavior).
+
+        `netns` should carry the attachment's recorded netns whenever
+        the caller knows it (the stateful DEL path does): plugins that
+        key lease identity on CNI_NETNS — the dhcp daemon plugin
+        notably — fail the release or leak the lease when handed "".
+        The empty default exists only for the stateless-DEL fallback,
+        where no record survived to consult."""
         cid, ifname = self._split_owner(owner)
-        self._exec("DEL", cid, "", ifname)
+        self._exec("DEL", cid, netns or "", ifname)

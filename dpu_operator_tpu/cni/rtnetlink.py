@@ -154,6 +154,16 @@ def _rtnl_call(msg_type: int, flags: int, body: bytes) -> None:
                             # Missing CAP_NET_ADMIN here — let the caller
                             # retry via the CLI (documented contract).
                             raise RtnlUnavailable("EPERM from kernel")
+                        if err == _errno.EOPNOTSUPP:
+                            # This kernel rejects the message SHAPE (old
+                            # kernels EOPNOTSUPP modern attr nesting, e.g.
+                            # 4.4 on the veth-with-peer-netns create) —
+                            # a capability gap, not a semantic error: the
+                            # CLI encodes the same request in a form the
+                            # kernel accepts, so fall back like EPERM. A
+                            # genuinely unsupported OPERATION fails again
+                            # under `ip` and surfaces with full context.
+                            raise RtnlUnavailable("EOPNOTSUPP from kernel")
                         raise RtnlError(err, os.strerror(err))
                     return
                 if sq == seq and typ == NLMSG_DONE:

@@ -164,10 +164,14 @@ def cmd_add_nf(args, chan):
     # tagged with THIS chain's key fail the call; anything else that
     # surfaced concurrently (e.g. a racing pod attach's baseline-rule
     # failure on another port) is reported but not blamed on this add.
-    chain_tag = f"[nf:{args.mac0}->{args.mac1}]"
+    # Both sides are case-normalized: a VSP/dataplane that canonicalizes
+    # MAC case before building its issue key must still match the CLI's
+    # verbatim args, or a genuine chain failure would be classified
+    # unrelated and the command would return success (ADVICE r5 #4).
+    chain_tag = f"[nf:{args.mac0}->{args.mac1}]".lower()
     new = sorted(after - before)
-    mine = [d for d in new if chain_tag in d]
-    unrelated = [d for d in new if chain_tag not in d]
+    mine = [d for d in new if chain_tag in d.lower()]
+    unrelated = [d for d in new if chain_tag not in d.lower()]
     if mine:
         print(json.dumps({"chained": [args.mac0, args.mac1],
                           "degraded": mine,

@@ -1,0 +1,42 @@
+"""jax version compatibility for the parallel library.
+
+`shard_map` graduated from `jax.experimental.shard_map` to `jax.shard_map`
+(and its skip-the-replication-check kwarg was renamed `check_rep` →
+`check_vma`) across the jax versions this operator meets in the field:
+TPU-VM images pin new jax, CI containers often carry an older one. Every
+parallel module imports `shard_map` from here so the whole library —
+and the fabric capstone that rides it — runs on both spellings instead
+of ImportError'ing the entire test tier on older installs.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.5 spelling
+except ImportError:  # pragma: no cover - exercised on old-jax installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = set(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # builtins without introspectable sigs
+    _PARAMS = set()
+
+
+def shard_map(*args, **kwargs):
+    if ("check_vma" in kwargs and "check_vma" not in _PARAMS
+            and "check_rep" in _PARAMS):
+        kwargs = dict(kwargs)
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def axis_size(name):
+    """`jax.lax.axis_size` appeared after 0.4.x; `psum(1, axis)` is the
+    classic equivalent (traced size of the named mapped axis)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return lax.psum(1, name)

@@ -1,0 +1,522 @@
+"""Chunked, pipelined ring allreduce over the operator-built pod fabric.
+
+Why this exists (BENCH_r05 decomposition): the fabric dataplane moves
+~19 Gb/s of plain TCP between two pod netns, but the gloo CPU-collective
+backend JAX rides sustains only ~3 Gb/s of ring-allreduce algorithm
+bandwidth through the very same veth — 16% of its own wire. The other
+84% is collective-engine overhead, not transport: gloo runs one
+unpipelined stream per peer with default socket buffers and serializes
+recv → reduce → send. This module is the decompose-then-optimize answer:
+
+  * ``RingTransport`` owns raw TCP sockets between ring neighbours —
+    ``streams`` connections per direction, ``SO_SNDBUF``/``SO_RCVBUF``
+    raised so the kernel keeps the pipe full while userspace reduces,
+    ``TCP_NODELAY`` so segment boundaries never stall on Nagle.
+  * ``allreduce`` is the textbook segmented ring (reduce-scatter +
+    all-gather, 2(n-1) steps, each rank moving 2(n-1)/n · D wire bytes)
+    with three overlaps stacked: send ∥ recv (different sockets, full
+    duplex veth), recv ∥ reduce (chunk granularity: while numpy sums
+    chunk k the kernel buffer absorbs chunk k+1), and slice ∥ slice
+    (each segment is split across the streams, one worker thread pair
+    per stream — numpy ufuncs release the GIL on large arrays, so the
+    reduces genuinely run in parallel).
+  * ``exchange`` moves the exact same wire bytes through the exact same
+    socket/step/chunk structure with the reduce deleted — the RAW
+    TRANSPORT CEILING for the ring pattern.  bench.py records it next
+    to the allreduce so the artifact separates "what the sockets can
+    do" from "what the collective achieves" (the gap IS the overhead).
+
+The CLI entry point runs one rank inside a pod netns (bench.py launches
+one per namespace) and prints a single JSON result line, mirroring the
+fabric_worker protocol.  Tuning knobs are env-overridable
+(``DPU_RING_STREAMS``, ``DPU_RING_CHUNK_KB``, ``DPU_RING_SOCKBUF_KB``);
+the defaults are the measured optimum on the veth fabric, not guesses —
+see BASELINE.md, "JAX-collective-vs-wire gap (round-5 weak #1,
+decomposed and optimized)".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Measured on the veth fabric (16 MiB fp32, 2 ranks, 2-cpu node — the
+# CI/bench class): the collective is CPU-bound there, not wire-bound
+# (one-directional python TCP does 21 Gb/s; the bidirectional ring
+# pattern's ceiling is ~7 Gb/s/direction), so FEWER threads win —
+# 1 stream allreduces at ~3.7 Gb/s vs ~2.6 with 2 streams (repeated
+# quiet-box runs), and raw exchange shows the same ordering (5.4 vs
+# 4.3). The streams knob stays for CPU-rich hosts where the extra
+# sockets can overlap instead of contend. 1 MiB chunks are small
+# enough that the kernel buffer (4 MiB) hides a whole reduce, large
+# enough that syscall count doesn't dominate (512 KiB measured worse).
+DEFAULT_STREAMS = int(os.environ.get("DPU_RING_STREAMS", "1"))
+DEFAULT_CHUNK_BYTES = int(os.environ.get("DPU_RING_CHUNK_KB", "1024")) << 10
+DEFAULT_SOCKBUF = int(os.environ.get("DPU_RING_SOCKBUF_KB", "4096")) << 10
+_HELLO = struct.Struct("!II")  # (rank, stream index)
+
+
+class RingError(RuntimeError):
+    """Transport setup/exchange failure — callers fall back to gloo."""
+
+
+def _segment_bounds(n_elems: int, world: int) -> List[Tuple[int, int]]:
+    """Even contiguous partition of [0, n_elems) into `world` segments
+    (first n_elems % world segments get the extra element)."""
+    base, rem = divmod(n_elems, world)
+    bounds, off = [], 0
+    for r in range(world):
+        size = base + (1 if r < rem else 0)
+        bounds.append((off, off + size))
+        off += size
+    return bounds
+
+
+def _tune(sock: socket.socket, sockbuf: int) -> None:
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sockbuf)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, sockbuf)
+
+
+def _recv_exact(sock: socket.socket, view: memoryview) -> None:
+    while len(view):
+        n = sock.recv_into(view)
+        if n == 0:
+            raise RingError("peer closed mid-transfer")
+        view = view[n:]
+
+
+class RingTransport:
+    """Raw-socket ring between `world` processes, one fabric address
+    each. Rank r SENDS to rank (r+1) % world on `streams` dialled
+    connections and RECEIVES from rank (r-1) % world on `streams`
+    accepted connections — send and recv never share a socket, so the
+    two directions overlap for free on the full-duplex veth."""
+
+    def __init__(self, rank: int, world: int, bind_ip: str,
+                 peer_ips: Sequence[str], port: int = 9411,
+                 streams: int = DEFAULT_STREAMS,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 sockbuf: int = DEFAULT_SOCKBUF,
+                 io_timeout: float = 120.0):
+        if world < 1 or not (0 <= rank < world):
+            raise RingError(f"bad ring shape rank={rank} world={world}")
+        if len(peer_ips) != world:
+            raise RingError(
+                f"need {world} peer ips (indexed by rank), got {len(peer_ips)}")
+        self.rank, self.world = rank, world
+        self.bind_ip, self.port = bind_ip, port
+        # A peer entry is "ip" (ring-wide port) or "ip:port" (per-rank
+        # override — lets tests stack several ranks on loopback where
+        # all ranks share one address).
+        self.peer_addrs: List[Tuple[str, int]] = []
+        for spec in peer_ips:
+            ip, _, p = str(spec).partition(":")
+            self.peer_addrs.append((ip, int(p) if p else port))
+        self.streams = max(1, streams)
+        self.chunk_bytes = max(64 << 10, chunk_bytes)
+        self.sockbuf = sockbuf
+        # Data-socket timeout: a peer that stalls (or dies without
+        # closing) must surface as RingError — the documented
+        # fall-back-to-gloo signal — not hang the worker until some
+        # outer process timeout kills it.
+        self.io_timeout = io_timeout
+        self._send: List[socket.socket] = []
+        self._recv: List[socket.socket] = []
+        self._listener: Optional[socket.socket] = None
+
+    # -- wiring ----------------------------------------------------------
+
+    def connect(self, timeout: float = 30.0) -> None:
+        """Listen, dial next, accept from prev. Safe to call on every
+        rank concurrently: listeners come up before any dial is retried,
+        and dials back off until the peer's listener exists. On failure
+        every socket opened so far is closed before the raise — the
+        caller falls back to gloo in the same process, so a leaked
+        listener would squat the ring port for the process lifetime."""
+        if self.world == 1:
+            return
+        try:
+            self._connect(timeout)
+        except BaseException:
+            self.close()
+            raise
+
+    def _connect(self, timeout: float) -> None:
+        nxt = self.peer_addrs[(self.rank + 1) % self.world]
+        prev_rank = (self.rank - 1) % self.world
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.bind_ip, self.peer_addrs[self.rank][1]))
+        self._listener.listen(self.streams + 2)
+        self._listener.settimeout(timeout)
+
+        deadline = time.monotonic() + timeout
+        for idx in range(self.streams):
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RingError(
+                        f"rank {self.rank}: peer {nxt[0]}:{nxt[1]} "
+                        f"never came up")
+                s = socket.socket()
+                _tune(s, self.sockbuf)
+                # Bound the dial by the REMAINING deadline: a blackholed
+                # SYN (peer veth down, no RST) otherwise blocks for the
+                # kernel's full syn-retry cycle (~2 min), blowing way
+                # past the connect contract while refused-instantly is
+                # the only failure the deadline check would ever see.
+                s.settimeout(max(0.05, remaining))
+                try:
+                    s.connect(nxt)
+                    break
+                except OSError:
+                    s.close()
+                    time.sleep(0.05)
+            s.settimeout(self.io_timeout)
+            s.sendall(_HELLO.pack(self.rank, idx))
+            self._send.append(s)
+
+        accepted: dict = {}
+        try:
+            while len(accepted) < self.streams:
+                c, _ = self._listener.accept()
+                try:
+                    _tune(c, self.sockbuf)
+                    c.settimeout(self.io_timeout)
+                    hello = bytearray(_HELLO.size)
+                    _recv_exact(c, memoryview(hello))
+                    peer, idx = _HELLO.unpack(bytes(hello))
+                except BaseException:
+                    c.close()
+                    raise
+                if peer != prev_rank or idx in accepted:
+                    c.close()
+                    continue
+                accepted[idx] = c
+        except BaseException as e:
+            # Any accept-phase failure (timeout, half-sent hello, …)
+            # must release every socket taken so far — the caller keeps
+            # living in this process on the gloo fallback.
+            for s in accepted.values():
+                s.close()
+            if isinstance(e, socket.timeout):
+                raise RingError(
+                    f"rank {self.rank}: prev rank {prev_rank} "
+                    f"never dialled in")
+            raise
+        self._recv = [accepted[i] for i in range(self.streams)]
+
+    def close(self) -> None:
+        for s in self._send + self._recv + (
+                [self._listener] if self._listener else []):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._send, self._recv, self._listener = [], [], None
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- data movement ---------------------------------------------------
+    #
+    # The whole 2(n-1)-step schedule runs as ONE continuous flow: a
+    # persistent sender thread and receiver thread (per stream) walk the
+    # schedule with per-chunk dependency events instead of per-step
+    # barriers. This matters measurably: step barriers leave the sockets
+    # idle between 2·payload/n bursts, so every step re-enters TCP
+    # slow-start (net.ipv4.tcp_slow_start_after_idle=1 is the kernel
+    # default) and re-pays thread spawn latency — the flow rewrite
+    # moved the raw exchange 4.0 → 5.4 Gb/s on the 2-cpu veth fabric
+    # (quiet-box repeats; per-step-barrier numbers for the same
+    # schedule, payload, and sockets). The data
+    # dependency that remains is real and chunk-granular: schedule item
+    # k forwards exactly the segment item k-1 received (rs and ag
+    # included, across the phase boundary too), so send(k, chunk c)
+    # waits only on recv(k-1, chunk c)'s event.
+
+    def _schedule(self) -> List[Tuple[int, int, bool]]:
+        """(send_seg, recv_seg, reduce_in) per ring step: n-1
+        reduce-scatter steps then n-1 all-gather steps."""
+        n, r = self.world, self.rank
+        items = [((r - s) % n, (r - s - 1) % n, True) for s in range(n - 1)]
+        items += [((r - s + 1) % n, (r - s) % n, False)
+                  for s in range(n - 1)]
+        return items
+
+    def _run(self, flat: np.ndarray, scratch: np.ndarray,
+             do_reduce: bool) -> None:
+        if self.world == 1:
+            return
+        itemsize = flat.itemsize
+        chunk_elems = max(1, self.chunk_bytes // itemsize)
+        seg = _segment_bounds(flat.size, self.world)
+        items = self._schedule()
+
+        def chunks(bounds: Tuple[int, int]) -> List[Tuple[int, int]]:
+            lo, hi = bounds
+            return [(a, min(a + chunk_elems, hi))
+                    for a in range(lo, hi, chunk_elems)] or [(lo, hi)]
+
+        # events[k][c] fires when recv item k's chunk c is in `flat`
+        # (reduced or written through) — the send-side dependency.
+        events = [[threading.Event() for _ in chunks(seg[rcv])]
+                  for (_snd, rcv, _red) in items]
+        flat_raw = flat.view(np.uint8)
+        scratch_raw = scratch.view(np.uint8)
+        errors: List[BaseException] = []
+
+        def sender(stream: int) -> None:
+            try:
+                sock = self._send[stream]
+                for k, (snd, _rcv, _red) in enumerate(items):
+                    cl = chunks(seg[snd])
+                    for c in range(stream, len(cl), self.streams):
+                        if k > 0 and not events[k - 1][c].wait(60.0):
+                            raise RingError(
+                                f"rank {self.rank}: stalled waiting for "
+                                f"step {k - 1} chunk {c}")
+                        lo, hi = cl[c]
+                        sock.sendall(
+                            memoryview(flat_raw)[lo * itemsize:hi * itemsize])
+            except BaseException as e:
+                errors.append(e)
+
+        def receiver(stream: int) -> None:
+            try:
+                sock = self._recv[stream]
+                for k, (_snd, rcv, red) in enumerate(items):
+                    cl = chunks(seg[rcv])
+                    for c in range(stream, len(cl), self.streams):
+                        lo, hi = cl[c]
+                        span = memoryview(
+                            scratch_raw if (do_reduce and red) else flat_raw
+                        )[lo * itemsize:hi * itemsize]
+                        _recv_exact(sock, span)
+                        if do_reduce and red:
+                            np.add(flat[lo:hi], scratch[lo:hi],
+                                   out=flat[lo:hi])
+                        events[k][c].set()
+            except BaseException as e:
+                errors.append(e)
+                # Unblock the sender: it will fail on its own socket (or
+                # finish) instead of waiting the full stall timeout.
+                for ev_row in events:
+                    for ev in ev_row:
+                        ev.set()
+
+        self._spawn_join([(fn, i) for i in range(self.streams)
+                          for fn in (sender, receiver)], errors)
+
+    def _pair_run(self, flat: np.ndarray, scratch: np.ndarray,
+                  do_reduce: bool) -> None:
+        """world == 2 fast path, picked by measurement: the ring's wire
+        cost 2(n-1)/n · D degenerates to exactly D at n=2, so a direct
+        full-payload exchange moves the SAME bytes as reduce-scatter +
+        all-gather — but in one dependency-free phase instead of two
+        chained ones. On the 2-cpu fabric that is worth ~1.8× (the
+        2-step schedule allreduces at ~2.0 Gb/s, this path at ~3.7: the
+        chunk dependency chain costs an event wakeup per chunk on the
+        critical path; here both directions stream flat out). Each side
+        sends its whole buffer while reducing the peer's incoming
+        chunks into its own."""
+        itemsize = flat.itemsize
+        chunk_elems = max(1, self.chunk_bytes // itemsize)
+        cl = [(a, min(a + chunk_elems, flat.size))
+              for a in range(0, flat.size, chunk_elems)] or [(0, flat.size)]
+        flat_raw = flat.view(np.uint8)
+        scratch_raw = scratch.view(np.uint8)
+        # The reduce writes flat[c] in place, and flat[c] is also the
+        # send source — a chunk must be ON THE WIRE before it may be
+        # overwritten. The sender is never itself blocked on these
+        # events and the peer's copy must cross the wire first, so the
+        # receiver's wait is almost always already satisfied.
+        sent = [threading.Event() for _ in cl]
+        errors: List[BaseException] = []
+
+        def sender(stream: int) -> None:
+            try:
+                sock = self._send[stream]
+                for c in range(stream, len(cl), self.streams):
+                    lo, hi = cl[c]
+                    sock.sendall(
+                        memoryview(flat_raw)[lo * itemsize:hi * itemsize])
+                    sent[c].set()
+            except BaseException as e:
+                errors.append(e)
+                for ev in sent:
+                    ev.set()
+
+        def receiver(stream: int) -> None:
+            try:
+                sock = self._recv[stream]
+                for c in range(stream, len(cl), self.streams):
+                    lo, hi = cl[c]
+                    _recv_exact(sock, memoryview(scratch_raw)
+                                [lo * itemsize:hi * itemsize])
+                    if do_reduce:
+                        if not sent[c].wait(60.0):
+                            raise RingError(
+                                f"rank {self.rank}: send of chunk {c} "
+                                f"stalled")
+                        np.add(flat[lo:hi], scratch[lo:hi], out=flat[lo:hi])
+            except BaseException as e:
+                errors.append(e)
+
+        self._spawn_join([(fn, i) for i in range(self.streams)
+                          for fn in (sender, receiver)], errors)
+
+    @staticmethod
+    def _spawn_join(work, errors: List[BaseException]) -> None:
+        workers = [threading.Thread(target=fn, args=(i,), daemon=True)
+                   for fn, i in work]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        if errors:
+            raise RingError(f"ring transfer failed: {errors[0]!r}")
+
+    def allreduce(self, arr: np.ndarray, out: Optional[np.ndarray] = None,
+                  scratch: Optional[np.ndarray] = None) -> np.ndarray:
+        """Sum-allreduce of a same-shaped contiguous array across the
+        ring; returns the reduced array (input untouched). Segmented
+        ring: n-1 reduce-scatter steps then n-1 all-gather steps, fully
+        pipelined at chunk granularity. Callers in a loop should pass
+        `out`/`scratch` (same shape/dtype) — a fresh 2×payload
+        allocation per call costs real page-fault time at 16 MiB+."""
+        src = np.ascontiguousarray(arr)
+        if out is None:
+            out = np.empty_like(src)
+        np.copyto(out, src)
+        if self.world == 1:
+            return out
+        flat = out.reshape(-1)
+        if scratch is None:
+            scratch = np.empty_like(flat)
+        run = self._pair_run if self.world == 2 else self._run
+        run(flat, scratch.reshape(-1), do_reduce=True)
+        return out
+
+    def exchange(self, arr: np.ndarray,
+                 scratch: Optional[np.ndarray] = None) -> None:
+        """The allreduce's exact wire pattern — same schedule, same
+        chunking, same dependency structure, same sockets — with the
+        arithmetic deleted (every recv writes through). This is the raw
+        transport ceiling the allreduce number must be read against;
+        the input is clobbered by design."""
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if self.world == 1:
+            return
+        if self.world == 2:
+            self._pair_run(
+                flat,
+                flat if scratch is None else scratch.reshape(-1),
+                do_reduce=False)
+        else:
+            self._run(flat, flat, do_reduce=False)  # scratch unused
+
+    # -- accounting ------------------------------------------------------
+
+    def wire_bytes(self, payload_bytes: int) -> int:
+        """Per-rank wire cost of one allreduce/exchange of a
+        payload_bytes buffer: 2(n-1)/n · D (what each rank sends AND
+        receives) — the standard algorithm-bandwidth denominator, same
+        formula the gloo path reports, so the numbers compare 1:1."""
+        return 2 * (self.world - 1) * payload_bytes // self.world
+
+
+def bench_ring(transport: RingTransport, payload_bytes: int, iters: int,
+               mode: str = "allreduce") -> dict:
+    """Timed loop + correctness: rank r contributes full(r+1), so every
+    reduced element must equal n(n+1)/2 (exchange mode checks transfer
+    liveness only). Returns algorithm Gb/s over `iters` runs."""
+    elems = payload_bytes // 4
+    local = np.full((elems,), float(transport.rank + 1), np.float32)
+    out = np.empty_like(local)
+    scratch = np.empty_like(local)
+    ok = True
+    if mode == "allreduce":
+        want = transport.world * (transport.world + 1) / 2.0
+        out = transport.allreduce(local, out, scratch)  # warmup + check
+        ok = bool(np.all(out == want))
+    else:
+        np.copyto(scratch, local)
+        transport.exchange(scratch)  # warmup
+
+    t0 = time.perf_counter()
+    if mode == "allreduce":
+        for _ in range(iters):
+            out = transport.allreduce(local, out, scratch)
+        ok = ok and bool(np.all(out == transport.world
+                                * (transport.world + 1) / 2.0))
+    else:
+        for _ in range(iters):
+            transport.exchange(scratch)
+    elapsed = time.perf_counter() - t0
+    wire = transport.wire_bytes(elems * 4) * iters
+    return {
+        "ok": ok,
+        "mode": mode,
+        "elapsed_s": round(elapsed, 4),
+        "gbps": round(wire * 8 / elapsed / 1e9, 3) if elapsed else 0.0,
+        "streams": transport.streams,
+        "chunk_bytes": transport.chunk_bytes,
+        "sockbuf": transport.sockbuf,
+    }
+
+
+def main(argv=None) -> int:
+    """One ring rank, run inside its pod netns (bench.py launches one
+    per namespace). Prints exactly one JSON object on stdout; rc 0 iff
+    the transfer verified."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--bind-ip", required=True)
+    ap.add_argument("--peer-ips", required=True,
+                    help="comma-separated fabric IPs of ALL ranks, "
+                         "indexed by rank")
+    ap.add_argument("--port", type=int, default=9411)
+    ap.add_argument("--payload-mb", type=float, default=16.0)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--mode", choices=["raw", "allreduce"], default="raw")
+    ap.add_argument("--streams", type=int, default=DEFAULT_STREAMS)
+    ap.add_argument("--chunk-kb", type=int,
+                    default=DEFAULT_CHUNK_BYTES >> 10)
+    args = ap.parse_args(argv)
+
+    peer_ips = [p for p in args.peer_ips.split(",") if p]
+    mode = "allreduce" if args.mode == "allreduce" else "exchange"
+    try:
+        with RingTransport(args.rank, args.world, args.bind_ip, peer_ips,
+                           port=args.port, streams=args.streams,
+                           chunk_bytes=args.chunk_kb << 10) as t:
+            res = bench_ring(t, int(args.payload_mb * (1 << 20)),
+                             args.iters, mode=mode)
+    except RingError as e:
+        print(json.dumps({"ok": False, "error": str(e)[:300]}), flush=True)
+        return 1
+    res["rank"] = args.rank
+    print(json.dumps(res), flush=True)
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

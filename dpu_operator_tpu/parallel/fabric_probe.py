@@ -101,7 +101,9 @@ def probe_shardings(mesh):
 
 def _probe_step_shardmapped(params, batch):
     """Per-shard body. batch: [B/dp, S/sp, DIM] local block."""
-    sp_size = jax.lax.axis_size("sp")
+    from ._compat import axis_size
+
+    sp_size = axis_size("sp")
 
     def loss_fn(p):
         h = jnp.einsum(
@@ -141,7 +143,7 @@ def _probe_step_shardmapped(params, batch):
 
 def make_probe_train_step(mesh):
     """The jitted full fabric-validation step over `mesh`."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mapped = shard_map(
         _probe_step_shardmapped,

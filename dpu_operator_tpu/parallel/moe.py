@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from ._compat import shard_map  # jax-version-portable spelling
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

@@ -694,7 +694,7 @@ def _axis_collective(mesh, axis, use_pallas, pallas_inner, xla_inner,
     on detection or mapping args. `in_specs` defaults to the single
     axis-sharded operand the probe collectives take; two-operand fused
     kernels pass their own tuple."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     axis_size = mesh.shape[axis]
     if use_pallas is None:

@@ -224,6 +224,15 @@ def test_fabric_ctl_add_nf_attributes_degradations(tmp_root, capsys):
         assert fabric_ctl(["--socket", sock, "add-nf", mac0, mac1]) == 1
         out = json.loads(capsys.readouterr().out)
         assert out["degraded"] and not out["unrelated_degradations"], out
+        # Attribution survives MAC-format normalization (ADVICE r5 #4):
+        # operator typed uppercase, VSP canonicalized to lowercase — a
+        # genuine chain failure must still be blamed on this chain.
+        vsp.degradations = []
+        vsp.inject = f"[nf:{mac0}->{mac1}] NF flow programming failed: boom"
+        assert fabric_ctl(["--socket", sock, "add-nf",
+                           mac0.upper(), mac1.upper()]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["degraded"] and not out["unrelated_degradations"], out
     finally:
         server.stop()
 

@@ -539,6 +539,7 @@ def test_jax_distributed_collectives_over_operator_fabric(stack):
                      "--process-id", str(i), "--num-processes", "2",
                      "--coordinator", coord, "--bind-ip", ips[i],
                      "--payload-mb", "4", "--iters", "5",
+                     "--peer-ips", ",".join(ips),
                      "--devices",
                      ",".join(d.host_path for d in cresps[i].devices)],
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -565,6 +566,12 @@ def test_jax_distributed_collectives_over_operator_fabric(stack):
             for i, r in enumerate(results):
                 assert r["ok"] and r["psum_ok"], r
                 assert r["process_count"] == 2 and r["n_devices"] == 2, r
+                # With --peer-ips wired, the custom pipelined ring
+                # transport must actually carry the headline allreduce
+                # (a silent fall-back to gloo would quietly undo the
+                # ISSUE-1 optimization while staying green).
+                assert r["collective_transport"] == "ring", r
+                assert r["ring_ok"], r
                 assert r["train_matches_dense"] and r["train_loss_descends"], r
                 assert r["devices_opened"] == [
                     d.host_path for d in cresps[i].devices], r

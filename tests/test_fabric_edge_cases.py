@@ -265,6 +265,96 @@ def test_delegated_ipam_missing_binary_is_clear(dataplane, pod_ns,
         dataplane.cmd_add(req)
 
 
+def _seed_delegated_state(dataplane, req):
+    """Record an attachment as if a delegated ADD had completed — the
+    DEL-path behaviors under test must hold regardless of whether THIS
+    environment can build the veth (hostIf points nowhere, so cmd_del
+    skips link teardown and goes straight to the IPAM release)."""
+    dataplane._store.save(req.container_id, req.ifname, {
+        "containerId": req.container_id,
+        "ifname": req.ifname,
+        "hostIf": "vepnonexistent",
+        "mac": "02:00:00:00:00:99",
+        "address": "10.91.0.7/24",
+        "gateway": "10.91.0.1",
+        "netns": req.netns,
+        "owner": f"{req.container_id}/{req.ifname}",
+        "sandbox": req.netns,
+    })
+
+
+def test_corrupt_delegated_binary_does_not_break_del_idempotency(
+        dataplane, tmp_path, monkeypatch):
+    """ADVICE r5 #1: a plugin binary that passes the isfile/X_OK probe
+    but fails to EXEC (ENOEXEC on a corrupt file) raises OSError from
+    subprocess — which must surface as IpamError and be swallowed by
+    both DEL paths, or every kubelet DEL retry re-raises and the pod
+    wedges in Terminating."""
+    bindir = tmp_path / "cnibin"
+    bindir.mkdir()
+    plug = bindir / "whereabouts"
+    # No shebang, not ELF: execve returns ENOEXEC while the isfile/X_OK
+    # probe still passes.
+    plug.write_bytes(b"\x00\x01corrupt\x02")
+    plug.chmod(0o755)
+    monkeypatch.setenv("CNI_PATH", str(bindir))
+
+    req = _delegated_req("ipam-ns-del", tmp_path)
+    _seed_delegated_state(dataplane, req)
+
+    # Stateful DEL: must drop the record and report released despite
+    # the plugin exec failure.
+    _, released = dataplane.cmd_del(_del_with_conf(req))
+    assert released, "exec-failed plugin release broke the DEL gate"
+    assert dataplane._store.load(req.container_id, req.ifname) is None
+
+    # Stateless DEL (kubelet retry after the state was dropped): same
+    # request again must stay idempotent, not raise.
+    _, released = dataplane.cmd_del(_del_with_conf(req))
+    assert released is False
+
+    # And the failure really is the exec-OSError path, wrapped in the
+    # IPAM error contract (not a bare OSError escaping).
+    from dpu_operator_tpu.cni.ipam import DelegatedIpam
+    with pytest.raises(IpamError, match="exec failed"):
+        DelegatedIpam(req.config).release(
+            f"{req.container_id}/net1", netns=req.netns)
+
+
+def test_delegated_release_carries_attachment_netns(
+        dataplane, tmp_path, monkeypatch):
+    """ADVICE r5 #2: the stateful DEL knows the pod netns — the plugin
+    must see it in CNI_NETNS (dhcp-style plugins key lease identity on
+    it; "" leaks the lease). The stateless fallback, with no record or
+    request netns to consult, keeps ""."""
+    bindir = tmp_path / "cnibin"
+    bindir.mkdir()
+    plug = bindir / "whereabouts"
+    plug.write_text(FAKE_IPAM)
+    plug.chmod(0o755)
+    log = tmp_path / "ipam.log"
+    monkeypatch.setenv("CNI_PATH", str(bindir))
+    monkeypatch.setenv("IPAM_LOG", str(log))
+
+    req = _delegated_req("ipam-ns-keep", tmp_path)
+    _seed_delegated_state(dataplane, req)
+    dataplane.cmd_del(_del_with_conf(req))
+    dels = [e for e in log.read_text().strip().splitlines()
+            if e.startswith("cmd=DEL")]
+    assert dels, "plugin never saw the DEL"
+    assert f"netns={req.netns}" in dels[0], (
+        f"plugin DEL saw the wrong CNI_NETNS: {dels[0]}")
+
+    # Stateless DEL with no netns on the request: "" is all that's left.
+    log.write_text("")
+    bare = _del_with_conf(req)
+    bare.netns = ""
+    dataplane.cmd_del(bare)
+    dels = [e for e in log.read_text().strip().splitlines()
+            if e.startswith("cmd=DEL")]
+    assert dels and dels[0].endswith("netns="), dels
+
+
 def test_nad_level_ipam_config_drives_allocation(dataplane, pod_ns):
     """A NetworkAttachmentDefinition carrying its own `ipam` section
     (subnet + rangeStart + routes) allocates from THAT range — not the

@@ -159,7 +159,7 @@ def test_hybrid_mesh_dcn_outermost():
         assert {d.id // 4 for d in mesh.devices[i].flat} == {i}
 
     # Cross-slice gradient sync: mean over dcn+dp of per-device values.
-    from jax import shard_map
+    from dpu_operator_tpu.parallel._compat import shard_map
 
     x = jnp.arange(8.0).reshape(8, 1)
     xs = jax.device_put(
@@ -219,16 +219,33 @@ def test_bench_operator_gates_trip_on_regression():
 
     history = {"fabric_tcp_gbps": [18.9, 20.9],
                "fabric_tcp_rr_tps": [139053.0, 152447.0],
-               "pod_attach_p50_ms": [3.758, 3.567, 4.594]}
-    # Healthy session (r4's own numbers): all gates true.
+               "pod_attach_p50_ms": [3.758, 3.567, 4.594],
+               "fabric_jax_allreduce_gbps": [3.017, 6.1],
+               "fabric_udp_gbps": [12.9, 12.202, 10.964],
+               "fabric_clusterip_tcp_gbps": [18.5, 20.006],
+               "pod_attach_concurrent_per_s": [142.2, 131.0, 103.3, 107.2]}
+    # Healthy session (r4/r5's own numbers): all gates true.
     healthy = {"fabric_tcp_gbps": 18.9, "fabric_tcp_rr_tps": 152447.6,
-               "pod_attach_p50_ms": 4.594}
+               "pod_attach_p50_ms": 4.594,
+               "fabric_jax_allreduce_gbps": 6.0,
+               "fabric_udp_gbps": 10.964,
+               "fabric_clusterip_tcp_gbps": 20.006,
+               "pod_attach_concurrent_per_s": 107.2}
     gates = bench.evaluate_gates(dict(healthy), history)
     assert gates and all(gates.values()), gates
+    # The previously-ungated metrics (ISSUE 1) each carry a gate now.
+    for label in ("allreduce_ge_085_median", "fabric_udp_ge_085_median",
+                  "clusterip_ge_085_median",
+                  "concurrent_attach_ge_085_median"):
+        assert label in gates, gates
     # Regressions: each metric tripping alone.
     for key, bad in (("fabric_tcp_gbps", 10.0),
                      ("fabric_tcp_rr_tps", 90000.0),
-                     ("pod_attach_p50_ms", 9.0)):
+                     ("pod_attach_p50_ms", 9.0),
+                     ("fabric_jax_allreduce_gbps", 2.0),
+                     ("fabric_udp_gbps", 6.0),
+                     ("fabric_clusterip_tcp_gbps", 11.0),
+                     ("pod_attach_concurrent_per_s", 60.0)):
         m = dict(healthy)
         m[key] = bad
         gates = bench.evaluate_gates(m, history)
@@ -238,3 +255,7 @@ def test_bench_operator_gates_trip_on_regression():
     # The real artifact files parse into usable history.
     real = bench._artifact_history()
     assert real.get("fabric_tcp_gbps") and real.get("pod_attach_p50_ms")
+    # Every newly gated metric has real artifact history to gate against.
+    for key in ("fabric_udp_gbps", "fabric_clusterip_tcp_gbps",
+                "pod_attach_concurrent_per_s", "fabric_jax_allreduce_gbps"):
+        assert real.get(key), key

@@ -125,7 +125,7 @@ def test_moe_top2_rank_priority_under_pressure():
     its primary expert's contribution whenever primaries are evenly
     spread."""
     import jax.numpy as jnp
-    from jax import shard_map
+    from dpu_operator_tpu.parallel._compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dpu_operator_tpu.parallel.moe import switch_moe_local

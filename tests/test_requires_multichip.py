@@ -31,6 +31,30 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _tpu_plausible() -> bool:
+    """Cheap pre-probe before committing a subprocess to TPU device
+    discovery: a local chip shows up as /dev/accel* or /dev/vfio, and
+    the axon tunnel serves 127.0.0.1:{8082..8117}. Where NONE of those
+    exist, jax.devices() can only block until the 120 s probe timeout —
+    pure wall-time (measured: the single biggest line item in the
+    suite, docs/ci.md) — so answer 'no chips' immediately. The whole
+    documented port range is scanned (not a sample): a closed local
+    port refuses in microseconds, so even the all-down case costs
+    nothing next to the probe it guards."""
+    import glob
+    import socket
+
+    if glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"):
+        return True
+    for port in range(8082, 8118):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return True
+        except OSError:
+            continue
+    return False
+
+
 @functools.lru_cache(maxsize=1)
 def _real_tpu_chip_count() -> int:
     """Count REAL TPU chips in a subprocess (the in-process jax is
@@ -39,6 +63,8 @@ def _real_tpu_chip_count() -> int:
     timeout). Cached and called LAZILY from inside the tests, never at
     collection time — a down tunnel must not stall every unrelated
     pytest run for the probe timeout."""
+    if not _tpu_plausible():
+        return 0
     try:
         r = subprocess.run(
             [sys.executable, "-c",

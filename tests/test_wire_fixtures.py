@@ -58,21 +58,32 @@ class FixtureApiServer:
                 pass
 
             def _reply(self, code, body_bytes, chunked=False):
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                if chunked:
-                    self.send_header("Transfer-Encoding", "chunked")
-                else:
-                    self.send_header("Content-Length", str(len(body_bytes)))
-                self.end_headers()
-                if chunked:
-                    for line in body_bytes:
-                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
-                        self.wfile.flush()
-                        time.sleep(0.01)
-                    self.wfile.write(b"0\r\n\r\n")
-                else:
-                    self.wfile.write(body_bytes)
+                # A client that got what it wanted from a watch stream
+                # closes mid-frame; the resulting EPIPE is the normal
+                # end of a fixture exchange, not a failure — swallowing
+                # it here keeps teardown output clean (a raised
+                # BrokenPipeError would splat a traceback from the
+                # server thread over the test summary).
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    if chunked:
+                        self.send_header("Transfer-Encoding", "chunked")
+                    else:
+                        self.send_header(
+                            "Content-Length", str(len(body_bytes)))
+                    self.end_headers()
+                    if chunked:
+                        for line in body_bytes:
+                            self.wfile.write(
+                                b"%x\r\n%s\r\n" % (len(line), line))
+                            self.wfile.flush()
+                            time.sleep(0.01)
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        self.wfile.write(body_bytes)
+                except BrokenPipeError:
+                    pass
 
             def _handle(self, method):
                 srv.requests.append((method, self.path))
