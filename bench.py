@@ -713,6 +713,44 @@ def bench_pod_context() -> dict:
     return out
 
 
+def bench_serving() -> dict:
+    """The serving plane (ISSUE 2): open-/closed-loop load over the real
+    HTTP front-end with a continuous-batching scheduler behind it, plus
+    the serial batch=1 baseline that prices the batching win, plus an
+    overload section that must shed with 503s while holding bounded p99
+    for admitted work. Runs in a subprocess pinned to the virtual CPU
+    platform (same reasoning as bench_virtual_ring: the axon tunnel must
+    not wedge the bench, and the plane under test is the scheduler/HTTP
+    machinery, not the chip — serving/bench_serving.py documents the
+    fixed-step-cost decomposition)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+    env.pop("XLA_FLAGS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "dpu_operator_tpu.serving.bench_serving"],
+            capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+        )
+        if r.returncode != 0:
+            print(f"serving bench failed: {r.stderr[-300:]}", file=sys.stderr)
+            return {"serving_error": f"rc={r.returncode}"}
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        print(
+            f"serving: continuous {out.get('serving_reqs_per_s')} req/s "
+            f"(p99 {out.get('serving_p99_ms')} ms) vs serial "
+            f"{out.get('serving_serial_reqs_per_s')} req/s = "
+            f"{out.get('serving_batching_speedup')}x; overload shed "
+            f"{out.get('serving_overload_shed_frac')} at p99 "
+            f"{out.get('serving_overload_p99_ms')} ms",
+            file=sys.stderr,
+        )
+        return out
+    except Exception as e:
+        print(f"serving bench skipped: {e}", file=sys.stderr)
+        return {"serving_error": str(e)[:200]}
+
+
 def _artifact_history() -> dict:
     """Metric series from the driver's BENCH_r*.json round artifacts
     (repo root): the rolling baseline the operator-side perf gates
@@ -777,6 +815,11 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         ("fabric_clusterip_tcp_gbps", 0.85, "clusterip_ge_085_median"),
         ("pod_attach_concurrent_per_s", 0.85,
          "concurrent_attach_ge_085_median"),
+        # Serving plane (ISSUE 2): throughput holds 0.85x the rolling
+        # median; p99 gets the attach-p50 latency band (1.35x — shared
+        # boxes swing tails far more than medians).
+        ("serving_reqs_per_s", 0.85, "serving_reqs_ge_085_median"),
+        ("serving_p99_ms", 1.35, "serving_p99_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -797,6 +840,7 @@ def main() -> int:
     metrics.update(bench_fabric_throughput())
     metrics.update(bench_jax_over_fabric())
     metrics.update(bench_virtual_ring())
+    metrics.update(bench_serving())
     metrics.update(bench_pod_context())
     metrics.update(bench_tpu())
 
@@ -822,6 +866,17 @@ def main() -> int:
         "fabric_ring_raw_gbps": "Gb/s",
         "fabric_jax_allreduce_gbps": "Gb/s",
         "fabric_gloo_allreduce_gbps": "Gb/s",
+        "serving_reqs_per_s": "req/s",
+        "serving_serial_reqs_per_s": "req/s",
+        "serving_batching_speedup": "x",
+        "serving_tok_per_s": "tok/s",
+        "serving_p50_ms": "ms",
+        "serving_p95_ms": "ms",
+        "serving_p99_ms": "ms",
+        "serving_overload_admitted_per_s": "req/s",
+        "serving_overload_p99_ms": "ms",
+        "serving_overload_shed_frac": "frac",
+        "serving_local_reqs_per_s": "req/s",
     }
     for key, unit in units.items():
         if key in metrics:

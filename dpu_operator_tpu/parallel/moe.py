@@ -28,11 +28,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def switch_moe_local(y, router_w, w1, w2, *, axis: str,
-                     capacity_factor: float, top_k: int = 1):
+                     capacity_factor: float, top_k: int = 1,
+                     row_mask=None):
     """The per-device MoE block on LOCAL tokens — the shared body of
-    make_moe and the five-axis training step (train_step._stage_fn), so
-    the subtle bucketing math exists exactly once. Must run inside a
-    shard_map over `axis`; w1/w2 are THIS device's expert ([d,h]/[h,d]),
+    make_moe, the five-axis training step (train_step._stage_fn) and
+    the serving-plane forward (serving/infer.py), so the subtle
+    bucketing math exists exactly once. Must run inside a shard_map
+    over `axis`; w1/w2 are THIS device's expert ([d,h]/[h,d]),
     router_w is [d, E] with E == the axis size.
 
     top_k=1 is Switch; top_k=2 is the classic MoE shape. Ranks are
@@ -40,7 +42,15 @@ def switch_moe_local(y, router_w, w1, w2, *, axis: str,
     order (all rank-0 assignments bucket before any rank-1), so the
     same cumsum/capacity/scatter math covers every k and lower ranks
     lose bucket slots first under pressure. Gates are renormalized over
-    the chosen k (the standard top-k formulation)."""
+    the chosen k (the standard top-k formulation).
+
+    row_mask ([rows] 0/1, optional): rows with 0 are excluded from
+    routing ENTIRELY — no bucket position, no capacity consumed, zero
+    output. The serving batcher's idle (zero-filled) slots need this:
+    a zero row's uniform softmax would otherwise win bucket slot 0 by
+    stream priority and silently drop a REAL token's dispatch under
+    capacity pressure. None (the default, every training caller) is
+    all-ones."""
     E = router_w.shape[1]
     rows, d = y.shape
     # top_k multiplies the assignment count, so expected load per
@@ -61,10 +71,18 @@ def switch_moe_local(y, router_w, w1, w2, *, axis: str,
     gate_all = gvals.T.reshape(-1)
     tok_all = jnp.tile(jnp.arange(rows), top_k)
     onehot = jax.nn.one_hot(expert_all, E, dtype=y.dtype)
+    if row_mask is not None:
+        # Masked rows vanish from the assignment stream: a zeroed
+        # onehot takes no cumsum position (consumes no capacity), and
+        # zeroing keep below drops them from dispatch AND combine.
+        mask_all = jnp.tile(row_mask.astype(y.dtype), top_k)
+        onehot = onehot * mask_all[:, None]
     # Position of each assignment within its expert's bucket.
     pos = jnp.cumsum(onehot, axis=0) - onehot
     pos_a = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
     keep = (pos_a < C).astype(y.dtype)
+    if row_mask is not None:
+        keep = keep * mask_all
     slot = jnp.clip(pos_a, 0, C - 1)
     # Scatter assignments into dispatch buckets [E, C, d]; bucket e
     # goes to device e, and we receive one from every source shard.

@@ -377,9 +377,15 @@ def run_schedule(sched: Schedule, stage_fn: Callable, params_local,
         _, vjp = jax.vjp(stage_fn, p_s, xb)
         dp, dx = vjp(cot)
         is_b = op == BWD
-        gmask = jnp.where(is_b, 1.0, 0.0).astype(x_mb.dtype)
+        # Masking by SELECTION, not multiplication: on non-BWD ticks the
+        # VJP above ran on zero-filled IDLE buffers, and a stage_fn with
+        # a division (rmsnorm, softmax denominators) yields NaN/Inf
+        # there — dpl * 0 would still be NaN and poison the accumulator
+        # for every real microbatch. jnp.where picks the zero branch
+        # outright, so garbage cotangents never touch the sum.
         grads = jax.tree.map(
-            lambda g, dpl: g.at[s].add(dpl * gmask), grads, dp)
+            lambda g, dpl: g.at[s].add(
+                jnp.where(is_b, dpl, jnp.zeros_like(dpl))), grads, dp)
 
         # ---- ship: activations forward, cotangents backward ----
         fsend = jnp.where(is_f & ((s * n + my) < S - 1), y,

@@ -104,7 +104,7 @@ def shard_params(params: Dict, mesh: Mesh) -> Dict:
 
 def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
               capacity_factor: float, seq_shape=None, attn_axes=None,
-              attn_ring: int = 1):
+              attn_ring: int = 1, row_mask=None):
     """One pipeline stage on LOCAL shards: optional causal ring
     attention over the token axes (when p carries wq/wk/wv — the
     cross-token block that makes sp real in the integrated program),
@@ -141,7 +141,7 @@ def _stage_fn(p, x, *, E: int, tp_axis: str, ep_axis: str,
     y = jnp.tanh(dense)
     moe_out = switch_moe_local(
         y, p["router"], p["moe_w1"][0], p["moe_w2"][0], axis=ep_axis,
-        capacity_factor=capacity_factor)
+        capacity_factor=capacity_factor, row_mask=row_mask)
     return y + moe_out  # residual keeps gradients flowing past drops
 
 

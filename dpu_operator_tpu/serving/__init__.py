@@ -1,0 +1,38 @@
+"""Serving plane: continuous-batching inference over the TPU fabric.
+
+The request path:
+
+    HTTP POST /v1/generate (server.py)
+      → bounded AdmissionQueue (queue.py — 503 + Retry-After past depth)
+      → ContinuousBatcher slot (scheduler.py — admit/retire at step
+        boundaries)
+      → Executor.step (executor.py seam: in-process jax replica today,
+        fabric-worker replica later)
+      → infer_step (infer.py — forward-only train_step model on a mesh)
+
+Importing this package stays jax-free; jax loads only when a
+LocalExecutor is constructed.
+"""
+
+from .api import (Draining, GenerateRequest, QueueFull, ServingError,
+                  encode_prompt)
+from .executor import (Executor, LocalExecutor, ReplicaPool,
+                       SyntheticExecutor)
+from .queue import AdmissionQueue
+from .scheduler import ContinuousBatcher
+from .server import ServingServer
+
+__all__ = [
+    "AdmissionQueue",
+    "ContinuousBatcher",
+    "Draining",
+    "Executor",
+    "GenerateRequest",
+    "LocalExecutor",
+    "QueueFull",
+    "ReplicaPool",
+    "ServingError",
+    "ServingServer",
+    "SyntheticExecutor",
+    "encode_prompt",
+]

@@ -1,0 +1,105 @@
+"""Request/response vocabulary of the serving plane.
+
+A GenerateRequest is the unit the continuous-batching scheduler moves:
+it enters through the HTTP front-end (server.py), waits in the bounded
+AdmissionQueue, occupies one batch SLOT in a ContinuousBatcher for
+`max_tokens` decode steps (or until its deadline), and completes back
+into the waiting handler thread via its event. Everything here is
+dependency-free (no jax) so the queue/scheduler plane imports in any
+process — the model only enters through the Executor seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+class ServingError(Exception):
+    """Base class for serving-plane rejections."""
+
+
+class QueueFull(ServingError):
+    """Admission refused: queue at max depth. Carries the backpressure
+    hint the HTTP layer turns into a 503 + Retry-After."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(f"admission queue full (depth={depth})")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class Draining(ServingError):
+    """Admission refused: server is draining (SIGTERM received).
+    In-flight requests keep running; new ones must go elsewhere."""
+
+
+# The queue's shed-at-pop error, matched EXACTLY by the HTTP layer to
+# pick 503 (back off and retry elsewhere) over 500 (replica failure) —
+# a substring match would misclassify executor errors that merely
+# mention deadlines (e.g. a collective's DEADLINE_EXCEEDED).
+DEADLINE_QUEUED_ERROR = "deadline exceeded while queued"
+
+
+def encode_prompt(text: str, d: int) -> np.ndarray:
+    """Deterministic prompt → [d] model-state embedding. The serving
+    model (a forward-only view of train_step's stage stack) consumes
+    hidden vectors, not token strings; this is the stand-in tokenizer:
+    same text always maps to the same state, distinct texts to distinct
+    states, so caching/batching behavior is measurable end-to-end."""
+    seed = int.from_bytes(hashlib.sha256(text.encode()).digest()[:4], "big")
+    return np.random.RandomState(seed).randn(d).astype(np.float32)
+
+
+@dataclass
+class GenerateRequest:
+    """One in-flight generation. Timestamps are time.monotonic() so
+    queue/decode decomposition survives wall-clock jumps."""
+
+    prompt_vec: np.ndarray
+    max_tokens: int
+    deadline: float                      # absolute monotonic
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    arrival: float = field(default_factory=time.monotonic)
+    admitted_at: Optional[float] = None  # scheduler placed it in a slot
+    finished_at: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+    truncated: bool = False              # deadline hit mid-decode
+    error: Optional[str] = None
+    _done: threading.Event = field(default_factory=threading.Event,
+                                   repr=False)
+
+    def finish(self) -> None:
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    def fail(self, error: str) -> None:
+        self.error = error
+        self.finish()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def timings_ms(self) -> dict:
+        """queue/decode/total decomposition for the response body."""
+        end = self.finished_at or time.monotonic()
+        admitted = self.admitted_at
+        queue_ms = ((admitted - self.arrival) if admitted is not None
+                    else (end - self.arrival)) * 1000.0
+        decode_ms = ((end - admitted) * 1000.0
+                     if admitted is not None else 0.0)
+        return {
+            "queue_ms": round(queue_ms, 3),
+            "decode_ms": round(decode_ms, 3),
+            "total_ms": round((end - self.arrival) * 1000.0, 3),
+        }

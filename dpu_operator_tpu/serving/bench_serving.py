@@ -1,0 +1,263 @@
+"""bench_serving — open- and closed-loop load over the REAL HTTP path.
+
+Decompose-then-optimize, the serving edition. The plane under test is
+the one this package owns: HTTP front-end → admission queue →
+continuous-batching scheduler → executor seam. The model's step cost
+is bench_tpu's domain, so the HEADLINE figures drive a FIXED-cost
+executor (SyntheticExecutor, --step-ms): on an MXU-bound chip a decode
+step prices a full batch the same as one row — the premise continuous
+batching exploits — and pinning that cost makes the figures move on
+scheduler/queue/HTTP regressions and NOTHING else (a jitted CPU matmul
+would re-measure the host's FLOPs and drown the plane in model noise).
+The real jitted path (LocalExecutor over the train_step model on a jax
+mesh) runs alongside as `serving_local_*` so every bench run exercises
+the full stack end to end.
+
+Sections:
+  1. closed-loop, continuous batching  → serving_reqs_per_s,
+     serving_tok_per_s, serving_p50/p95/p99_ms
+  2. closed-loop, serial batch=1       → serving_serial_reqs_per_s,
+     serving_batching_speedup (the continuous-batching win)
+  3. open-loop at ~2x measured capacity, small queue → bounded p99 for
+     admitted work + 503 shed fraction (serving_overload_p99_ms,
+     serving_overload_shed_frac, serving_overload_admitted_per_s) and
+     the server must still answer /healthz after the storm
+  4. the jitted-model path             → serving_local_reqs_per_s,
+     serving_local_p99_ms
+
+Protocol: exactly one JSON object on stdout; progress on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+
+def _post(url: str, body: dict, timeout: float = 120.0
+          ) -> Tuple[int, float]:
+    data = json.dumps(body).encode()
+    t0 = time.perf_counter()
+    try:
+        r = urllib.request.urlopen(
+            urllib.request.Request(url + "/v1/generate", data=data),
+            timeout=timeout)
+        r.read()
+        code = r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        code = e.code
+    return code, (time.perf_counter() - t0) * 1000.0
+
+
+def nearest_rank(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank (ceil) percentile over a SORTED sample list: p99
+    over <100 samples must still be able to land on the worst
+    observation — int() truncation would exclude it. The one percentile
+    convention for serving measurements (tests import it too)."""
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, max(0, math.ceil(n * q) - 1))]
+
+
+def _quantiles(lat: List[float]) -> dict:
+    if not lat:
+        return {"p50": None, "p95": None, "p99": None}
+    s = sorted(lat)
+    return {"p50": round(nearest_rank(s, 0.50), 2),
+            "p95": round(nearest_rank(s, 0.95), 2),
+            "p99": round(nearest_rank(s, 0.99), 2)}
+
+
+def closed_loop(url: str, clients: int, per_client: int,
+                max_tokens: int, deadline_ms: float = 120_000.0):
+    lat, codes = [], []
+    lock = threading.Lock()
+
+    def run(c):
+        for i in range(per_client):
+            code, ms = _post(url, {"prompt": f"c{c}-{i}",
+                                   "max_tokens": max_tokens,
+                                   "deadline_ms": deadline_ms})
+            with lock:
+                codes.append(code)
+                if code == 200:
+                    lat.append(ms)
+
+    ts = [threading.Thread(target=run, args=(c,)) for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    return wall, lat, codes
+
+
+def open_loop(url: str, rate_per_s: float, seconds: float,
+              max_tokens: int, deadline_ms: float):
+    """Fixed-rate arrivals regardless of completions — the load shape
+    that exposes queue growth (closed-loop self-throttles; an open
+    loop does not, which is why overload must be measured this way)."""
+    lat, codes = [], []
+    lock = threading.Lock()
+    threads: List[threading.Thread] = []
+
+    def one(i):
+        code, ms = _post(url, {"prompt": f"o{i}",
+                               "max_tokens": max_tokens,
+                               "deadline_ms": deadline_ms})
+        with lock:
+            codes.append(code)
+            if code == 200:
+                lat.append(ms)
+
+    n = int(rate_per_s * seconds)
+    t0 = time.perf_counter()
+    for i in range(n):
+        target = t0 + i / rate_per_s
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        th = threading.Thread(target=one, args=(i,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=deadline_ms / 1000.0 + 30)
+    wall = time.perf_counter() - t0
+    return wall, lat, codes
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--step-ms", type=float, default=4.0,
+                    help="fixed per-step executor cost (the accelerator "
+                         "cost model)")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--per-client", type=int, default=3)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--overload-x", type=float, default=2.0)
+    ap.add_argument("--overload-seconds", type=float, default=3.0)
+    ap.add_argument("--overload-deadline-ms", type=float, default=2000.0)
+    ap.add_argument("--skip-local", action="store_true",
+                    help="skip the jitted-model section (no jax)")
+    args = ap.parse_args(argv)
+
+    from .executor import SyntheticExecutor
+    from .server import ServingServer
+
+    def trace(msg):
+        print(f"bench_serving: {msg}", file=sys.stderr, flush=True)
+
+    out: dict = {}
+    step_s = args.step_ms / 1000.0
+
+    # 1+2: closed-loop, continuous vs serial, same fixed step cost.
+    mk = lambda slots: ServingServer(
+        [SyntheticExecutor(slots=slots, d=16, step_time_s=step_s)],
+        max_queue_depth=max(64, 4 * args.clients)).start()
+    cont, serial = mk(args.slots), mk(1)
+    try:
+        closed_loop(cont.url, 2, 2, 2)
+        closed_loop(serial.url, 2, 2, 2)
+        wall, lat, codes = closed_loop(
+            cont.url, args.clients, args.per_client, args.max_tokens)
+        n_ok = sum(1 for c in codes if c == 200)
+        q = _quantiles(lat)
+        out.update(
+            serving_reqs_per_s=round(n_ok / wall, 2),
+            serving_tok_per_s=round(n_ok * args.max_tokens / wall, 1),
+            serving_p50_ms=q["p50"], serving_p95_ms=q["p95"],
+            serving_p99_ms=q["p99"])
+        trace(f"continuous: {out['serving_reqs_per_s']} req/s "
+              f"p99={q['p99']} ms over {n_ok} reqs")
+
+        wall_s, lat_s, codes_s = closed_loop(
+            serial.url, args.clients, args.per_client, args.max_tokens)
+        n_ok_s = sum(1 for c in codes_s if c == 200)
+        out["serving_serial_reqs_per_s"] = round(n_ok_s / wall_s, 2)
+        if out["serving_serial_reqs_per_s"]:
+            out["serving_batching_speedup"] = round(
+                out["serving_reqs_per_s"]
+                / out["serving_serial_reqs_per_s"], 2)
+        trace(f"serial: {out['serving_serial_reqs_per_s']} req/s → "
+              f"speedup {out.get('serving_batching_speedup')}x")
+    finally:
+        cont.stop()
+        serial.stop()
+
+    # 3: open-loop overload at ~2x the measured closed-loop capacity,
+    # queue barely deeper than the batch — the shed-don't-park test.
+    ov = ServingServer(
+        [SyntheticExecutor(slots=args.slots, d=16, step_time_s=step_s)],
+        max_queue_depth=args.slots).start()
+    try:
+        closed_loop(ov.url, 2, 2, 2)
+        rate = args.overload_x * max(out["serving_reqs_per_s"], 1.0)
+        wall, lat, codes = open_loop(
+            ov.url, rate, args.overload_seconds, args.max_tokens,
+            args.overload_deadline_ms)
+        n_ok = sum(1 for c in codes if c == 200)
+        n_503 = sum(1 for c in codes if c == 503)
+        q = _quantiles(lat)
+        alive = False
+        try:
+            alive = urllib.request.urlopen(
+                ov.url + "/healthz", timeout=5).status == 200
+        except OSError:
+            pass
+        out.update(
+            serving_overload_offered_per_s=round(rate, 1),
+            serving_overload_admitted_per_s=round(n_ok / wall, 2),
+            serving_overload_shed_frac=round(
+                n_503 / max(1, len(codes)), 3),
+            serving_overload_p99_ms=q["p99"],
+            serving_overload_healthz_ok=alive,
+            serving_overload_other_codes=sorted(
+                {c for c in codes if c not in (200, 503)}))
+        trace(f"overload @{rate:.0f}/s: admitted "
+              f"{out['serving_overload_admitted_per_s']}/s, shed "
+              f"{out['serving_overload_shed_frac']}, p99 {q['p99']} ms, "
+              f"healthz={alive}")
+    finally:
+        ov.stop()
+
+    # 4: the real jitted path — forward-only train_step model on a mesh.
+    if not args.skip_local:
+        try:
+            from .executor import LocalExecutor
+
+            t0 = time.perf_counter()
+            ex = LocalExecutor(slots=args.slots, S=1, d=8, h=8, E=1)
+            out["serving_local_compile_s"] = round(
+                time.perf_counter() - t0, 2)
+            local = ServingServer([ex], max_queue_depth=64).start()
+            try:
+                closed_loop(local.url, 2, 2, 2)
+                wall, lat, codes = closed_loop(
+                    local.url, args.clients, args.per_client,
+                    args.max_tokens)
+                n_ok = sum(1 for c in codes if c == 200)
+                out["serving_local_reqs_per_s"] = round(n_ok / wall, 2)
+                out["serving_local_p99_ms"] = _quantiles(lat)["p99"]
+                trace(f"local jitted model: "
+                      f"{out['serving_local_reqs_per_s']} req/s")
+            finally:
+                local.stop()
+        except Exception as e:  # the headline figures stand regardless
+            out["serving_local_error"] = str(e)[:200]
+            trace(f"local section failed: {e}")
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""The executor seam: what a batch slot's worth of model step IS.
+
+The continuous-batching scheduler only ever calls
+`step(x[slots, d]) -> y[slots, d]` — it neither imports jax nor knows
+where the forward runs. That seam is what lets replicas be swapped:
+
+  * LocalExecutor — the in-process replica: infer.make_infer_step on a
+    jax mesh (CPU/TPU), params from train_step.init_params or a
+    checkpoint. The bench and smoke tests run this one.
+  * SyntheticExecutor — a jax-free replica with a CONTROLLED per-step
+    cost: the scheduler/backpressure plane's test double (the
+    RecordingDataplane idiom from bench.py), and the knob that makes
+    overload tests deterministic on shared CI boxes.
+  * A fabric-worker-backed replica — the planned third implementation:
+    `step` ships the batch to a pool of parallel/fabric_worker.py-style
+    processes inside operator-attached pod netns (same rendezvous, a
+    forward-only program instead of the train slice) and collects the
+    result off the fabric. It needs nothing from the scheduler beyond
+    this interface; see docs/serving.md.
+
+ReplicaPool owns one ContinuousBatcher per executor, all fed from one
+AdmissionQueue — requests land on whichever replica frees a slot first.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class Executor:
+    """One model replica: a fixed number of batch slots over a fixed
+    feature dim. step() must be safe to call from the replica's single
+    batcher thread; it need not be reentrant."""
+
+    slots: int
+    d: int
+
+    def step(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalExecutor(Executor):
+    """In-process replica: forward-only train_step model on a jax mesh.
+
+    Builds tiny demo params when none are given (the bench/test shape);
+    production hands in trained params in init_params layout. The first
+    step() after construction pays the jit compile; `warmup=True` pays
+    it here instead so admission latency never includes XLA."""
+
+    def __init__(self, params=None, mesh=None, slots: int = 8,
+                 capacity_factor: float = 4.0, S: int = 1, d: int = 16,
+                 h: int = 32, E: int = 1, seed: int = 0,
+                 warmup: bool = True):
+        from ..parallel.train_step import init_params, shard_params
+        from .infer import make_infer_step, serving_mesh
+
+        self.mesh = mesh if mesh is not None else serving_mesh()
+        if params is None:
+            if E != self.mesh.shape["ep"]:
+                raise ValueError(
+                    f"demo params need E == ep axis size "
+                    f"{self.mesh.shape['ep']}, got {E}")
+            params = init_params(S=S, d=d, h=h, E=E, seed=seed)
+        shard = self.mesh.shape["dp"] * self.mesh.shape["ep"]
+        if slots % shard:
+            raise ValueError(
+                f"slots={slots} must divide over dp*ep={shard} "
+                f"(batch rows shard over both)")
+        self.slots = slots
+        self.d = int(params["w1"].shape[1])
+        self.params = shard_params(params, self.mesh)
+        self._infer = make_infer_step(self.mesh, capacity_factor)
+        if warmup:
+            self.step(np.zeros((self.slots, self.d), np.float32))
+
+    def step(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._infer(self.params, x))
+
+
+class SyntheticExecutor(Executor):
+    """Deterministic jax-free replica with a dialable per-step cost.
+
+    y = tanh(x @ W) for a fixed seeded W, after sleeping step_time_s —
+    the model-cost knob that makes scheduler/backpressure tests assert
+    timing properties instead of hoping the CI box is quiet."""
+
+    def __init__(self, slots: int = 8, d: int = 16,
+                 step_time_s: float = 0.0, seed: int = 0):
+        self.slots = slots
+        self.d = d
+        self.step_time_s = step_time_s
+        self._w = np.random.RandomState(seed).randn(d, d).astype(
+            np.float32) / np.sqrt(d)
+        self.steps = 0
+
+    def step(self, x: np.ndarray) -> np.ndarray:
+        if self.step_time_s:
+            time.sleep(self.step_time_s)
+        self.steps += 1
+        return np.tanh(x @ self._w)
+
+
+class ReplicaPool:
+    """One ContinuousBatcher per executor over a shared AdmissionQueue."""
+
+    def __init__(self, executors: Sequence[Executor], queue,
+                 registry=None):
+        from .scheduler import ContinuousBatcher
+
+        if not executors:
+            raise ValueError("a pool needs at least one executor")
+        self.queue = queue
+        self.executors = list(executors)
+        self.batchers: List = [
+            ContinuousBatcher(ex, queue, registry=registry,
+                              replica=f"replica{i}")
+            for i, ex in enumerate(self.executors)
+        ]
+
+    def start(self) -> None:
+        for b in self.batchers:
+            b.start()
+
+    def stop(self) -> None:
+        for b in self.batchers:
+            b.stop()
+        for ex in self.executors:
+            ex.close()
+
+    def active(self) -> int:
+        return sum(b.active for b in self.batchers)
+
+    def quiesce(self, timeout: float = 30.0,
+                poll_s: float = 0.02) -> bool:
+        """Wait until queue, pop-to-slot hand-off AND every batcher are
+        empty (drain path: the queue has already stopped admitting, so
+        empty is stable). inflight() covers the window where a request
+        is popped but not yet in a slot — without it a drain stop()
+        could land exactly there and fail an admitted request."""
+
+        def idle() -> bool:
+            return (self.queue.depth() == 0 and self.queue.inflight() == 0
+                    and self.active() == 0)
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if idle():
+                return True
+            time.sleep(poll_s)
+        return idle()

@@ -1,0 +1,99 @@
+"""Forward-only `infer_step` built from the train_step.py model.
+
+The serving plane runs the SAME stage math the five-axis training step
+trains — train_step._stage_fn's Megatron-paired dense block + Switch
+MoE — stripped to a pure forward on a jax mesh: no loss, no VJP, no
+optimizer, jitted ONCE for a fixed [slots, d] batch shape so the
+continuous-batching scheduler never recompiles as requests come and go
+(slot count is static; occupancy varies, shapes don't — the vLLM
+fixed-slot discipline).
+
+Mesh contract: the serving mesh keeps pp == sp == 1 (no microbatch
+pipeline and no sequence axis in the decode state; every stage is
+local), and shards the BATCH over ("dp", "ep") with weights over
+tp/ep — the inference projection of train_step's token-sharded layout
+(each ep device routes its own distinct batch rows, so the MoE
+all_to_all carries no duplicates; tp replicates rows and shards the
+matmul, the Megatron pairing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..parallel.train_step import AXES, _stage_fn, param_specs
+
+
+def serving_mesh(devices: Optional[Sequence] = None,
+                 shape: Optional[Dict[str, int]] = None):
+    """A 5-axis (dp, pp, sp, tp, ep) mesh for the forward-only step.
+    Default: ONE device, every axis singleton — the per-replica shape;
+    `shape` assigns sizes to dp/tp/ep (pp and sp must stay 1)."""
+    import jax
+    from jax.sharding import Mesh
+
+    shape = dict(shape or {})
+    if shape.get("pp", 1) != 1 or shape.get("sp", 1) != 1:
+        raise ValueError(
+            "serving mesh keeps pp == sp == 1: decode state has no "
+            f"sequence axis and every stage is local, got {shape}")
+    if devices is None:
+        n = 1
+        for a in ("dp", "tp", "ep"):
+            n *= shape.get(a, 1)
+        devices = jax.devices()[:n]
+    sizes = tuple(shape.get(a, 1) for a in AXES)
+    want = int(np.prod(sizes))
+    if len(devices) != want:
+        raise ValueError(
+            f"mesh shape {dict(zip(AXES, sizes))} needs {want} devices, "
+            f"got {len(devices)}")
+    return Mesh(np.array(devices).reshape(sizes), AXES)
+
+
+def make_infer_step(mesh, capacity_factor: float = 4.0):
+    """infer_step(params, x[B, d]) -> y[B, d]: one decode step of the
+    stage stack. Params are the stage-stacked train_step.init_params
+    layout (leading dim S) in param_specs sharding; with pp == 1 the
+    whole stack is local to every device and the stage loop unrolls at
+    trace time. B must divide by dp·ep (batch rows shard over both)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel._compat import shard_map
+
+    for axis in ("pp", "sp"):
+        if mesh.shape[axis] != 1:
+            raise ValueError(
+                f"infer_step requires {axis}=1, got {mesh.shape[axis]}")
+    E = mesh.shape["ep"]
+    specs = param_specs()
+    x_spec = P(("dp", "ep"), None)
+
+    def per_device(params_local, x_loc):
+        S = params_local["router"].shape[0]
+        # Idle slots are EXACTLY zero-filled (the scheduler's contract)
+        # and stay zero through every stage (relu/tanh/psum of zero).
+        # They must also vanish from MoE routing: a zero row's uniform
+        # softmax would win bucket slot 0 by stream priority and, on an
+        # ep-sharded mesh under capacity pressure, silently drop a REAL
+        # token's dispatch — making decode output occupancy-dependent.
+        active = jnp.any(x_loc != 0, axis=1)
+        x = x_loc
+        for s in range(S):
+            p = jax.tree.map(lambda a: a[s], params_local)
+            x = _stage_fn(p, x, E=E, tp_axis="tp", ep_axis="ep",
+                          capacity_factor=capacity_factor,
+                          row_mask=active)
+        return x
+
+    @jax.jit
+    def infer_step(params, x):
+        return shard_map(
+            per_device, mesh=mesh, in_specs=(specs, x_spec),
+            out_specs=x_spec, check_vma=False)(params, x)
+
+    return infer_step

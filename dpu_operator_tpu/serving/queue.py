@@ -1,0 +1,119 @@
+"""Bounded admission queue — the backpressure point of the serving plane.
+
+Overload policy (the Orca/vLLM-era contract): the queue has a hard
+depth; past it, submission fails IMMEDIATELY with QueueFull and the
+HTTP layer returns 503 + Retry-After. Latency for admitted requests
+stays bounded because the excess is rejected at the door instead of
+parked — queue depth, not queue time, is the knob. Requests whose
+deadline expires while still queued are shed at pop time (they would
+only waste batch slots on an answer nobody is waiting for).
+
+begin_drain() flips the queue to refuse-new mode for SIGTERM drain:
+already-queued work still pops and completes; submissions raise
+Draining.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from .api import (DEADLINE_QUEUED_ERROR, Draining, GenerateRequest,
+                  QueueFull)
+
+
+class AdmissionQueue:
+    def __init__(self, max_depth: int = 64, retry_after_s: float = 1.0,
+                 registry=None):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self.retry_after_s = retry_after_s
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._q: deque = deque()
+        self._draining = False
+        self._inflight = 0  # popped by a batcher, not yet in a slot
+        self.rejected_full = 0
+        self.rejected_draining = 0
+        self.shed_expired = 0
+
+    def _gauge(self) -> None:
+        if self._registry is not None:
+            self._registry.gauge_set(
+                "serving_queue_depth", float(len(self._q)),
+                help="requests waiting for a batch slot")
+
+    def submit(self, req: GenerateRequest) -> None:
+        with self._lock:
+            if self._draining:
+                self.rejected_draining += 1
+                raise Draining("server is draining")
+            if len(self._q) >= self.max_depth:
+                self.rejected_full += 1
+                raise QueueFull(len(self._q), self.retry_after_s)
+            self._q.append(req)
+            self._gauge()
+            self._nonempty.notify()
+
+    def get_many(self, n: int, timeout: float = 0.0
+                 ) -> List[GenerateRequest]:
+        """Pop up to n requests; blocks up to `timeout` only while the
+        queue is empty (a busy batcher polls with timeout=0 so decode
+        steps never stall on admission). Expired entries are shed here,
+        failed with the error the HTTP layer maps to a 503."""
+        out: List[GenerateRequest] = []
+        with self._lock:
+            if not self._q and timeout > 0:
+                self._nonempty.wait(timeout)
+            now = time.monotonic()
+            while self._q and len(out) < n:
+                req = self._q.popleft()
+                if req.deadline <= now:
+                    self.shed_expired += 1
+                    req.fail(DEADLINE_QUEUED_ERROR)
+                    continue
+                out.append(req)
+            # Popped requests are invisible to depth() but not yet in a
+            # slot (active). Counting them under the SAME lock as the
+            # pop closes the quiesce race: at no instant can a request
+            # be in none of depth/inflight/active — drain's "everything
+            # finished" check must see it somewhere.
+            self._inflight += len(out)
+            self._gauge()
+        return out
+
+    def mark_placed(self, n: int) -> None:
+        """The batcher finished placing (or failing) n popped requests."""
+        with self._lock:
+            self._inflight -= n
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+            self._nonempty.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def fail_all(self, error: str) -> int:
+        """Empty the queue, failing every waiter (server stop path)."""
+        with self._lock:
+            n = len(self._q)
+            while self._q:
+                self._q.popleft().fail(error)
+            self._gauge()
+        return n

@@ -1,0 +1,167 @@
+"""Continuous batching: admit/retire at STEP boundaries, not batch ones.
+
+The classic serving mistake is static batching — collect B requests,
+run all their tokens, return, repeat — which makes every request wait
+for the slowest member of its batch and leaves slots idle as members
+finish early. Continuous batching (Orca, OSDI '22) re-forms the batch
+every model step: a request occupies one SLOT, each step decodes one
+token for every occupied slot, finished requests free their slot at
+the step boundary and queued requests are admitted into free slots
+before the next step. Occupancy tracks offered load step by step;
+nobody waits for a stranger's tail.
+
+The executor's batch shape is FIXED at [slots, d] (idle slots carry
+zeros) so the jitted forward compiles once — occupancy varies, shapes
+don't. One batcher per replica, one thread per batcher; the shared
+AdmissionQueue is the only cross-replica coupling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .api import GenerateRequest
+
+log = logging.getLogger(__name__)
+
+
+class ContinuousBatcher:
+    def __init__(self, executor, queue, registry=None,
+                 replica: str = "replica0", idle_wait_s: float = 0.05):
+        self.executor = executor
+        self.queue = queue
+        self.registry = registry
+        self.replica = replica
+        self.idle_wait_s = idle_wait_s
+        self._slots: List[Optional[GenerateRequest]] = (
+            [None] * executor.slots)
+        self._x = np.zeros((executor.slots, executor.d), np.float32)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"batcher-{self.replica}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.fail("server stopped")
+                self._slots[i] = None
+
+    @property
+    def active(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    # -- the loop -------------------------------------------------------------
+
+    def _observe(self, name: str, value: float, help: str = "",
+                 buckets=None) -> None:
+        if self.registry is not None:
+            self.registry.observe(name, value, {"replica": self.replica},
+                                  help=help, buckets=buckets)
+
+    def _count(self, name: str, labels: dict, help: str = "",
+               by: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.counter_inc(name, labels, by=by, help=help)
+
+    def _admit(self) -> None:
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        if not free:
+            return
+        # Block only when fully idle: a running batch polls (timeout 0)
+        # so decode steps are never held hostage to admission.
+        timeout = self.idle_wait_s if len(free) == len(self._slots) else 0.0
+        for req in self.queue.get_many(len(free), timeout=timeout):
+            try:
+                i = free.pop(0)
+                req.admitted_at = time.monotonic()
+                self._slots[i] = req
+                self._x[i] = req.prompt_vec
+            except Exception as e:
+                # A request popped from the queue has exactly one owner
+                # now — losing it here would park its handler thread
+                # for the full deadline.
+                log.exception("batcher %s: admit failed", self.replica)
+                if self._slots[i] is req:
+                    self._slots[i] = None
+                    self._x[i] = 0.0
+                req.fail(f"admission failed: {e}")
+            finally:
+                # In a slot (or failed) — no longer "in flight between
+                # queue and slot" for the drain quiesce accounting.
+                self.queue.mark_placed(1)
+
+    def _retire(self, y: np.ndarray) -> None:
+        now = time.monotonic()
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.done:
+                # Abandoned by the handler (wait timeout → 500): evict
+                # rather than decode to max_tokens for nobody — zombie
+                # slots are capacity loss exactly when capacity is short.
+                self._slots[i] = None
+                self._x[i] = 0.0
+                continue
+            req.tokens.append(int(np.argmax(y[i])))
+            self._x[i] = y[i]  # decode recurrence: output is next state
+            finished = len(req.tokens) >= req.max_tokens
+            if not finished and now >= req.deadline:
+                # Deadline mid-decode: return what exists, marked, at
+                # the boundary — p99 for admitted work stays bounded by
+                # deadline + one step, never by another request's tail.
+                req.truncated = True
+                finished = True
+            if finished:
+                self._count("serving_tokens_total",
+                            {"replica": self.replica},
+                            by=float(len(req.tokens)),
+                            help="decoded tokens")
+                req.finish()
+                self._slots[i] = None
+                self._x[i] = 0.0
+
+    def _run(self) -> None:
+        occupancy_buckets = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                             0.875, 1.0)
+        while not self._stop.is_set():
+            # Any failure in this body must cost at most the CURRENT
+            # occupants — never the thread. A dead batcher is a replica
+            # that silently serves nothing while /healthz stays green.
+            try:
+                self._admit()
+                n_active = self.active
+                if n_active == 0:
+                    continue
+                t0 = time.perf_counter()
+                y = self.executor.step(self._x)
+                dt = time.perf_counter() - t0
+                self.steps += 1
+                self._observe("serving_step_seconds", dt,
+                              help="model step wall time")
+                self._observe("serving_batch_occupancy",
+                              n_active / self.executor.slots,
+                              help="occupied fraction of batch slots",
+                              buckets=occupancy_buckets)
+                self._retire(y)
+            except Exception as e:  # broken replica must not wedge waiters
+                log.exception("batcher %s: step failed", self.replica)
+                for i, req in enumerate(self._slots):
+                    if req is not None:
+                        req.fail(f"executor failed: {e}")
+                        self._slots[i] = None
+                        self._x[i] = 0.0

@@ -1,0 +1,369 @@
+"""HTTP front-end of the serving plane: /v1/generate, /healthz, /metrics.
+
+Follows the k8s/http_server.py idiom (ThreadingHTTPServer, handler
+back-references through the server object, quiet logs) with the
+serving-specific contract on top:
+
+  POST /v1/generate   {"prompt": str | "prompt_vec": [d floats],
+                       "max_tokens": int, "deadline_ms": int}
+      200 {"id", "tokens", "truncated", "timings": {queue_ms,
+           decode_ms, total_ms}}
+      400 malformed body / wrong prompt_vec width
+      503 + Retry-After on queue-full, drain, or deadline shed — the
+          backpressure answer: overload is REJECTED at the door so
+          admitted requests keep a bounded p99 (never parked into an
+          unbounded queue).
+  GET /healthz        200 always while the process lives (liveness)
+  GET /readyz         503 while draining, else 200 (readiness — what a
+                      k8s Service endpoint should key on)
+  GET /metrics        utils/metrics.Registry exposition
+
+SIGTERM drain (install_signal_handlers): stop admitting (everything new
+gets 503), let queued + in-flight requests finish, then — when a
+drain.Drainer and node name are wired — cordon the node and evict
+fabric pods exactly as the daemon's repartition path does, so the
+replica disappears from scheduling before the process exits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils.metrics import Registry
+from .api import (DEADLINE_QUEUED_ERROR, Draining, QueueFull,
+                  GenerateRequest, encode_prompt)
+from .executor import Executor, ReplicaPool
+from .queue import AdmissionQueue
+
+log = logging.getLogger(__name__)
+
+_DEADLINE_CAP_MS = 24 * 3600 * 1000.0  # nobody waits a day for tokens
+_MAX_BODY_BYTES = 1 << 20  # prompt_vec of a few thousand floats fits 100x over
+
+
+class ServingServer:
+    def __init__(self, executors: Sequence[Executor], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_queue_depth: int = 64,
+                 default_max_tokens: int = 16,
+                 max_tokens_cap: int = 1024,
+                 default_deadline_s: float = 30.0,
+                 retry_after_s: float = 1.0,
+                 registry: Optional[Registry] = None,
+                 drainer=None, node_name: Optional[str] = None):
+        # Per-server registry by default: tests and benches run several
+        # servers in one process; sharing default_registry would blend
+        # their series.
+        self.registry = registry if registry is not None else Registry()
+        self.queue = AdmissionQueue(max_depth=max_queue_depth,
+                                    retry_after_s=retry_after_s,
+                                    registry=self.registry)
+        self.pool = ReplicaPool(executors, self.queue,
+                                registry=self.registry)
+        self.default_max_tokens = default_max_tokens
+        self.max_tokens_cap = max_tokens_cap
+        self.default_deadline_s = default_deadline_s
+        dims = {ex.d for ex in executors}
+        if len(dims) != 1:
+            # prompt_vec width is validated once at the front door; a
+            # mixed-d pool would admit vectors some replica cannot hold.
+            raise ValueError(f"all replicas must share one feature dim, "
+                             f"got {sorted(dims)}")
+        self.d = executors[0].d
+        self.drainer = drainer
+        self.node_name = node_name
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._drain_ok = False
+        self._stopped = False
+
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: dict,
+                      headers: Optional[dict] = None) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, val in (headers or {}).items():
+                    self.send_header(k, val)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/readyz":
+                    if server_ref.draining:
+                        return self._send(503, {"status": "draining"})
+                    return self._send(200, {"status": "ready"})
+                if self.path == "/metrics":
+                    server_ref.update_derived_metrics()
+                    data = server_ref.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                # Read the declared body BEFORE any reply: these are
+                # HTTP/1.1 keep-alive connections, and replying with the
+                # body still unread would desync the stream (the next
+                # request line would parse from our leftover JSON).
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (ValueError, TypeError):
+                    self.close_connection = True
+                    return self._send(400,
+                                      {"error": "bad Content-Length"})
+                if length > _MAX_BODY_BYTES:
+                    # Bounded like everything else on this front door —
+                    # a declared multi-GB body must not buffer into a
+                    # handler thread while /healthz stays green.
+                    self.close_connection = True
+                    return self._send(
+                        413, {"error": f"body over {_MAX_BODY_BYTES} "
+                                       f"bytes"})
+                raw = self.rfile.read(length) if length > 0 else b""
+                if self.path != "/v1/generate":
+                    return self._send(404, {"error": "not found"})
+                server_ref.handle_generate(self, raw)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def start(self) -> "ServingServer":
+        self.pool.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="serving")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        # Refuse-new FIRST: a POST racing this teardown must get a
+        # prompt 503, not a submit into a queue no batcher will ever
+        # pop again (the handler would park its full wait timeout).
+        self._draining.set()
+        self.queue.begin_drain()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.queue.fail_all("server stopped")
+        self.pool.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- drain ----------------------------------------------------------------
+
+    def begin_drain(self, timeout: float = 30.0) -> bool:
+        """SIGTERM path: refuse new work (503), finish queued +
+        in-flight work, then cordon/evict via drain.Drainer when wired.
+        Idempotent; returns True once quiesced."""
+        self._draining.set()
+        self.queue.begin_drain()
+        ok = self.pool.quiesce(timeout)
+        if ok and self.drainer is not None and self.node_name:
+            try:
+                self.drainer.drain_node(self.node_name)
+            except Exception:
+                log.exception("drain: Drainer.drain_node failed")
+        self._drain_ok = ok
+        self._drained.set()
+        return ok
+
+    def install_signal_handlers(self, stop_after: bool = True,
+                                drain_timeout: float = 30.0):
+        """SIGTERM → drain in a background thread (the handler itself
+        must return immediately — it runs on the main thread mid-
+        whatever). Returns the previous handler."""
+
+        def _on_sigterm(signum, frame):
+            log.info("SIGTERM: draining serving plane")
+            t = threading.Thread(target=self._drain_and_stop,
+                                 args=(drain_timeout, stop_after),
+                                 daemon=True, name="serving-drain")
+            t.start()
+
+        return signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def _drain_and_stop(self, timeout: float, stop_after: bool) -> None:
+        self.begin_drain(timeout)
+        if stop_after:
+            self.stop()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """True only for a COMPLETED drain (everything in flight
+        finished). A quiesce timeout unblocks waiters but returns
+        False — an orchestrator keyed on this must not tear down a
+        process still holding requests."""
+        return self._drained.wait(timeout) and self._drain_ok
+
+    # -- request handling ------------------------------------------------------
+
+    def update_derived_metrics(self) -> None:
+        """Scrape-time derived gauges: the in-process p50/p99 estimate
+        over the request-latency histogram (Registry.quantile — the SLO
+        number an operator alerts on, computed where the buckets live
+        instead of in PromQL)."""
+        for q, name in ((0.5, "serving_request_p50_seconds"),
+                        (0.99, "serving_request_p99_seconds")):
+            est = self.registry.quantile(
+                "serving_request_seconds", q, {"outcome": "ok"})
+            if est is not None:
+                self.registry.gauge_set(
+                    name, round(est, 6),
+                    help=f"estimated q={q} of serving_request_seconds "
+                         f"(ok outcomes)")
+
+    def _finish(self, handler, code: int, body: dict, outcome: str,
+                headers: Optional[dict] = None,
+                elapsed_s: Optional[float] = None) -> None:
+        self.registry.counter_inc(
+            "serving_requests_total", {"code": str(code),
+                                       "outcome": outcome},
+            help="generate requests by outcome")
+        if elapsed_s is not None:
+            self.registry.observe(
+                "serving_request_seconds", elapsed_s,
+                {"outcome": outcome},
+                help="end-to-end request wall time")
+        handler._send(code, body, headers)
+
+    def handle_generate(self, handler, raw: bytes) -> None:
+        t0 = time.monotonic()
+        retry = {"Retry-After": str(max(1, int(round(
+            self.queue.retry_after_s))))}
+        if self.draining:
+            return self._finish(handler, 503, {"error": "draining"},
+                                "draining", retry)
+        try:
+            body = json.loads(raw) if raw else {}
+        except (ValueError, TypeError):
+            return self._finish(handler, 400,
+                                {"error": "malformed JSON body"}, "bad")
+        if not isinstance(body, dict):
+            return self._finish(handler, 400,
+                                {"error": "body must be an object"}, "bad")
+        try:
+            vec = self._prompt_vec(body)
+        except (ValueError, TypeError) as e:
+            # TypeError too: np.asarray raises it for non-numeric JSON
+            # (e.g. prompt_vec as an object) — that's a client error,
+            # not a dropped connection.
+            return self._finish(handler, 400, {"error": str(e)}, "bad")
+        try:
+            max_tokens = int(body.get("max_tokens",
+                                      self.default_max_tokens))
+            deadline_ms = float(body.get("deadline_ms",
+                                         self.default_deadline_s * 1000))
+        except (TypeError, ValueError):
+            return self._finish(
+                handler, 400,
+                {"error": "max_tokens/deadline_ms must be numbers"}, "bad")
+        if not 1 <= max_tokens <= self.max_tokens_cap:
+            return self._finish(
+                handler, 400,
+                {"error": f"max_tokens must be in [1, "
+                          f"{self.max_tokens_cap}]"}, "bad")
+        # Finite and capped, not just positive: json.loads accepts
+        # Infinity/NaN, and a NaN deadline poisons every expiry
+        # comparison while an astronomic one overflows Event.wait.
+        if not (math.isfinite(deadline_ms)
+                and 0 < deadline_ms <= _DEADLINE_CAP_MS):
+            return self._finish(
+                handler, 400,
+                {"error": f"deadline_ms must be a finite number in "
+                          f"(0, {_DEADLINE_CAP_MS:.0f}]"}, "bad")
+
+        req = GenerateRequest(prompt_vec=vec, max_tokens=max_tokens,
+                              deadline=t0 + deadline_ms / 1000.0)
+        try:
+            self.queue.submit(req)
+        except QueueFull as e:
+            return self._finish(
+                handler, 503,
+                {"error": "overloaded: admission queue full",
+                 "queue_depth": e.depth}, "queue_full",
+                {"Retry-After": str(max(1, int(round(e.retry_after_s))))})
+        except Draining:
+            return self._finish(handler, 503, {"error": "draining"},
+                                "draining", retry)
+
+        # The handler thread parks on the request event; the batcher
+        # completes it. Grace past the deadline covers the final step +
+        # hand-off — a miss here means the scheduler plane wedged.
+        req.wait(deadline_ms / 1000.0 + 10.0)
+        elapsed = time.monotonic() - t0
+        if not req.done:
+            req.fail("scheduler wedged")  # unparks nothing; marks it
+            return self._finish(handler, 500,
+                                {"error": "internal: request lost"},
+                                "lost", elapsed_s=elapsed)
+        if req.error is not None:
+            shed = req.error == DEADLINE_QUEUED_ERROR
+            code = 503 if shed else 500
+            outcome = "deadline_queue" if shed else "error"
+            return self._finish(handler, code, {"error": req.error},
+                                outcome,
+                                retry if code == 503 else None,
+                                elapsed_s=elapsed)
+        self._finish(handler, 200, {
+            "id": req.request_id,
+            "tokens": req.tokens,
+            "truncated": req.truncated,
+            "timings": req.timings_ms(),
+        }, "ok", elapsed_s=elapsed)
+
+    def _prompt_vec(self, body: dict) -> np.ndarray:
+        if "prompt_vec" in body:
+            vec = np.asarray(body["prompt_vec"], dtype=np.float32)
+            if vec.shape != (self.d,):
+                raise ValueError(
+                    f"prompt_vec must be [{self.d}] floats, "
+                    f"got shape {list(vec.shape)}")
+            if not np.isfinite(vec).all():
+                # Same json.loads quirk as deadline_ms: Infinity/NaN
+                # literals parse fine and would decode garbage tokens.
+                raise ValueError("prompt_vec must be finite")
+            return vec
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise ValueError("need 'prompt' (string) or 'prompt_vec'")
+        return encode_prompt(prompt, self.d)

@@ -15,11 +15,28 @@ from typing import Dict, List, Optional, Tuple
 _BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text exposition format: inside a label value,
+    backslash, double-quote and line-feed must be escaped (in that
+    order — escaping the escape char first keeps it idempotent-safe)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{val}"' for k, val in labels)
+    inner = ",".join(
+        f'{k}="{_escape_label_value(val)}"' for k, val in labels)
     return "{" + inner + "}"
+
+
+def _fmt_bucket_bound(b: float) -> str:
+    """str(float) — 'le="1.0"', the python-client form. le is a
+    SERIES-IDENTITY label: the pre-existing histograms already scrape
+    with these spellings, so custom buckets must render the same way or
+    existing series silently end and restart under new names."""
+    return str(float(b))
 
 
 class Registry:
@@ -28,6 +45,7 @@ class Registry:
         self._counters: Dict[str, Dict[tuple, float]] = {}
         self._gauges: Dict[str, Dict[tuple, float]] = {}
         self._hists: Dict[str, Dict[tuple, dict]] = {}
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
         self._help: Dict[str, str] = {}
 
     def counter_inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0,
@@ -46,22 +64,80 @@ class Registry:
             self._gauges.setdefault(name, {})[key] = value
 
     def observe(self, name: str, value: float, labels: Optional[dict] = None,
-                help: str = "") -> None:
+                help: str = "", buckets: Optional[tuple] = None) -> None:
         """Cumulative bucket counts + sum + count, prometheus-style — O(1)
-        memory per series regardless of observation volume."""
+        memory per series regardless of observation volume.
+
+        `buckets` sets this METRIC's upper bounds (ascending) on first
+        use; later observations reuse them (per-metric, like
+        promclient's histogram registration — a histogram cannot change
+        buckets mid-flight without corrupting the cumulative counts)."""
         key = tuple(sorted((labels or {}).items()))
+        if buckets:
+            import math
+
+            bs_new = tuple(float(b) for b in buckets)
+            # Finite and ascending, no trailing +Inf: render() appends
+            # the +Inf line itself (from count), and a non-finite bound
+            # would break both the le= formatting and quantile()'s
+            # interpolation.
+            if (not all(math.isfinite(b) for b in bs_new)
+                    or list(bs_new) != sorted(set(bs_new))):
+                raise ValueError(
+                    f"buckets must be finite, ascending and distinct "
+                    f"(+Inf is implicit): {buckets}")
         with self._lock:
             self._help.setdefault(name, help)
+            bs = self._hist_buckets.setdefault(
+                name, bs_new if buckets else _BUCKETS)
+            if buckets and bs != bs_new:
+                # Changing buckets mid-flight would corrupt the
+                # cumulative counts; a silently-ignored spec would make
+                # resolution depend on call order. Same-spec repeats
+                # (the hot observe path) pass untouched.
+                raise ValueError(
+                    f"{name} already registered with buckets {bs}, "
+                    f"got conflicting {bs_new}")
             series = self._hists.setdefault(name, {})
             state = series.get(key)
             if state is None:
-                state = {"buckets": [0] * len(_BUCKETS), "sum": 0.0, "count": 0}
+                state = {"buckets": [0] * len(bs), "sum": 0.0, "count": 0}
                 series[key] = state
-            for i, b in enumerate(_BUCKETS):
+            for i, b in enumerate(bs):
                 if value <= b:
                     state["buckets"][i] += 1
             state["sum"] += value
             state["count"] += 1
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[dict] = None) -> Optional[float]:
+        """Estimate the q-quantile (0 < q <= 1) of a histogram series
+        from its cumulative bucket counts — the server-side twin of
+        PromQL's histogram_quantile, for in-process p99 (the serving
+        plane's latency SLO check). Linear interpolation within the
+        containing bucket, 0 as the implicit lower bound of the first;
+        observations past the last finite bucket clamp to that bound
+        (exactly histogram_quantile's convention). None when the series
+        has no observations."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"q must be in (0, 1], got {q}")
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            state = self._hists.get(name, {}).get(key)
+            if state is None or state["count"] == 0:
+                return None
+            bs = self._hist_buckets.get(name, _BUCKETS)
+            target = q * state["count"]
+            prev_cum, prev_bound = 0, 0.0
+            for i, b in enumerate(bs):
+                cum = state["buckets"][i]
+                if cum >= target:
+                    in_bucket = cum - prev_cum
+                    frac = ((target - prev_cum) / in_bucket
+                            if in_bucket else 1.0)
+                    return prev_bound + (b - prev_bound) * frac
+                prev_cum, prev_bound = cum, b
+            return float(bs[-1])
 
     def render(self) -> str:
         lines: List[str] = []
@@ -82,9 +158,10 @@ class Registry:
                 if self._help.get(name):
                     lines.append(f"# HELP {name} {self._help[name]}")
                 lines.append(f"# TYPE {name} histogram")
+                bs = self._hist_buckets.get(name, _BUCKETS)
                 for key, state in sorted(series.items()):
-                    for i, b in enumerate(_BUCKETS):
-                        bl = key + (("le", str(b)),)
+                    for i, b in enumerate(bs):
+                        bl = key + (("le", _fmt_bucket_bound(b)),)
                         lines.append(
                             f"{name}_bucket{_fmt_labels(bl)} {state['buckets'][i]}"
                         )

@@ -87,6 +87,71 @@ def test_registry_renders_prometheus_text():
     assert 'dpu_cni_request_seconds_count{command="ADD"} 1' in text
 
 
+def test_registry_render_exact_custom_buckets_and_escaping():
+    """The full exposition text, byte for byte: HELP/TYPE ordering,
+    label-value escaping (backslash, quote, newline — the three the
+    Prometheus text format mandates), per-metric custom buckets with
+    bounds rendered str(float)-style (le="1.0" — the spelling the
+    PRE-EXISTING histogram series already scrape under; le is a
+    series-identity label, so it must never change), cumulative bucket
+    counts, sum and count."""
+    r = Registry()
+    r.counter_inc("req_total", {"path": 'a"b\\c\nd'}, help="requests")
+    r.gauge_set("depth", 2)
+    r.observe("lat_seconds", 0.25, {"replica": "r0"}, help="latency",
+              buckets=(0.5, 1.0))
+    r.observe("lat_seconds", 0.5, {"replica": "r0"})
+    r.observe("lat_seconds", 2.0, {"replica": "r0"})
+    assert r.render() == (
+        "# HELP req_total requests\n"
+        "# TYPE req_total counter\n"
+        'req_total{path="a\\"b\\\\c\\nd"} 1.0\n'
+        "# TYPE depth gauge\n"
+        "depth 2\n"
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{replica="r0",le="0.5"} 2\n'
+        'lat_seconds_bucket{replica="r0",le="1.0"} 2\n'
+        'lat_seconds_bucket{replica="r0",le="+Inf"} 3\n'
+        'lat_seconds_sum{replica="r0"} 2.75\n'
+        'lat_seconds_count{replica="r0"} 3\n'
+    )
+
+
+def test_registry_quantile_estimator():
+    """quantile() — histogram_quantile's estimate, in-process: linear
+    interpolation inside the containing bucket, implicit 0 lower bound
+    on the first, clamp to the last finite bound for the +Inf bucket,
+    None for series with no data."""
+    r = Registry()
+    assert r.quantile("missing", 0.99) is None
+    for v in (0.25, 0.5, 2.0):
+        r.observe("lat", v, {"replica": "r0"}, buckets=(0.5, 1.0))
+    # count=3: q=0.5 → target 1.5 of the 2 in (0, 0.5] → 0.375.
+    assert r.quantile("lat", 0.5, {"replica": "r0"}) == pytest.approx(0.375)
+    # q=0.99 → target 2.97 falls past the last finite bucket → clamp.
+    assert r.quantile("lat", 0.99, {"replica": "r0"}) == pytest.approx(1.0)
+    # Exact bucket edge: q such that target == cumulative count.
+    assert r.quantile("lat", 2 / 3, {"replica": "r0"}) == pytest.approx(0.5)
+    # Default buckets still work and label-less series resolve.
+    r.observe("plain", 0.003)
+    est = r.quantile("plain", 0.5)
+    assert 0.001 < est <= 0.005
+    with pytest.raises(ValueError):
+        r.quantile("lat", 0.0)
+    # +Inf is implicit (render appends it from count); explicit inf/NaN
+    # or unsorted bounds would corrupt le= formatting and interpolation.
+    for bad in ((0.5, float("inf")), (float("nan"),), (1.0, 0.5),
+                (0.5, 0.5)):
+        with pytest.raises(ValueError, match="buckets"):
+            r.observe("bad_hist", 0.1, buckets=bad)
+    # Re-registering with a CONFLICTING spec is loud (call-order bugs);
+    # repeating the same spec — the hot observe path — is fine.
+    r.observe("lat", 0.3, {"replica": "r0"}, buckets=(0.5, 1.0))
+    with pytest.raises(ValueError, match="conflicting"):
+        r.observe("lat", 0.3, {"replica": "r0"}, buckets=(0.25, 1.0))
+
+
 def test_metrics_server_serves_http():
     r = Registry()
     r.counter_inc("x_total", help="x")

@@ -359,3 +359,50 @@ def test_1f1b_rejects_wrong_chunk_count():
     step = make_1f1b(mesh, mlp_stage, v=1, M=2)
     with pytest.raises(ValueError, match="v=1"):
         step(stacked, x, x)
+
+
+def test_1f1b_masked_grads_survive_division_bearing_stage():
+    """ADVICE r5: run_schedule's masked backward used to accumulate
+    `dpl * gmask` — on IDLE ticks the rematerialized VJP runs over the
+    ZERO-filled buffers, and any stage_fn with a division (rmsnorm,
+    softmax denominators) yields NaN there, which NaN·0 = NaN then
+    smeared into the gradient accumulator for every real microbatch.
+    Masking must SELECT (jnp.where), not multiply. The stage here is an
+    rmsnorm-style map: finite on real data, 0/0 = NaN on the idle
+    zeros — so this test fails loudly on the multiplicative form."""
+    import jax.numpy as jnp
+
+    from dpu_operator_tpu.parallel.pipeline_1f1b import (
+        make_1f1b, sequential_loss)
+
+    def rms_stage(p, x):
+        h = x @ p["w"]
+        return h / jnp.sqrt(jnp.mean(h ** 2))  # NaN on all-zero input
+
+    n, M, v, d, rows = 2, 3, 1, 8, 4
+    mesh = _mesh([("pp", n)])
+    rng = np.random.RandomState(5)
+    per_stage = [{"w": jnp.asarray(
+        rng.randn(d, d).astype(np.float32) / np.sqrt(d))}
+        for _ in range(n * v)]
+    stacked = {"w": jnp.stack([p["w"] for p in per_stage])}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stacked = {"w": jax.device_put(
+        stacked["w"], NamedSharding(mesh, P("pp")))}
+    x = jnp.asarray(rng.randn(M, rows, d).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(M, rows, d).astype(np.float32))
+
+    step = jax.jit(make_1f1b(mesh, rms_stage, v=v, M=M))
+    loss, grads = step(stacked, x, tgt)
+    assert np.isfinite(float(loss)), float(loss)
+    gw = np.asarray(grads["w"])
+    assert np.isfinite(gw).all(), "IDLE-tick NaN poisoned the grads"
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda ps: sequential_loss(ps, x, tgt, rms_stage))(per_stage)
+    assert np.isclose(float(loss), float(ref_loss), rtol=1e-5)
+    for i, ref in enumerate(ref_grads):
+        np.testing.assert_allclose(gw[i], np.asarray(ref["w"]),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=f"stage {i}")

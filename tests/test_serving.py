@@ -1,0 +1,572 @@
+"""Serving plane: continuous batching, backpressure, drain, HTTP contract.
+
+Tier-1 scope: the smoke test runs the REAL path end to end (HTTP →
+AdmissionQueue → ContinuousBatcher → jitted infer_step on a jax mesh)
+with a tiny model; the batching-vs-serial comparison and the overload
+test are the acceptance evidence for ISSUE 2 (≥2× over serial batch=1,
+bounded p99 + 503 shedding under 2× overload). Scheduler-plane timing
+tests use SyntheticExecutor so CI-box noise cannot flake them; only the
+sustained-load soak is marked slow.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.serving import (AdmissionQueue, Draining,
+                                      GenerateRequest, LocalExecutor,
+                                      QueueFull, ServingServer,
+                                      SyntheticExecutor, encode_prompt)
+
+# One compiled model shared by every LocalExecutor test (compile cost is
+# the dominant line item, so the real-model tests share one server).
+MODEL = dict(S=1, d=8, h=8, E=1)
+
+
+def _post(url, body, timeout=30.0):
+    data = json.dumps(body).encode()
+    try:
+        r = urllib.request.urlopen(
+            urllib.request.Request(url + "/v1/generate", data=data,
+                                   headers={"Content-Type":
+                                            "application/json"}),
+            timeout=timeout)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _closed_loop(url, clients, per_client, max_tokens, deadline_ms=30000):
+    """clients threads, each `per_client` sequential requests; returns
+    (wall_s, latencies_ms_of_200s, all_codes, headers_of_503s)."""
+    lat, codes, h503 = [], [], []
+    lock = threading.Lock()
+
+    def run(c):
+        for i in range(per_client):
+            t0 = time.perf_counter()
+            code, _, headers = _post(url, {"prompt": f"c{c}-{i}",
+                                           "max_tokens": max_tokens,
+                                           "deadline_ms": deadline_ms})
+            ms = (time.perf_counter() - t0) * 1000
+            with lock:
+                codes.append(code)
+                if code == 200:
+                    lat.append(ms)
+                elif code == 503:
+                    h503.append(headers)
+
+    ts = [threading.Thread(target=run, args=(c,)) for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return time.perf_counter() - t0, lat, codes, h503
+
+
+@pytest.fixture(scope="module")
+def batched_server():
+    ex = LocalExecutor(slots=8, **MODEL)
+    srv = ServingServer([ex], max_queue_depth=64).start()
+    yield srv
+    srv.stop()
+
+
+# -- smoke: the real path, end to end -----------------------------------------
+
+
+def test_generate_http_roundtrip(batched_server):
+    url = batched_server.url
+    code, doc, _ = _post(url, {"prompt": "hello fabric", "max_tokens": 6})
+    assert code == 200, doc
+    assert len(doc["tokens"]) == 6
+    assert all(0 <= t < MODEL["d"] for t in doc["tokens"])
+    assert doc["truncated"] is False
+    assert doc["timings"]["total_ms"] > 0
+
+    # Deterministic prompt encoding → deterministic greedy decode.
+    code2, doc2, _ = _post(url, {"prompt": "hello fabric",
+                                 "max_tokens": 6})
+    assert code2 == 200 and doc2["tokens"] == doc["tokens"]
+
+    # prompt_vec path: explicit state vector, same contract.
+    vec = encode_prompt("hello fabric", MODEL["d"])
+    code3, doc3, _ = _post(url, {"prompt_vec": [float(v) for v in vec],
+                                 "max_tokens": 6})
+    assert code3 == 200 and doc3["tokens"] == doc["tokens"]
+
+    assert urllib.request.urlopen(url + "/healthz").status == 200
+    assert urllib.request.urlopen(url + "/readyz").status == 200
+    metrics = urllib.request.urlopen(url + "/metrics").read().decode()
+    assert 'serving_requests_total{code="200",outcome="ok"}' in metrics
+    assert "serving_batch_occupancy_bucket" in metrics
+    assert "serving_queue_depth" in metrics
+    assert "serving_request_seconds_bucket" in metrics
+
+
+def test_generate_rejects_malformed(batched_server):
+    url = batched_server.url
+    for body, frag in (
+        ({"max_tokens": 4}, "prompt"),
+        ({"prompt": "x", "max_tokens": 0}, "max_tokens"),
+        ({"prompt": "x", "max_tokens": "NaN"}, "numbers"),
+        ({"prompt": "x", "deadline_ms": -5}, "deadline_ms"),
+        # json accepts Infinity/NaN literals and Python floats overflow
+        # Event.wait — all three must die in validation, not mid-slot.
+        ({"prompt": "x", "deadline_ms": 1e13}, "deadline_ms"),
+        ({"prompt": "x", "deadline_ms": float("inf")}, "deadline_ms"),
+        ({"prompt": "x", "deadline_ms": float("nan")}, "deadline_ms"),
+        ({"prompt_vec": [1.0, 2.0], "max_tokens": 4}, "prompt_vec"),
+    ):
+        code, doc, _ = _post(url, body)
+        assert code == 400, (body, doc)
+        assert frag in doc["error"], (body, doc)
+    # Non-numeric prompt_vec raises TypeError inside np.asarray — must
+    # still be a 400, not a dropped connection.
+    code, doc, _ = _post(url, {"prompt_vec": {"a": 1}, "max_tokens": 2})
+    assert code == 400, doc
+    # Non-finite prompt_vec (json.loads accepts NaN/Infinity literals).
+    code, doc, _ = _post(url, {"prompt_vec":
+                               [float("nan")] * MODEL["d"],
+                               "max_tokens": 2})
+    assert code == 400 and "finite" in doc["error"], doc
+    # Oversized body → 413 before buffering it.
+    big = urllib.request.Request(url + "/v1/generate",
+                                 data=b" " * ((1 << 20) + 1))
+    try:
+        urllib.request.urlopen(big, timeout=10)
+        assert False, "oversized body must be rejected"
+    except urllib.error.HTTPError as e:
+        assert e.code == 413
+    except OSError:
+        pass  # server closed mid-send after replying; also a rejection
+    req = urllib.request.Request(url + "/v1/generate", data=b"{nope")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        assert False, "malformed JSON must not 200"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+# -- the continuous-batching win (ISSUE 2 acceptance) -------------------------
+
+
+def test_continuous_batching_at_least_2x_serial():
+    """≥2× the serial batch=1 baseline on req/s over the REAL HTTP
+    path: same front-end, same queue, same scheduler — only the slot
+    count differs. The executors carry a FIXED 4 ms per-step cost (the
+    accelerator cost model: an MXU-bound decode step prices a full
+    batch the same as one row — the premise continuous batching exists
+    to exploit; a jitted CPU matmul scales with batch instead, which
+    would measure the wrong substrate, and its dispatch overhead is
+    too small to clear this harness's in-process GIL-bound HTTP
+    ceiling). bench_serving measures the same pair plus the real
+    jitted-model path."""
+    step_s = 0.004
+    batched = ServingServer([SyntheticExecutor(slots=8, d=16,
+                                               step_time_s=step_s)],
+                            max_queue_depth=128).start()
+    serial = ServingServer([SyntheticExecutor(slots=1, d=16,
+                                              step_time_s=step_s)],
+                           max_queue_depth=128).start()
+    try:
+        # Warm both HTTP paths (first-request thread spin-up).
+        _closed_loop(batched.url, 2, 2, 2)
+        _closed_loop(serial.url, 2, 2, 2)
+        wall_b, lat_b, codes_b, _ = _closed_loop(
+            batched.url, clients=16, per_client=2, max_tokens=32,
+            deadline_ms=120_000)
+        wall_s, lat_s, codes_s, _ = _closed_loop(
+            serial.url, clients=16, per_client=2, max_tokens=32,
+            deadline_ms=120_000)
+        assert all(c == 200 for c in codes_b), codes_b
+        assert all(c == 200 for c in codes_s), codes_s
+        rate_b = len(codes_b) / wall_b
+        rate_s = len(codes_s) / wall_s
+        assert rate_b >= 2.0 * rate_s, (
+            f"continuous batching {rate_b:.1f} req/s vs serial "
+            f"{rate_s:.1f} req/s — win below 2x")
+    finally:
+        batched.stop()
+        serial.stop()
+
+
+# -- backpressure: overload is shed, admitted latency stays bounded -----------
+
+
+def test_overload_503_and_bounded_p99():
+    """Under ~2x overload with a small queue: the excess gets 503 +
+    Retry-After, every ADMITTED request finishes within deadline +
+    step-granularity slack, the queue never exceeds its depth, and the
+    server stays healthy. SyntheticExecutor pins the per-step cost so
+    the arithmetic of 'overload' is deterministic."""
+    step_s = 0.005
+    ex = SyntheticExecutor(slots=4, d=16, step_time_s=step_s)
+    srv = ServingServer([ex], max_queue_depth=6,
+                        default_deadline_s=2.0).start()
+    try:
+        deadline_ms = 2000.0
+        wall, lat, codes, h503 = _closed_loop(
+            srv.url, clients=16, per_client=4, max_tokens=8,
+            deadline_ms=deadline_ms)
+        n_ok = sum(1 for c in codes if c == 200)
+        n_503 = sum(1 for c in codes if c == 503)
+        assert n_ok + n_503 == len(codes), codes  # no 5xx crashes
+        assert n_ok >= 1
+        assert n_503 >= 1, "2x overload over a 6-deep queue must shed"
+        # Bounded tail for admitted work: deadline + one decode step +
+        # hand-off grace, NOT proportional to offered load.
+        assert max(lat) < deadline_ms + 8 * step_s * 1000 + 500, lat
+        # Retry-After rides every 503.
+        assert all("Retry-After" in h for h in h503), h503
+        # Still alive and ready after the storm.
+        assert urllib.request.urlopen(srv.url + "/healthz").status == 200
+        metrics = urllib.request.urlopen(
+            srv.url + "/metrics").read().decode()
+        assert 'outcome="queue_full"' in metrics
+    finally:
+        srv.stop()
+
+
+def test_queue_full_and_expiry_shed():
+    """AdmissionQueue unit seam: depth is a hard bound; entries whose
+    deadline lapsed while queued are failed at pop, not decoded."""
+    q = AdmissionQueue(max_depth=2, retry_after_s=3.0)
+    now = time.monotonic()
+    mk = lambda dl: GenerateRequest(
+        prompt_vec=np.zeros(4, np.float32), max_tokens=1, deadline=dl)
+    q.submit(mk(now + 10))
+    stale = mk(now - 0.001)
+    q.submit(stale)
+    with pytest.raises(QueueFull) as ei:
+        q.submit(mk(now + 10))
+    assert ei.value.retry_after_s == 3.0
+    got = q.get_many(5)
+    assert len(got) == 1 and got[0].deadline > now
+    assert stale.done and "deadline" in stale.error
+    assert q.shed_expired == 1
+    q.begin_drain()
+    with pytest.raises(Draining):
+        q.submit(mk(now + 10))
+
+
+def test_deadline_mid_decode_truncates():
+    """A request whose deadline lands mid-decode returns 200 with the
+    tokens it earned, marked truncated — bounded latency without
+    throwing away paid-for work."""
+    ex = SyntheticExecutor(slots=2, d=8, step_time_s=0.02)
+    srv = ServingServer([ex]).start()
+    try:
+        code, doc, _ = _post(srv.url, {"prompt": "slow",
+                                       "max_tokens": 500,
+                                       "deadline_ms": 150})
+        assert code == 200, doc
+        assert doc["truncated"] is True
+        assert 1 <= len(doc["tokens"]) < 500
+    finally:
+        srv.stop()
+
+
+# -- drain: SIGTERM lets in-flight work finish, new work bounces --------------
+
+
+def _drain_fixture_server(step_s=0.02):
+    from dpu_operator_tpu import vars as v
+    from dpu_operator_tpu.drain import Drainer
+    from dpu_operator_tpu.k8s import InMemoryClient, InMemoryCluster
+
+    client = InMemoryClient(InMemoryCluster())
+    client.create({"apiVersion": "v1", "kind": "Node",
+                   "metadata": {"name": "serve-n0"}})
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "fabric-pod", "namespace": "default"},
+        "spec": {"nodeName": "serve-n0", "containers": [
+            {"name": "c", "image": "i", "resources": {
+                "requests": {v.DPU_RESOURCE_NAME: "1"}}}]},
+    })
+    ex = SyntheticExecutor(slots=2, d=8, step_time_s=step_s)
+    srv = ServingServer([ex], drainer=Drainer(client),
+                        node_name="serve-n0").start()
+    return srv, client
+
+
+def test_drain_completes_inflight_rejects_new_and_cordons():
+    srv, client = _drain_fixture_server()
+    try:
+        result = {}
+
+        def long_request():
+            result["resp"] = _post(srv.url, {"prompt": "inflight",
+                                             "max_tokens": 40,
+                                             "deadline_ms": 30000})
+
+        t = threading.Thread(target=long_request)
+        t.start()
+        deadline = time.monotonic() + 5
+        while srv.pool.active() == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.pool.active() == 1
+
+        drained = threading.Thread(target=srv.begin_drain, args=(30.0,))
+        drained.start()
+        deadline = time.monotonic() + 5
+        while not srv.draining and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # New work during drain → 503, while the in-flight request is
+        # still decoding.
+        code, doc, headers = _post(srv.url, {"prompt": "late",
+                                             "max_tokens": 2})
+        assert code == 503 and doc["error"] == "draining"
+        assert "Retry-After" in headers
+        try:
+            urllib.request.urlopen(srv.url + "/readyz")
+            assert False, "readyz must be 503 while draining"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # Liveness stays green (kubelet must not kill a draining pod).
+        assert urllib.request.urlopen(srv.url + "/healthz").status == 200
+
+        t.join(timeout=30)
+        drained.join(timeout=30)
+        assert not drained.is_alive()
+        code, doc, _ = result["resp"]
+        assert code == 200 and len(doc["tokens"]) == 40, doc
+
+        # The wired drain.Drainer ran: node cordoned, fabric pod evicted.
+        node = client.get("v1", "Node", None, "serve-n0")
+        assert node["spec"]["unschedulable"] is True
+        assert client.get_or_none(
+            "v1", "Pod", "default", "fabric-pod") is None
+    finally:
+        srv.stop()
+
+
+def test_keepalive_connection_survives_early_503():
+    """HTTP/1.1 keep-alive: paths that reply before the handler logic
+    (drain 503, POST 404) must still have consumed the request body, or
+    the leftover bytes desync every later request on the connection.
+    urllib opens fresh connections and cannot catch this; a persistent
+    http.client connection does."""
+    import http.client
+
+    ex = SyntheticExecutor(slots=2, d=8)
+    srv = ServingServer([ex]).start()
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        body = json.dumps({"prompt": "x", "max_tokens": 2}).encode()
+        # 404 path with a body, same connection reused after.
+        conn.request("POST", "/nope", body=body)
+        assert conn.getresponse().read() is not None
+        conn.request("POST", "/v1/generate", body=body)
+        r = conn.getresponse()
+        assert r.status == 200, r.read()
+        r.read()
+        # Drain 503 path, then the connection must still be usable.
+        srv.queue.begin_drain()
+        srv._draining.set()
+        conn.request("POST", "/v1/generate", body=body)
+        r = conn.getresponse()
+        assert r.status == 503, r.read()
+        r.read()
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_sigterm_triggers_drain():
+    srv, client = _drain_fixture_server(step_s=0.005)
+    prev = srv.install_signal_handlers(stop_after=False)
+    try:
+        code, _, _ = _post(srv.url, {"prompt": "pre", "max_tokens": 2})
+        assert code == 200
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert srv.wait_drained(timeout=10)
+        code, doc, _ = _post(srv.url, {"prompt": "post", "max_tokens": 2})
+        assert code == 503 and doc["error"] == "draining"
+        assert client.get("v1", "Node", None,
+                          "serve-n0")["spec"]["unschedulable"] is True
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        srv.stop()
+
+
+# -- scheduler plane ----------------------------------------------------------
+
+
+def test_batch_reforms_at_step_boundaries():
+    """Continuous means continuous: a late request joins while an early
+    long request is still decoding (no wait for the batch to clear),
+    and the early one's finish frees its slot for the next waiter."""
+    ex = SyntheticExecutor(slots=2, d=8, step_time_s=0.01)
+    srv = ServingServer([ex]).start()
+    try:
+        out = {}
+
+        def go(name, tokens):
+            out[name] = _post(srv.url, {"prompt": name,
+                                        "max_tokens": tokens,
+                                        "deadline_ms": 30000})
+
+        long_t = threading.Thread(target=go, args=("long", 60))
+        long_t.start()
+        time.sleep(0.1)  # long is mid-decode now
+        t0 = time.perf_counter()
+        go("short", 3)
+        short_wall = time.perf_counter() - t0
+        long_t.join(timeout=30)
+        assert out["short"][0] == 200 and out["long"][0] == 200
+        # The short request finished while long was still running: its
+        # wall time is a few steps, nowhere near long's remaining ~0.5s.
+        assert short_wall < 0.3, short_wall
+    finally:
+        srv.stop()
+
+
+def test_replica_pool_spreads_load():
+    """Two replicas over one queue: both take work."""
+    ex0 = SyntheticExecutor(slots=1, d=8, step_time_s=0.002)
+    ex1 = SyntheticExecutor(slots=1, d=8, step_time_s=0.002)
+    srv = ServingServer([ex0, ex1], max_queue_depth=64).start()
+    try:
+        wall, lat, codes, _ = _closed_loop(srv.url, clients=4,
+                                           per_client=4, max_tokens=8)
+        assert all(c == 200 for c in codes)
+        assert ex0.steps > 0 and ex1.steps > 0
+    finally:
+        srv.stop()
+
+
+def test_mixed_feature_dim_pool_rejected():
+    """prompt_vec width is validated once at the front door, so every
+    replica must agree on d — a mixed pool would admit vectors some
+    replica cannot hold."""
+    with pytest.raises(ValueError, match="feature dim"):
+        ServingServer([SyntheticExecutor(slots=1, d=16),
+                       SyntheticExecutor(slots=1, d=8)])
+
+
+def test_executor_failure_fails_requests_not_server():
+    class Exploding(SyntheticExecutor):
+        def step(self, x):
+            raise RuntimeError("replica lost")
+
+    srv = ServingServer([Exploding(slots=2, d=8)]).start()
+    try:
+        code, doc, _ = _post(srv.url, {"prompt": "x", "max_tokens": 2,
+                                       "deadline_ms": 2000})
+        assert code == 500 and "replica lost" in doc["error"]
+        assert urllib.request.urlopen(srv.url + "/healthz").status == 200
+    finally:
+        srv.stop()
+
+
+def test_idle_slots_do_not_steal_moe_capacity_on_ep_mesh():
+    """A request's decode must not depend on how many batch slots are
+    idle. On an ep-sharded mesh under capacity pressure (C=1 here), a
+    zero-filled idle slot's uniform router softmax would win bucket
+    slot 0 by stream priority and drop a real token's MoE dispatch —
+    infer_step masks idle rows out of routing entirely, so the same
+    prompt decodes identically in any slot position at any occupancy."""
+    import jax
+
+    from dpu_operator_tpu.parallel.train_step import (init_params,
+                                                      shard_params)
+    from dpu_operator_tpu.serving.infer import (make_infer_step,
+                                                serving_mesh)
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices for an ep=2 mesh")
+    mesh = serving_mesh(shape={"ep": 2})
+    params = shard_params(init_params(S=1, d=8, h=8, E=2, seed=2), mesh)
+    step = make_infer_step(mesh, capacity_factor=1.0)
+    rng = np.random.RandomState(9)
+    for _ in range(8):  # vectors routing to both experts get exercised
+        r = rng.randn(8).astype(np.float32)
+        first = np.zeros((4, 8), np.float32)
+        first[0] = r
+        last = np.zeros((4, 8), np.float32)
+        last[3] = r
+        y_first = np.asarray(step(params, first))
+        y_last = np.asarray(step(params, last))
+        np.testing.assert_allclose(y_first[0], y_last[3],
+                                   rtol=1e-5, atol=1e-6)
+        # Idle rows stay exactly zero — the scheduler's slot contract.
+        assert not y_first[1:].any() and not y_last[:3].any()
+
+
+# -- sustained load (slow tier) -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_sustained_open_loop_holds_p99():
+    """Open-loop arrivals at ~60% of measured capacity for several
+    seconds: p99 stays near service time (no queue growth), nothing is
+    shed. The bench's open-loop overload counterpart lives in
+    serving/bench_serving.py."""
+    step_s = 0.004
+    tokens = 8
+    ex = SyntheticExecutor(slots=4, d=16, step_time_s=step_s)
+    srv = ServingServer([ex], max_queue_depth=64).start()
+    try:
+        capacity = ex.slots / (tokens * step_s)     # req/s, fully batched
+        rate = 0.4 * capacity
+        lat, codes = [], []
+        lock = threading.Lock()
+
+        def one(i):
+            t0 = time.perf_counter()
+            code, _, _ = _post(srv.url, {"prompt": f"s{i}",
+                                         "max_tokens": tokens,
+                                         "deadline_ms": 10000})
+            with lock:
+                codes.append(code)
+                lat.append((time.perf_counter() - t0) * 1000)
+
+        threads = []
+        n = int(rate * 4.0)
+        t0 = time.perf_counter()
+        for i in range(n):
+            target = t0 + i / rate
+            time.sleep(max(0.0, target - time.perf_counter()))
+            th = threading.Thread(target=one, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=30)
+        # Half-capacity load: overwhelmingly served. A small shed slice
+        # is contention bursts on a shared box (steps cost more than
+        # their sleep when the CPU is oversubscribed), not queue growth;
+        # sustained overload sheds ~40% (see bench_serving).
+        assert all(c in (200, 503) for c in codes), codes
+        ok_frac = sum(1 for c in codes if c == 200) / len(codes)
+        assert ok_frac >= 0.9, f"shed {1 - ok_frac:.2%} at half capacity"
+        from dpu_operator_tpu.serving.bench_serving import nearest_rank
+
+        lat = sorted(l for l, c in zip(lat, codes) if c == 200)
+        p99 = nearest_rank(lat, 0.99)
+        # Bounded means near service time, not near the 10 s deadline a
+        # growing queue would march toward. Service time is taken from
+        # the server's OWN step histogram (p95), not the nominal sleep:
+        # on a contended box a 4 ms sleep-step costs several times that
+        # (GIL + scheduler), and a bound that ignores it flakes exactly
+        # when CI is busiest. Queue growth still blows past this within
+        # the window — it compounds per request, contention doesn't.
+        step_p95_s = srv.registry.quantile(
+            "serving_step_seconds", 0.95, {"replica": "replica0"}) or step_s
+        service_ms = tokens * max(step_s, step_p95_s) * 1000
+        assert p99 < 10 * service_ms + 600, (p99, service_ms)
+    finally:
+        srv.stop()
