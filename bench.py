@@ -742,7 +742,11 @@ def bench_serving() -> dict:
             f"{out.get('serving_serial_reqs_per_s')} req/s = "
             f"{out.get('serving_batching_speedup')}x; overload shed "
             f"{out.get('serving_overload_shed_frac')} at p99 "
-            f"{out.get('serving_overload_p99_ms')} ms",
+            f"{out.get('serving_overload_p99_ms')} ms; decode loop "
+            f"pipelined {out.get('serving_steps_per_s')} vs sync "
+            f"{out.get('serving_sync_steps_per_s')} steps/s = "
+            f"{out.get('serving_pipeline_speedup')}x (host-gap frac "
+            f"{out.get('serving_host_gap_frac')})",
             file=sys.stderr,
         )
         return out
@@ -820,6 +824,13 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # boxes swing tails far more than medians).
         ("serving_reqs_per_s", 0.85, "serving_reqs_ge_085_median"),
         ("serving_p99_ms", 1.35, "serving_p99_le_135_median"),
+        # Decode loop (ISSUE 3): the pipelined device-resident useful
+        # step rate holds 0.85x the rolling median, and the host-gap
+        # share of the loop gets the latency band (1.35x) — a host-gap
+        # regression is the overlap silently rotting back toward the
+        # synchronous loop even when steps/s noise masks it.
+        ("serving_steps_per_s", 0.85, "serving_steps_ge_085_median"),
+        ("serving_host_gap_frac", 1.35, "serving_host_gap_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -877,6 +888,12 @@ def main() -> int:
         "serving_overload_p99_ms": "ms",
         "serving_overload_shed_frac": "frac",
         "serving_local_reqs_per_s": "req/s",
+        "serving_steps_per_s": "steps/s",
+        "serving_sync_steps_per_s": "steps/s",
+        "serving_pipeline_speedup": "x",
+        "serving_host_gap_frac": "frac",
+        "serving_step_device_ms": "ms",
+        "serving_host_gap_ms": "ms",
     }
     for key, unit in units.items():
         if key in metrics:

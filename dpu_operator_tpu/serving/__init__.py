@@ -5,10 +5,13 @@ The request path:
     HTTP POST /v1/generate (server.py)
       → bounded AdmissionQueue (queue.py — 503 + Retry-After past depth)
       → ContinuousBatcher slot (scheduler.py — admit/retire at step
-        boundaries)
-      → Executor.step (executor.py seam: in-process jax replica today,
+        boundaries; pipelined: submit step k, settle step k-1 while
+        the device runs)
+      → Executor seam (executor.py: submit/collect two-phase decode,
+        step(x) sync fallback; in-process jax replica today,
         fabric-worker replica later)
-      → infer_step (infer.py — forward-only train_step model on a mesh)
+      → DecodeStep (infer.py — device-resident forward-only train_step
+        model on a mesh; only token ids cross PCIe)
 
 Importing this package stays jax-free; jax loads only when a
 LocalExecutor is constructed.

@@ -24,6 +24,17 @@ Sections:
      the server must still answer /healthz after the storm
   4. the jitted-model path             → serving_local_reqs_per_s,
      serving_local_p99_ms
+  5. decode-loop decomposition (ISSUE 3): the REAL scheduler over the
+     REAL jitted model, queue preloaded, no HTTP — the synchronous
+     PR 2 LocalExecutor vs the device-resident pipelined one at the
+     same slot count. steps/s here is USEFUL steps (decoded tokens ÷
+     slots per second): pipeline hand-off steps and partial-occupancy
+     drain count against it, so the figure cannot be inflated by
+     decoding stale rows. → serving_steps_per_s (pipelined, headline),
+     serving_sync_steps_per_s, serving_pipeline_speedup, and the
+     device-vs-host-gap split (serving_step_device_ms,
+     serving_host_gap_ms, serving_host_gap_frac) from the scheduler's
+     own histograms.
 
 Protocol: exactly one JSON object on stdout; progress on stderr.
 """
@@ -134,6 +145,102 @@ def open_loop(url: str, rate_per_s: float, seconds: float,
     return wall, lat, codes
 
 
+def decode_loop_rates(slots: int, model: dict, n_req: int,
+                      toks: int, trace, repeats: int = 3) -> dict:
+    """Section 5: steps/s through the real ContinuousBatcher for the
+    sync vs pipelined LocalExecutor. The queue is preloaded and driven
+    without HTTP so the figure measures the decode loop (scheduler
+    bookkeeping vs device step), not the GIL-bound front-end. Each
+    executor compiles once, then the modes run INTERLEAVED `repeats`
+    times and the best wall per mode is kept — the shared-box defense:
+    a noisy neighbour lands on both modes or neither, and best-of
+    discards the hits (same reasoning as the fabric bench's paired
+    in-bench samples). The device/host-gap split comes from the
+    scheduler's own histograms on the best pipelined run."""
+    import time as _time
+
+    from ..utils.metrics import Registry
+    from .api import GenerateRequest, encode_prompt
+    from .executor import LocalExecutor
+    from .queue import AdmissionQueue
+    from .scheduler import ContinuousBatcher
+
+    out: dict = {}
+    tok_total = n_req * toks
+    execs: dict = {}
+    for mode in ("sync", "pipelined"):
+        t0 = _time.perf_counter()
+        execs[mode] = LocalExecutor(slots=slots, mode=mode, **model)
+        if mode == "pipelined":
+            out["serving_decode_compile_s"] = round(
+                _time.perf_counter() - t0, 2)
+
+    def one_run(mode):
+        ex = execs[mode]
+        reg = Registry()
+        q = AdmissionQueue(max_depth=n_req + 1)
+        b = ContinuousBatcher(ex, q, registry=reg)
+        reqs = [GenerateRequest(
+            prompt_vec=encode_prompt(f"decode-{i}", ex.d),
+            max_tokens=toks, deadline=_time.monotonic() + 600.0)
+            for i in range(n_req)]
+        for r in reqs:
+            q.submit(r)
+        t0 = _time.perf_counter()
+        b.start()
+        ok = all(r.wait(timeout=600) for r in reqs)
+        wall = _time.perf_counter() - t0
+        b.stop()
+        if not ok or any(r.error for r in reqs):
+            raise RuntimeError(next(
+                (r.error for r in reqs if r.error), "request lost"))
+        # Useful steps: tokens delivered / slots — pipeline hand-off
+        # steps and drain-tail partial occupancy count AGAINST the
+        # rate, so stale-row decodes can't inflate it.
+        return (tok_total / slots) / wall, reg, b.steps
+
+    try:
+        for mode in ("sync", "pipelined"):
+            one_run(mode)  # unrecorded warm-up: first post-compile
+            # loop runs measurably cold (allocator/cache warmth)
+        best: dict = {}
+        for rep in range(repeats):
+            for mode in ("sync", "pipelined"):
+                rate, reg, steps = one_run(mode)
+                trace(f"decode {mode} rep{rep}: {rate:.0f} useful "
+                      f"steps/s ({steps} loop steps)")
+                if mode not in best or rate > best[mode][0]:
+                    best[mode] = (rate, reg)
+    finally:
+        for ex in execs.values():
+            ex.close()
+
+    out["serving_sync_steps_per_s"] = round(best["sync"][0], 1)
+    out["serving_steps_per_s"] = round(best["pipelined"][0], 1)
+    out["serving_pipeline_speedup"] = round(
+        best["pipelined"][0] / best["sync"][0], 2)
+    reg = best["pipelined"][1]
+    dev = sum(s for s, _ in reg.histogram_totals(
+        "serving_step_device_seconds").values())
+    dev_n = sum(n for _, n in reg.histogram_totals(
+        "serving_step_device_seconds").values())
+    gap = sum(s for s, _ in reg.histogram_totals(
+        "serving_host_gap_seconds").values())
+    gap_n = sum(n for _, n in reg.histogram_totals(
+        "serving_host_gap_seconds").values())
+    if dev + gap > 0:
+        out["serving_host_gap_frac"] = round(gap / (dev + gap), 3)
+    if dev_n:
+        out["serving_step_device_ms"] = round(dev / dev_n * 1000, 3)
+    if gap_n:
+        out["serving_host_gap_ms"] = round(gap / gap_n * 1000, 3)
+    trace(f"decode: pipelined {out['serving_steps_per_s']} vs sync "
+          f"{out['serving_sync_steps_per_s']} useful steps/s = "
+          f"{out['serving_pipeline_speedup']}x, host-gap frac "
+          f"{out.get('serving_host_gap_frac')}")
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -147,7 +254,12 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--overload-seconds", type=float, default=3.0)
     ap.add_argument("--overload-deadline-ms", type=float, default=2000.0)
     ap.add_argument("--skip-local", action="store_true",
-                    help="skip the jitted-model section (no jax)")
+                    help="skip the jitted-model sections (no jax)")
+    ap.add_argument("--decode-reqs", type=int, default=64)
+    ap.add_argument("--decode-tokens", type=int, default=32)
+    ap.add_argument("--decode-S", type=int, default=2)
+    ap.add_argument("--decode-d", type=int, default=64)
+    ap.add_argument("--decode-h", type=int, default=128)
     args = ap.parse_args(argv)
 
     from .executor import SyntheticExecutor
@@ -254,6 +366,18 @@ def main(argv: Optional[list] = None) -> int:
         except Exception as e:  # the headline figures stand regardless
             out["serving_local_error"] = str(e)[:200]
             trace(f"local section failed: {e}")
+
+        # 5: decode-loop decomposition — sync vs device-resident
+        # pipelined over the same jitted model at the same slot count.
+        try:
+            out.update(decode_loop_rates(
+                args.slots,
+                dict(S=args.decode_S, d=args.decode_d, h=args.decode_h,
+                     E=1),
+                args.decode_reqs, args.decode_tokens, trace))
+        except Exception as e:
+            out["serving_decode_error"] = str(e)[:200]
+            trace(f"decode section failed: {e}")
 
     print(json.dumps(out), flush=True)
     return 0

@@ -14,6 +14,32 @@ The executor's batch shape is FIXED at [slots, d] (idle slots carry
 zeros) so the jitted forward compiles once — occupancy varies, shapes
 don't. One batcher per replica, one thread per batcher; the shared
 AdmissionQueue is the only cross-replica coupling.
+
+Two loop shapes (picked off `executor.pipelined`):
+
+  * sync — the PR 2 loop: step(x) blocks, then retire/admit run while
+    the device idles. Kept as the fallback for step()-only executors
+    and as the measured baseline.
+  * pipelined — the ISSUE 3 loop: submit step k (async dispatch), THEN
+    retire step k-1's tokens and admit for step k+1 while the device
+    runs k. Host bookkeeping hides behind device time; the device
+    never waits for python. The semantic delta, by construction: a
+    slot freed by step k-1's retire is admitted at step k+1, one step
+    later than the sync loop would (submit(k) precedes retire(k-1)),
+    and each slot hand-off decodes one stale step nobody reads. Token
+    STREAMS are identical to the sync loop — rows decode
+    independently, so a later admission shifts when tokens are
+    computed, never what they are.
+
+Step-time decomposition (per replica, both loops):
+`serving_step_device_seconds` is time blocked on the device (sync:
+step() wall; pipelined: collect() wall — the device time host work
+did NOT hide); `serving_host_gap_seconds` is host bookkeeping between
+observing one step's completion and dispatching the next — the window
+the device sits idle in the sync loop, and the budget that must stay
+under device step time for full overlap in the pipelined loop.
+`serving_step_seconds` keeps its PR 2 series as the blocked-time
+back-compat alias.
 """
 
 from __future__ import annotations
@@ -21,7 +47,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -29,18 +55,30 @@ from .api import GenerateRequest
 
 log = logging.getLogger(__name__)
 
+# Decode loops run 10^2..10^4 steps/s; the default request-latency
+# buckets start two decades too high to resolve them.
+_STEP_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                 0.05, 0.1, 0.25, 1.0)
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
 
 class ContinuousBatcher:
     def __init__(self, executor, queue, registry=None,
-                 replica: str = "replica0", idle_wait_s: float = 0.05):
+                 replica: str = "replica0", idle_wait_s: float = 0.05,
+                 pipelined: Optional[bool] = None):
         self.executor = executor
         self.queue = queue
         self.registry = registry
         self.replica = replica
         self.idle_wait_s = idle_wait_s
+        self.pipelined = (bool(executor.pipelined) if pipelined is None
+                          else bool(pipelined))
         self._slots: List[Optional[GenerateRequest]] = (
             [None] * executor.slots)
         self._x = np.zeros((executor.slots, executor.d), np.float32)
+        self._zero_row = np.zeros(executor.d, np.float32)
+        self._dirty: set = set()  # freed slots with stale device rows
+        self._prezeroed: set = set()  # zeroed ahead of their retire
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
@@ -65,7 +103,7 @@ class ContinuousBatcher:
     def active(self) -> int:
         return sum(1 for r in self._slots if r is not None)
 
-    # -- the loop -------------------------------------------------------------
+    # -- metrics helpers ------------------------------------------------------
 
     def _observe(self, name: str, value: float, help: str = "",
                  buckets=None) -> None:
@@ -78,19 +116,54 @@ class ContinuousBatcher:
         if self.registry is not None:
             self.registry.counter_inc(name, labels, by=by, help=help)
 
-    def _admit(self) -> None:
+    def _observe_step(self, blocked_s: float, n_active: int) -> None:
+        self._observe("serving_step_device_seconds", blocked_s,
+                      help="wall time blocked on the device per step "
+                           "(device time not hidden by host work)",
+                      buckets=_STEP_BUCKETS)
+        self._observe("serving_step_seconds", blocked_s,
+                      help="model step wall time")
+        self._observe("serving_batch_occupancy",
+                      n_active / self.executor.slots,
+                      help="occupied fraction of batch slots",
+                      buckets=_OCCUPANCY_BUCKETS)
+
+    def _observe_gap(self, gap_s: float) -> None:
+        self._observe("serving_host_gap_seconds", gap_s,
+                      help="host bookkeeping between observing a step's "
+                           "completion and dispatching the next",
+                      buckets=_STEP_BUCKETS)
+
+    # -- admission ------------------------------------------------------------
+
+    def _pop_admissions(self, block: bool
+                        ) -> List[Tuple[int, GenerateRequest,
+                                        np.ndarray]]:
+        """Pop up to len(free slots) requests and place each in a slot;
+        returns [(slot, request, prompt_row)] for successful
+        placements. The slot index binds BEFORE the guarded region: a
+        failure inside it must report the real error against a known
+        slot (the old `i = free.pop(0)` inside the try raised
+        NameError('i') in its own handler, masking the actual failure
+        and leaking the queue's inflight count)."""
         free = [i for i, r in enumerate(self._slots) if r is None]
         if not free:
-            return
+            return []
         # Block only when fully idle: a running batch polls (timeout 0)
         # so decode steps are never held hostage to admission.
-        timeout = self.idle_wait_s if len(free) == len(self._slots) else 0.0
+        timeout = self.idle_wait_s if block else 0.0
+        placed: List[Tuple[int, GenerateRequest, np.ndarray]] = []
         for req in self.queue.get_many(len(free), timeout=timeout):
+            i = free.pop(0)
             try:
-                i = free.pop(0)
+                vec = np.asarray(req.prompt_vec, np.float32)
+                if vec.shape != (self.executor.d,):
+                    raise ValueError(
+                        f"prompt_vec shape {vec.shape} != "
+                        f"({self.executor.d},)")
                 req.admitted_at = time.monotonic()
                 self._slots[i] = req
-                self._x[i] = req.prompt_vec
+                placed.append((i, req, vec))
             except Exception as e:
                 # A request popped from the queue has exactly one owner
                 # now — losing it here would park its handler thread
@@ -98,14 +171,46 @@ class ContinuousBatcher:
                 log.exception("batcher %s: admit failed", self.replica)
                 if self._slots[i] is req:
                     self._slots[i] = None
-                    self._x[i] = 0.0
                 req.fail(f"admission failed: {e}")
             finally:
                 # In a slot (or failed) — no longer "in flight between
                 # queue and slot" for the drain quiesce accounting.
                 self.queue.mark_placed(1)
+        return placed
 
-    def _retire(self, y: np.ndarray) -> None:
+    # -- sync loop (fallback + measured baseline) -----------------------------
+
+    def _settle(self, req: GenerateRequest, token: int,
+                now: float) -> bool:
+        """Append one decoded token and finish the request if its
+        budget or deadline says so; True when it leaves its slot. THE
+        retire bookkeeping, shared by both loops — sync and pipelined
+        request outcomes must never diverge (the token-stream
+        equivalence contract)."""
+        req.tokens.append(int(token))
+        finished = len(req.tokens) >= req.max_tokens
+        if not finished and now >= req.deadline:
+            # Deadline mid-decode: return what exists, marked, at the
+            # boundary — p99 for admitted work stays bounded by
+            # deadline + one step, never by another request's tail.
+            req.truncated = True
+            finished = True
+        if finished:
+            self._count("serving_tokens_total",
+                        {"replica": self.replica},
+                        by=float(len(req.tokens)),
+                        help="decoded tokens")
+            req.finish()
+        return finished
+
+    def _admit(self) -> None:
+        for i, _req, vec in self._pop_admissions(block=self.active == 0):
+            self._x[i] = vec
+
+    def _retire(self, y: np.ndarray, tokens: np.ndarray) -> None:
+        """Step-boundary bookkeeping. `tokens` is ONE batched argmax
+        over all slots (the per-row np.argmax python loop costs real
+        time at decode step rates)."""
         now = time.monotonic()
         for i, req in enumerate(self._slots):
             if req is None:
@@ -117,51 +222,169 @@ class ContinuousBatcher:
                 self._slots[i] = None
                 self._x[i] = 0.0
                 continue
-            req.tokens.append(int(np.argmax(y[i])))
-            self._x[i] = y[i]  # decode recurrence: output is next state
-            finished = len(req.tokens) >= req.max_tokens
-            if not finished and now >= req.deadline:
-                # Deadline mid-decode: return what exists, marked, at
-                # the boundary — p99 for admitted work stays bounded by
-                # deadline + one step, never by another request's tail.
-                req.truncated = True
-                finished = True
-            if finished:
-                self._count("serving_tokens_total",
-                            {"replica": self.replica},
-                            by=float(len(req.tokens)),
-                            help="decoded tokens")
-                req.finish()
+            if self._settle(req, tokens[i], now):
                 self._slots[i] = None
                 self._x[i] = 0.0
+            else:
+                self._x[i] = y[i]  # decode recurrence: output is next state
 
-    def _run(self) -> None:
-        occupancy_buckets = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
-                             0.875, 1.0)
+    def _run_sync(self) -> None:
+        t_gap_start = None
         while not self._stop.is_set():
             # Any failure in this body must cost at most the CURRENT
             # occupants — never the thread. A dead batcher is a replica
             # that silently serves nothing while /healthz stays green.
             try:
+                if self.active == 0:
+                    # Drained before the (possibly blocking) admit:
+                    # queue-idle wait must not masquerade as host gap.
+                    t_gap_start = None
                 self._admit()
                 n_active = self.active
                 if n_active == 0:
+                    t_gap_start = None
                     continue
+                if t_gap_start is not None:
+                    self._observe_gap(time.perf_counter() - t_gap_start)
                 t0 = time.perf_counter()
-                y = self.executor.step(self._x)
-                dt = time.perf_counter() - t0
+                y = np.asarray(self.executor.step(self._x), np.float32)
+                t1 = time.perf_counter()
+                t_gap_start = t1
                 self.steps += 1
-                self._observe("serving_step_seconds", dt,
-                              help="model step wall time")
-                self._observe("serving_batch_occupancy",
-                              n_active / self.executor.slots,
-                              help="occupied fraction of batch slots",
-                              buckets=occupancy_buckets)
-                self._retire(y)
+                self._observe_step(t1 - t0, n_active)
+                self._retire(y, y.argmax(axis=1))
             except Exception as e:  # broken replica must not wedge waiters
                 log.exception("batcher %s: step failed", self.replica)
-                for i, req in enumerate(self._slots):
-                    if req is not None:
-                        req.fail(f"executor failed: {e}")
-                        self._slots[i] = None
-                        self._x[i] = 0.0
+                self._fail_occupants(e)
+                t_gap_start = None
+
+    # -- pipelined loop (device-resident executors) ---------------------------
+
+    def _retire_tokens(self, tokens: np.ndarray,
+                       snapshot: List[Optional[GenerateRequest]]) -> None:
+        """Retire against the slot SNAPSHOT taken at that step's
+        submit: by retire time self._slots may already hold newer
+        occupants (admissions run before collect). Freed slots join
+        _dirty — their device rows are stale until the next submit
+        zeroes them (or an admission overwrites them)."""
+        now = time.monotonic()
+        for i, req in enumerate(snapshot):
+            if req is None:
+                continue
+            if req.done:
+                # Finished or abandoned at an earlier boundary; this
+                # step ran its slot for nobody (the one-step pipeline
+                # cost). Free the slot only if still ours.
+                if self._slots[i] is req:
+                    self._free_slot(i)
+                continue
+            if self._settle(req, tokens[i], now) and self._slots[i] is req:
+                self._free_slot(i)
+
+    def _free_slot(self, i: int) -> None:
+        """Release slot i at retire. Rows zeroed AHEAD of their retire
+        (in the submit that overlapped it) are already clean on device;
+        everything else carries stale state until the next scatter."""
+        self._slots[i] = None
+        if i in self._prezeroed:
+            self._prezeroed.discard(i)
+        else:
+            self._dirty.add(i)
+
+    def _zero_ahead(self, updates: list, snap_prev) -> None:
+        """Zero rows whose occupant is certain to leave at the PENDING
+        retire, in the scatter of the step being submitted. Without
+        this, the hand-off step would run the finished request's stale
+        nonzero row: content-derived row masking (infer.py's
+        `any(x != 0)`) would count it active, and on an ep-sharded mesh
+        under capacity pressure a ghost competitor can evict a real
+        row's MoE dispatch — a divergence the sync loop never exhibits.
+        Completion is predictable exactly for the max_tokens path
+        (len + the pending token >= budget) and for already-abandoned
+        requests; deadline truncation is timing-dependent and keeps its
+        one stale step."""
+        for i, req in enumerate(self._slots):
+            if (req is not None and snap_prev[i] is req
+                    and (req.done
+                         or len(req.tokens) + 1 >= req.max_tokens)):
+                updates.append((i, self._zero_row))
+                self._prezeroed.add(i)
+
+    def _run_pipelined(self) -> None:
+        ex = self.executor
+        ex.reset()
+        self._dirty.clear()
+        self._prezeroed.clear()
+        prev = None  # (handle, slot snapshot) of the step in flight
+        t_gap_start = None
+        while not self._stop.is_set():
+            try:
+                # Admit for step k+1 (block only when nothing is active
+                # AND nothing is in flight — a pending collect must not
+                # wait out the idle timeout behind an empty queue).
+                block = self.active == 0 and prev is None
+                updates = []
+                for i, _req, vec in self._pop_admissions(block=block):
+                    # Admission overwrites the row, whatever its state.
+                    self._dirty.discard(i)
+                    self._prezeroed.discard(i)
+                    updates.append((i, vec))
+                submitted = None
+                if self.active > 0:
+                    # Freed-but-unadmitted slots get explicit zero rows:
+                    # idle slots must be EXACTLY zero (the MoE row-mask
+                    # contract) and must not keep decoding garbage.
+                    for i in sorted(self._dirty):
+                        updates.append((i, self._zero_row))
+                    self._dirty.clear()
+                    if prev is not None:
+                        self._zero_ahead(updates, prev[1])
+                    if t_gap_start is not None:
+                        self._observe_gap(
+                            time.perf_counter() - t_gap_start)
+                    snapshot = list(self._slots)
+                    handle = ex.submit(updates)  # step k dispatched
+                    self.steps += 1
+                    submitted = (handle, snapshot)
+                # Step k runs on the device while the host settles step
+                # k-1: collect its token ids and do retire bookkeeping.
+                if prev is not None:
+                    h_prev, snap_prev = prev
+                    tc = time.perf_counter()
+                    tokens = ex.collect(h_prev)
+                    t_done = time.perf_counter()
+                    n_prev = sum(1 for r in snap_prev if r is not None)
+                    self._observe_step(t_done - tc, n_prev)
+                    self._retire_tokens(tokens, snap_prev)
+                    # Gap clock starts at device completion so retire
+                    # bookkeeping counts toward the host gap it is.
+                    t_gap_start = t_done
+                if submitted is None:
+                    t_gap_start = None  # pipeline drained: idle queue
+                    # waits must not masquerade as host gap
+                prev = submitted
+            except Exception as e:
+                log.exception("batcher %s: step failed", self.replica)
+                self._fail_occupants(e)
+                prev = None
+                self._dirty.clear()
+                self._prezeroed.clear()
+                t_gap_start = None
+                try:
+                    ex.reset()  # drop poisoned device state
+                except Exception:
+                    log.exception("batcher %s: executor reset failed",
+                                  self.replica)
+
+    def _fail_occupants(self, e: Exception) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.fail(f"executor failed: {e}")
+                self._slots[i] = None
+                self._x[i] = 0.0
+
+    def _run(self) -> None:
+        if self.pipelined:
+            self._run_pipelined()
+        else:
+            self._run_sync()

@@ -251,6 +251,22 @@ class ServingServer:
                     name, round(est, 6),
                     help=f"estimated q={q} of serving_request_seconds "
                          f"(ok outcomes)")
+        # Per-replica host-gap share of the decode loop: the overlap
+        # number an operator watches — near 0 means host scheduling
+        # hides behind device steps; climbing toward 1 means the device
+        # waits on python (ISSUE 3's regression signal, visible in
+        # /metrics, not just the bench artifact).
+        device = self.registry.histogram_totals(
+            "serving_step_device_seconds")
+        for key, (gap_sum, _n) in self.registry.histogram_totals(
+                "serving_host_gap_seconds").items():
+            total = gap_sum + device.get(key, (0.0, 0))[0]
+            if total > 0:
+                self.registry.gauge_set(
+                    "serving_host_gap_fraction",
+                    round(gap_sum / total, 6), dict(key),
+                    help="host-gap share of decode-loop wall time "
+                         "(host_gap / (host_gap + device))")
 
     def _finish(self, handler, code: int, body: dict, outcome: str,
                 headers: Optional[dict] = None,

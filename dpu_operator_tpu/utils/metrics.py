@@ -139,6 +139,16 @@ class Registry:
                 prev_cum, prev_bound = cum, b
             return float(bs[-1])
 
+    def histogram_totals(self, name: str
+                         ) -> Dict[tuple, Tuple[float, int]]:
+        """(sum, count) per label-set of a histogram — for derived
+        scrape-time gauges (e.g. the serving plane's host-gap fraction)
+        computed where the series live instead of in PromQL. Keys are
+        the sorted (label, value) tuples the registry stores."""
+        with self._lock:
+            return {key: (state["sum"], state["count"])
+                    for key, state in self._hists.get(name, {}).items()}
+
     def render(self) -> str:
         lines: List[str] = []
         with self._lock:

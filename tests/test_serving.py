@@ -20,10 +20,11 @@ import urllib.request
 import numpy as np
 import pytest
 
-from dpu_operator_tpu.serving import (AdmissionQueue, Draining,
-                                      GenerateRequest, LocalExecutor,
-                                      QueueFull, ServingServer,
-                                      SyntheticExecutor, encode_prompt)
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      Draining, GenerateRequest,
+                                      LocalExecutor, QueueFull,
+                                      ServingServer, SyntheticExecutor,
+                                      encode_prompt)
 
 # One compiled model shared by every LocalExecutor test (compile cost is
 # the dominant line item, so the real-model tests share one server).
@@ -110,6 +111,13 @@ def test_generate_http_roundtrip(batched_server):
     assert "serving_batch_occupancy_bucket" in metrics
     assert "serving_queue_depth" in metrics
     assert "serving_request_seconds_bucket" in metrics
+    # The decode-loop decomposition (ISSUE 3): device time and host
+    # gap are separate series, and the derived overlap fraction is a
+    # scrape-time gauge — the win must be visible in /metrics, not
+    # just the bench artifact.
+    assert "serving_step_device_seconds_bucket" in metrics
+    assert "serving_host_gap_seconds_bucket" in metrics
+    assert "serving_host_gap_fraction" in metrics
 
 
 def test_generate_rejects_malformed(batched_server):
@@ -436,8 +444,11 @@ def test_batch_reforms_at_step_boundaries():
 
 
 def test_replica_pool_spreads_load():
-    """Two replicas over one queue: both take work."""
-    ex0 = SyntheticExecutor(slots=1, d=8, step_time_s=0.002)
+    """Two replicas over one queue: both take work — and a MIXED pool
+    works, each batcher picking its loop off its own executor (one
+    pipelined, one sync)."""
+    ex0 = SyntheticExecutor(slots=1, d=8, step_time_s=0.002,
+                            pipelined=True)
     ex1 = SyntheticExecutor(slots=1, d=8, step_time_s=0.002)
     srv = ServingServer([ex0, ex1], max_queue_depth=64).start()
     try:
@@ -505,6 +516,181 @@ def test_idle_slots_do_not_steal_moe_capacity_on_ep_mesh():
                                    rtol=1e-5, atol=1e-6)
         # Idle rows stay exactly zero — the scheduler's slot contract.
         assert not y_first[1:].any() and not y_last[:3].any()
+
+
+# -- device-resident pipelined decode (ISSUE 3) -------------------------------
+
+
+def _trace_reqs(n, d, toks):
+    """A fixed admitted trace: distinct deterministic prompts, long
+    deadlines (equivalence must not depend on deadline races)."""
+    return [GenerateRequest(prompt_vec=encode_prompt(f"trace-{i}", d),
+                            max_tokens=toks,
+                            deadline=time.monotonic() + 600.0)
+            for i in range(n)]
+
+
+def _drive_trace(ex, reqs):
+    """Run a preloaded request trace through a ContinuousBatcher (no
+    HTTP — the loop under test is the scheduler/executor pair)."""
+    q = AdmissionQueue(max_depth=len(reqs) + 1)
+    b = ContinuousBatcher(ex, q)
+    for r in reqs:
+        q.submit(r)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=60), "request lost"
+    finally:
+        b.stop()
+        ex.close()
+    return b
+
+
+def test_pipelined_sync_token_equivalence_synthetic():
+    """Same trace, same seed: token streams are identical between the
+    sync loop and the pipelined loop. Admissions land one step later
+    in the pipelined loop (and slot assignment may differ), but rows
+    decode independently — a shifted admission changes WHEN a token is
+    computed, never what it is. More requests than slots so the
+    one-step-delayed hand-off is actually exercised."""
+    streams = {}
+    for pipelined in (False, True):
+        ex = SyntheticExecutor(slots=4, d=16, seed=3,
+                               pipelined=pipelined)
+        reqs = _trace_reqs(12, 16, 6)
+        _drive_trace(ex, reqs)
+        streams[pipelined] = [(r.error, list(r.tokens)) for r in reqs]
+    assert all(e is None for e, _ in streams[True])
+    assert streams[False] == streams[True]
+
+
+def test_pipelined_sync_token_equivalence_local():
+    """ISSUE 3 acceptance: identical decode token streams between the
+    PR 2 synchronous LocalExecutor and the device-resident pipelined
+    one for the same admitted trace, on the real jitted model."""
+    streams = {}
+    for mode in ("sync", "pipelined"):
+        ex = LocalExecutor(slots=4, mode=mode, **MODEL)
+        reqs = _trace_reqs(8, MODEL["d"], 5)
+        _drive_trace(ex, reqs)
+        streams[mode] = [(r.error, list(r.tokens)) for r in reqs]
+    assert all(e is None for e, _ in streams["pipelined"])
+    assert streams["sync"] == streams["pipelined"]
+
+
+def test_pipelined_executor_overlaps_host_work():
+    """The two-phase contract's point: with device step cost D and
+    host work H per step, K pipelined steps cost ≈ K·max(D, H), not
+    K·(D+H). SyntheticExecutor's worker thread is the controlled
+    device; the host sleeps between submit and collect."""
+    D = H = 0.03
+    K = 8
+    ex = SyntheticExecutor(slots=2, d=8, step_time_s=D, pipelined=True)
+    try:
+        ex.reset()
+        h_prev = None
+        t0 = time.perf_counter()
+        for _ in range(K):
+            h = ex.submit([])
+            time.sleep(H)  # scheduler-bookkeeping stand-in
+            if h_prev is not None:
+                ex.collect(h_prev)
+            h_prev = h
+        ex.collect(h_prev)
+        wall = time.perf_counter() - t0
+    finally:
+        ex.close()
+    # Serial cost would be K*(D+H) = 0.48 s; overlapped ≈ K*max + one
+    # step ≈ 0.27 s. The 0.8x line keeps CI-noise margin from both.
+    assert wall < 0.8 * K * (D + H), wall
+    assert wall >= K * max(D, H) - 0.01, wall
+
+
+def test_pipelined_admission_lands_one_step_later():
+    """The documented semantic delta: submit(k) precedes retire(k-1),
+    so a slot freed by step k-1 is admitted at step k+1 — one stale
+    step decodes per slot hand-off. Two 3-token requests through one
+    slot: exactly 6 steps sync, exactly 8 pipelined (one hand-off step
+    after each completion)."""
+    counts = {}
+    for pipelined, want in ((False, 6), (True, 8)):
+        ex = SyntheticExecutor(slots=1, d=8, pipelined=pipelined)
+        _drive_trace(ex, _trace_reqs(2, 8, 3))
+        deadline = time.monotonic() + 5
+        while ex.steps < want and time.monotonic() < deadline:
+            time.sleep(0.002)
+        counts[pipelined] = ex.steps
+    assert counts == {False: 6, True: 8}, counts
+
+
+def test_handoff_step_runs_with_finished_slot_zeroed():
+    """A finished request must not ride the hand-off step as a ghost:
+    submit(k) precedes retire(k-1), so without zero-ahead the step
+    overlapping a completion would run the finished request's stale
+    nonzero row — content-derived row masking (infer.py's any(x != 0))
+    would count it ACTIVE, and on an ep-sharded mesh under capacity
+    pressure a ghost competitor can evict a real row's MoE dispatch.
+    Completion is predictable for the max_tokens path, so the
+    scheduler zeroes the retiring row in the same scatter that
+    dispatches the overlapping step. Asserted on the recorded batch
+    states: from the hand-off step on, the finished slot is exactly
+    zero at step time."""
+
+    class Recording(SyntheticExecutor):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.states = []
+
+        def step(self, x):
+            self.states.append(np.array(x))
+            return super().step(x)
+
+    ex = Recording(slots=2, d=8, pipelined=True)
+    a = GenerateRequest(prompt_vec=encode_prompt("short", 8),
+                        max_tokens=2,
+                        deadline=time.monotonic() + 600.0)
+    b = GenerateRequest(prompt_vec=encode_prompt("long", 8),
+                        max_tokens=5,
+                        deadline=time.monotonic() + 600.0)
+    _drive_trace(ex, [a, b])
+    assert a.error is None and b.error is None
+    assert len(a.tokens) == 2 and len(b.tokens) == 5
+    states = ex.states
+    assert len(states) >= 6, len(states)
+    # Step 1 runs both admitted prompts; A (slot 0) finishes at the
+    # retire overlapping step 3 — so steps 3+ must carry slot 0 as
+    # exact zeros, pre-zeroed by the scatter, never A's stale state.
+    assert states[0][0].any() and states[0][1].any()
+    for k in (2, 3, 4):
+        assert not states[k][0].any(), f"ghost row rode step {k + 1}"
+    # B's own hand-off step (6) gets the same treatment.
+    assert not states[5][1].any()
+
+
+def test_admit_failure_reports_real_error():
+    """The slot index binds BEFORE the guarded region: a request whose
+    prompt_vec cannot land in a slot must fail with the real error
+    (the old `i = free.pop(0)` inside the try raised NameError in its
+    own handler, masking the cause) and must not leak the queue's
+    inflight accounting or block later admissions."""
+    ex = SyntheticExecutor(slots=2, d=8)
+    q = AdmissionQueue(max_depth=8)
+    b = ContinuousBatcher(ex, q)
+    bad = GenerateRequest(prompt_vec=np.zeros(3, np.float32),
+                          max_tokens=2,
+                          deadline=time.monotonic() + 30)
+    good = GenerateRequest(prompt_vec=np.zeros(8, np.float32),
+                           max_tokens=1,
+                           deadline=time.monotonic() + 30)
+    q.submit(bad)
+    q.submit(good)
+    b._admit()
+    assert bad.done and "admission failed" in bad.error, bad.error
+    assert "NameError" not in bad.error
+    assert not good.done
+    assert q.inflight() == 0  # mark_placed ran for BOTH pops
+    assert b.active == 1 and good in b._slots
 
 
 # -- sustained load (slow tier) -----------------------------------------------
