@@ -2,7 +2,7 @@
 # taskfile.yaml task system).
 
 .PHONY: all native proto test fast-test e2e-test kind-test kind-lane traffic-flow-tests \
-        traffic-flow-matrix bench \
+        traffic-flow-matrix bench lint \
         build-images deploy undeploy clean bundle bundle-check provision provision-dry
 
 IMG_REGISTRY ?= localhost
@@ -17,11 +17,26 @@ native:
 proto:
 	./scripts/genproto.sh
 
-test: native
+# lint first: 2 s of AST analysis fails faster than any broken-pattern
+# test would, and test_graftlint.py re-enforces the same gate in-tier.
+test: lint native
 	python -m pytest tests/ -q
 
-fast-test:
+fast-test: lint
 	python -m pytest tests/ -q -x -m "not slow"
+
+# Static analysis lane (docs/static-analysis.md): graftlint is the
+# project-specific analyzer and always runs (it's also a tier-1 test);
+# ruff is config'd in pyproject.toml and runs wherever it's installed —
+# the base CI image doesn't bake it in, so absence is a skip, not a
+# failure.
+lint:
+	python -m dpu_operator_tpu.analysis dpu_operator_tpu/
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "lint: ruff not installed; skipped (pip install ruff)"; \
+	fi
 
 e2e-test:
 	python -m pytest tests/test_e2e.py -q

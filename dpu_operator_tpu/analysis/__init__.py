@@ -1,0 +1,24 @@
+"""graftlint — project-specific static analysis for dpu_operator_tpu.
+
+Each rule encodes a bug class this repo has already paid to fix in
+review (rule catalog: docs/static-analysis.md). Run it as
+`python -m dpu_operator_tpu.analysis [paths...]`; the tier-1 gate
+(tests/test_graftlint.py) runs it over the whole package and fails on
+any non-baselined finding.
+"""
+
+from .baseline import Baseline, BaselineError
+from .core import (SEVERITY_ERROR, SEVERITY_WARNING, Finding, Module,
+                   Project, Report, Rule, run_analysis)
+from .rules import default_rules
+
+__all__ = [
+    "Baseline", "BaselineError", "Finding", "Module", "Project",
+    "Report", "Rule", "SEVERITY_ERROR", "SEVERITY_WARNING",
+    "default_rules", "run_analysis", "DEFAULT_BASELINE",
+]
+
+from pathlib import Path as _Path
+
+# The checked-in grandfathered-findings baseline, next to this package.
+DEFAULT_BASELINE = str(_Path(__file__).parent / "baseline.toml")

@@ -1,0 +1,84 @@
+"""graftlint CLI.
+
+    python -m dpu_operator_tpu.analysis [paths...]
+        [--format text|json] [--baseline FILE | --no-baseline]
+        [--list-rules]
+
+Exit codes: 0 clean (stale baseline entries are notes, not failures),
+1 findings, 2 usage/config error. The tier-1 gate and `make lint` both
+run exactly this entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import DEFAULT_BASELINE, run_analysis
+from .baseline import BaselineError
+from .rules import default_rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpu_operator_tpu.analysis",
+        description="graftlint: project-specific static analysis "
+                    "(rule catalog: docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["dpu_operator_tpu"],
+                    help="files or directories to analyze "
+                         "(default: dpu_operator_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline.toml path (default: the checked-in "
+                         "analysis/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.rule_id}  {rule.severity:7s}  {rule.title}")
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        report = run_analysis(
+            args.paths,
+            baseline=None if args.no_baseline else args.baseline)
+    except BaselineError as e:
+        print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+        return 2
+    except (OSError, SyntaxError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+    if report.checked_files == 0:
+        # A typo'd path must not read as a green lint lane.
+        print(f"graftlint: no python files found under {args.paths!r}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        out = report.as_json()
+        out["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(out, indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        for s in report.stale_baseline:
+            advice = ("fixed? delete it from baseline.toml"
+                      if s["used"] == 0
+                      else f"lower its count to {s['used']}")
+            print(f"note: stale baseline entry {s['rule']} {s['path']} "
+                  f"[{s['func']}] (unused {s['unused']}) — {advice}")
+        print(f"graftlint: {len(report.findings)} finding(s), "
+              f"{report.suppressed_baseline} baselined, "
+              f"{report.checked_files} files in {elapsed:.2f}s")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+"""Baseline: grandfathered findings the gate tolerates, keyed by
+(rule, path, function qualname) — deliberately NOT by line number, so
+unrelated edits above a baselined site don't invalidate the entry.
+
+Each `[[suppress]]` entry absorbs up to `count` (default 1) matching
+findings. The ratchet contract:
+
+  * findings beyond an entry's count are REPORTED — a baselined
+    function can't silently grow more instances of its bug class;
+  * entries that match nothing are stale — reported as notes (exit 0),
+    so fixing a baselined site then deleting its entry keeps the gate
+    green, and forgetting to delete it only nags;
+  * new findings anywhere need a fix, a pragma with a reason, or a
+    reviewed baseline entry.
+
+The file format is the obvious TOML subset (``[[suppress]]`` tables of
+string/int scalars + comments). Python 3.10 has no tomllib and this
+repo vendors no TOML dependency, so `_parse_toml_subset` below reads
+exactly that subset and rejects anything fancier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Tuple
+
+from .core import Finding
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def _parse_toml_subset(text: str, origin: str = "<baseline>") -> List[dict]:
+    """[[suppress]] array-of-tables with `key = "str"` / `key = int`
+    pairs. Raises BaselineError on anything outside the subset."""
+    entries: List[dict] = []
+    current = None
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            current = {}
+            entries.append(current)
+            continue
+        if line.startswith("["):
+            raise BaselineError(
+                f"{origin}:{i}: only [[suppress]] tables are supported, "
+                f"got {line!r}")
+        if current is None:
+            raise BaselineError(
+                f"{origin}:{i}: key outside a [[suppress]] table")
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise BaselineError(f"{origin}:{i}: expected key = value")
+        key = key.strip()
+        val = val.split("#", 1)[0].strip() if not val.strip().startswith(
+            ('"', "'")) else val.strip()
+        if val.startswith(('"', "'")):
+            quote = val[0]
+            end = val.find(quote, 1)
+            if end < 0:
+                raise BaselineError(f"{origin}:{i}: unterminated string")
+            current[key] = val[1:end]
+        else:
+            try:
+                current[key] = int(val)
+            except ValueError:
+                raise BaselineError(
+                    f"{origin}:{i}: value must be a string or int, "
+                    f"got {val!r}") from None
+    return entries
+
+
+@dataclass
+class _Entry:
+    rule: str
+    path: str
+    func: str
+    count: int
+    reason: str = ""
+    used: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule and f.path == self.path
+                and f.func == self.func)
+
+
+@dataclass
+class Baseline:
+    entries: List[_Entry] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls([])
+        entries = []
+        for e in _parse_toml_subset(p.read_text(), origin=str(p)):
+            missing = {"rule", "path", "func"} - set(e)
+            if missing:
+                raise BaselineError(
+                    f"{p}: [[suppress]] entry missing {sorted(missing)}: "
+                    f"{e}")
+            entries.append(_Entry(
+                rule=str(e["rule"]), path=str(e["path"]),
+                func=str(e["func"]), count=int(e.get("count", 1)),
+                reason=str(e.get("reason", ""))))
+        return cls(entries)
+
+    def filter(self, findings: List[Finding]
+               ) -> Tuple[List[Finding], int]:
+        """(kept findings, number suppressed). Each entry absorbs at
+        most `count` matches; the rest stay reported (the ratchet)."""
+        kept: List[Finding] = []
+        suppressed = 0
+        for f in findings:
+            entry = next((e for e in self.entries
+                          if e.matches(f) and e.used < e.count), None)
+            if entry is None:
+                kept.append(f)
+            else:
+                entry.used += 1
+                suppressed += 1
+        return kept, suppressed
+
+    def stale(self) -> List[dict]:
+        """Entries with unused headroom. `used == 0` means the site was
+        fixed — safe to delete the entry; `used > 0` means only the
+        COUNT is stale — lower it to `used`, deleting would turn the
+        gate red on the remaining findings. Informational only."""
+        return [
+            {"rule": e.rule, "path": e.path, "func": e.func,
+             "used": e.used, "unused": e.count - e.used}
+            for e in self.entries if e.used < e.count
+        ]

@@ -1,0 +1,265 @@
+"""graftlint core: module model, pragma handling, rule registry, runner.
+
+The analyzer is deliberately project-specific — each rule encodes a bug
+class this codebase has already shipped and paid to fix in review (the
+rule catalog in docs/static-analysis.md links each rule to its origin
+CHANGES.md entry). Rules are small `ast` visitors keyed by a stable ID;
+the runner parses every first-party module once, hands each rule a
+`Module` (plus the cross-module `Project` context some rules need) and
+collects `Finding`s, then filters them through inline pragmas and the
+checked-in baseline so the gate starts green and only ratchets down.
+
+Suppression, in precedence order:
+  * `# graftlint: disable=GL001[,GL004]` trailing on the offending line
+    or alone on the line directly above it;
+  * `# graftlint: disable-file=GL005` in the first 10 lines of a file;
+  * a `[[suppress]]` entry in analysis/baseline.toml keyed by
+    (rule, path, function qualname) — for grandfathered sites.
+
+Fixture files (the analyzer's own test corpus) declare the path the
+path-scoped rules should pretend they live at via a magic comment in
+the first 10 lines: `# graftlint-fixture-path: dpu_operator_tpu/...`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
+_PRAGMA_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Z0-9, ]+)")
+_FIXTURE_PATH_RE = re.compile(r"#\s*graftlint-fixture-path:\s*(\S+)")
+
+# Generated code is not first-party style; never lint it.
+EXCLUDE_PARTS = ("__pycache__", "gen")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str          # repo-relative, '/'-separated (the baseline key)
+    line: int
+    col: int
+    func: str          # enclosing function qualname, "" at module level
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        where = f" [{self.func}]" if self.func else ""
+        out = f"{loc}: {self.rule} {self.severity}:{where} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def as_json(self) -> dict:
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "path": self.path, "line": self.line, "col": self.col,
+            "func": self.func, "message": self.message, "hint": self.hint,
+        }
+
+
+def _canonical_relpath(path: str) -> str:
+    """Repo-relative '/'-separated path so baseline keys are stable no
+    matter where the analyzer is invoked from: cut at the first
+    `dpu_operator_tpu` component when present, else relativize to cwd
+    when possible."""
+    parts = Path(path).parts
+    if "dpu_operator_tpu" in parts:
+        # LAST occurrence: a checkout directory itself named
+        # dpu_operator_tpu must not produce doubled-prefix keys.
+        idx = len(parts) - 1 - parts[::-1].index("dpu_operator_tpu")
+        return "/".join(parts[idx:])
+    try:
+        return Path(path).resolve().relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return path.replace("\\", "/")
+
+
+class Module:
+    """One parsed source file plus the derived context rules share."""
+
+    def __init__(self, path: str, source: str,
+                 relpath: Optional[str] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        head = self.lines[:10]
+        m = next((_FIXTURE_PATH_RE.search(l) for l in head
+                  if _FIXTURE_PATH_RE.search(l)), None)
+        if relpath is not None:
+            self.relpath = relpath.replace("\\", "/")
+        elif m:
+            self.relpath = m.group(1)
+        else:
+            self.relpath = _canonical_relpath(path)
+        self.file_disabled = set()
+        for l in head:
+            fm = _PRAGMA_FILE_RE.search(l)
+            if fm:
+                self.file_disabled.update(
+                    r.strip() for r in fm.group(1).split(",") if r.strip())
+        # Enclosing-function qualnames and jax-importing gate, computed
+        # once per module (several rules key off both).
+        self.func_of: Dict[ast.AST, str] = {}
+        self.functions: List[Tuple[ast.AST, str]] = []
+        self._annotate_functions()
+        self.imports_jax = any(
+            (isinstance(n, ast.Import)
+             and any(a.name.split(".")[0] == "jax" for a in n.names))
+            or (isinstance(n, ast.ImportFrom)
+                and (n.module or "").split(".")[0] == "jax")
+            for n in ast.walk(self.tree))
+
+    def _annotate_functions(self) -> None:
+        def visit(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    self.functions.append((child, qual))
+                    self._mark_subtree(child, qual)
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+        visit(self.tree, [])
+
+    def _mark_subtree(self, fn: ast.AST, qual: str) -> None:
+        # Plain assignment, and _annotate_functions visits outer before
+        # inner: nested functions overwrite their subtree with the
+        # deeper qualname.
+        for n in ast.walk(fn):
+            self.func_of[n] = qual
+
+    def qualname_at(self, node: ast.AST) -> str:
+        return self.func_of.get(node, "")
+
+    def in_dir(self, *parts: str) -> bool:
+        """True when the (virtual) path sits under any of the given
+        package subdirectories, e.g. in_dir('parallel', 'serving')."""
+        return any(f"/{p}/" in f"/{self.relpath}" for p in parts)
+
+    def line_suppressed(self, line: int, rule: str) -> bool:
+        if rule in self.file_disabled:
+            return True
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                text = self.lines[ln - 1]
+                m = _PRAGMA_RE.search(text)
+                if m and rule in [r.strip()
+                                  for r in m.group(1).split(",")]:
+                    # A pragma on the line above only counts when it is
+                    # a standalone comment (not some other statement's
+                    # trailing pragma).
+                    if ln == line or text.lstrip().startswith("#"):
+                        return True
+        return False
+
+
+@dataclass
+class Project:
+    """Cross-module context. `declared_axes` is the union of every mesh
+    axis name any analyzed module declares (GL006 checks usage against
+    it; collection lives in rules.collect_declared_axes)."""
+
+    modules: List[Module] = field(default_factory=list)
+    declared_axes: set = field(default_factory=set)
+
+
+class Rule:
+    rule_id = "GL000"
+    severity = SEVERITY_ERROR
+    title = ""
+    hint = ""
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.rule_id, severity=self.severity,
+            path=module.relpath, line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            func=module.qualname_at(node), message=message,
+            hint=self.hint if hint is None else hint)
+
+
+def discover_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if any(part in EXCLUDE_PARTS for part in f.parts):
+                    continue
+                out.append(str(f))
+        elif path.suffix == ".py":
+            out.append(str(path))
+    return out
+
+
+def load_modules(files: Iterable[str]) -> List[Module]:
+    mods = []
+    for f in files:
+        mods.append(Module(f, Path(f).read_text()))
+    return mods
+
+
+@dataclass
+class Report:
+    findings: List[Finding]
+    suppressed_baseline: int
+    stale_baseline: List[dict]
+    checked_files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def as_json(self) -> dict:
+        return {
+            "findings": [f.as_json() for f in self.findings],
+            "suppressed_baseline": self.suppressed_baseline,
+            "stale_baseline": self.stale_baseline,
+            "checked_files": self.checked_files,
+            "clean": self.clean,
+        }
+
+
+def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+                 baseline: Optional[str] = None) -> Report:
+    """Parse every file under `paths`, run the registry, apply pragma +
+    baseline suppression. `baseline` is a path to baseline.toml or None
+    for no baseline."""
+    from .baseline import Baseline
+    from .rules import collect_declared_axes, default_rules
+
+    rules = list(default_rules() if rules is None else rules)
+    files = discover_files(paths)
+    project = Project(modules=load_modules(files))
+    project.declared_axes = collect_declared_axes(project.modules)
+
+    raw: List[Finding] = []
+    for module in project.modules:
+        for rule in rules:
+            for f in rule.check(module, project):
+                if not module.line_suppressed(f.line, f.rule):
+                    raw.append(f)
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    bl = Baseline.load(baseline) if baseline else Baseline([])
+    kept, n_suppressed = bl.filter(raw)
+    return Report(findings=kept, suppressed_baseline=n_suppressed,
+                  stale_baseline=bl.stale(), checked_files=len(files))

@@ -1,0 +1,747 @@
+"""The graftlint rule registry: six launch rules, each distilled from a
+bug class this repo already shipped (origin entries in CHANGES.md; the
+full catalog with fix-it guidance lives in docs/static-analysis.md).
+
+GL001  mask-multiply in gradient-bearing parallel/ code
+GL002  host-device sync inside decode/collective hot loops
+GL003  except handler reads a name first bound inside its own try body
+GL004  lock held across a blocking call (serving/daemon/cni/vsp)
+GL005  broad except that neither re-raises, logs, nor narrows
+       (dataplane + CNI paths)
+GL006  collective/PartitionSpec axis name no analyzed mesh declares
+
+Rules lean conservative: a near-miss that must stay silent is as much a
+part of each rule's contract as its true positive, and both ship as
+fixtures in tests/fixtures/graftlint/.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+from .core import (SEVERITY_ERROR, SEVERITY_WARNING, Finding, Module,
+                   Project, Rule)
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """The rightmost identifier of a Name/Attribute chain, '' otherwise."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _walk_same_function(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function or
+    class definitions (their scope is not ours)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _walk_through_lambdas(root: ast.AST) -> Iterator[ast.AST]:
+    """Like _walk_same_function but DOES descend into lambdas — the
+    PR 2 mask-multiply bug sat inside a `jax.tree.map(lambda g, dpl:
+    ...)`; a lambda is still this function's code."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _stmt_bound_names(stmt: ast.AST) -> Set[str]:
+    """Names a statement binds IN ITS OWN SCOPE: nested function/class
+    definitions bind only their name — their internals are invisible
+    to the enclosing scope (a local `i` inside a helper must not count
+    as bound for the scope around it)."""
+    out: Set[str] = set()
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            out.add(n.name)
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            # Comprehension targets are comprehension-local (py3); only
+            # a walrus inside one binds the enclosing scope.
+            out.update(t.target.id for t in ast.walk(n)
+                       if isinstance(t, ast.NamedExpr)
+                       and isinstance(t.target, ast.Name))
+            continue
+        if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            out.add(n.name)
+        elif isinstance(n, ast.Import):
+            out.update(a.asname or a.name.split(".")[0] for a in n.names)
+        elif isinstance(n, ast.ImportFrom):
+            out.update(a.asname or a.name for a in n.names)
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            out.update(n.names)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _module_toplevel_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in tree.body:
+        out |= _stmt_bound_names(stmt)
+    return out
+
+
+def _const_str_tuple(node: ast.AST,
+                     consts: Dict[str, tuple]) -> Optional[tuple]:
+    """Resolve a tuple/list of string literals, a Name bound to one at
+    module top level, or a `+` of two resolvable tuples. None when the
+    value isn't statically a string tuple."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        items = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                items.append(e.value)
+            else:
+                return None
+        return tuple(items)
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _const_str_tuple(node.left, consts)
+        right = _const_str_tuple(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _module_str_tuple_consts(tree: ast.Module) -> Dict[str, tuple]:
+    """Module-level `AXES = ("dp", "sp", ...)`-style constants."""
+    consts: Dict[str, tuple] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            val = _const_str_tuple(stmt.value, consts)
+            if val is not None:
+                consts[stmt.targets[0].id] = val
+    return consts
+
+
+# --------------------------------------------------------------------------
+# GL001 — mask multiplication in gradient-bearing code
+
+
+class MaskMultiplyInGrad(Rule):
+    """Origin: PR 2 pipeline_1f1b `dpl * gmask` — on IDLE pipeline
+    ticks the VJP runs over zero-filled buffers, a division-bearing
+    stage_fn yields NaN there, and NaN * 0 is NaN: one idle tick
+    poisons the gradient accumulator for every real microbatch. Masking
+    in gradient-bearing code must SELECT (`jnp.where`), never scale.
+
+    Scope: functions in parallel/ that are gradient-bearing — they (or
+    an enclosing function) call vjp/grad/value_and_grad or are named
+    like a backward pass. Forward-only routing math multiplying by a
+    mask (moe.py's capacity bucketing) is the near-miss: no cotangent
+    flows through it at the masked-out points, so scaling is fine."""
+
+    rule_id = "GL001"
+    severity = SEVERITY_ERROR
+    title = "mask multiply in gradient-bearing code"
+    hint = ("mask by selection, not multiplication: "
+            "jnp.where(cond, value, jnp.zeros_like(value)) — NaN/Inf in "
+            "the masked-out branch must never touch the accumulator")
+
+    _GRAD_CALLEES = {"vjp", "grad", "value_and_grad"}
+    _GRAD_NAME_HINTS = ("bwd", "backward", "grad")
+
+    def _is_grad_bearing(self, fn: ast.AST, qual: str) -> bool:
+        name = qual.rsplit(".", 1)[-1].lower()
+        if any(h in name for h in self._GRAD_NAME_HINTS):
+            return True
+        for n in _walk_through_lambdas(fn):
+            if isinstance(n, ast.Call) and \
+                    _terminal_name(n.func) in self._GRAD_CALLEES:
+                return True
+        return False
+
+    @staticmethod
+    def _masky(node: ast.AST) -> bool:
+        return "mask" in _terminal_name(node).lower()
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("parallel"):
+            return
+        grad_quals = {qual for fn, qual in module.functions
+                      if self._is_grad_bearing(fn, qual)}
+        for fn, qual in module.functions:
+            # Gradient-bearing context is inherited by nested functions
+            # (the loss_fn inside a value_and_grad'd step).
+            if not any(qual == g or qual.startswith(g + ".")
+                       for g in grad_quals):
+                continue
+            for n in _walk_through_lambdas(fn):
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult) \
+                        and (self._masky(n.left) or self._masky(n.right)):
+                    yield self.finding(
+                        module, n,
+                        f"'{ast.unparse(n)}' multiplies by a mask inside "
+                        f"gradient-bearing '{qual}' — NaN/Inf on masked "
+                        f"lanes survives multiplication by zero")
+
+
+# --------------------------------------------------------------------------
+# GL002 — host-device synchronization in hot loops
+
+
+class HostSyncInHotLoop(Rule):
+    """Origin: the PR 2 `np.asarray(infer(params, x))` decode loop —
+    every step materialized the whole [slots, d] state across PCIe and
+    blocked dispatch, which PR 3's device-resident DecodeStep exists to
+    remove. A host sync re-introduced anywhere in the pipelined decode
+    path or the fabric send loops silently serializes the overlap.
+
+    Scope: jax-importing modules (plus fabric_collectives), functions
+    reachable (same-module call graph) from DecodeStep's step path, a
+    `_run_pipelined` loop, or fabric_collectives' transport loops.
+    Flags .item(), float()/int() on a bare name/attribute,
+    np.asarray/np.array/jnp.asarray over a call result,
+    .block_until_ready(), and device_get.
+
+    Deliberate exclusion: serving/scheduler.py's _run_pipelined is
+    numpy-only by contract — the executor seam materializes token ids
+    before collect() returns, so float()/np.asarray there are host
+    no-ops and flagging them would be pure false positives (int(token)
+    in _settle is reachable from the loop). The rule guards the side
+    of the seam where device arrays live: infer.py's DecodeStep,
+    LocalExecutor, and the transport loops. The `_run_pipelined` root
+    exists so a pipelined loop MOVED into a jax-importing module
+    (where the seam no longer protects it) is covered on arrival."""
+
+    rule_id = "GL002"
+    severity = SEVERITY_ERROR
+    title = "host-device sync in a decode/collective hot loop"
+    hint = ("keep the hot loop async: let token ids/arrays stay in "
+            "flight (jax async dispatch) and cross the host boundary "
+            "outside the loop, or add a pragma with a measured "
+            "justification")
+
+    _HOT_CLASSES = {"DecodeStep": {"__call__"}}
+    _HOT_FUNCS = {"_run_pipelined"}
+    _HOT_COLLECTIVE_HINTS = ("sender", "receiver", "_run", "_pair_run",
+                             "allreduce", "exchange")
+
+    def _roots(self, module: Module) -> Set[str]:
+        roots: Set[str] = set()
+        is_collectives = module.relpath.endswith("fabric_collectives.py")
+        for fn, qual in module.functions:
+            parts = qual.split(".")
+            name = parts[-1]
+            if name in self._HOT_FUNCS:
+                roots.add(qual)
+            for cls, methods in self._HOT_CLASSES.items():
+                if cls in parts and name in methods:
+                    roots.add(qual)
+            if is_collectives and name in self._HOT_COLLECTIVE_HINTS:
+                roots.add(qual)
+        return roots
+
+    @staticmethod
+    def _callees(fn: ast.AST, qual: str,
+                 defined: Dict[str, List[str]]) -> Set[str]:
+        """Same-module resolution: plain-name calls to any function of
+        that name; self.m()/cls.m() to a method of the enclosing
+        class."""
+        out: Set[str] = set()
+        cls_prefix = qual.rsplit(".", 2)[0] + "." if "." in qual else ""
+        for n in _walk_through_lambdas(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Name):
+                out.update(defined.get(f.id, ()))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                out.update(q for q in defined.get(f.attr, ())
+                           if cls_prefix and q.startswith(cls_prefix))
+        return out
+
+    def _reachable(self, module: Module) -> Set[str]:
+        defined: Dict[str, List[str]] = {}
+        by_qual = {}
+        for fn, qual in module.functions:
+            defined.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+            by_qual[qual] = fn
+        seen = set()
+        frontier = list(self._roots(module))
+        while frontier:
+            qual = frontier.pop()
+            if qual in seen or qual not in by_qual:
+                continue
+            seen.add(qual)
+            frontier.extend(self._callees(by_qual[qual], qual, defined))
+        return seen
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        # The scheduler plane is numpy-only by design (its float()/
+        # np.asarray are host values); fabric_collectives is too, but
+        # its transport loops carry device-fed buffers and ARE the hot
+        # path the rule was written for.
+        if not (module.imports_jax
+                or module.relpath.endswith("fabric_collectives.py")):
+            return
+        hot = self._reachable(module)
+        if not hot:
+            return
+        for fn, qual in module.functions:
+            if qual not in hot:
+                continue
+            for n in _walk_through_lambdas(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                tname = _terminal_name(f)
+                if tname == "item" and isinstance(f, ast.Attribute) \
+                        and not n.args:
+                    yield self.finding(
+                        module, n, f".item() in hot '{qual}' forces a "
+                        f"device round-trip per call")
+                elif tname == "block_until_ready":
+                    yield self.finding(
+                        module, n, f".block_until_ready() in hot "
+                        f"'{qual}' serializes async dispatch")
+                elif tname == "device_get":
+                    yield self.finding(
+                        module, n, f"device_get in hot '{qual}' blocks "
+                        f"on a transfer")
+                elif isinstance(f, ast.Name) and f.id in ("float", "int") \
+                        and len(n.args) == 1 and isinstance(
+                            n.args[0], (ast.Name, ast.Attribute)):
+                    yield self.finding(
+                        module, n,
+                        f"{f.id}({ast.unparse(n.args[0])}) in hot "
+                        f"'{qual}' blocks until the value is on host")
+                elif tname in ("asarray", "array") and n.args and \
+                        isinstance(n.args[0], ast.Call):
+                    yield self.finding(
+                        module, n,
+                        f"{ast.unparse(f)}(...) over a call result in "
+                        f"hot '{qual}' materializes the array on host")
+
+
+# --------------------------------------------------------------------------
+# GL003 — except handler reads a name first bound inside its try body
+
+
+class ExceptReadsTryBinding(Rule):
+    """Origin: PR 3 satellite — `_admit`'s old `i = free.pop(0)` INSIDE
+    the try meant the handler's own `self._slots[i]` raised
+    NameError('i') whenever the failure hit before the bind, masking
+    the real error and leaking the queue's inflight count. Generalized:
+    any handler that reads a name whose only binding sits inside its
+    own try body can NameError at exactly the moment it is reporting a
+    different failure."""
+
+    rule_id = "GL003"
+    severity = SEVERITY_ERROR
+    title = "except handler reads a name first bound inside its try"
+    hint = ("bind the name BEFORE the try (the handler must be able to "
+            "run when any statement of the try body raises), or guard "
+            "the handler's use")
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        top = _module_toplevel_names(module.tree)
+        import builtins
+        known = top | set(dir(builtins))
+        all_bound = {
+            qual: set().union(
+                *(_stmt_bound_names(s) for s in fn.body), set())
+            | self._arg_names(fn)
+            for fn, qual in module.functions}
+        # Module-level code, then each function as its own scope. The
+        # module scope starts from BUILTINS ONLY — its own top-level
+        # binds accumulate sequentially inside _walk_scope, so a
+        # module-level try/except is checked in import order (seeding
+        # `top` here would pre-bind every try-bound name and blind the
+        # rule to module-level init code). Functions run after import:
+        # they pre-bind the full module top-level set, and a nested
+        # function additionally pre-binds every name any ENCLOSING
+        # function binds anywhere (closures — over-approximated, which
+        # can only suppress findings: the false-positive-safe
+        # direction).
+        scopes = [(module.tree, set(dir(builtins)))]
+        for fn, qual in module.functions:
+            bound = set(known) | self._arg_names(fn)
+            for anc_qual, anc_bound in all_bound.items():
+                if qual != anc_qual and qual.startswith(anc_qual + "."):
+                    bound |= anc_bound
+            scopes.append((fn, bound))
+        for scope_node, bound in scopes:
+            yield from self._walk_scope(
+                module, list(ast.iter_child_nodes(scope_node))
+                if isinstance(scope_node, ast.Module)
+                else list(scope_node.body), bound)
+
+    @staticmethod
+    def _arg_names(fn: ast.AST) -> Set[str]:
+        args = fn.args
+        out = {a.arg for a in (args.posonlyargs + args.args
+                               + args.kwonlyargs)}
+        if args.vararg:
+            out.add(args.vararg.arg)
+        if args.kwarg:
+            out.add(args.kwarg.arg)
+        return out
+
+    def _walk_scope(self, module: Module, body: List[ast.stmt],
+                    bound: Set[str]) -> Iterator[Finding]:
+        for stmt in body:
+            if isinstance(stmt, ast.Try):
+                try_binds: Set[str] = set()
+                for ts in stmt.body:
+                    try_binds |= _stmt_bound_names(ts)
+                for h in stmt.handlers:
+                    hbound = set(bound) | ({h.name} if h.name else set())
+                    for hs in h.body:
+                        for n in _walk_same_function(hs):
+                            if isinstance(n, ast.Name) and \
+                                    isinstance(n.ctx, ast.Load) and \
+                                    n.id in try_binds and \
+                                    n.id not in hbound:
+                                yield self.finding(
+                                    module, n,
+                                    f"handler reads '{n.id}', first "
+                                    f"bound inside its own try (line "
+                                    f"{stmt.lineno}): a failure before "
+                                    f"the bind raises NameError here, "
+                                    f"masking the real error")
+                        hbound |= _stmt_bound_names(hs)
+                    # Recurse into the handler with its own bindings.
+                    yield from self._walk_scope(
+                        module, h.body,
+                        set(bound) | ({h.name} if h.name else set()))
+                yield from self._walk_scope(module, stmt.body, set(bound))
+                yield from self._walk_scope(
+                    module, stmt.orelse, bound | try_binds)
+                yield from self._walk_scope(
+                    module, stmt.finalbody, set(bound))
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.AsyncFor, ast.AsyncWith)):
+                # Only the compound's own control targets pre-bind for
+                # its body (for-target, with-as); body statements then
+                # accumulate sequentially inside the recursion — a try
+                # nested in a loop keeps its real before/after order
+                # (the PR 3 bug WAS inside a for loop).
+                inner = set(bound)
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    inner |= {n.id for n in ast.walk(stmt.target)
+                              if isinstance(n, ast.Name)}
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        if item.optional_vars is not None:
+                            inner |= {
+                                n.id for n in ast.walk(item.optional_vars)
+                                if isinstance(n, ast.Name)}
+                for sub in (getattr(stmt, "body", []),
+                            getattr(stmt, "orelse", [])):
+                    yield from self._walk_scope(module, sub, set(inner))
+                bound |= _stmt_bound_names(stmt)
+                continue
+            bound |= _stmt_bound_names(stmt)
+
+
+# --------------------------------------------------------------------------
+# GL004 — lock held across a blocking call
+
+
+class LockAcrossBlockingCall(Rule):
+    """Origin: the serving plane's lock/drain races (PR 2 review) and
+    the VSP Init-vs-heartbeat stall fixed in this PR: a mutex held
+    across network/subprocess/thread-join work turns every other
+    contender into a queue behind the slow path — the kubelet's 5 s
+    ListAndWatch poll or the daemon's heartbeat times out behind a
+    bridge bring-up retry loop.
+
+    Near-misses that stay silent: dict .get/.put-alikes, str.join,
+    Condition.wait on the condition wrapping the SAME with'd lock
+    (wait releases it), and callables with no blocking pedigree."""
+
+    rule_id = "GL004"
+    severity = SEVERITY_WARNING
+    title = "lock held across a blocking call"
+    hint = ("do the blocking work outside the lock: snapshot state "
+            "under the lock, run the call, re-acquire to publish the "
+            "result (see TpuVsp.Init)")
+
+    _LOCK_HINTS = ("lock", "mutex", "_mu")
+    _SOCK_HINTS = ("sock", "conn", "sk")
+    _QUEUE_HINTS = ("queue", "_q", "work", "jobs")
+    _THREAD_HINTS = ("thread", "thr", "worker", "proc")
+    _SUBPROCESS_FNS = {"run", "call", "check_call", "check_output",
+                       "Popen", "getoutput", "getstatusoutput"}
+    # Project-annotated blocking callables: these shell out to ip/nft
+    # or retry against external processes (see docs/static-analysis.md
+    # for how to extend this set).
+    _PROJECT_BLOCKING = {"ensure_bridge", "setup_comm_channel",
+                         "partition_endpoints", "cmd_add", "cmd_del"}
+
+    @classmethod
+    def _lockish(cls, expr: ast.AST) -> bool:
+        name = _terminal_name(expr).lower()
+        return bool(name) and any(h in name for h in cls._LOCK_HINTS)
+
+    @staticmethod
+    def _conditions_of(module: Module) -> Dict[str, str]:
+        """attr name of `self.X = threading.Condition(self.Y)` -> Y:
+        X.wait() under `with self.Y` releases Y and must not fire."""
+        out: Dict[str, str] = {}
+        for n in ast.walk(module.tree):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Attribute) and \
+                    isinstance(n.value, ast.Call) and \
+                    _terminal_name(n.value.func) == "Condition" and \
+                    n.value.args:
+                out[n.targets[0].attr] = _terminal_name(n.value.args[0])
+        return out
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving", "daemon", "cni", "vsp"):
+            return
+        conds = self._conditions_of(module)
+        for n in ast.walk(module.tree):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            held = [i.context_expr for i in n.items
+                    if self._lockish(i.context_expr)]
+            if not held:
+                continue
+            held_names = {_terminal_name(h) for h in held}
+            for c in self._blocking_calls(n, conds, held_names):
+                yield self.finding(
+                    module, c,
+                    f"'{ast.unparse(c.func)}(...)' can block while "
+                    f"'{ast.unparse(held[0])}' is held (with at line "
+                    f"{n.lineno}) — every other contender stalls "
+                    f"behind it")
+
+    def _blocking_calls(self, with_node: ast.AST, conds: Dict[str, str],
+                        held_names: Set[str]) -> Iterator[ast.Call]:
+        stack: List[ast.AST] = list(with_node.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue  # deferred work doesn't hold the lock
+            if isinstance(n, ast.Call) and self._is_blocking(
+                    n, conds, held_names):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _is_blocking(self, call: ast.Call, conds: Dict[str, str],
+                     held_names: Set[str]) -> bool:
+        f = call.func
+        attr = _terminal_name(f)
+        recv = f.value if isinstance(f, ast.Attribute) else None
+        recv_name = _terminal_name(recv).lower() if recv is not None \
+            else ""
+        if attr in ("sendall", "send", "recv", "recv_into", "accept",
+                    "connect"):
+            return any(h in recv_name for h in self._SOCK_HINTS)
+        if attr in ("get", "put"):
+            return any(h in recv_name for h in self._QUEUE_HINTS)
+        if attr == "join":
+            return any(h in recv_name for h in self._THREAD_HINTS)
+        if attr in self._SUBPROCESS_FNS and recv is not None and \
+                _terminal_name(recv) == "subprocess":
+            return True
+        if attr == "sleep":
+            return True
+        if attr == "wait" and recv is not None:
+            rname = _terminal_name(recv)
+            # Condition.wait on the condition wrapping a held lock
+            # RELEASES it — the correct pattern, not a stall.
+            if conds.get(rname) in held_names or rname in held_names:
+                return False
+            return True
+        if attr in self._PROJECT_BLOCKING:
+            return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# GL005 — broad except that neither re-raises, logs, nor narrows
+
+
+class SilentBroadExcept(Rule):
+    """Origin: PR 1's swallowed dataplane OSErrors (DelegatedIpam
+    `_exec`) and this PR's `_rollback` blanket `except Exception:
+    pass`, which hid lease leaks AND programming errors. In CNI/daemon/
+    VSP paths a silent broad except erases the only trace a failed
+    teardown leaves behind."""
+
+    rule_id = "GL005"
+    severity = SEVERITY_WARNING
+    title = "broad except swallows without re-raise, log, or narrowing"
+    hint = ("narrow to the exception types the call can actually "
+            "raise, and log what was swallowed (owner/device identity "
+            "included); keep broad ONLY with a log + baseline entry or "
+            "pragma stating why")
+
+    _LOG_BASES = {"log", "logger", "logging", "trace", "print"}
+    _LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                    "critical", "log"}
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [_terminal_name(e) for e in t.elts]
+        else:
+            names = [_terminal_name(t)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def _handled(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in self._LOG_METHODS:
+                    return True
+                base = f
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name) and \
+                        base.id in self._LOG_BASES:
+                    return True
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("cni", "daemon", "vsp"):
+            return
+        for n in ast.walk(module.tree):
+            if isinstance(n, ast.ExceptHandler) and self._broad(n) \
+                    and not self._handled(n):
+                caught = ast.unparse(n.type) if n.type else "everything"
+                yield self.finding(
+                    module, n,
+                    f"except {caught} swallows silently in a dataplane "
+                    f"path — no re-raise, no log, no narrowing")
+
+
+# --------------------------------------------------------------------------
+# GL006 — collective axis names no analyzed mesh declares
+
+
+_AXIS_CALLEES = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                 "all_gather", "all_to_all", "psum_scatter",
+                 "axis_size", "axis_index"}
+_SPEC_CALLEES = {"P", "PartitionSpec"}
+_MESH_CALLEES = {"Mesh", "make_mesh"}
+
+
+def collect_declared_axes(modules: Sequence[Module]) -> Set[str]:
+    """Union of axis names declared by any Mesh construction in any
+    analyzed module (axis_names= kwarg or the positional tuple),
+    resolving module-level string-tuple constants like AXES."""
+    axes: Set[str] = set()
+    for module in modules:
+        consts = _module_str_tuple_consts(module.tree)
+        for n in ast.walk(module.tree):
+            if not (isinstance(n, ast.Call)
+                    and _terminal_name(n.func) in _MESH_CALLEES):
+                continue
+            candidates = [kw.value for kw in n.keywords
+                          if kw.arg == "axis_names"]
+            if not candidates and len(n.args) >= 2:
+                candidates = [n.args[1]]
+            for c in candidates:
+                got = _const_str_tuple(c, consts)
+                if got:
+                    axes.update(got)
+    return axes
+
+
+class UndeclaredAxisName(Rule):
+    """Origin: the shard_map/psum axis-name plumbing PR 1's _compat
+    shim exists to keep working across jax versions — a typo'd or
+    stale axis name surfaces as an opaque tracing error three layers
+    from the mistake (or, with check_vma=False, as silent
+    mis-reduction). Every string-literal axis fed to a collective or a
+    PartitionSpec must be declared by SOME analyzed mesh construction;
+    axis names passed as variables are the caller's contract and stay
+    silent."""
+
+    rule_id = "GL006"
+    severity = SEVERITY_ERROR
+    title = "axis name not declared by any analyzed mesh"
+    hint = ("declare the axis in the mesh construction (Mesh(...,"
+            " axis_names=...)) or fix the typo; the declared set is "
+            "collected across the whole analyzed tree")
+
+    @staticmethod
+    def _literal_axes(node: ast.AST) -> List[tuple]:
+        """(axis_string, node) pairs inside an argument expression —
+        a bare string or a tuple/list of strings (nested one level,
+        for P(('dp', 'ep'), None))."""
+        out = []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append((node.value, node))
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    out.append((e.value, e))
+                elif isinstance(e, (ast.Tuple, ast.List)):
+                    out.extend(UndeclaredAxisName._literal_axes(e))
+        return out
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        declared = project.declared_axes
+        if not declared:
+            return
+        for n in ast.walk(module.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = _terminal_name(n.func)
+            if callee in _AXIS_CALLEES:
+                args = list(n.args) + [
+                    kw.value for kw in n.keywords
+                    if kw.arg in ("axis_name", "axis")]
+            elif callee in _SPEC_CALLEES:
+                args = list(n.args)
+            else:
+                continue
+            for arg in args:
+                for axis, node in self._literal_axes(arg):
+                    if axis not in declared:
+                        yield self.finding(
+                            module, node,
+                            f"axis '{axis}' in {callee}(...) is not "
+                            f"declared by any analyzed mesh "
+                            f"(declared: {sorted(declared)})")
+
+
+def default_rules() -> List[Rule]:
+    return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
+            ExceptReadsTryBinding(), LockAcrossBlockingCall(),
+            SilentBroadExcept(), UndeclaredAxisName()]

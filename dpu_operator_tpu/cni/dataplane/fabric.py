@@ -216,11 +216,18 @@ class FabricDataplane:
             # the cleanup entirely.
             try:
                 rollback_ipam = self._ipam_for(req)[0]
-            except Exception:
+            except Exception as cfg_err:
+                log.debug("rollback allocator re-resolve failed (%s); "
+                          "default allocator", cfg_err)
                 rollback_ipam = self._ipam
-            self._rollback(host_if, tmp_if, req.ifname, netns, owner,
-                           rollback_ipam, release_netns=req.netns or "")
-            nl.release_named_netns(netns, netns_created)
+            try:
+                self._rollback(host_if, tmp_if, req.ifname, netns, owner,
+                               rollback_ipam, release_netns=req.netns or "")
+            finally:
+                # A programming error propagating out of _rollback (its
+                # deliberate escape path) must still not leak the named
+                # netns this ADD created.
+                nl.release_named_netns(netns, netns_created)
             raise CniError(f"fabric ADD failed: {e}") from e
 
         state = {
@@ -419,5 +426,13 @@ class FabricDataplane:
                 target.release(owner, netns=release_netns)
             else:
                 target.release(owner)
-        except Exception:
-            pass
+        except (IpamError, ValueError, OSError) as e:
+            # Rollback stays best-effort for the failures release can
+            # legitimately hit (allocator state unwritable or corrupt —
+            # json raises ValueError, same tuple as the DEL handlers —
+            # delegated plugin down) — but the leaked lease must leave
+            # a trace, and anything ELSE (a programming error) must
+            # surface, not vanish: the old blanket `except Exception:
+            # pass` hid both.
+            log.warning("rollback: ipam release for %s failed "
+                        "(lease may be leaked until GC): %s", owner, e)

@@ -24,7 +24,6 @@ from ..images import (
 from ..k8s import Client, Reconciler, Request, Result
 from ..k8s.objects import (
     add_finalizer,
-    has_finalizer,
     remove_finalizer,
     set_condition,
 )

@@ -422,6 +422,12 @@ class Daemon:
                 v1.GROUP_VERSION, v1.KIND_DATA_PROCESSING_UNIT_CONFIG, self._namespace
             )
         except Exception:
+            # Transient apiserver trouble: skip this tick, retry next.
+            # Logged at debug (not warning) because a flapping apiserver
+            # would spam at tick cadence — but never silently: a
+            # permanently failing list used to leave zero trace.
+            log.debug("DPUConfig list failed; retrying next tick",
+                      exc_info=True)
             return
         if not configs:
             return

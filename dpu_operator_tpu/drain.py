@@ -12,7 +12,7 @@ default behavior."""
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import List
 
 from . import vars as v
 from .k8s import Client

@@ -105,7 +105,6 @@ def _psum_bench(mesh, payload_mb: float, iters: int):
     have pushed through its fabric port (one reduce step's worth), for
     the caller's counter assertion."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 

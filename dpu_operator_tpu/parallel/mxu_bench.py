@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

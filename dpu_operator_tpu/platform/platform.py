@@ -15,7 +15,7 @@ from __future__ import annotations
 import glob
 import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import List, Optional
+from typing import List
 
 from .api import (DEADLINE_QUEUED_ERROR, Draining, GenerateRequest,
                   QueueFull)

@@ -65,6 +65,9 @@ class TpuVsp(
         self._opi = (opi_ip, opi_port or int(os.environ.get("DPU_OPI_PORT", DEFAULT_OPI_PORT)))
         self._cp_agent = cp_agent_client
         self._lock = threading.Lock()
+        # Serializes Init's blocking bring-up WITHOUT stalling the
+        # request path: _lock is only ever held for state snapshots.
+        self._init_lock = threading.Lock()
         self._num_endpoints = num_endpoints
         # Fresh per process: echoed in Ping so the daemon detects VSP
         # restarts deterministically (sub-heartbeat bounces included) and
@@ -89,27 +92,47 @@ class TpuVsp(
     # -- LifeCycle -----------------------------------------------------------
 
     def Init(self, request, context):
-        with self._lock:
-            if self._topology is None:
-                self._topology = SliceTopology.from_env()
-                if not self._topology.chips:
-                    self._topology = SliceTopology.single_chip()
-            if self._dataplane is None:
-                from .tpu_dataplane import DebugDataplane, TpuFabricDataplane
+        # Bridge bring-up and comm-channel setup shell out to ip/nft
+        # (with fallback retries on old kernels) — seconds, worst case.
+        # They run under _init_lock, NOT _lock: _lock guards the state
+        # Ping/GetDevices read on the request path, and the kubelet's
+        # 5 s ListAndWatch poll plus the daemon's heartbeat must never
+        # queue behind a slow bring-up (the module's no-inline-refresh
+        # contract; regression: test_tpu_platform.py
+        # test_ping_not_blocked_by_slow_init). _init_lock still keeps
+        # two concurrent Inits from racing the bring-up itself.
+        with self._init_lock:
+            with self._lock:
+                if self._topology is None:
+                    self._topology = SliceTopology.from_env()
+                    if not self._topology.chips:
+                        self._topology = SliceTopology.single_chip()
+                dataplane = self._dataplane
+                opi = self._opi
+            if dataplane is None:
+                # Built into the LOCAL only — a dataplane must not be
+                # visible to concurrent RPCs (CreateBridgePort gates on
+                # `dp is not None`) until its bridge exists; the final
+                # publish below is the only self._dataplane write.
+                from .tpu_dataplane import (DebugDataplane,
+                                            TpuFabricDataplane)
 
                 uplink = os.environ.get("DPU_FABRIC_UPLINK")
                 if os.environ.get("DPU_DATAPLANE", "bridge") == "debug":
-                    self._dataplane = DebugDataplane(uplink=uplink)
+                    dataplane = DebugDataplane(uplink=uplink)
                 else:
-                    self._dataplane = TpuFabricDataplane(uplink=uplink)
+                    dataplane = TpuFabricDataplane(uplink=uplink)
             try:
-                self._dataplane.ensure_bridge()
+                # Blocking under _init_lock is the DESIGN here: only
+                # other Inits contend on it, never Ping/GetDevices.
+                # graftlint: disable=GL004
+                dataplane.ensure_bridge()
             except Exception as e:
                 log.warning("bridge bring-up failed (%s); debug dataplane", e)
                 from .tpu_dataplane import DebugDataplane
 
-                self._dataplane = DebugDataplane()
-                self._dataplane.ensure_bridge()
+                dataplane = DebugDataplane()
+                dataplane.ensure_bridge()  # graftlint: disable=GL004
             # Optional IPv6 link-local control channel on the device that
             # joins host and DPU sides (reference Marvell fe80::1/::2 on
             # SDP, NetSec configureCommChannelIPs on the backplane): the
@@ -121,28 +144,32 @@ class TpuVsp(
 
                 try:
                     dpu_mode = request.dpu_mode == pb.DPU_MODE_DPU
+                    # graftlint: disable=GL004 (same: _init_lock only)
                     conn = setup_comm_channel(comm_dev, dpu_mode=dpu_mode)
                     if not dpu_mode:
                         # The host daemon DIALS what Init returns; its own
                         # address is only the source — the target is the
                         # DPU side's fixed address over this device.
                         conn = peer_target(comm_dev)
-                    self._opi = (conn, self._opi[1])
+                    opi = (conn, opi[1])
                 except Exception as e:
                     log.warning(
                         "comm channel on %s failed (%s); OPI stays on %s",
-                        comm_dev, e, self._opi[0],
+                        comm_dev, e, opi[0],
                     )
-            self._initialized = True
+            with self._lock:
+                self._dataplane = dataplane
+                self._opi = opi
+                self._initialized = True
         self._start_health_watchers()
         log.info(
             "tpuvsp Init(id=%s): slice=%s chips=%d, OPI at %s:%d",
             request.dpu_identifier,
             self._topology.accelerator_type or "single",
             self._topology.num_chips,
-            *self._opi,
+            *opi,
         )
-        return pb.IpPort(ip=self._opi[0], port=self._opi[1])
+        return pb.IpPort(ip=opi[0], port=opi[1])
 
     # -- Devices -------------------------------------------------------------
 
@@ -286,7 +313,12 @@ class TpuVsp(
                 with self._lock:
                     self._agent_health_cache = health
             except Exception:
-                pass
+                # Broad on purpose (like the stream handler above): a
+                # malformed agent frame raises JSONDecodeError/ValueError
+                # out of chip_health, and ANY escape here kills the
+                # watcher thread — freezing the health cache forever.
+                log.debug("cp-agent poll sample failed; stale health "
+                          "cache until the stream returns", exc_info=True)
 
     def _deep_health_loop(self) -> None:
         """The MXU burn probe (compute-path liveness, the OCTEON mailbox

@@ -1,0 +1,13 @@
+# graftlint-fixture-path: dpu_operator_tpu/parallel/fx_gl001_nm.py
+"""GL001 near-miss: forward-only routing math scaling by a mask (the
+moe.py capacity-bucketing shape). No vjp/grad flows through it at the
+masked points — multiplication is the correct tool and must NOT fire."""
+import jax
+import jax.numpy as jnp
+
+
+def route(y, row_mask, onehot):
+    mask_all = jnp.tile(row_mask.astype(y.dtype), 2)
+    onehot = onehot * mask_all[:, None]
+    keep = jnp.cumsum(onehot, axis=0) * mask_all[:, None]
+    return keep

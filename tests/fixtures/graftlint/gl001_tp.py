@@ -1,0 +1,16 @@
+# graftlint-fixture-path: dpu_operator_tpu/parallel/fx_gl001_tp.py
+"""GL001 true positive: mask-multiply on a cotangent inside a
+gradient-bearing function (the PR 2 pipeline_1f1b bug shape — the VJP
+runs over zero-filled IDLE buffers, NaN * 0 poisons the accumulator)."""
+import jax
+import jax.numpy as jnp
+
+
+def accumulate_step(params, x, gmask, grads):
+    def loss(p):
+        return jnp.sum(p / jnp.sum(p))  # division: NaN on zero input
+
+    _, vjp = jax.vjp(loss, params)
+    (dpl,) = vjp(jnp.float32(1.0))
+    # BUG: scaling by the mask keeps NaN (NaN * 0 == NaN).
+    return jax.tree.map(lambda g, d: g + d * gmask, grads, dpl)

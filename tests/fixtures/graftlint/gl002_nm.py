@@ -1,0 +1,19 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl002_nm.py
+"""GL002 near-misses that must stay silent: float() over a len() call
+(host int, no sync), np.asarray over a bare name (host value — the
+scheduler's prompt_vec path), and a sync helper NOT reachable from the
+hot roots."""
+import jax
+import numpy as np
+
+
+class DecodeStep:
+    def __call__(self, x, updates=()):
+        count = float(len(updates))     # len() result: host-side
+        vec = np.asarray(x, np.float32)  # bare name arg: host value
+        return vec, count
+
+
+def _sync_baseline(ex, state):
+    # The measured sync loop — deliberately outside the hot roots.
+    return np.asarray(ex.step(state))

@@ -1,0 +1,20 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl002_tp.py
+"""GL002 true positive: host-device syncs inside the decode hot path —
+DecodeStep.__call__ and the pipelined scheduler loop (the PR 2
+np.asarray-per-step decode loop this rule exists to keep dead)."""
+import jax
+import numpy as np
+
+
+class DecodeStep:
+    def __call__(self, x, updates=()):
+        y = self._step(x)
+        return float(y)  # blocks dispatch until y is on host
+
+
+def _run_pipelined(ex, state):
+    while True:
+        tok = ex.submit(state)
+        state = np.asarray(ex.collect(tok))  # materializes every step
+        if tok.item() < 0:  # device round-trip per step
+            return state

@@ -1,0 +1,15 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl003_nm.py
+"""GL003 near-miss: the name binds BEFORE the try (the fixed `_admit`
+shape) — the handler can always run it; rebinding inside the try is
+fine. Must NOT fire."""
+
+
+def admit(free, queue, slots):
+    for req in queue:
+        i = free.pop(0)
+        try:
+            slots[i] = req
+            i = i + 0  # rebind inside try: still bound before
+        except Exception:
+            slots[i] = None
+            req.fail("admission failed")

@@ -1,0 +1,15 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl003_tp.py
+"""GL003 true positive: the handler reads a name whose only binding is
+inside its own try body (the PR 3 `_admit` NameError-masking bug — a
+failure BEFORE the bind raises NameError in the handler, replacing the
+real error)."""
+
+
+def admit(free, queue, slots):
+    for req in queue:
+        try:
+            i = free.pop(0)
+            slots[i] = req
+        except Exception:
+            slots[i] = None  # NameError when pop() itself raised
+            req.fail("admission failed")

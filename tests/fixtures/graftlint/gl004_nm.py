@@ -1,0 +1,21 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl004_nm.py
+"""GL004 near-misses that must stay silent: dict .get under a lock
+(instant), str.join (no receiver hint), and Condition.wait on the
+condition wrapping the SAME held lock (wait RELEASES it — the
+AdmissionQueue pattern)."""
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._cache = {}
+
+    def get_many(self, key, timeout):
+        with self._lock:
+            entry = self._cache.get(key)      # dict get: instant
+            label = ", ".join(["a", "b"])     # str join: no hint
+            if entry is None and timeout > 0:
+                self._nonempty.wait(timeout)  # releases _lock
+            return entry, label

@@ -1,0 +1,15 @@
+# graftlint-fixture-path: dpu_operator_tpu/daemon/fx_gl004_tp.py
+"""GL004 true positive: a mutex held across subprocess + socket +
+thread-join work — every other contender (heartbeat, kubelet poll)
+queues behind the slow path (the TpuVsp.Init-vs-Ping stall)."""
+import subprocess
+import threading
+
+_lock = threading.Lock()
+
+
+def reapply(sock, worker_thread, payload):
+    with _lock:
+        subprocess.run(["ip", "link", "set", "up"], check=True)
+        sock.sendall(payload)
+        worker_thread.join()

@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/cni/fx_gl005_nm.py
+"""GL005 near-misses that must stay silent: a broad except that LOGS
+what it swallowed, one that re-raises, and a NARROW handler that may
+stay quiet (the caller chose the types)."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def rollback(ipam, owner, metrics):
+    try:
+        ipam.release(owner)
+    except Exception as e:
+        log.warning("release for %s failed: %s", owner, e)
+
+
+def handle(req, handler):
+    try:
+        return handler(req)
+    except Exception:
+        metrics_mark_error(req)
+        raise
+
+
+def garp(sock, frame):
+    try:
+        sock.send(frame)
+    except OSError:
+        return False  # narrow: best-effort announce
+
+
+def metrics_mark_error(req):
+    pass

@@ -1,0 +1,19 @@
+# graftlint-fixture-path: dpu_operator_tpu/cni/fx_gl005_tp.py
+"""GL005 true positive: broad excepts in a CNI path that neither
+re-raise, log, nor narrow — the failed teardown's only trace,
+erased (the _rollback lease-leak shape)."""
+
+
+def rollback(ipam, owner):
+    try:
+        ipam.release(owner)
+    except Exception:
+        pass
+
+
+def teardown(links):
+    for name in links:
+        try:
+            links[name].delete()
+        except BaseException:
+            continue

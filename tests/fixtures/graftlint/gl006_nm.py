@@ -1,0 +1,17 @@
+# graftlint-fixture-path: dpu_operator_tpu/parallel/fx_gl006_nm.py
+"""GL006 near-misses that must stay silent: collectives over DECLARED
+axes (including via the module AXES constant and tuple args), and an
+axis passed as a VARIABLE (the caller's contract, unknowable here)."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make(devs, x, axis):
+    mesh = Mesh(devs, AXES)
+    spec = P(("dp", "tp"), None)
+    a = jax.lax.psum(x, "dp")
+    b = jax.lax.pmean(x, ("dp", "tp"))
+    c = jax.lax.psum(x, axis)  # variable axis: caller's contract
+    return mesh, spec, a, b, c

@@ -1,0 +1,18 @@
+# graftlint-fixture-path: dpu_operator_tpu/parallel/fx_gl006_tp.py
+"""GL006 true positive: a collective over an axis name ('pd' — a typo
+of 'dp') that no mesh construction declares; surfaces three layers away
+as an opaque tracing error, or silently with check_vma=False."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make(devs, x):
+    mesh = Mesh(devs, axis_names=AXES)
+    spec = P("dp", None)
+
+    def body(v):
+        return jax.lax.psum(v, "pd")  # typo: undeclared axis
+
+    return mesh, spec, body(x)

@@ -593,3 +593,44 @@ def test_override_raises_uplink_above_boot_mtu(netns, monkeypatch):
     finally:
         subprocess.run(["ip", "link", "del", up_a], capture_output=True)
         subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+
+
+def test_rollback_release_failure_logs_not_swallows(dataplane, caplog):
+    """Regression (graftlint GL005 triage): _rollback used to wrap the
+    ipam release in `except Exception: pass` — a failed release leaked
+    the lease with ZERO trace, and even programming errors (a TypeError
+    from a bad allocator double) vanished into the same pass. Now the
+    legitimate best-effort failures (IpamError, OSError) leave a
+    warning carrying the owner identity, and anything else surfaces."""
+    import logging
+
+    class ReleaseExplodes:
+        delegated = False
+
+        def __init__(self, exc):
+            self.exc = exc
+
+        def release(self, owner):
+            raise self.exc
+
+    owner = "cid-reg/net1"
+    with caplog.at_level(
+            logging.WARNING, logger="dpu_operator_tpu.cni.dataplane.fabric"):
+        dataplane._rollback("hxreg0", "txreg0", "net1", None, owner,
+                            ipam=ReleaseExplodes(IpamError("state dir gone")))
+    assert any(owner in r.message and "leaked" in r.message
+               for r in caplog.records), caplog.records
+
+    # Corrupt lease-file json raises ValueError from release — an
+    # environmental failure, best-effort like the DEL handlers' tuple.
+    with caplog.at_level(
+            logging.WARNING, logger="dpu_operator_tpu.cni.dataplane.fabric"):
+        dataplane._rollback("hxreg0", "txreg0", "net1", None, owner,
+                            ipam=ReleaseExplodes(ValueError("bad json")))
+    assert any("bad json" in r.message for r in caplog.records)
+
+    # A programming error in the release path must PROPAGATE: the old
+    # blanket swallow turned an always-broken rollback into silence.
+    with pytest.raises(TypeError):
+        dataplane._rollback("hxreg0", "txreg0", "net1", None, owner,
+                            ipam=ReleaseExplodes(TypeError("bad allocator")))

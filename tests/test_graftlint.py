@@ -1,0 +1,293 @@
+"""graftlint: the tier-1 gate plus the analyzer's own test suite.
+
+Three layers:
+  * THE GATE — the whole package must analyze clean against the
+    checked-in baseline (this is the test that makes every rule a
+    permanent regression guard);
+  * per-rule fixture pairs — each rule's minimal true positive fires
+    and its near-miss stays silent (tests/fixtures/graftlint/);
+  * machinery — pragma suppression (line / line-above / file), the
+    baseline ratchet (count caps, stale entries stay green), and the
+    acceptance scratch-copies: re-introducing the PR 2 mask-multiply
+    bug or the PR 3 except-binding bug into a copy of the REAL source
+    must make the analyzer fail.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from dpu_operator_tpu.analysis import (DEFAULT_BASELINE, default_rules,
+                                       run_analysis)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).parent / "fixtures" / "graftlint"
+
+
+def _analyze(path, baseline=None):
+    return run_analysis([str(path)], baseline=baseline)
+
+
+def _analyze_source(tmp_path, source, name="fx.py", baseline=None):
+    p = tmp_path / name
+    p.write_text(source)
+    return _analyze(p, baseline=baseline)
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def test_package_gate_clean_and_fast():
+    """The tier-1 gate: zero non-baselined findings over the whole
+    package, in well under the 10 s lint-lane budget."""
+    t0 = time.perf_counter()
+    report = run_analysis([str(REPO / "dpu_operator_tpu")],
+                          baseline=DEFAULT_BASELINE)
+    elapsed = time.perf_counter() - t0
+    assert report.clean, "\n".join(f.format() for f in report.findings)
+    assert report.checked_files > 100  # really saw the package
+    assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s (budget 10s)"
+
+
+def test_rule_ids_unique_and_documented():
+    rules = default_rules()
+    ids = [r.rule_id for r in rules]
+    assert len(set(ids)) == len(ids) == 6
+    for r in rules:
+        assert r.title and r.hint and r.severity in ("error", "warning")
+
+
+# -- per-rule fixture pairs ---------------------------------------------------
+
+_EXPECT = {
+    "GL001": 1,  # the lambda cotangent-scale
+    "GL002": 3,  # float(), np.asarray(call), .item()
+    "GL003": 1,  # handler reads try-bound slot index
+    "GL004": 3,  # subprocess, socket send, thread join under lock
+    "GL005": 2,  # except: pass, except BaseException: continue
+    "GL006": 1,  # psum over the 'pd' typo
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(_EXPECT))
+def test_true_positive_fires(rule_id):
+    report = _analyze(FIXTURES / f"{rule_id.lower()}_tp.py")
+    assert len(report.findings) == _EXPECT[rule_id], [
+        f.format() for f in report.findings]
+    assert all(f.rule == rule_id for f in report.findings)
+
+
+@pytest.mark.parametrize("rule_id", sorted(_EXPECT))
+def test_near_miss_stays_silent(rule_id):
+    report = _analyze(FIXTURES / f"{rule_id.lower()}_nm.py")
+    assert report.clean, [f.format() for f in report.findings]
+
+
+def test_relpath_stable_when_checkout_dir_shares_package_name():
+    """A checkout directory itself named dpu_operator_tpu must not
+    produce doubled-prefix baseline keys (which would unmatch the
+    checked-in baseline and turn a clean gate red)."""
+    from dpu_operator_tpu.analysis.core import _canonical_relpath
+    assert _canonical_relpath(
+        "/home/u/dpu_operator_tpu/dpu_operator_tpu/vsp/tpu_vsp.py"
+    ) == "dpu_operator_tpu/vsp/tpu_vsp.py"
+
+
+def test_gl003_fires_at_module_level(tmp_path):
+    """Module-level init code is import-time code: a module try whose
+    handler reads a try-bound name NameErrors at import — GL003 must
+    see it, not only function bodies."""
+    src = (
+        "import logging\n"
+        "log = logging.getLogger(__name__)\n"
+        "try:\n"
+        "    sock = _dial()\n"
+        "except Exception:\n"
+        "    log.warning('dial failed: %s', sock)\n"
+        "def _dial():\n"
+        "    return None\n"
+    )
+    report = _analyze_source(tmp_path, src)
+    assert any(f.rule == "GL003" and "'sock'" in f.message
+               for f in report.findings), [
+        f.format() for f in report.findings]
+
+
+# -- pragma suppression -------------------------------------------------------
+
+
+def _gl005_tp_source():
+    return (FIXTURES / "gl005_tp.py").read_text()
+
+
+def test_pragma_on_finding_line(tmp_path):
+    src = _gl005_tp_source().replace(
+        "    except Exception:",
+        "    except Exception:  # graftlint: disable=GL005")
+    report = _analyze_source(tmp_path, src)
+    # Only the pragma'd handler is silenced; the other still fires.
+    assert len(report.findings) == 1
+    assert report.findings[0].func == "teardown"
+
+
+def test_pragma_on_line_above(tmp_path):
+    src = _gl005_tp_source().replace(
+        "    except Exception:",
+        "    # graftlint: disable=GL005\n    except Exception:")
+    report = _analyze_source(tmp_path, src)
+    assert len(report.findings) == 1
+
+
+def test_pragma_wrong_rule_does_not_suppress(tmp_path):
+    src = _gl005_tp_source().replace(
+        "    except Exception:",
+        "    except Exception:  # graftlint: disable=GL001")
+    report = _analyze_source(tmp_path, src)
+    assert len(report.findings) == 2
+
+
+def test_file_level_pragma(tmp_path):
+    src = _gl005_tp_source().replace(
+        '"""GL005',
+        '# graftlint: disable-file=GL005\n"""GL005')
+    report = _analyze_source(tmp_path, src)
+    assert report.clean
+
+
+# -- baseline ratchet ---------------------------------------------------------
+
+_TWO_SILENT = '''\
+# graftlint-fixture-path: dpu_operator_tpu/cni/fx_ratchet.py
+def teardown(a, b):
+    try:
+        a.close()
+    except Exception:
+        pass
+    try:
+        b.close()
+    except Exception:
+        pass
+'''
+
+
+def _baseline(tmp_path, count):
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        f'count = {count}\n')
+    return str(p)
+
+
+def test_baseline_absorbs_up_to_count(tmp_path):
+    report = _analyze_source(tmp_path, _TWO_SILENT,
+                             baseline=_baseline(tmp_path, 2))
+    assert report.clean and report.suppressed_baseline == 2
+
+
+def test_baseline_ratchets_past_count(tmp_path):
+    """count=1 with two findings: the second is REPORTED — a baselined
+    function can't silently grow more instances."""
+    report = _analyze_source(tmp_path, _TWO_SILENT,
+                             baseline=_baseline(tmp_path, 1))
+    assert len(report.findings) == 1
+    assert report.suppressed_baseline == 1
+
+
+def test_removing_baselined_entry_after_fix_stays_green(tmp_path):
+    """Fix the site, delete the entry: gate stays green (no baseline at
+    all over a clean file)."""
+    clean = _TWO_SILENT.replace("pass", "raise")
+    report = _analyze_source(tmp_path, clean, baseline=None)
+    assert report.clean
+
+
+def test_stale_baseline_entry_is_note_not_failure(tmp_path):
+    """Entry outlives its fixed site: reported stale, exit still
+    clean — deleting baseline entries is always safe."""
+    clean = _TWO_SILENT.replace("pass", "raise")
+    report = _analyze_source(tmp_path, clean,
+                             baseline=_baseline(tmp_path, 1))
+    assert report.clean
+    assert report.stale_baseline and \
+        report.stale_baseline[0]["func"] == "teardown"
+
+
+# -- acceptance scratch-copies: re-introduce the historical bugs --------------
+
+
+def test_reintroducing_pr2_mask_multiply_fails(tmp_path):
+    """Flip pipeline_1f1b's jnp.where SELECTION back to the PR 2
+    `dpl * gmask` multiply in a scratch copy of the REAL source: the
+    analyzer must fail it (and pass the unmodified copy)."""
+    real = (REPO / "dpu_operator_tpu" / "parallel"
+            / "pipeline_1f1b.py").read_text()
+    header = ("# graftlint-fixture-path: "
+              "dpu_operator_tpu/parallel/pipeline_1f1b.py\n")
+    assert _analyze_source(tmp_path, header + real,
+                           name="control.py").clean
+    wanted = "jnp.where(is_b, dpl, jnp.zeros_like(dpl))"
+    assert wanted in real, "pipeline_1f1b selection site moved"
+    bugged = header + real.replace(wanted, "dpl * gmask")
+    report = _analyze_source(tmp_path, bugged, name="bugged.py")
+    assert any(f.rule == "GL001" for f in report.findings), [
+        f.format() for f in report.findings]
+
+
+def test_reintroducing_pr3_except_binding_fails(tmp_path):
+    """Move `i = free.pop(0)` back inside the try in a scratch copy of
+    the REAL scheduler: the handler's `self._slots[i]` NameErrors when
+    the failure precedes the bind — the analyzer must fail it."""
+    real = (REPO / "dpu_operator_tpu" / "serving"
+            / "scheduler.py").read_text()
+    header = ("# graftlint-fixture-path: "
+              "dpu_operator_tpu/serving/scheduler.py\n")
+    assert _analyze_source(tmp_path, header + real,
+                           name="control.py").clean
+    wanted = "            i = free.pop(0)\n            try:"
+    assert wanted in real, "scheduler admission site moved"
+    bugged = header + real.replace(
+        wanted, "            try:\n                i = free.pop(0)")
+    report = _analyze_source(tmp_path, bugged, name="bugged.py")
+    assert any(f.rule == "GL003" and "'i'" in f.message
+               for f in report.findings), [
+        f.format() for f in report.findings]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_json_and_exit_codes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         str(FIXTURES / "gl005_tp.py"), "--no-baseline",
+         "--format", "json"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 1, proc.stderr
+    out = json.loads(proc.stdout)
+    assert len(out["findings"]) == 2 and not out["clean"]
+    assert all(f["rule"] == "GL005" for f in out["findings"])
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0
+    for rid in _EXPECT:
+        assert rid in proc.stdout
+
+
+def test_cli_zero_files_is_usage_error_not_green():
+    """A typo'd path must not read as a clean lint lane."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         "no_such_dir_xyz"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 2
+    assert "no python files" in proc.stderr

@@ -4,7 +4,6 @@ and the converged-node attach path with the real bridge dataplane."""
 import subprocess
 import uuid
 
-import pytest
 from google.protobuf import empty_pb2
 
 from dpu_operator_tpu.dpu_api.gen import dpu_api_pb2 as pb
@@ -181,3 +180,55 @@ def test_fabric_bridge_enslaves_uplink(netns):
     finally:
         subprocess.run(["ip", "link", "del", up_a], capture_output=True)
         subprocess.run(["ip", "link", "del", bridge], capture_output=True)
+
+
+def test_ping_not_blocked_by_slow_init():
+    """Regression (graftlint GL004 triage): Init used to hold the state
+    lock across bridge bring-up — which shells out to ip/nft and can
+    retry for seconds on old kernels — so Ping and GetDevices queued
+    behind it, heartbeats timed out, and the daemon declared a healthy
+    VSP dead in the middle of its own bring-up. The request path must
+    answer while bring-up is in flight (tpu_vsp's no-inline-refresh
+    contract)."""
+    import threading
+    import time
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class SlowBridgeDataplane(DebugDataplane):
+        def ensure_bridge(self):
+            entered.set()
+            # Released by the test AFTER the request path answers;
+            # pre-fix, Ping could not run until this returned.
+            if not release.wait(8.0):
+                raise RuntimeError("bring-up never released")
+            return super().ensure_bridge()
+
+    vsp = TpuVsp(
+        topology=SliceTopology.single_chip(),
+        dataplane=SlowBridgeDataplane(),
+        opi_port=50198,
+    )
+    ctx = _Ctx()
+    init_t = threading.Thread(
+        target=vsp.Init,
+        args=(pb.InitRequest(dpu_mode=pb.DPU_MODE_DPU,
+                             dpu_identifier="slow"), ctx),
+        daemon=True,
+    )
+    init_t.start()
+    assert entered.wait(5.0), "Init never reached bring-up"
+    try:
+        t0 = time.monotonic()
+        resp = vsp.Ping(pb.PingRequest(timestamp_ns=0, sender_id="hb"), ctx)
+        devices = vsp.GetDevices(empty_pb2.Empty(), ctx).devices
+        elapsed = time.monotonic() - t0
+        assert resp.healthy
+        assert len(devices) >= 1
+        assert elapsed < 2.0, (
+            f"request path stalled {elapsed:.1f}s behind Init bring-up")
+    finally:
+        release.set()
+        init_t.join(10.0)
+    assert not init_t.is_alive()
