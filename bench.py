@@ -746,7 +746,9 @@ def bench_serving() -> dict:
             f"pipelined {out.get('serving_steps_per_s')} vs sync "
             f"{out.get('serving_sync_steps_per_s')} steps/s = "
             f"{out.get('serving_pipeline_speedup')}x (host-gap frac "
-            f"{out.get('serving_host_gap_frac')})",
+            f"{out.get('serving_host_gap_frac')}); recovery "
+            f"{out.get('serving_recovery_ms')} ms (goodput retention "
+            f"{out.get('serving_fault_goodput_retention')})",
             file=sys.stderr,
         )
         return out
@@ -831,6 +833,11 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # synchronous loop even when steps/s noise masks it.
         ("serving_steps_per_s", 0.85, "serving_steps_ge_085_median"),
         ("serving_host_gap_frac", 1.35, "serving_host_gap_le_135_median"),
+        # Self-healing (ISSUE 5): time from an injected replica kill to
+        # the pool back at full live-replica count. Latency band
+        # (1.35x): a watchdog/backoff/restart regression moves recovery
+        # time even when throughput noise hides it.
+        ("serving_recovery_ms", 1.35, "serving_recovery_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -888,6 +895,8 @@ def main() -> int:
         "serving_overload_p99_ms": "ms",
         "serving_overload_shed_frac": "frac",
         "serving_local_reqs_per_s": "req/s",
+        "serving_recovery_ms": "ms",
+        "serving_fault_goodput_retention": "frac",
         "serving_steps_per_s": "steps/s",
         "serving_sync_steps_per_s": "steps/s",
         "serving_pipeline_speedup": "x",

@@ -9,6 +9,8 @@ GL004  lock held across a blocking call (serving/daemon/cni/vsp)
 GL005  broad except that neither re-raises, logs, nor narrows
        (dataplane + CNI paths)
 GL006  collective/PartitionSpec axis name no analyzed mesh declares
+GL007  unbounded connect/send retry loop with no backoff sleep
+       (serving/daemon/vsp/parallel)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -741,7 +743,104 @@ class UndeclaredAxisName(Rule):
                             f"(declared: {sorted(declared)})")
 
 
+# --------------------------------------------------------------------------
+# GL007 — unbounded retry loop without backoff
+
+
+class UnboundedRetryLoop(Rule):
+    """Origin: fabric_collectives._connect's dial loop (ISSUE 5
+    satellite) — `while True: try: s.connect(...) except OSError:
+    retry` burns CPU and socket churn through its whole deadline and,
+    fleet-wide, re-dials in lockstep (the synchronized retry storm SRE
+    backoff exists to kill). Any `while True` loop that swallows a
+    connect/send/rpc failure and retries MUST either bound its
+    attempts (a `for _ in range(...)` shape) or sleep between tries.
+
+    Fires on: a `while True` loop whose body contains a try whose BODY
+    makes a network-ish call (connect/send/sendall/recv/urlopen/
+    request/dial) and whose handler swallows the failure back into the
+    loop (no raise, no break, no return) — with NO sleep/wait call
+    anywhere in the loop body.
+
+    Stays silent on: loops with a backoff (or even fixed) sleep,
+    attempt-bounded `for ... in range(...)` retries, handlers that
+    surface the failure (raise — the deadline-expiry shape — or
+    break/return), and non-network try bodies (a scheduler loop
+    retrying its own bookkeeping is a different contract)."""
+
+    rule_id = "GL007"
+    severity = SEVERITY_WARNING
+    title = "unbounded retry loop with no backoff"
+    hint = ("bound the retries or back off between them: exponential "
+            "sleep + jitter inside the deadline, and raise a typed "
+            "error at expiry (see fabric_collectives._connect)")
+
+    _NET_ATTRS = {"connect", "connect_ex", "send", "sendall", "sendto",
+                  "recv", "recv_into", "recvfrom", "urlopen",
+                  "request", "dial"}
+    _SLEEP_ATTRS = {"sleep", "wait"}
+
+    @staticmethod
+    def _is_while_true(node: ast.AST) -> bool:
+        return (isinstance(node, ast.While)
+                and isinstance(node.test, ast.Constant)
+                and bool(node.test.value))
+
+    def _calls(self, body: List[ast.stmt]) -> Iterator[ast.Call]:
+        for stmt in body:
+            for n in _walk_same_function(stmt):
+                if isinstance(n, ast.Call):
+                    yield n
+
+    def _has_sleep(self, loop: ast.While) -> bool:
+        for c in self._calls(loop.body):
+            if _terminal_name(c.func) in self._SLEEP_ATTRS:
+                return True
+        return False
+
+    def _net_call(self, try_body: List[ast.stmt]) -> Optional[ast.Call]:
+        for c in self._calls(try_body):
+            if _terminal_name(c.func) in self._NET_ATTRS:
+                return c
+        return None
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        """The handler keeps the loop retrying: nothing in it raises,
+        breaks, or returns (pass/continue/cleanup-only bodies)."""
+        for n in ast.walk(handler):
+            if isinstance(n, (ast.Raise, ast.Break, ast.Return)):
+                return False
+        return True
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving", "daemon", "vsp", "parallel"):
+            return
+        for loop in ast.walk(module.tree):
+            if not self._is_while_true(loop):
+                continue
+            if self._has_sleep(loop):
+                continue
+            for n in _walk_same_function(loop):
+                if not isinstance(n, ast.Try):
+                    continue
+                call = self._net_call(n.body)
+                if call is None:
+                    continue
+                for h in n.handlers:
+                    if self._swallows(h):
+                        yield self.finding(
+                            module, h,
+                            f"'{ast.unparse(call.func)}(...)' failure "
+                            f"retries in a while-True loop (line "
+                            f"{loop.lineno}) with no attempt bound and "
+                            f"no backoff sleep — a dead peer becomes a "
+                            f"busy-spin and a fleet restart a retry "
+                            f"storm")
+
+
 def default_rules() -> List[Rule]:
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
-            SilentBroadExcept(), UndeclaredAxisName()]
+            SilentBroadExcept(), UndeclaredAxisName(),
+            UnboundedRetryLoop()]

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import struct
 import threading
@@ -46,6 +47,8 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .. import faults
 
 # Measured on the veth fabric (16 MiB fp32, 2 ranks, 2-cpu node — the
 # CI/bench class): the collective is CPU-bound there, not wire-bound
@@ -65,6 +68,29 @@ _HELLO = struct.Struct("!II")  # (rank, stream index)
 
 class RingError(RuntimeError):
     """Transport setup/exchange failure — callers fall back to gloo."""
+
+
+class FabricConnectError(RingError):
+    """Ring dial never reached the peer inside the deadline. Carries
+    the peer address (the thing the operator needs to go look at) and
+    the attempt count (which proves the retry loop backed off instead
+    of busy-spinning through the deadline)."""
+
+    def __init__(self, rank: int, peer: Tuple[str, int], attempts: int,
+                 elapsed_s: float):
+        super().__init__(
+            f"rank {rank}: peer {peer[0]}:{peer[1]} never came up "
+            f"({attempts} dial attempts over {elapsed_s:.2f}s)")
+        self.peer = peer
+        self.attempts = attempts
+
+
+# Dial-retry backoff: exponential from base to cap, with jitter so a
+# pod-wide restart doesn't re-dial in lockstep (the retry-storm shape
+# SRE backoff exists to kill). The cap keeps worst-case added latency
+# past the peer's come-up to one beat.
+_DIAL_BACKOFF_BASE_S = 0.05
+_DIAL_BACKOFF_CAP_S = 1.0
 
 
 def _segment_bounds(n_elems: int, world: int) -> List[Tuple[int, int]]:
@@ -158,14 +184,18 @@ class RingTransport:
         self._listener.listen(self.streams + 2)
         self._listener.settimeout(timeout)
 
-        deadline = time.monotonic() + timeout
+        t_start = time.monotonic()
+        deadline = t_start + timeout
+        dial_rng = random.Random(self.rank * 7919 + self.port)
+        attempts = 0
         for idx in range(self.streams):
+            backoff = _DIAL_BACKOFF_BASE_S
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise RingError(
-                        f"rank {self.rank}: peer {nxt[0]}:{nxt[1]} "
-                        f"never came up")
+                    raise FabricConnectError(
+                        self.rank, nxt, attempts,
+                        time.monotonic() - t_start)
                 s = socket.socket()
                 _tune(s, self.sockbuf)
                 # Bound the dial by the REMAINING deadline: a blackholed
@@ -175,11 +205,21 @@ class RingTransport:
                 # the only failure the deadline check would ever see.
                 s.settimeout(max(0.05, remaining))
                 try:
+                    attempts += 1
+                    faults.fire("fabric.connect")
                     s.connect(nxt)
                     break
                 except OSError:
+                    # Refused-instantly must not burn the deadline in a
+                    # hot loop: exponential backoff (doubling to the
+                    # cap) with jitter, clamped to the remaining budget
+                    # so the expiry check above stays authoritative.
                     s.close()
-                    time.sleep(0.05)
+                    delay = min(backoff * dial_rng.uniform(0.5, 1.0),
+                                max(0.0, deadline - time.monotonic()))
+                    if delay > 0:
+                        time.sleep(delay)
+                    backoff = min(backoff * 2, _DIAL_BACKOFF_CAP_S)
             s.settimeout(self.io_timeout)
             s.sendall(_HELLO.pack(self.rank, idx))
             self._send.append(s)
@@ -289,6 +329,7 @@ class RingTransport:
                                 f"rank {self.rank}: stalled waiting for "
                                 f"step {k - 1} chunk {c}")
                         lo, hi = cl[c]
+                        faults.fire("fabric.send")
                         sock.sendall(
                             memoryview(flat_raw)[lo * itemsize:hi * itemsize])
             except BaseException as e:
@@ -351,6 +392,7 @@ class RingTransport:
                 sock = self._send[stream]
                 for c in range(stream, len(cl), self.streams):
                     lo, hi = cl[c]
+                    faults.fire("fabric.send")
                     sock.sendall(
                         memoryview(flat_raw)[lo * itemsize:hi * itemsize])
                     sent[c].set()

@@ -46,6 +46,12 @@ class Draining(ServingError):
 # mention deadlines (e.g. a collective's DEADLINE_EXCEEDED).
 DEADLINE_QUEUED_ERROR = "deadline exceeded while queued"
 
+# The supervisor's give-up error: a request that rode `attempts`
+# replica failures has burned its retry budget — 500, not 503, because
+# retrying elsewhere is exactly what already failed (matched exactly,
+# same reasoning as above).
+RETRIES_EXHAUSTED_ERROR = "retries_exhausted"
+
 
 def encode_prompt(text: str, d: int) -> np.ndarray:
     """Deterministic prompt → [d] model-state embedding. The serving
@@ -72,6 +78,10 @@ class GenerateRequest:
     tokens: List[int] = field(default_factory=list)
     truncated: bool = False              # deadline hit mid-decode
     error: Optional[str] = None
+    # Replica failures survived so far: the supervisor bumps this on
+    # every re-admission after a replica death/wedge; past the pool's
+    # attempts budget the request 500s with RETRIES_EXHAUSTED_ERROR.
+    attempts: int = 0
     _done: threading.Event = field(default_factory=threading.Event,
                                    repr=False)
 

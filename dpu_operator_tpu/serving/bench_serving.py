@@ -14,6 +14,11 @@ mesh) runs alongside as `serving_local_*` so every bench run exercises
 the full stack end to end.
 
 Sections:
+  0. (below as section 6) fault recovery — one replica killed mid-run
+     at 2x overload by a deterministic injected executor failure:
+     serving_recovery_ms (kill → pool back to full live replicas) and
+     serving_fault_goodput_retention (completion rate during the
+     outage vs before it)
   1. closed-loop, continuous batching  → serving_reqs_per_s,
      serving_tok_per_s, serving_p50/p95/p99_ms
   2. closed-loop, serial batch=1       → serving_serial_reqs_per_s,
@@ -112,10 +117,15 @@ def closed_loop(url: str, clients: int, per_client: int,
 
 
 def open_loop(url: str, rate_per_s: float, seconds: float,
-              max_tokens: int, deadline_ms: float):
+              max_tokens: int, deadline_ms: float,
+              on_tick=None, completions: Optional[list] = None):
     """Fixed-rate arrivals regardless of completions — the load shape
     that exposes queue growth (closed-loop self-throttles; an open
-    loop does not, which is why overload must be measured this way)."""
+    loop does not, which is why overload must be measured this way).
+    `on_tick(elapsed_s)` runs once per arrival before it is paced
+    (the fault-recovery section arms its mid-run kill there);
+    `completions`, when given, collects (code, time.monotonic())
+    per finished request (same section's goodput windows)."""
     lat, codes = [], []
     lock = threading.Lock()
     threads: List[threading.Thread] = []
@@ -128,12 +138,16 @@ def open_loop(url: str, rate_per_s: float, seconds: float,
             codes.append(code)
             if code == 200:
                 lat.append(ms)
+            if completions is not None:
+                completions.append((code, time.monotonic()))
 
     n = int(rate_per_s * seconds)
     t0 = time.perf_counter()
     for i in range(n):
-        target = t0 + i / rate_per_s
         now = time.perf_counter()
+        if on_tick is not None:
+            on_tick(now - t0)
+        target = t0 + i / rate_per_s
         if target > now:
             time.sleep(target - now)
         th = threading.Thread(target=one, args=(i,))
@@ -241,6 +255,116 @@ def decode_loop_rates(slots: int, model: dict, n_req: int,
     return out
 
 
+def fault_recovery(slots: int, step_s: float, reqs_per_s: float,
+                   trace, seconds: float = 4.0, kill_at_s: float = 1.2
+                   ) -> dict:
+    """Section 6 (ISSUE 5): self-healing under fire. Two synthetic
+    replicas behind the supervised pool, an open loop at ~2x measured
+    capacity, and ONE deterministic injected replica kill mid-run
+    (times=1 spec armed at t=kill_at_s; the fire timestamp is the
+    kill's ground truth). Records:
+
+      serving_recovery_ms            kill -> pool back to full live
+                                     replica count (sampled at 2 ms)
+      serving_fault_goodput_retention  200-completions/s inside the
+                                     outage window / the pre-kill rate
+      serving_fault_requeued         requests seized + re-admitted
+
+    The recovery gate in bench.py holds serving_recovery_ms to 1.35x
+    its rolling median — restart/backoff/watchdog regressions move it
+    even when throughput noise hides them."""
+    from dpu_operator_tpu import faults
+
+    from .executor import SyntheticExecutor
+    from .server import ServingServer
+
+    plan = faults.install(seed=0)
+    site = "bench-r0"
+    ex0 = faults.FaultyExecutor(
+        SyntheticExecutor(slots=slots, d=16, step_time_s=step_s),
+        site=site)
+    ex1 = SyntheticExecutor(slots=slots, d=16, step_time_s=step_s)
+    srv = ServingServer(
+        [ex0, ex1], max_queue_depth=4 * slots,
+        pool_opts=dict(watchdog_s=1.0, restart_backoff_s=0.02,
+                       poll_s=0.002, max_attempts=5)).start()
+    out: dict = {}
+    try:
+        closed_loop(srv.url, 2, 2, 2)  # warm the path
+        rate = 2.0 * max(reqs_per_s, 1.0)
+        done: List[Tuple[int, float]] = []  # (code, finish time)
+        live_samples: List[Tuple[float, int, int]] = []
+        stop_sampler = threading.Event()
+
+        def sampler():
+            while not stop_sampler.is_set():
+                live_samples.append(
+                    (time.monotonic(), srv.pool.live_count(),
+                     sum(srv.pool.restarts)))
+                stop_sampler.wait(0.002)
+
+        armed = [False]
+
+        def arm_kill(elapsed_s):
+            if not armed[0] and elapsed_s >= kill_at_s:
+                plan.inject(f"{site}.step",
+                            exc=RuntimeError("bench: injected kill"),
+                            times=1)
+                armed[0] = True
+
+        samp = threading.Thread(target=sampler, daemon=True)
+        samp.start()
+        t0 = time.monotonic()
+        open_loop(srv.url, rate, seconds, 8, 4000.0,
+                  on_tick=arm_kill, completions=done)
+        stop_sampler.set()
+        samp.join(timeout=1.0)
+
+        kill_ts = plan.fired_at.get(f"{site}.step")
+        if not kill_ts:
+            out["serving_fault_error"] = "kill never fired"
+            return out
+        kill_t = kill_ts[0]
+        # Recovery = kill -> (a restart has happened AND the pool is
+        # back at full strength). Gating on the restart counter keeps
+        # a pre-detection "still looks live" sample from reading as an
+        # instant recovery.
+        recovered_t = next(
+            (ts for ts, live, restarts in live_samples
+             if ts > kill_t and restarts >= 1 and live == 2), None)
+        if recovered_t is None:
+            out["serving_fault_error"] = "pool never recovered"
+            return out
+        out["serving_recovery_ms"] = round(
+            (recovered_t - kill_t) * 1000.0, 1)
+
+        # Goodput retention: completion RATE inside the outage window
+        # against the pre-kill steady rate. Windows padded to 0.25 s
+        # (a sub-poll recovery must not divide by a sliver) and the
+        # pre-kill window clamped to the load's actual start — letting
+        # it reach before t0 would count an empty stretch as "steady
+        # state" and flatter the retention figure.
+        window = max(recovered_t - kill_t, 0.25)
+        pre_window = min(window, max(kill_t - t0, 0.25))
+        pre = sum(1 for c, ts in done
+                  if c == 200 and kill_t - pre_window <= ts < kill_t)
+        during = sum(1 for c, ts in done
+                     if c == 200 and kill_t <= ts < kill_t + window)
+        if pre > 0:
+            out["serving_fault_goodput_retention"] = round(
+                min((during / window) / (pre / pre_window), 1.0), 3)
+        out["serving_fault_requeued"] = int(srv.queue.requeued)
+        out["serving_fault_restarts"] = int(sum(srv.pool.restarts))
+        trace(f"fault recovery: {out['serving_recovery_ms']} ms to "
+              f"full strength, goodput retention "
+              f"{out.get('serving_fault_goodput_retention')}, "
+              f"{out['serving_fault_requeued']} requeued")
+        return out
+    finally:
+        faults.uninstall()
+        srv.stop()
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -340,6 +464,16 @@ def main(argv: Optional[list] = None) -> int:
               f"healthz={alive}")
     finally:
         ov.stop()
+
+    # 6: fault recovery — a deterministic replica kill at 2x overload;
+    # the self-healing plane's headline numbers.
+    try:
+        out.update(fault_recovery(args.slots, step_s,
+                                  out.get("serving_reqs_per_s", 0.0),
+                                  trace))
+    except Exception as e:
+        out["serving_fault_error"] = str(e)[:200]
+        trace(f"fault-recovery section failed: {e}")
 
     # 4: the real jitted path — forward-only train_step model on a mesh.
     if not args.skip_local:

@@ -44,12 +44,21 @@ AdmissionQueue — requests land on whichever replica frees a slot first.
 
 from __future__ import annotations
 
+import logging
 import queue as _queue
+import random
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .. import faults
+from .api import (DEADLINE_QUEUED_ERROR, RETRIES_EXHAUSTED_ERROR,
+                  GenerateRequest)
+
+log = logging.getLogger(__name__)
 
 Update = Tuple[int, np.ndarray]  # (slot index, row[d]) applied at submit
 
@@ -211,11 +220,18 @@ class SyntheticExecutor(Executor):
 
     def __init__(self, slots: int = 8, d: int = 16,
                  step_time_s: float = 0.0, seed: int = 0,
-                 pipelined: bool = False):
+                 pipelined: bool = False,
+                 fault_site: Optional[str] = None):
         self.slots = slots
         self.d = d
         self.step_time_s = step_time_s
         self.pipelined = pipelined
+        # Fault seam INSIDE the device: with pipelined=True the step
+        # runs on the worker thread, where a FaultyExecutor wrapper
+        # (which intercepts the submit/collect seam on the scheduler
+        # thread) can't reach — naming a site here is how chaos tests
+        # break the "device" itself.
+        self.fault_site = fault_site
         self._w = np.random.RandomState(seed).randn(d, d).astype(
             np.float32) / np.sqrt(d)
         self.steps = 0
@@ -223,6 +239,8 @@ class SyntheticExecutor(Executor):
         self._worker: Optional[threading.Thread] = None
 
     def step(self, x: np.ndarray) -> np.ndarray:
+        if self.fault_site is not None:
+            faults.fire(f"{self.fault_site}.step")
         if self.step_time_s:
             time.sleep(self.step_time_s)
         self.steps += 1
@@ -239,34 +257,52 @@ class SyntheticExecutor(Executor):
             self._worker.start()
 
     def _worker_run(self) -> None:
+        # EVERY failure path must land in the owning handle and the
+        # worker must survive: an exception that escaped this loop used
+        # to kill the thread silently, so collect() on any outstanding
+        # (or future) handle blocked forever — the replica wedged with
+        # no error anywhere. Guard the WHOLE body, reset included.
         while True:
             item = self._work.get()
             if item is None:
                 return
-            if item[0] == "reset":
-                self._resident = np.zeros((self.slots, self.d),
-                                          np.float32)
-                item[1].set()
-                continue
-            _, updates, pending = item
+            pending = None
             try:
-                # The base eager adapter IS one step of the contract
-                # (apply updates, step, batched argmax); the worker
-                # only moves it off the submitter's thread.
-                pending.tokens = Executor.submit(self, updates)
-            except BaseException as e:  # surfaced by collect()
-                pending.error = e
-            pending.event.set()
+                if item[0] == "reset":
+                    pending = item[1]
+                    self._resident = np.zeros((self.slots, self.d),
+                                              np.float32)
+                else:
+                    _, updates, pending = item
+                    # The base eager adapter IS one step of the
+                    # contract (apply updates, step, batched argmax);
+                    # the worker only moves it off the submitter's
+                    # thread.
+                    pending.tokens = Executor.submit(self, updates)
+            except BaseException as e:  # surfaced by collect()/reset()
+                if pending is not None:
+                    pending.error = e
+                else:
+                    log.exception(
+                        "synthetic worker: malformed work item %r "
+                        "(dropped; worker survives)", item)
+            finally:
+                if pending is not None:
+                    pending.event.set()
 
     def reset(self) -> None:
         if not self.pipelined or self._worker is None:
             super().reset()
             return
         # The worker owns the resident state between submit and
-        # collect; a reset must serialize behind queued steps.
-        done = threading.Event()
-        self._work.put(("reset", done))
-        done.wait()
+        # collect; a reset must serialize behind queued steps — and
+        # must RE-RAISE a worker-side failure instead of reporting a
+        # clean session over poisoned state.
+        pending = _Pending()
+        self._work.put(("reset", pending))
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
 
     def submit(self, updates: Sequence[Update]):
         if not self.pipelined:
@@ -293,28 +329,122 @@ class SyntheticExecutor(Executor):
             self._worker = None
 
 
+REPLICA_LIVE = "live"
+REPLICA_BACKOFF = "backoff"
+REPLICA_PARKED = "parked"
+
+
 class ReplicaPool:
-    """One ContinuousBatcher per executor over a shared AdmissionQueue."""
+    """One ContinuousBatcher per executor over a shared AdmissionQueue
+    — and, when `supervise` (the default), the SUPERVISOR that keeps
+    them converged on "every replica live":
+
+      * detection — a monitor thread polls every `poll_s` for replica
+        DEATH (batcher thread exited with a recorded failure) and
+        WEDGE (the batcher has been blocked on the device — step() or
+        collect() — longer than `watchdog_s`; a hung device step can
+        never time itself out, so the deadline lives out here);
+      * requeue — the dead replica's in-flight requests are seized
+        (under the batcher's settle lock: no double-settle) and
+        re-admitted at the FRONT of the shared queue with a
+        per-request attempts budget — past `max_attempts` replica
+        failures a request 500s with RETRIES_EXHAUSTED_ERROR; a
+        request whose deadline lapsed mid-failure settles exactly once
+        (truncated 200 if it already has tokens, 503 deadline-shed
+        otherwise) and never re-enters the queue;
+      * restart — a fresh ContinuousBatcher over the same executor
+        (which `reset()`s at loop start) under exponential backoff +
+        jitter (SRE retry discipline: backoff bounds the flap rate,
+        jitter de-synchronizes a fleet of restarts);
+      * circuit breaker — `breaker_threshold` failures inside
+        `breaker_window_s` PARK the replica: no more restarts, the
+        pool serves degraded, and the operator sees
+        serving_breaker_state=1 instead of an infinite crash loop.
+
+    `watchdog_s` bounds the time a batcher may sit blocked on the
+    device (step/collect/reset); executors must therefore pay their
+    compile cost in the CONSTRUCTOR (the LocalExecutor contract since
+    PR 2 — warmup=True) or hand the pool a watchdog_s above their
+    worst first step, or a cold compile reads as a wedge.
+
+    Readiness contract consumed by the HTTP front-end: live replicas <
+    `quorum` (default: all of them) → /readyz 503 "degraded"; zero
+    live replicas → /healthz goes red too. Recovery metrics:
+    serving_replica_restarts_total, serving_requeue_total{outcome},
+    serving_breaker_state, serving_pool_replicas{state}."""
 
     def __init__(self, executors: Sequence[Executor], queue,
-                 registry=None):
+                 registry=None, *, supervise: bool = True,
+                 watchdog_s: float = 5.0, max_attempts: int = 3,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0,
+                 breaker_window_s: float = 30.0,
+                 breaker_threshold: int = 5,
+                 quorum: Optional[int] = None,
+                 poll_s: float = 0.02, seed: int = 0):
         from .scheduler import ContinuousBatcher
 
         if not executors:
             raise ValueError("a pool needs at least one executor")
         self.queue = queue
+        self.registry = registry
         self.executors = list(executors)
+        self.supervised = bool(supervise)
+        self.watchdog_s = watchdog_s
+        self.max_attempts = max_attempts
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_cap_s = restart_backoff_cap_s
+        self.breaker_window_s = breaker_window_s
+        self.breaker_threshold = breaker_threshold
+        self.quorum = (len(self.executors) if quorum is None
+                       else max(1, int(quorum)))
+        self.poll_s = poll_s
+        self._rng = random.Random(seed)
+        self._Batcher = ContinuousBatcher
+        # _plock guards the state arrays and batcher swaps (monitor
+        # thread vs readers like live_count); the per-batcher settle
+        # lock guards request ownership.
+        self._plock = threading.Lock()
         self.batchers: List = [
-            ContinuousBatcher(ex, queue, registry=registry,
-                              replica=f"replica{i}")
+            self._make_batcher(i, ex)
             for i, ex in enumerate(self.executors)
         ]
+        n = len(self.executors)
+        self._state = [REPLICA_LIVE] * n
+        self._restart_at: List[Optional[float]] = [None] * n
+        self._fail_times: List[deque] = [deque() for _ in range(n)]
+        # Nonzero while a seize→requeue hand-off is in flight: in that
+        # window the seized requests are in no batcher's slots and not
+        # yet back in the queue, and quiesce() must not read the pool
+        # as drained around them.
+        self._seizing = 0
+        self.restarts = [0] * n
+        self._sup_stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+
+    def _make_batcher(self, i: int, ex: Executor):
+        return self._Batcher(ex, self.queue, registry=self.registry,
+                             replica=f"replica{i}",
+                             crash_only=self.supervised)
+
+    # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> None:
         for b in self.batchers:
             b.start()
+        if self.supervised:
+            self._publish_state()
+            self._sup_thread = threading.Thread(
+                target=self._supervise, daemon=True,
+                name="replica-supervisor")
+            self._sup_thread.start()
 
     def stop(self) -> None:
+        # Supervisor first: a replica dying DURING teardown must not be
+        # requeued into a queue the server is about to fail_all().
+        self._sup_stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout=5)
         for b in self.batchers:
             b.stop()
         for ex in self.executors:
@@ -323,16 +453,213 @@ class ReplicaPool:
     def active(self) -> int:
         return sum(b.active for b in self.batchers)
 
+    # -- observability --------------------------------------------------------
+
+    def live_count(self) -> int:
+        """Replicas currently serving. Supervised: state LIVE (the
+        monitor flips it within ~poll_s of a death/wedge).
+        Unsupervised: batcher threads actually running."""
+        with self._plock:
+            if self.supervised:
+                return sum(1 for s in self._state if s == REPLICA_LIVE)
+            return sum(1 for b in self.batchers if b.thread_alive)
+
+    def states(self) -> Dict[str, str]:
+        with self._plock:
+            return {f"replica{i}": s for i, s in enumerate(self._state)}
+
+    def all_parked(self) -> bool:
+        """True when every replica's breaker is open — no restart will
+        ever be scheduled again, so the pool is dead, not degraded."""
+        with self._plock:
+            return all(s == REPLICA_PARKED for s in self._state)
+
+    def _publish_state(self) -> None:
+        if self.registry is None:
+            return
+        with self._plock:
+            counts = {REPLICA_LIVE: 0, REPLICA_BACKOFF: 0,
+                      REPLICA_PARKED: 0}
+            for s in self._state:
+                counts[s] += 1
+        for st, n in counts.items():
+            self.registry.gauge_set(
+                "serving_pool_replicas", float(n), {"state": st},
+                help="replicas by supervision state")
+
+    def _count(self, name: str, labels: dict, help: str = "") -> None:
+        if self.registry is not None:
+            self.registry.counter_inc(name, labels, help=help)
+
+    # -- the supervisor -------------------------------------------------------
+
+    def _supervise(self) -> None:
+        while not self._sup_stop.is_set():
+            now = time.monotonic()
+            for i in range(len(self.executors)):
+                # Per-replica guard: the monitor IS the self-healing
+                # plane — one throw here (thread exhaustion during a
+                # fault storm, a poisoned executor attribute) must cost
+                # at most this replica this cycle, never the thread.
+                try:
+                    with self._plock:
+                        st = self._state[i]
+                        b = self.batchers[i]
+                        restart_at = self._restart_at[i]
+                    if st == REPLICA_LIVE:
+                        bs = b.blocked_since
+                        wedged = (bs is not None
+                                  and now - bs > self.watchdog_s)
+                        dead = (not b.thread_alive and not b.stopping
+                                and b._thread is not None)
+                        if dead or wedged:
+                            self._replica_down(
+                                i, b, "wedged" if wedged else "died")
+                    elif st == REPLICA_BACKOFF and restart_at is not None \
+                            and now >= restart_at:
+                        self._restart(i)
+                except Exception:
+                    log.exception("supervisor: replica%d cycle failed",
+                                  i)
+            self._sup_stop.wait(self.poll_s)
+
+    def _replica_down(self, i: int, batcher, why: str) -> None:
+        err = batcher.failure
+        # _seizing flips BEFORE seize(): at no instant is a seized
+        # request in none of {batcher slots, this hand-off, the queue}
+        # — the same closed-accounting contract the queue's inflight
+        # counter keeps for pop→place (quiesce checks all three).
+        with self._plock:
+            self._seizing += 1
+        try:
+            seized = batcher.seize()
+            log.warning("replica%d %s (%s); requeueing %d in-flight "
+                        "request(s)", i, why, err, len(seized))
+            self._requeue(i, seized)
+        finally:
+            with self._plock:
+                self._seizing -= 1
+        self._record_failure(i)
+
+    def _record_failure(self, i: int) -> None:
+        """Window bookkeeping shared by the death/wedge path and a
+        failed restart: park past the breaker threshold, otherwise
+        schedule the next restart under exponential backoff + jitter."""
+        now = time.monotonic()
+        window = self._fail_times[i]
+        window.append(now)
+        while window and window[0] < now - self.breaker_window_s:
+            window.popleft()
+        if len(window) >= self.breaker_threshold:
+            with self._plock:
+                self._state[i] = REPLICA_PARKED
+                self._restart_at[i] = None
+            if self.registry is not None:
+                self.registry.gauge_set(
+                    "serving_breaker_state", 1.0,
+                    {"replica": f"replica{i}"},
+                    help="1 when the replica's restart breaker is "
+                         "open (replica parked)")
+            log.error("replica%d: breaker OPEN (%d failures in %.0fs) "
+                      "— parked, pool degraded",
+                      i, len(window), self.breaker_window_s)
+        else:
+            delay = min(self.restart_backoff_cap_s,
+                        self.restart_backoff_s
+                        * (2 ** (len(window) - 1)))
+            delay *= 1.0 + 0.25 * self._rng.random()  # de-sync restarts
+            with self._plock:
+                self._state[i] = REPLICA_BACKOFF
+                self._restart_at[i] = now + delay
+        self._publish_state()
+
+    def _requeue(self, i: int, reqs: List[GenerateRequest]) -> None:
+        now = time.monotonic()
+        replica = f"replica{i}"
+        for req in reqs:
+            if req.done:
+                # Settled before (or while) the replica fell over —
+                # nothing to do, and settling again is the double-
+                # settle this path exists to prevent.
+                outcome = "already_done"
+            elif req.deadline <= now:
+                # Deadline lapsed mid-failure: settle ONCE, never
+                # re-enter the queue (the pop-side shed would settle it
+                # a second time). With tokens already decoded this is
+                # the mid-decode truncation contract; with none it is
+                # the queued-deadline shed.
+                if req.tokens:
+                    req.truncated = True
+                    req.finish()
+                    outcome = "deadline_truncated"
+                else:
+                    req.fail(DEADLINE_QUEUED_ERROR)
+                    outcome = "deadline_lapsed"
+            else:
+                req.attempts += 1
+                if req.attempts >= self.max_attempts:
+                    req.fail(RETRIES_EXHAUSTED_ERROR)
+                    outcome = "retries_exhausted"
+                else:
+                    # Fresh decode from the prompt: the recurrence is
+                    # deterministic, so the retried stream is identical
+                    # to an unfailed run's — half-decoded state must
+                    # not leak into the retry.
+                    req.tokens.clear()
+                    req.truncated = False
+                    self.queue.requeue(req)
+                    outcome = "requeued"
+            self._count("serving_requeue_total",
+                        {"replica": replica, "outcome": outcome},
+                        help="in-flight requests seized from failed "
+                             "replicas, by disposition")
+
+    def _restart(self, i: int) -> None:
+        ex = self.executors[i]
+        try:
+            b = self._make_batcher(i, ex)
+        except Exception:
+            # Construction failure counts as another replica failure:
+            # same window bookkeeping, so backoff escalates and the
+            # breaker eventually parks a replica that cannot even be
+            # rebuilt. (Executor-level failures surface later, in the
+            # new batcher thread's reset/step, and come back through
+            # the normal death path.)
+            log.exception("replica%d: restart construction failed", i)
+            self._record_failure(i)
+            return
+        with self._plock:
+            self.batchers[i] = b
+            # restarts increments under the same lock and BEFORE the
+            # state flips LIVE: an observer seeing the pool at full
+            # strength must also see every restart that got it there.
+            self.restarts[i] += 1
+            self._state[i] = REPLICA_LIVE
+            self._restart_at[i] = None
+        b.start()
+        self._count("serving_replica_restarts_total",
+                    {"replica": f"replica{i}"},
+                    help="supervisor-initiated replica restarts")
+        self._publish_state()
+        log.info("replica%d: restarted (attempt %d)", i,
+                 self.restarts[i])
+
     def quiesce(self, timeout: float = 30.0,
                 poll_s: float = 0.02) -> bool:
-        """Wait until queue, pop-to-slot hand-off AND every batcher are
-        empty (drain path: the queue has already stopped admitting, so
-        empty is stable). inflight() covers the window where a request
-        is popped but not yet in a slot — without it a drain stop()
-        could land exactly there and fail an admitted request."""
+        """Wait until queue, pop-to-slot hand-off, supervisor
+        seize-to-requeue hand-off AND every batcher are empty (drain
+        path: the queue has already stopped admitting, so empty is
+        stable). inflight() covers the window where a request is
+        popped but not yet in a slot; _seizing covers the one where a
+        failed replica's requests are seized but not yet re-admitted —
+        without either, a drain stop() could land exactly there and
+        fail an admitted request."""
 
         def idle() -> bool:
-            return (self.queue.depth() == 0 and self.queue.inflight() == 0
+            with self._plock:
+                seizing = self._seizing
+            return (seizing == 0 and self.queue.depth() == 0
+                    and self.queue.inflight() == 0
                     and self.active() == 0)
 
         deadline = time.monotonic() + timeout

@@ -20,6 +20,7 @@ import time
 from collections import deque
 from typing import List
 
+from .. import faults
 from .api import (DEADLINE_QUEUED_ERROR, Draining, GenerateRequest,
                   QueueFull)
 
@@ -40,6 +41,7 @@ class AdmissionQueue:
         self.rejected_full = 0
         self.rejected_draining = 0
         self.shed_expired = 0
+        self.requeued = 0
 
     def _gauge(self) -> None:
         if self._registry is not None:
@@ -48,6 +50,7 @@ class AdmissionQueue:
                 help="requests waiting for a batch slot")
 
     def submit(self, req: GenerateRequest) -> None:
+        faults.fire("queue.submit")
         with self._lock:
             if self._draining:
                 self.rejected_draining += 1
@@ -85,6 +88,20 @@ class AdmissionQueue:
             self._inflight += len(out)
             self._gauge()
         return out
+
+    def requeue(self, req: GenerateRequest) -> None:
+        """Supervisor re-admission of a request seized from a dead or
+        wedged replica. Front of the line (it already waited its turn
+        once) and EXEMPT from both the depth bound and the drain
+        refusal: the request was admitted before the failure, so
+        shedding it now would convert a replica fault into a
+        client-visible overload answer even while capacity exists —
+        and a drain must finish admitted work, re-admitted included."""
+        with self._lock:
+            self._q.appendleft(req)
+            self.requeued += 1
+            self._gauge()
+            self._nonempty.notify()
 
     def mark_placed(self, n: int) -> None:
         """The batcher finished placing (or failing) n popped requests."""

@@ -40,6 +40,16 @@ the device sits idle in the sync loop, and the budget that must stay
 under device step time for full overlap in the pipelined loop.
 `serving_step_seconds` keeps its PR 2 series as the blocked-time
 back-compat alias.
+
+Failure policy is two-mode. Standalone batchers keep the legacy shape
+(an executor failure 500s the current occupants and the loop keeps
+running). Under a supervising ReplicaPool the batcher is CRASH-ONLY:
+the failure exits the loop with the occupants left in their slots and
+the supervisor seizes them (under this batcher's settle lock, so
+nothing is ever settled twice), re-admits them to the shared queue and
+restarts the replica. `blocked_since` is the watchdog hook: published
+while the thread is blocked on the device, it lets the supervisor
+detect a wedged step no in-thread timeout could ever fire on.
 """
 
 from __future__ import annotations
@@ -65,7 +75,8 @@ _OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 class ContinuousBatcher:
     def __init__(self, executor, queue, registry=None,
                  replica: str = "replica0", idle_wait_s: float = 0.05,
-                 pipelined: Optional[bool] = None):
+                 pipelined: Optional[bool] = None,
+                 crash_only: bool = False):
         self.executor = executor
         self.queue = queue
         self.registry = registry
@@ -73,6 +84,24 @@ class ContinuousBatcher:
         self.idle_wait_s = idle_wait_s
         self.pipelined = (bool(executor.pipelined) if pipelined is None
                           else bool(pipelined))
+        # crash_only (Candea & Fox): an executor failure EXITS the loop
+        # with the occupants left in their slots and the error on
+        # self.failure — the supervisor (ReplicaPool) seizes, requeues
+        # and restarts. Standalone batchers keep the legacy policy
+        # (fail the current occupants, keep looping).
+        self.crash_only = crash_only
+        self.failure: Optional[BaseException] = None
+        # monotonic timestamp published while the thread is blocked on
+        # the device (step()/collect()) — the supervisor's watchdog
+        # reads it to catch a wedged device step the loop itself can
+        # never time out of.
+        self.blocked_since: Optional[float] = None
+        # Serializes settle/pop bookkeeping against a supervisor
+        # seize(): once _abandoned flips under this lock, the loop will
+        # never settle a request or pop the queue again — the no-
+        # double-settle guarantee re-admission depends on.
+        self._settle_lock = threading.Lock()
+        self._abandoned = False
         self._slots: List[Optional[GenerateRequest]] = (
             [None] * executor.slots)
         self._x = np.zeros((executor.slots, executor.d), np.float32)
@@ -94,10 +123,41 @@ class ContinuousBatcher:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
-        for i, req in enumerate(self._slots):
-            if req is not None:
-                req.fail("server stopped")
-                self._slots[i] = None
+        # Under the settle lock with _abandoned flipped: a thread that
+        # outlived the join timeout (wedged in the executor) must not
+        # settle anything after we fail its occupants here.
+        with self._settle_lock:
+            self._abandoned = True
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    req.fail("server stopped")
+                    self._slots[i] = None
+
+    @property
+    def thread_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop.is_set()
+
+    def seize(self) -> List[GenerateRequest]:
+        """Supervisor-side takeover of a dead or wedged replica's
+        in-flight requests. Taking the settle lock first means an
+        in-progress retire completes before ownership moves; after
+        _abandoned flips, the batcher thread (should it ever wake from
+        a wedge) exits without settling or popping anything — each
+        seized request has exactly one owner: the caller."""
+        self._stop.set()
+        got = self._settle_lock.acquire(timeout=5.0)
+        try:
+            self._abandoned = True
+            occ = [r for r in self._slots if r is not None]
+            self._slots = [None] * len(self._slots)
+            return occ
+        finally:
+            if got:
+                self._settle_lock.release()
 
     @property
     def active(self) -> int:
@@ -229,31 +289,54 @@ class ContinuousBatcher:
                 self._x[i] = y[i]  # decode recurrence: output is next state
 
     def _run_sync(self) -> None:
+        if self.crash_only:
+            # A restarted replica must not inherit poisoned state from
+            # the incarnation the supervisor just tore down. Under the
+            # watchdog clock: a reset that serializes behind a still-
+            # hung device step would otherwise block HERE invisibly,
+            # recreating the exact wedge the supervisor just detected
+            # while reporting the replica live.
+            self.blocked_since = time.monotonic()
+            self.executor.reset()
+            self.blocked_since = None
         t_gap_start = None
         while not self._stop.is_set():
-            # Any failure in this body must cost at most the CURRENT
-            # occupants — never the thread. A dead batcher is a replica
-            # that silently serves nothing while /healthz stays green.
+            # crash_only: any failure exits the loop with the slots
+            # intact — the supervisor requeues and restarts. Legacy
+            # (standalone) policy: the failure costs at most the
+            # CURRENT occupants, never the thread.
             try:
-                if self.active == 0:
-                    # Drained before the (possibly blocking) admit:
-                    # queue-idle wait must not masquerade as host gap.
-                    t_gap_start = None
-                self._admit()
-                n_active = self.active
+                with self._settle_lock:
+                    if self._abandoned:
+                        return
+                    if self.active == 0:
+                        # Drained before the (possibly blocking) admit:
+                        # queue-idle wait must not masquerade as host
+                        # gap.
+                        t_gap_start = None
+                    self._admit()
+                    n_active = self.active
                 if n_active == 0:
                     t_gap_start = None
                     continue
                 if t_gap_start is not None:
                     self._observe_gap(time.perf_counter() - t_gap_start)
                 t0 = time.perf_counter()
+                self.blocked_since = time.monotonic()
                 y = np.asarray(self.executor.step(self._x), np.float32)
+                self.blocked_since = None
                 t1 = time.perf_counter()
                 t_gap_start = t1
                 self.steps += 1
                 self._observe_step(t1 - t0, n_active)
-                self._retire(y, y.argmax(axis=1))
-            except Exception as e:  # broken replica must not wedge waiters
+                with self._settle_lock:
+                    if self._abandoned:
+                        return
+                    self._retire(y, y.argmax(axis=1))
+            except Exception as e:
+                self.blocked_since = None
+                if self.crash_only:
+                    raise
                 log.exception("batcher %s: step failed", self.replica)
                 self._fail_occupants(e)
                 t_gap_start = None
@@ -312,50 +395,88 @@ class ContinuousBatcher:
 
     def _run_pipelined(self) -> None:
         ex = self.executor
+        # Under the watchdog clock (see _run_sync): on a restart after
+        # a WEDGE, this reset can serialize behind the still-hung step
+        # on the device/worker — blocked_since keeps the supervisor's
+        # deadline on it, so a reset that never returns parks the
+        # replica through the breaker instead of wedging it invisibly
+        # in a state the pool reports as live.
+        self.blocked_since = time.monotonic()
         ex.reset()
+        self.blocked_since = None
         self._dirty.clear()
         self._prezeroed.clear()
         prev = None  # (handle, slot snapshot) of the step in flight
         t_gap_start = None
         while not self._stop.is_set():
             try:
-                # Admit for step k+1 (block only when nothing is active
-                # AND nothing is in flight — a pending collect must not
-                # wait out the idle timeout behind an empty queue).
-                block = self.active == 0 and prev is None
-                updates = []
-                for i, _req, vec in self._pop_admissions(block=block):
-                    # Admission overwrites the row, whatever its state.
-                    self._dirty.discard(i)
-                    self._prezeroed.discard(i)
-                    updates.append((i, vec))
                 submitted = None
-                if self.active > 0:
-                    # Freed-but-unadmitted slots get explicit zero rows:
-                    # idle slots must be EXACTLY zero (the MoE row-mask
-                    # contract) and must not keep decoding garbage.
-                    for i in sorted(self._dirty):
-                        updates.append((i, self._zero_row))
-                    self._dirty.clear()
-                    if prev is not None:
-                        self._zero_ahead(updates, prev[1])
-                    if t_gap_start is not None:
-                        self._observe_gap(
-                            time.perf_counter() - t_gap_start)
-                    snapshot = list(self._slots)
+                snapshot = None
+                # Admission bookkeeping runs under the settle lock: a
+                # supervisor seize() serializes against it, so an
+                # abandoned batcher can never pop the queue again.
+                with self._settle_lock:
+                    if self._abandoned:
+                        return
+                    # Admit for step k+1 (block only when nothing is
+                    # active AND nothing is in flight — a pending
+                    # collect must not wait out the idle timeout behind
+                    # an empty queue).
+                    block = self.active == 0 and prev is None
+                    updates = []
+                    for i, _req, vec in self._pop_admissions(block=block):
+                        # Admission overwrites the row, whatever its
+                        # state.
+                        self._dirty.discard(i)
+                        self._prezeroed.discard(i)
+                        updates.append((i, vec))
+                    if self.active > 0:
+                        # Freed-but-unadmitted slots get explicit zero
+                        # rows: idle slots must be EXACTLY zero (the MoE
+                        # row-mask contract) and must not keep decoding
+                        # garbage.
+                        for i in sorted(self._dirty):
+                            updates.append((i, self._zero_row))
+                        self._dirty.clear()
+                        if prev is not None:
+                            self._zero_ahead(updates, prev[1])
+                        if t_gap_start is not None:
+                            self._observe_gap(
+                                time.perf_counter() - t_gap_start)
+                        snapshot = list(self._slots)
+                if snapshot is not None:
+                    # Dispatch OUTSIDE the settle lock, under the
+                    # watchdog clock: a submit that blocks (a wedged
+                    # device can stall dispatch, not just completion)
+                    # must be seizable — held across the lock it would
+                    # deadlock stop()/seize() AND hide from the
+                    # watchdog. A seize landing between the lock and
+                    # this dispatch only wastes one step: the retire
+                    # path re-checks _abandoned before settling.
+                    self.blocked_since = time.monotonic()
                     handle = ex.submit(updates)  # step k dispatched
+                    self.blocked_since = None
                     self.steps += 1
                     submitted = (handle, snapshot)
                 # Step k runs on the device while the host settles step
                 # k-1: collect its token ids and do retire bookkeeping.
+                # collect() is the one place a wedged device parks this
+                # thread forever, so it runs OUTSIDE the settle lock
+                # with blocked_since published — the supervisor's
+                # watchdog can both see the wedge and seize around it.
                 if prev is not None:
                     h_prev, snap_prev = prev
                     tc = time.perf_counter()
+                    self.blocked_since = time.monotonic()
                     tokens = ex.collect(h_prev)
+                    self.blocked_since = None
                     t_done = time.perf_counter()
                     n_prev = sum(1 for r in snap_prev if r is not None)
                     self._observe_step(t_done - tc, n_prev)
-                    self._retire_tokens(tokens, snap_prev)
+                    with self._settle_lock:
+                        if self._abandoned:
+                            return
+                        self._retire_tokens(tokens, snap_prev)
                     # Gap clock starts at device completion so retire
                     # bookkeeping counts toward the host gap it is.
                     t_gap_start = t_done
@@ -364,6 +485,9 @@ class ContinuousBatcher:
                     # waits must not masquerade as host gap
                 prev = submitted
             except Exception as e:
+                self.blocked_since = None
+                if self.crash_only:
+                    raise
                 log.exception("batcher %s: step failed", self.replica)
                 self._fail_occupants(e)
                 prev = None
@@ -384,7 +508,17 @@ class ContinuousBatcher:
                 self._x[i] = 0.0
 
     def _run(self) -> None:
-        if self.pipelined:
-            self._run_pipelined()
-        else:
-            self._run_sync()
+        try:
+            if self.pipelined:
+                self._run_pipelined()
+            else:
+                self._run_sync()
+        except Exception as e:
+            # crash_only loops re-raise here; the recorded failure and
+            # the dead thread ARE the signal the supervisor keys on.
+            # (A legacy loop only reaches this for a harness bug — the
+            # loops themselves absorb executor failures.)
+            self.blocked_since = None
+            self.failure = e
+            log.error("batcher %s: replica failed (%s); awaiting "
+                      "supervision", self.replica, e)

@@ -13,9 +13,14 @@ serving-specific contract on top:
           backpressure answer: overload is REJECTED at the door so
           admitted requests keep a bounded p99 (never parked into an
           unbounded queue).
-  GET /healthz        200 always while the process lives (liveness)
-  GET /readyz         503 while draining, else 200 (readiness — what a
-                      k8s Service endpoint should key on)
+  GET /healthz        liveness: 200 while anything serves or is coming
+                      back; 503 "dead" only when zero replicas are
+                      live AND every breaker is open (nothing will
+                      ever restart — a process restart is the only
+                      medicine left)
+  GET /readyz         readiness — what a k8s Service endpoint should
+                      key on: 503 while draining, 503 "degraded" while
+                      live replicas < the pool's quorum, else 200
   GET /metrics        utils/metrics.Registry exposition
 
 SIGTERM drain (install_signal_handlers): stop admitting (everything new
@@ -39,8 +44,8 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..utils.metrics import Registry
-from .api import (DEADLINE_QUEUED_ERROR, Draining, QueueFull,
-                  GenerateRequest, encode_prompt)
+from .api import (DEADLINE_QUEUED_ERROR, RETRIES_EXHAUSTED_ERROR,
+                  Draining, QueueFull, GenerateRequest, encode_prompt)
 from .executor import Executor, ReplicaPool
 from .queue import AdmissionQueue
 
@@ -59,7 +64,8 @@ class ServingServer:
                  default_deadline_s: float = 30.0,
                  retry_after_s: float = 1.0,
                  registry: Optional[Registry] = None,
-                 drainer=None, node_name: Optional[str] = None):
+                 drainer=None, node_name: Optional[str] = None,
+                 pool_opts: Optional[dict] = None):
         # Per-server registry by default: tests and benches run several
         # servers in one process; sharing default_registry would blend
         # their series.
@@ -67,8 +73,12 @@ class ServingServer:
         self.queue = AdmissionQueue(max_depth=max_queue_depth,
                                     retry_after_s=retry_after_s,
                                     registry=self.registry)
+        # pool_opts passes supervision knobs through (supervise,
+        # watchdog_s, max_attempts, quorum, backoff/breaker tuning) —
+        # the pool's defaults are the production contract.
         self.pool = ReplicaPool(executors, self.queue,
-                                registry=self.registry)
+                                registry=self.registry,
+                                **(pool_opts or {}))
         self.default_max_tokens = default_max_tokens
         self.max_tokens_cap = max_tokens_cap
         self.default_deadline_s = default_deadline_s
@@ -107,11 +117,36 @@ class ServingServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    return self._send(200, {"status": "ok"})
+                    # Liveness goes red ONLY when zero replicas are
+                    # live AND none is coming back (every breaker
+                    # open) — then a process restart is the only
+                    # medicine left. A replica mid-backoff is seconds
+                    # from returning; killing the pod for that would
+                    # turn every transient fault into a full restart.
+                    # Degraded and draining are readiness problems.
+                    live = server_ref.pool.live_count()
+                    if server_ref.pool.supervised and live == 0 \
+                            and server_ref.pool.all_parked():
+                        return self._send(
+                            503, {"status": "dead", "live_replicas": 0})
+                    return self._send(
+                        200, {"status": "ok", "live_replicas": live})
                 if self.path == "/readyz":
                     if server_ref.draining:
                         return self._send(503, {"status": "draining"})
-                    return self._send(200, {"status": "ready"})
+                    live = server_ref.pool.live_count()
+                    quorum = server_ref.pool.quorum
+                    if live < quorum:
+                        # Below quorum: stop routing NEW traffic here
+                        # (a Service endpoint keyed on readiness drops
+                        # out) while in-flight work keeps completing.
+                        return self._send(
+                            503, {"status": "degraded",
+                                  "live_replicas": live,
+                                  "quorum": quorum})
+                    return self._send(
+                        200, {"status": "ready",
+                              "live_replicas": live})
                 if self.path == "/metrics":
                     server_ref.update_derived_metrics()
                     data = server_ref.registry.render().encode()
@@ -187,6 +222,11 @@ class ServingServer:
         self._httpd.server_close()
         self.queue.fail_all("server stopped")
         self.pool.stop()
+        # Again after the pool is down: a replica that died during
+        # teardown may have requeued its occupants between the first
+        # fail_all and the supervisor stopping — nobody will ever pop
+        # them, so fail them here instead of parking their handlers.
+        self.queue.fail_all("server stopped")
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -341,6 +381,15 @@ class ServingServer:
         except Draining:
             return self._finish(handler, 503, {"error": "draining"},
                                 "draining", retry)
+        except Exception as e:
+            # Anything else out of the admission path (a poisoned
+            # queue, an injected fault) must cost THIS request a JSON
+            # 500, not the connection — the plane keeps serving.
+            log.exception("generate: admission failed")
+            return self._finish(
+                handler, 500,
+                {"error": f"internal: admission failed: {e}"}, "error",
+                elapsed_s=time.monotonic() - t0)
 
         # The handler thread parks on the request event; the batcher
         # completes it. Grace past the deadline covers the final step +
@@ -355,7 +404,14 @@ class ServingServer:
         if req.error is not None:
             shed = req.error == DEADLINE_QUEUED_ERROR
             code = 503 if shed else 500
-            outcome = "deadline_queue" if shed else "error"
+            if shed:
+                outcome = "deadline_queue"
+            elif req.error == RETRIES_EXHAUSTED_ERROR:
+                # The supervisor's give-up: the request rode its full
+                # attempts budget through replica failures.
+                outcome = "retries_exhausted"
+            else:
+                outcome = "error"
             return self._finish(handler, code, {"error": req.error},
                                 outcome,
                                 retry if code == 503 else None,

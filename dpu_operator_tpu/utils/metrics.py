@@ -109,6 +109,29 @@ class Registry:
             state["sum"] += value
             state["count"] += 1
 
+    def counter_value(self, name: str,
+                      labels: Optional[dict] = None) -> float:
+        """Read one counter series (0.0 when never incremented) — for
+        tests and in-process consumers (the bench's recovery section),
+        instead of re-parsing render() output."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            return self._counters.get(name, {}).get(key, 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across ALL label sets (e.g. requeues over
+        every replica × outcome)."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
+    def gauge_value(self, name: str,
+                    labels: Optional[dict] = None) -> Optional[float]:
+        """Read one gauge series; None when the series doesn't exist
+        (unlike counters, an absent gauge is 'never published', not 0)."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            return self._gauges.get(name, {}).get(key)
+
     def quantile(self, name: str, q: float,
                  labels: Optional[dict] = None) -> Optional[float]:
         """Estimate the q-quantile (0 < q <= 1) of a histogram series

@@ -31,6 +31,7 @@ from typing import Dict, Optional
 import grpc
 from google.protobuf import empty_pb2
 
+from .. import faults
 from ..dpu_api import services
 from ..dpu_api.gen import bridge_port_pb2 as bp
 from ..dpu_api.gen import dpu_api_pb2 as pb
@@ -215,6 +216,11 @@ class TpuVsp(
     # -- Heartbeat -----------------------------------------------------------
 
     def Ping(self, request, context):
+        # Fault seam: the daemon's heartbeat-loss → Ready-flip →
+        # recovery contract (tests/test_resilience.py) is exercised by
+        # injecting a raise/hang/corrupt HERE instead of killing a VSP
+        # process and hoping the timing lands.
+        faults.fire("vsp.ping")
         healthy = True
         instance_id = self._instance_id
         if self._cp_agent is not None:
@@ -230,8 +236,10 @@ class TpuVsp(
                         getattr(dp, "flow_state", "ok"))
             if s != "ok"
         ] if dp is not None else []
-        return pb.PingResponse(healthy=healthy, instance_id=instance_id,
-                               degradations=degradations)
+        return faults.wrap(
+            "vsp.ping",
+            pb.PingResponse(healthy=healthy, instance_id=instance_id,
+                            degradations=degradations))
 
     def _chip_health(self, n_local: int) -> Dict[int, bool]:
         """Cache reads only — the caches are fed by background threads

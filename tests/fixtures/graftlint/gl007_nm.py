@@ -1,0 +1,51 @@
+# graftlint-fixture-path: dpu_operator_tpu/daemon/fx_gl007_nm.py
+"""GL007 near-misses that must stay silent: a retry loop with a
+backoff sleep (the fixed fabric shape), an attempt-bounded for-range
+retry, a handler that surfaces the failure at expiry, and a
+non-network retry body."""
+import socket
+import time
+
+
+def dial_with_backoff(addr):
+    delay = 0.05
+    while True:
+        s = socket.socket()
+        try:
+            s.connect(addr)
+            return s
+        except OSError:
+            s.close()
+            time.sleep(delay)          # backoff: the fix
+            delay = min(1.0, delay * 2)
+
+
+def dial_bounded(addr):
+    for _ in range(5):                 # attempt bound
+        s = socket.socket()
+        try:
+            s.connect(addr)
+            return s
+        except OSError:
+            s.close()
+    raise TimeoutError(addr)
+
+
+def dial_surfaces(addr, deadline):
+    while True:
+        s = socket.socket()
+        try:
+            s.connect(addr)
+            return s
+        except OSError:
+            s.close()
+            if time.monotonic() > deadline:
+                raise                  # expiry is surfaced, not eaten
+
+
+def recompute_forever(state):
+    while True:
+        try:
+            state.refresh()            # no network pedigree
+        except ValueError:
+            continue

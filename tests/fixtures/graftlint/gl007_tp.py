@@ -1,0 +1,17 @@
+# graftlint-fixture-path: dpu_operator_tpu/daemon/fx_gl007_tp.py
+"""GL007 true positive: the pre-fix fabric dial shape — a while-True
+loop that swallows a refused connect and retries with neither an
+attempt bound nor a backoff sleep. A dead peer turns this into a
+busy-spin for the whole deadline, and a fleet restart into a
+synchronized retry storm."""
+import socket
+
+
+def dial_forever(addr):
+    while True:
+        s = socket.socket()
+        try:
+            s.connect(addr)
+            return s
+        except OSError:
+            s.close()

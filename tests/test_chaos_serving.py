@@ -1,0 +1,599 @@
+"""Chaos matrix for the self-healing serving plane (ISSUE 5).
+
+Every recovery path is driven by a DETERMINISTIC injected fault
+(dpu_operator_tpu.faults: count-triggered, seeded) — never by luck:
+
+  * the acceptance matrix: {step-raise, step-hang, submit-raise,
+    collect-hang, device-worker-raise} × {sync, pipelined} ×
+    {SyntheticExecutor, real jitted LocalExecutor} — the pool returns
+    to full live-replica count, every seized in-flight request
+    completes with the token stream an uninjected run produces, and no
+    request is settled twice;
+  * the watchdog: a hung collect() is detected within its deadline and
+    the replica restarts — a wedge the loop itself could never time
+    out of;
+  * the health contract: /readyz 503 "degraded" while live < quorum
+    and back to 200 after recovery; /healthz red only when every
+    replica's breaker is open (nothing is ever coming back);
+  * the breaker: a flapping replica is parked after K failures in the
+    window instead of crash-looping forever.
+
+All tier-1, all wall-time-budgeted (each case asserts its own ceiling;
+the lane total is documented in docs/ci.md). SyntheticExecutor keeps
+the scheduler-plane cases immune to CI-box noise; the LocalExecutor
+cases prove the same contracts over the real jitted model.
+"""
+
+import time
+import urllib.request
+from collections import Counter
+
+import pytest
+
+from dpu_operator_tpu import faults
+from dpu_operator_tpu.faults import FaultError, FaultPlan, FaultyExecutor
+from dpu_operator_tpu.serving import (AdmissionQueue, GenerateRequest,
+                                      LocalExecutor, ReplicaPool,
+                                      ServingServer, SyntheticExecutor,
+                                      encode_prompt)
+from dpu_operator_tpu.utils.metrics import Registry
+
+MODEL = dict(S=1, d=8, h=8, E=1)
+
+# Wall ceiling for any single chaos case: generous against CI noise,
+# tight enough that a recovery path that waits out a deadline instead
+# of healing shows up as a failure, not a slow creep.
+CASE_BUDGET_S = 12.0
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    # A plan leaked across tests would inject faults into unrelated
+    # suites — UNINSTALL FIRST (so the leak is contained even when we
+    # fail), then flag the leaking test loudly.
+    leaked = faults.active_plan()
+    faults.uninstall()
+    assert leaked is None, "test leaked an installed FaultPlan"
+
+
+@pytest.fixture()
+def settle_counts(monkeypatch):
+    """Count settles per request id: finish() (fail() funnels through
+    it) must run EXACTLY once per request — the no-double-settle
+    acceptance check."""
+    counts = Counter()
+    orig = GenerateRequest.finish
+
+    def counting(self):
+        counts[self.request_id] += 1
+        orig(self)
+
+    monkeypatch.setattr(GenerateRequest, "finish", counting)
+    return counts
+
+
+def _reqs(n, d, toks, prefix="chaos", deadline_s=60.0):
+    return [GenerateRequest(prompt_vec=encode_prompt(f"{prefix}-{i}", d),
+                            max_tokens=toks,
+                            deadline=time.monotonic() + deadline_s)
+            for i in range(n)]
+
+
+def _run_pool(executors, reqs, *, registry=None, watchdog_s=0.25,
+              timeout=20.0, **pool_kw):
+    q = AdmissionQueue(max_depth=len(reqs) + 1)
+    pool = ReplicaPool(executors, q, registry=registry,
+                       watchdog_s=watchdog_s, restart_backoff_s=0.01,
+                       poll_s=0.005, **pool_kw)
+    for r in reqs:
+        q.submit(r)
+    pool.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=timeout), "request lost"
+        return pool, q
+    except BaseException:
+        pool.stop()
+        raise
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    assert cond(), f"timed out waiting for {msg}"
+
+
+# -- the FaultPlan itself -----------------------------------------------------
+
+
+def test_fault_plan_triggers_are_deterministic():
+    plan = FaultPlan(seed=7)
+    plan.inject("s.a", exc=FaultError, at_calls=[2, 4])
+    plan.inject("s.b", exc=FaultError, probability=0.5, times=1)
+    hits = []
+    for _ in range(5):
+        try:
+            plan.fire("s.a")
+            hits.append(False)
+        except FaultError:
+            hits.append(True)
+    assert hits == [False, True, False, True, False]
+    assert plan.calls["s.a"] == 5 and plan.fired["s.a"] == 2
+    # probability draws come from the plan's own seeded RNG: the same
+    # seed fires on the same call index every run.
+    b_hits = []
+    for _ in range(20):
+        try:
+            plan.fire("s.b")
+            b_hits.append(False)
+        except FaultError:
+            b_hits.append(True)
+    assert sum(b_hits) == 1  # times=1 caps it
+    ref = FaultPlan(seed=7)
+    ref.inject("s.b", exc=FaultError, probability=0.5, times=1)
+    ref_hits = []
+    for _ in range(20):
+        try:
+            ref.fire("s.b")
+            ref_hits.append(False)
+        except FaultError:
+            ref_hits.append(True)
+    assert b_hits == ref_hits
+
+
+def test_fault_plan_corrupt_wraps_return_value():
+    with faults.injected() as plan:
+        plan.inject("s.c", corrupt=lambda r: None, at_calls=[2])
+        assert faults.wrap("s.c", "ok") == "ok"  # no fire() yet: no-op
+        faults.fire("s.c")
+        assert faults.wrap("s.c", "ok") == "ok"   # call 1: not armed
+        faults.fire("s.c")
+        assert faults.wrap("s.c", "ok") is None   # call 2: corrupted
+        faults.fire("s.c")
+        assert faults.wrap("s.c", "ok") == "ok"
+
+
+def test_fire_is_noop_without_installed_plan():
+    faults.fire("nowhere.at-all")
+    assert faults.wrap("nowhere.at-all", 42) == 42
+
+
+# -- satellite: the synthetic worker must never die silently ------------------
+
+
+def test_synthetic_worker_survives_poison_item():
+    """Regression (pre-fix hang): an exception outside the step guard
+    — e.g. a malformed work item — killed the worker thread silently,
+    so collect() on the NEXT handle blocked forever. The whole loop
+    body is now guarded; the worker logs and survives."""
+    ex = SyntheticExecutor(slots=2, d=8, pipelined=True)
+    try:
+        ex.collect(ex.submit([]))          # spin the worker up
+        ex._work.put(("bogus",))           # the pre-fix killer
+        h = ex.submit([])
+        assert h.event.wait(2.0), \
+            "worker died on the poison item: collect() would hang forever"
+        ex.collect(h)
+    finally:
+        ex.close()
+
+
+def test_synthetic_worker_step_error_reraised_from_collect():
+    """A device-side step failure lands in the owning handle and
+    re-raises from collect() — with a bounded wait, proving the
+    pre-fix failure mode (silent thread death, infinite collect)
+    cannot recur."""
+    with faults.injected() as plan:
+        plan.inject("dev.step", exc=FaultError, at_calls=[2])
+        ex = SyntheticExecutor(slots=2, d=8, pipelined=True,
+                               fault_site="dev")
+        try:
+            ex.collect(ex.submit([]))      # call 1: clean
+            h = ex.submit([])              # call 2: raises on worker
+            assert h.event.wait(2.0), "worker died instead of reporting"
+            with pytest.raises(FaultError):
+                ex.collect(h)
+            ex.collect(ex.submit([]))      # worker survived the error
+        finally:
+            ex.close()
+
+
+def test_synthetic_reset_error_reraised_not_hung():
+    """A worker-side reset failure re-raises from reset() instead of
+    reporting a clean session over poisoned state (or hanging the
+    caller forever on a dead worker)."""
+    with faults.injected() as plan:
+        ex = SyntheticExecutor(slots=2, d=8, pipelined=True)
+        try:
+            ex.collect(ex.submit([]))
+            # Force the reset branch itself to fail on the worker.
+            ex.slots = "poison"  # np.zeros((..)) will raise TypeError
+            with pytest.raises(TypeError):
+                ex.reset()
+            ex.slots = 2
+            ex.reset()                     # worker survived
+            ex.collect(ex.submit([]))
+        finally:
+            ex.close()
+
+
+# -- the acceptance test: count-triggered kill at 2x overload -----------------
+
+
+def test_replica_kill_at_2x_overload_recovers_requeues_and_preserves_streams(
+        settle_counts):
+    """ISSUE 5 acceptance: two replicas, queue preloaded at 2x slot
+    capacity, a count-triggered step failure kills replica0 mid-run.
+    The pool must return to full live-replica count, every in-flight
+    request from the dead replica must be retried and complete with
+    the SAME token stream as an uninjected run, and nothing may be
+    settled twice."""
+    t0 = time.perf_counter()
+
+    def run(inject):
+        ex0 = SyntheticExecutor(slots=2, d=8, seed=5)
+        ex1 = SyntheticExecutor(slots=2, d=8, seed=5)
+        execs = [FaultyExecutor(ex0, site="r0") if inject else ex0, ex1]
+        reg = Registry()
+        reqs = _reqs(8, 8, 6)  # 8 requests over 4 slots: 2x overload
+        pool, _q = _run_pool(execs, reqs, registry=reg)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 2, msg="full recovery")
+                assert sum(pool.restarts) >= 1
+                assert reg.counter_value(
+                    "serving_requeue_total",
+                    {"replica": "replica0", "outcome": "requeued"}) >= 1
+        finally:
+            pool.stop()
+        return [(r.error, list(r.tokens)) for r in reqs]
+
+    baseline = run(inject=False)
+    with faults.injected() as plan:
+        plan.inject("r0.step", exc=RuntimeError("injected kill"),
+                    at_calls=[4])
+        injected = run(inject=True)
+    assert all(e is None for e, _ in injected), injected
+    assert injected == baseline
+    assert set(settle_counts.values()) == {1}, \
+        f"double-settle: {settle_counts}"
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
+# -- the watchdog: a wedged collect() cannot time itself out ------------------
+
+
+def test_collect_hang_watchdog_detects_within_deadline(settle_counts):
+    """A hang injected into a pipelined replica's collect() parks the
+    batcher thread forever — only the supervisor's watchdog deadline
+    can see it. Detection must land within ~watchdog_s + poll jitter,
+    the seized requests must complete on the other replica well before
+    the hang resolves, and the wedged replica must rejoin the pool."""
+    t0 = time.perf_counter()
+    hang_s, watchdog_s = 1.5, 0.2
+    with faults.injected() as plan:
+        plan.inject("r0.collect", hang_s=hang_s, at_calls=[2])
+        ex0 = FaultyExecutor(
+            SyntheticExecutor(slots=2, d=8, seed=5, pipelined=True),
+            site="r0")
+        ex1 = SyntheticExecutor(slots=2, d=8, seed=5, pipelined=True)
+        reqs = _reqs(8, 8, 6)
+        pool, _q = _run_pool([ex0, ex1], reqs,
+                             watchdog_s=watchdog_s, timeout=10.0)
+        try:
+            done_at = time.perf_counter()
+            # All requests completed without waiting out the hang: the
+            # watchdog seized and requeued them to the live replica.
+            kill_t = plan.fired_at["r0.collect"][0]
+            assert done_at - t0 < hang_s + 1.0
+            _wait(lambda: pool.live_count() == 2,
+                  msg="wedged replica rejoining")
+            recovery_s = time.monotonic() - kill_t
+            assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+    assert all(r.error is None for r in reqs)
+    assert set(settle_counts.values()) == {1}
+    assert time.perf_counter() - t0 < CASE_BUDGET_S, recovery_s
+
+
+# -- the chaos matrix ---------------------------------------------------------
+
+_SYNTH_CASES = [
+    ("sync", "step-raise"),
+    ("sync", "step-hang"),
+    ("pipelined", "submit-raise"),
+    ("pipelined", "submit-hang"),
+    ("pipelined", "collect-hang"),
+    ("pipelined", "worker-step-raise"),
+]
+
+
+_FAULT_POINT = {"step-raise": "step", "submit-raise": "submit",
+                "worker-step-raise": "step", "step-hang": "step",
+                "submit-hang": "submit", "collect-hang": "collect"}
+
+
+def _arm(plan, site, fault, at_call=3):
+    point = f"{site}.{_FAULT_POINT[fault]}"
+    if fault.endswith("raise"):
+        plan.inject(point, exc=RuntimeError(f"injected {fault}"),
+                    at_calls=[at_call])
+    else:
+        plan.inject(point, hang_s=1.2, at_calls=[at_call])
+
+
+@pytest.mark.parametrize("mode,fault", _SYNTH_CASES,
+                         ids=[f"{m}-{f}" for m, f in _SYNTH_CASES])
+def test_chaos_matrix_synthetic(mode, fault, settle_counts):
+    """Each injection point × loop shape over SyntheticExecutor: the
+    pool recovers to full strength, requeued requests complete with
+    the uninjected run's token streams, nothing settles twice, and
+    the whole case fits its wall budget."""
+    t0 = time.perf_counter()
+    pipelined = mode == "pipelined"
+
+    def mk(inject):
+        inner = SyntheticExecutor(
+            slots=2, d=8, seed=5, pipelined=pipelined,
+            fault_site="r0dev" if inject and fault == "worker-step-raise"
+            else None)
+        if inject and fault != "worker-step-raise":
+            return FaultyExecutor(inner, site="r0")
+        return inner
+
+    def run(inject):
+        execs = [mk(inject),
+                 SyntheticExecutor(slots=2, d=8, seed=5,
+                                   pipelined=pipelined)]
+        reqs = _reqs(8, 8, 5)
+        pool, _q = _run_pool(execs, reqs, timeout=10.0)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 2,
+                      msg="full live-replica count")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        return [(r.error, list(r.tokens)) for r in reqs]
+
+    baseline = run(inject=False)
+    with faults.injected() as plan:
+        _arm(plan, "r0dev" if fault == "worker-step-raise" else "r0",
+             fault)
+        injected = run(inject=True)
+    assert all(e is None for e, _ in injected), injected
+    assert injected == baseline
+    assert set(settle_counts.values()) == {1}
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
+_LOCAL_CASES = [
+    ("sync", "step-raise"),
+    ("pipelined", "submit-raise"),
+    ("pipelined", "collect-hang"),
+]
+
+
+@pytest.fixture(scope="module")
+def local_executors():
+    """One compiled LocalExecutor per mode, shared by every local
+    chaos case (compile cost dominates; close() is a no-op so reuse
+    across pools is safe — each pool's batcher reset()s at start)."""
+    return {"sync": LocalExecutor(slots=2, mode="sync", **MODEL),
+            "pipelined": LocalExecutor(slots=2, mode="pipelined",
+                                       **MODEL)}
+
+
+@pytest.mark.parametrize("mode,fault", _LOCAL_CASES,
+                         ids=[f"local-{m}-{f}" for m, f in _LOCAL_CASES])
+def test_chaos_matrix_local(mode, fault, local_executors, settle_counts):
+    """The same contracts over the REAL jitted model: single-replica
+    pool, so requeued requests re-decode on the restarted replica and
+    stream equality proves the restart path re-creates clean device
+    state (executor.reset())."""
+    t0 = time.perf_counter()
+    inner = local_executors[mode]
+
+    def run(inject, site):
+        ex = FaultyExecutor(inner, site=site) if inject else inner
+        reqs = _reqs(6, MODEL["d"], 4)
+        pool, _q = _run_pool([ex], reqs, timeout=15.0)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        return [(r.error, list(r.tokens)) for r in reqs]
+
+    site = f"L{mode}-{fault}"
+    baseline = run(False, site)
+    with faults.injected() as plan:
+        _arm(plan, site, fault, at_call=2)
+        injected = run(True, site)
+    assert all(e is None for e, _ in injected), injected
+    assert injected == baseline
+    assert set(settle_counts.values()) == {1}
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
+# -- health contract over HTTP ------------------------------------------------
+
+
+def _get(url):
+    try:
+        r = urllib.request.urlopen(url, timeout=5)
+        return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+def test_readyz_flips_degraded_then_ready():
+    """One replica killed by a one-shot fault: /readyz reports 503
+    'degraded' while live < quorum and flips back to 200 after the
+    supervisor restarts it; /healthz stays 200 throughout (a replica
+    is coming back — liveness must not kill the pod)."""
+    with faults.injected() as plan:
+        # Keep replica0 down long enough to OBSERVE degraded: the
+        # restart's own reset re-arms it once, then it comes up clean.
+        plan.inject("hr0.step", exc=RuntimeError("kill"), at_calls=[2])
+        plan.inject("hr0.reset", exc=RuntimeError("still down"),
+                    at_calls=[2, 3])
+        ex0 = FaultyExecutor(SyntheticExecutor(slots=1, d=8), site="hr0")
+        ex1 = SyntheticExecutor(slots=1, d=8)
+        srv = ServingServer(
+            [ex0, ex1],
+            pool_opts=dict(restart_backoff_s=0.05, poll_s=0.005,
+                           breaker_threshold=50)).start()
+        try:
+            assert _get(srv.url + "/readyz") == 200
+            # Trip the fault with one request (it retries on ex1).
+            import json as _json
+            data = _json.dumps({"prompt": "x", "max_tokens": 3,
+                                "deadline_ms": 10000}).encode()
+            urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/v1/generate",
+                                       data=data), timeout=10).read()
+            _wait(lambda: srv.pool.live_count() < 2, msg="replica down")
+            assert _get(srv.url + "/readyz") == 503
+            assert _get(srv.url + "/healthz") == 200
+            # A restart flips LIVE before the new thread's reset runs,
+            # and the armed reset faults kill the first two comebacks
+            # — wait until the THIRD restart (the one whose reset is
+            # clean) is up before asserting the stable ready state.
+            _wait(lambda: sum(srv.pool.restarts) >= 3
+                  and _get(srv.url + "/readyz") == 200,
+                  msg="ready again after the clean restart")
+            assert srv.pool.live_count() == 2
+            assert _get(srv.url + "/healthz") == 200
+        finally:
+            srv.stop()
+
+
+def test_breaker_parks_flapping_replica_healthz_red_at_zero_live():
+    """A replica that dies on every restart is PARKED after
+    breaker_threshold failures (no infinite crash loop), with
+    serving_breaker_state=1 and the pool degraded. With ALL replicas
+    parked, /healthz finally goes red — zero live, none coming back."""
+    with faults.injected() as plan:
+        # reset fires on every (re)start of the pipelined loop: the
+        # replica can never come up.
+        plan.inject("br0.reset", exc=RuntimeError("dead on arrival"))
+        ex = FaultyExecutor(
+            SyntheticExecutor(slots=1, d=8, pipelined=True), site="br0")
+        reg = Registry()
+        srv = ServingServer(
+            [ex], registry=reg,
+            pool_opts=dict(restart_backoff_s=0.01, poll_s=0.005,
+                           breaker_threshold=3,
+                           breaker_window_s=30.0)).start()
+        try:
+            _wait(lambda: srv.pool.states()["replica0"] == "parked",
+                  msg="breaker opening")
+            restarts_at_park = sum(srv.pool.restarts)
+            assert reg.gauge_value("serving_breaker_state",
+                                   {"replica": "replica0"}) == 1.0
+            assert _get(srv.url + "/healthz") == 503
+            assert _get(srv.url + "/readyz") == 503
+            # Parked means parked: no further restarts accrue.
+            time.sleep(0.1)
+            assert sum(srv.pool.restarts) == restarts_at_park
+            assert reg.gauge_value("serving_pool_replicas",
+                                   {"state": "parked"}) == 1.0
+        finally:
+            srv.stop()
+
+
+def test_reset_hang_on_restart_is_watchdogged_not_invisibly_live():
+    """Review catch: after a wedge, the restarted batcher's first act
+    is executor.reset(), which can serialize behind the still-hung
+    device step — pre-fix it blocked there with blocked_since unset,
+    so the supervisor reported the replica LIVE forever while it
+    served nothing. reset() now runs under the watchdog clock: a
+    hanging reset is detected like any other wedge and the breaker
+    parks the replica instead of wedging it invisibly."""
+    with faults.injected() as plan:
+        # Startup reset (call 1) is clean; the replica dies once (on
+        # its first submit — the pipelined loop's seam), and every
+        # restart's reset hangs.
+        plan.inject("wr0.submit", exc=RuntimeError("kill"), at_calls=[1])
+        plan.inject("wr0.reset", hang_s=1.0,
+                    at_calls=list(range(2, 12)))
+        ex = FaultyExecutor(
+            SyntheticExecutor(slots=1, d=8, pipelined=True), site="wr0")
+        q = AdmissionQueue(max_depth=8)
+        pool = ReplicaPool([ex], q, watchdog_s=0.2,
+                           restart_backoff_s=0.01, poll_s=0.005,
+                           breaker_threshold=3)
+        for r in _reqs(1, 8, 3):
+            q.submit(r)
+        pool.start()
+        try:
+            _wait(lambda: pool.states()["replica0"] == "parked",
+                  timeout=8.0, msg="hanging-reset replica parked")
+        finally:
+            pool.stop()
+
+
+def test_queue_submit_fault_returns_500_not_dropped_connection():
+    """An injected AdmissionQueue.submit failure must surface as a
+    JSON 500 on THIS request and leave the server serving — not tear
+    down the handler connection."""
+    import json as _json
+    with faults.injected() as plan:
+        plan.inject("queue.submit", exc=RuntimeError("queue blew up"),
+                    at_calls=[1])
+        srv = ServingServer([SyntheticExecutor(slots=1, d=8)]).start()
+        try:
+            def post():
+                data = _json.dumps({"prompt": "x", "max_tokens": 2,
+                                    "deadline_ms": 5000}).encode()
+                try:
+                    r = urllib.request.urlopen(
+                        urllib.request.Request(srv.url + "/v1/generate",
+                                               data=data), timeout=10)
+                    r.read()
+                    return r.status
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    return e.code
+
+            assert post() == 500
+            assert post() == 200  # the plane survived its queue fault
+        finally:
+            srv.stop()
+
+
+# -- the VSP heartbeat seam ---------------------------------------------------
+
+
+def test_vsp_ping_fault_seam():
+    """The daemon-facing heartbeat breaks on demand: an injected raise
+    surfaces to the caller (heartbeat-loss path), an injected corrupt
+    flips the response unhealthy without touching the VSP."""
+    from dpu_operator_tpu.parallel.topology import SliceTopology
+    from dpu_operator_tpu.vsp.tpu_vsp import TpuVsp
+
+    vsp = TpuVsp(topology=SliceTopology.single_chip())
+    with faults.injected() as plan:
+        plan.inject("vsp.ping", exc=RuntimeError("heartbeat eaten"),
+                    at_calls=[1])
+        with pytest.raises(RuntimeError):
+            vsp.Ping(None, None)
+        resp = vsp.Ping(None, None)
+        assert resp.healthy
+
+        def unhealthy(r):
+            r.healthy = False
+            return r
+
+        plan.inject("vsp.ping", corrupt=unhealthy, at_calls=[3])
+        assert not vsp.Ping(None, None).healthy
+        assert vsp.Ping(None, None).healthy

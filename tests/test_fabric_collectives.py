@@ -14,7 +14,8 @@ import numpy as np
 import pytest
 
 from dpu_operator_tpu.parallel.fabric_collectives import (
-    RingError, RingTransport, _segment_bounds, bench_ring)
+    FabricConnectError, RingError, RingTransport, _segment_bounds,
+    bench_ring)
 
 PORTS = iter(range(29500, 29900, 10))
 
@@ -144,6 +145,35 @@ def test_absent_peer_fails_fast_not_forever():
     with pytest.raises(RingError, match="never came up"):
         t.connect(timeout=0.5)
     t.close()
+
+
+def test_dead_peer_typed_error_with_backoff_not_busy_spin():
+    """Regression (ISSUE 5 satellite): the dial loop used to retry a
+    refused connect on a fixed 50 ms beat — ~20 socket churns in a 1 s
+    deadline, and an untyped RingError at expiry. Now: exponential
+    backoff + jitter inside the deadline (attempt count stays small),
+    and a typed FabricConnectError carrying the peer address and the
+    attempt count."""
+    import time as _time
+
+    t = RingTransport(0, 2, "127.0.0.1",
+                      ["127.0.0.1:29992", "127.0.0.1:29993"])
+    t0 = _time.monotonic()
+    with pytest.raises(FabricConnectError) as ei:
+        t.connect(timeout=1.0)
+    elapsed = _time.monotonic() - t0
+    t.close()
+    e = ei.value
+    assert e.peer == ("127.0.0.1", 29993)
+    # Bounded time: the deadline, not the kernel's syn-retry cycle.
+    assert elapsed < 5.0, elapsed
+    # Backoff means FEW attempts, not a deadline-long churn: doubling
+    # from 50 ms covers a 1 s budget in well under 10 dials (the old
+    # fixed beat needed ~20; a tight loop, thousands).
+    assert 1 <= e.attempts <= 10, e.attempts
+    # The typed error still IS a RingError: the gloo-fallback callers
+    # keep working unchanged.
+    assert isinstance(e, RingError)
 
 
 def test_cli_raw_mode_prints_json_result():

@@ -56,7 +56,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 6
+    assert len(set(ids)) == len(ids) == 7
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -70,6 +70,7 @@ _EXPECT = {
     "GL004": 3,  # subprocess, socket send, thread join under lock
     "GL005": 2,  # except: pass, except BaseException: continue
     "GL006": 1,  # psum over the 'pd' typo
+    "GL007": 1,  # while-True connect retry, no bound, no sleep
 }
 
 

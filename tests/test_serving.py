@@ -470,15 +470,26 @@ def test_mixed_feature_dim_pool_rejected():
 
 
 def test_executor_failure_fails_requests_not_server():
+    """Crash-only contract: a persistently failing replica costs the
+    request its retry budget (500 retries_exhausted after max_attempts
+    replica failures), never the server — the supervisor restarts the
+    replica under backoff and /healthz stays green throughout (one
+    replica is still nominally live, just flapping)."""
+
     class Exploding(SyntheticExecutor):
         def step(self, x):
             raise RuntimeError("replica lost")
 
-    srv = ServingServer([Exploding(slots=2, d=8)]).start()
+    srv = ServingServer(
+        [Exploding(slots=2, d=8)],
+        pool_opts=dict(restart_backoff_s=0.01, poll_s=0.005)).start()
     try:
         code, doc, _ = _post(srv.url, {"prompt": "x", "max_tokens": 2,
-                                       "deadline_ms": 2000})
-        assert code == 500 and "replica lost" in doc["error"]
+                                       "deadline_ms": 5000})
+        assert code == 500 and doc["error"] == "retries_exhausted"
+        # The request rode max_attempts (3) replica failures; each one
+        # restarted the replica rather than wedging the pool.
+        assert sum(srv.pool.restarts) >= 2
         assert urllib.request.urlopen(srv.url + "/healthz").status == 200
     finally:
         srv.stop()
