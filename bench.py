@@ -748,7 +748,8 @@ def bench_serving() -> dict:
             f"{out.get('serving_pipeline_speedup')}x (host-gap frac "
             f"{out.get('serving_host_gap_frac')}); recovery "
             f"{out.get('serving_recovery_ms')} ms (goodput retention "
-            f"{out.get('serving_fault_goodput_retention')})",
+            f"{out.get('serving_fault_goodput_retention')}); trace "
+            f"overhead {out.get('serving_trace_overhead_frac')}",
             file=sys.stderr,
         )
         return out
@@ -806,6 +807,13 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
     mp, mj = metrics.get("mxu_pallas_tflops"), metrics.get("mxu_jnp_tflops")
     if mp is not None and mj is not None:
         gates["mxu_pallas_ge_093_jnp"] = bool(mp >= 0.93 * mj)
+    # Tracing overhead (ISSUE 6) is an ABSOLUTE gate, not a rolling
+    # median: "always-on cheap" is a design invariant (<2% of decode
+    # steps/s), and a median would happily ratchet an overhead creep
+    # into the baseline.
+    tof = metrics.get("serving_trace_overhead_frac")
+    if tof is not None:
+        gates["serving_trace_overhead_le_002"] = bool(tof <= 0.02)
 
     for key, band, label in (
         ("fabric_tcp_gbps", 0.85, "fabric_tcp_ge_085_median"),
@@ -903,6 +911,8 @@ def main() -> int:
         "serving_host_gap_frac": "frac",
         "serving_step_device_ms": "ms",
         "serving_host_gap_ms": "ms",
+        "serving_trace_overhead_frac": "frac",
+        "serving_traced_steps_per_s": "steps/s",
     }
     for key, unit in units.items():
         if key in metrics:

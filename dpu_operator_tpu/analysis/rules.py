@@ -11,6 +11,7 @@ GL005  broad except that neither re-raises, logs, nor narrows
 GL006  collective/PartitionSpec axis name no analyzed mesh declares
 GL007  unbounded connect/send retry loop with no backoff sleep
        (serving/daemon/vsp/parallel)
+GL008  request-path log call that binds no request id (serving/)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -144,6 +145,46 @@ def _module_str_tuple_consts(tree: ast.Module) -> Dict[str, tuple]:
     return consts
 
 
+def _same_module_callees(fn: ast.AST, qual: str,
+                         defined: Dict[str, List[str]]) -> Set[str]:
+    """Same-module call resolution shared by the reachability rules
+    (GL002, GL008): plain-name calls to any function of that name;
+    self.m()/cls.m() to a method of the enclosing class."""
+    out: Set[str] = set()
+    cls_prefix = qual.rsplit(".", 2)[0] + "." if "." in qual else ""
+    for n in _walk_through_lambdas(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name):
+            out.update(defined.get(f.id, ()))
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in ("self", "cls"):
+            out.update(q for q in defined.get(f.attr, ())
+                       if cls_prefix and q.startswith(cls_prefix))
+    return out
+
+
+def _reachable_from(module: Module, roots: Set[str]) -> Set[str]:
+    """Transitive same-module call-graph closure over `roots`."""
+    defined: Dict[str, List[str]] = {}
+    by_qual: Dict[str, ast.AST] = {}
+    for fn, qual in module.functions:
+        defined.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+        by_qual[qual] = fn
+    seen: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        qual = frontier.pop()
+        if qual in seen or qual not in by_qual:
+            continue
+        seen.add(qual)
+        frontier.extend(
+            _same_module_callees(by_qual[qual], qual, defined))
+    return seen
+
+
 # --------------------------------------------------------------------------
 # GL001 — mask multiplication in gradient-bearing code
 
@@ -262,42 +303,8 @@ class HostSyncInHotLoop(Rule):
                 roots.add(qual)
         return roots
 
-    @staticmethod
-    def _callees(fn: ast.AST, qual: str,
-                 defined: Dict[str, List[str]]) -> Set[str]:
-        """Same-module resolution: plain-name calls to any function of
-        that name; self.m()/cls.m() to a method of the enclosing
-        class."""
-        out: Set[str] = set()
-        cls_prefix = qual.rsplit(".", 2)[0] + "." if "." in qual else ""
-        for n in _walk_through_lambdas(fn):
-            if not isinstance(n, ast.Call):
-                continue
-            f = n.func
-            if isinstance(f, ast.Name):
-                out.update(defined.get(f.id, ()))
-            elif isinstance(f, ast.Attribute) and \
-                    isinstance(f.value, ast.Name) and \
-                    f.value.id in ("self", "cls"):
-                out.update(q for q in defined.get(f.attr, ())
-                           if cls_prefix and q.startswith(cls_prefix))
-        return out
-
     def _reachable(self, module: Module) -> Set[str]:
-        defined: Dict[str, List[str]] = {}
-        by_qual = {}
-        for fn, qual in module.functions:
-            defined.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
-            by_qual[qual] = fn
-        seen = set()
-        frontier = list(self._roots(module))
-        while frontier:
-            qual = frontier.pop()
-            if qual in seen or qual not in by_qual:
-                continue
-            seen.add(qual)
-            frontier.extend(self._callees(by_qual[qual], qual, defined))
-        return seen
+        return _reachable_from(module, self._roots(module))
 
     def check(self, module: Module, project: Project) -> Iterator[Finding]:
         # The scheduler plane is numpy-only by design (its float()/
@@ -839,8 +846,95 @@ class UnboundedRetryLoop(Rule):
                             f"storm")
 
 
+# --------------------------------------------------------------------------
+# GL008 — request-path log line without request context
+
+
+class RequestLogWithoutContext(Rule):
+    """Origin: ISSUE 6 — when one request's p99 blows up, the serving
+    plane's logs were un-greppable by request: the admission-failure
+    and step-failure lines carried only the replica name, so the one
+    piece of evidence about THE request that failed (which one?) was
+    discarded at the moment it existed. With structured logging
+    (obs/logging.py) the contract is mechanical: a log call emitted
+    while handling a SPECIFIC request must bind that request — either
+    a request-id expression in its args (``req.request_id``,
+    ``request_id``) or an ``extra=`` mapping for the JSON-lines
+    formatter.
+
+    Scope: serving/ functions reachable (same-module call graph) from
+    the request-scoped set — the functions that own one
+    GenerateRequest at a time (handle_generate, admission placement,
+    settle/retire, occupant-failure, supervisor requeue). Replica-
+    lifecycle logging ("replica restarted", "breaker open") is the
+    near-miss: those lines describe a replica, not a request, and are
+    emitted outside the request-scoped graph."""
+
+    rule_id = "GL008"
+    severity = SEVERITY_WARNING
+    title = "request-path log line without request context"
+    hint = ("bind the request: pass a request-id expression "
+            "(req.request_id) as a message arg or "
+            "extra={'request_id': ...} for the JSON-lines formatter — "
+            "a log line you cannot grep by request is invisible "
+            "exactly when one request's p99 blows up")
+
+    # Functions that own a specific GenerateRequest: the roots of the
+    # request-scoped call graph.
+    _ROOTS = {"handle_generate", "_pop_admissions", "_settle",
+              "_retire", "_retire_tokens", "_fail_occupants",
+              "_requeue"}
+    _LOG_METHODS = {"info", "warning", "error", "exception"}
+    _LOG_OBJS = {"log", "logger", "logging"}
+    _RID_NAMES = {"request_id", "rid", "req_id", "rids",
+                  "request_ids"}
+
+    def _root_quals(self, module: Module) -> Set[str]:
+        return {qual for _fn, qual in module.functions
+                if qual.rsplit(".", 1)[-1] in self._ROOTS}
+
+    def _binds_request(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "extra":
+                return True
+        values = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in values:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Attribute) \
+                        and n.attr in self._RID_NAMES:
+                    return True
+                if isinstance(n, ast.Name) and n.id in self._RID_NAMES:
+                    return True
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving"):
+            return
+        roots = self._root_quals(module)
+        if not roots:
+            return
+        hot = _reachable_from(module, roots)
+        for fn, qual in module.functions:
+            if qual not in hot:
+                continue
+            for n in _walk_same_function(fn):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in self._LOG_METHODS
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in self._LOG_OBJS):
+                    continue
+                if not self._binds_request(n):
+                    yield self.finding(
+                        module, n,
+                        f"log.{n.func.attr}(...) in request-scoped "
+                        f"'{qual}' binds no request id — the line "
+                        f"cannot be correlated with the request it "
+                        f"describes")
+
+
 def default_rules() -> List[Rule]:
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
             SilentBroadExcept(), UndeclaredAxisName(),
-            UnboundedRetryLoop()]
+            UnboundedRetryLoop(), RequestLogWithoutContext()]

@@ -16,8 +16,16 @@ log = logging.getLogger(__name__)
 
 
 def main() -> None:
-    logging.basicConfig(
-        level=logging.DEBUG if os.environ.get("DPU_LOG_LEVEL", "0") != "0" else logging.INFO
+    # JSON-lines structured logging (obs/logging.py): every record
+    # carries component=daemon plus whatever request/replica context
+    # the emitting thread bound — one grep'able stream across the
+    # daemon and any co-resident serving plane.
+    from ..obs import logging as obs_logging
+
+    obs_logging.setup(
+        "daemon",
+        level=logging.DEBUG if os.environ.get("DPU_LOG_LEVEL", "0") != "0"
+        else logging.INFO,
     )
     client = client_from_kubeconfig()
     platform = HardwarePlatform()

@@ -36,6 +36,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from .obs import trace as _obs_trace
+
 
 class FaultError(RuntimeError):
     """Default exception type for injected raises."""
@@ -105,6 +107,16 @@ class FaultPlan:
         spec.fired += 1
         self.fired[site] = self.fired.get(site, 0) + 1
         self.fired_at.setdefault(site, []).append(time.monotonic())
+        # Fault firings are span EVENTS in the same monotonic timeline
+        # the serving spans live in: a flight-recorder snapshot can
+        # order injection → detection → recovery without correlating
+        # clocks. Recorded before a hang behavior sleeps (this runs at
+        # arm time), so the event marks when the fault STARTED.
+        behavior = ("raise" if spec.exc is not None
+                    else "hang" if spec.hang_s else "corrupt")
+        _obs_trace.event("fault.fired",
+                         attrs={"site": site, "behavior": behavior,
+                                "hang_s": spec.hang_s or None})
 
     def _arm(self, site: str) -> Optional[FaultSpec]:
         """Count the call; return the first spec that triggers on it.

@@ -1,0 +1,22 @@
+"""Observability plane (ISSUE 6): request-scoped tracing, the wedge
+flight recorder, and structured JSON logging.
+
+Dependency-free by contract (stdlib only — no jax, no numpy): the
+queue/scheduler plane, the daemon, and the fabric transport all import
+this package, and it must cost nothing but a dict append when nobody
+is scraping. See docs/observability.md for the span taxonomy and the
+flight-recorder format.
+"""
+
+from .flight import FlightRecorder, default_flight_dir
+from .trace import Span, Tracer, get_tracer, scoped, set_tracer
+
+__all__ = [
+    "FlightRecorder",
+    "Span",
+    "Tracer",
+    "default_flight_dir",
+    "get_tracer",
+    "scoped",
+    "set_tracer",
+]

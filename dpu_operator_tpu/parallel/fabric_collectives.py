@@ -49,6 +49,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import faults
+from ..obs import trace as obs_trace
 
 # Measured on the veth fabric (16 MiB fp32, 2 ranks, 2-cpu node — the
 # CI/bench class): the collective is CPU-bound there, not wire-bound
@@ -157,6 +158,7 @@ class RingTransport:
         self._send: List[socket.socket] = []
         self._recv: List[socket.socket] = []
         self._listener: Optional[socket.socket] = None
+        self._dial_attempts = 0
 
     # -- wiring ----------------------------------------------------------
 
@@ -169,11 +171,21 @@ class RingTransport:
         listener would squat the ring port for the process lifetime."""
         if self.world == 1:
             return
+        tr = obs_trace.get_tracer()
+        t0 = time.monotonic()
         try:
             self._connect(timeout)
-        except BaseException:
+        except BaseException as e:
+            tr.record_span(
+                "fabric.connect", t0, time.monotonic(),
+                attrs={"rank": self.rank, "world": self.world,
+                       "ok": False, "error": str(e)[:200]})
             self.close()
             raise
+        tr.record_span(
+            "fabric.connect", t0, time.monotonic(),
+            attrs={"rank": self.rank, "world": self.world, "ok": True,
+                   "dial_attempts": self._dial_attempts})
 
     def _connect(self, timeout: float) -> None:
         nxt = self.peer_addrs[(self.rank + 1) % self.world]
@@ -223,6 +235,7 @@ class RingTransport:
             s.settimeout(self.io_timeout)
             s.sendall(_HELLO.pack(self.rank, idx))
             self._send.append(s)
+        self._dial_attempts = attempts
 
         accepted: dict = {}
         try:
@@ -317,10 +330,12 @@ class RingTransport:
         flat_raw = flat.view(np.uint8)
         scratch_raw = scratch.view(np.uint8)
         errors: List[BaseException] = []
+        tr = obs_trace.get_tracer()
 
         def sender(stream: int) -> None:
             try:
                 sock = self._send[stream]
+                traced = tr.enabled
                 for k, (snd, _rcv, _red) in enumerate(items):
                     cl = chunks(seg[snd])
                     for c in range(stream, len(cl), self.streams):
@@ -330,14 +345,23 @@ class RingTransport:
                                 f"step {k - 1} chunk {c}")
                         lo, hi = cl[c]
                         faults.fire("fabric.send")
+                        ts = time.monotonic() if traced else 0.0
                         sock.sendall(
                             memoryview(flat_raw)[lo * itemsize:hi * itemsize])
+                        if traced:
+                            tr.record_span(
+                                "fabric.send", ts, time.monotonic(),
+                                attrs={"rank": self.rank,
+                                       "stream": stream, "step": k,
+                                       "chunk": c,
+                                       "bytes": (hi - lo) * itemsize})
             except BaseException as e:
                 errors.append(e)
 
         def receiver(stream: int) -> None:
             try:
                 sock = self._recv[stream]
+                traced = tr.enabled
                 for k, (_snd, rcv, red) in enumerate(items):
                     cl = chunks(seg[rcv])
                     for c in range(stream, len(cl), self.streams):
@@ -345,7 +369,15 @@ class RingTransport:
                         span = memoryview(
                             scratch_raw if (do_reduce and red) else flat_raw
                         )[lo * itemsize:hi * itemsize]
+                        ts = time.monotonic() if traced else 0.0
                         _recv_exact(sock, span)
+                        if traced:
+                            tr.record_span(
+                                "fabric.recv", ts, time.monotonic(),
+                                attrs={"rank": self.rank,
+                                       "stream": stream, "step": k,
+                                       "chunk": c,
+                                       "bytes": (hi - lo) * itemsize})
                         if do_reduce and red:
                             np.add(flat[lo:hi], scratch[lo:hi],
                                    out=flat[lo:hi])
@@ -386,15 +418,24 @@ class RingTransport:
         # receiver's wait is almost always already satisfied.
         sent = [threading.Event() for _ in cl]
         errors: List[BaseException] = []
+        tr = obs_trace.get_tracer()
 
         def sender(stream: int) -> None:
             try:
                 sock = self._send[stream]
+                traced = tr.enabled
                 for c in range(stream, len(cl), self.streams):
                     lo, hi = cl[c]
                     faults.fire("fabric.send")
+                    ts = time.monotonic() if traced else 0.0
                     sock.sendall(
                         memoryview(flat_raw)[lo * itemsize:hi * itemsize])
+                    if traced:
+                        tr.record_span(
+                            "fabric.send", ts, time.monotonic(),
+                            attrs={"rank": self.rank, "stream": stream,
+                                   "chunk": c,
+                                   "bytes": (hi - lo) * itemsize})
                     sent[c].set()
             except BaseException as e:
                 errors.append(e)
@@ -404,10 +445,18 @@ class RingTransport:
         def receiver(stream: int) -> None:
             try:
                 sock = self._recv[stream]
+                traced = tr.enabled
                 for c in range(stream, len(cl), self.streams):
                     lo, hi = cl[c]
+                    ts = time.monotonic() if traced else 0.0
                     _recv_exact(sock, memoryview(scratch_raw)
                                 [lo * itemsize:hi * itemsize])
+                    if traced:
+                        tr.record_span(
+                            "fabric.recv", ts, time.monotonic(),
+                            attrs={"rank": self.rank, "stream": stream,
+                                   "chunk": c,
+                                   "bytes": (hi - lo) * itemsize})
                     if do_reduce:
                         if not sent[c].wait(60.0):
                             raise RingError(

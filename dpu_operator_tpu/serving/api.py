@@ -82,6 +82,16 @@ class GenerateRequest:
     # every re-admission after a replica death/wedge; past the pool's
     # attempts budget the request 500s with RETRIES_EXHAUSTED_ERROR.
     attempts: int = 0
+    # Span id (int) of the HTTP handler's root "request" span: the
+    # explicit parent every cross-thread span for this request hangs
+    # off (queue, admit/retire, supervisor requeue). None for requests
+    # submitted without a traced front door.
+    trace_parent: Optional[int] = None
+    # (Re-)enqueue time, stamped by AdmissionQueue.submit/requeue: the
+    # queue.wait span's t0. Distinct from arrival so a requeued
+    # request's second wait leg doesn't swallow its failed first
+    # decode attempt (seize/requeue latency has its own spans).
+    enqueued_at: float = field(default_factory=time.monotonic)
     _done: threading.Event = field(default_factory=threading.Event,
                                    repr=False)
 

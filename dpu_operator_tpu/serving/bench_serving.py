@@ -40,6 +40,10 @@ Sections:
      device-vs-host-gap split (serving_step_device_ms,
      serving_host_gap_ms, serving_host_gap_frac) from the scheduler's
      own histograms.
+  7. tracing overhead (ISSUE 6): the section-5 pipelined loop with the
+     obs tracer enabled vs disabled, interleaved best-of →
+     serving_trace_overhead_frac (absolute gate <= 0.02 — always-on
+     tracing must stay always-on cheap), serving_traced_steps_per_s.
 
 Protocol: exactly one JSON object on stdout; progress on stderr.
 """
@@ -252,6 +256,100 @@ def decode_loop_rates(slots: int, model: dict, n_req: int,
           f"{out['serving_sync_steps_per_s']} useful steps/s = "
           f"{out['serving_pipeline_speedup']}x, host-gap frac "
           f"{out.get('serving_host_gap_frac')}")
+    return out
+
+
+def trace_overhead(slots: int, model: dict, n_req: int, toks: int,
+                   trace, repeats: int = 5) -> dict:
+    """Section 7 (ISSUE 6): the always-on price of tracing. The SAME
+    pipelined decode loop as section 5, run with the tracer enabled vs
+    disabled in back-to-back INTERLEAVED pairs; the figure is the
+    MEDIAN of the per-pair rate ratios:
+
+      serving_trace_overhead_frac = max(0, 1 - median(on_i / off_i))
+
+    Per-pair ratios, not best-of-per-arm: each run here is a few
+    hundred ms, and on a shared 2-core box run-to-run swing (~10-15%)
+    dwarfs the effect being measured — a slow patch lands on BOTH
+    halves of a pair and cancels in the ratio, and the median discards
+    the pairs a noisy neighbour split down the middle (best-of compares
+    two different patches of box weather and measured tracing as
+    *negative* overhead as often as 15%).
+
+    Gated ABSOLUTE in bench.py at <= 0.02 — tracing that costs more
+    than 2% of steps/s is a regression no rolling median should ever
+    absorb, because the whole design premise ("always-on cheap") dies
+    with it."""
+    import statistics
+    import time as _time
+
+    from ..obs import trace as obs_trace
+    from .api import GenerateRequest, encode_prompt
+    from .executor import LocalExecutor
+    from .queue import AdmissionQueue
+    from .scheduler import ContinuousBatcher
+
+    ex = LocalExecutor(slots=slots, mode="pipelined", **model)
+    tok_total = n_req * toks
+
+    def one_run() -> float:
+        q = AdmissionQueue(max_depth=n_req + 1)
+        b = ContinuousBatcher(ex, q)
+        reqs = [GenerateRequest(
+            prompt_vec=encode_prompt(f"trace-{i}", ex.d),
+            max_tokens=toks, deadline=_time.monotonic() + 600.0)
+            for i in range(n_req)]
+        for r in reqs:
+            q.submit(r)
+        t0 = _time.perf_counter()
+        b.start()
+        ok = all(r.wait(timeout=600) for r in reqs)
+        wall = _time.perf_counter() - t0
+        b.stop()
+        if not ok or any(r.error for r in reqs):
+            raise RuntimeError(next(
+                (r.error for r in reqs if r.error), "request lost"))
+        return (tok_total / slots) / wall
+
+    out: dict = {}
+    tr = obs_trace.get_tracer()
+    ratios: List[float] = []
+    rates = {"on": [], "off": []}
+    try:
+        for arm in (True, False):  # unrecorded warm-up per arm
+            tr.enabled = arm
+            one_run()
+        for rep in range(repeats):
+            pair = {"on": 0.0, "off": 0.0}
+            # Best-of-2 per arm INSIDE the pair, arms alternating and
+            # the leading arm flipping per pair: a CPU-throttle window
+            # (the dominant noise on CI-class containers — whole runs
+            # halve) is discarded by the inner best-of, and slow drift
+            # cannot systematically favour one arm.
+            order = ("on", "off", "on", "off") if rep % 2 == 0 \
+                else ("off", "on", "off", "on")
+            for arm in order:
+                tr.enabled = arm == "on"
+                r = one_run()
+                pair[arm] = max(pair[arm], r)
+                rates[arm].append(r)
+            ratios.append(pair["on"] / pair["off"])
+            trace(f"trace pair {rep}: on {pair['on']:.0f} / off "
+                  f"{pair['off']:.0f} steps/s = ratio "
+                  f"{ratios[-1]:.3f}")
+            # Bound tracer memory across reps: each run's batcher
+            # thread leaves a buffer until drained.
+            tr.clear()
+    finally:
+        tr.enabled = True
+        ex.close()
+
+    out["serving_traced_steps_per_s"] = round(max(rates["on"]), 1)
+    out["serving_untraced_steps_per_s"] = round(max(rates["off"]), 1)
+    out["serving_trace_overhead_frac"] = round(
+        max(0.0, 1.0 - statistics.median(ratios)), 4)
+    trace(f"trace overhead: {out['serving_trace_overhead_frac']} "
+          f"(median of {len(ratios)} paired ratios)")
     return out
 
 
@@ -512,6 +610,19 @@ def main(argv: Optional[list] = None) -> int:
         except Exception as e:
             out["serving_decode_error"] = str(e)[:200]
             trace(f"decode section failed: {e}")
+
+        # 7: tracing overhead (ISSUE 6) — traced vs untraced pipelined
+        # decode over the same jitted model; gated absolute (<= 0.02)
+        # in bench.py.
+        try:
+            out.update(trace_overhead(
+                args.slots,
+                dict(S=args.decode_S, d=args.decode_d, h=args.decode_h,
+                     E=1),
+                args.decode_reqs, args.decode_tokens, trace))
+        except Exception as e:
+            out["serving_trace_error"] = str(e)[:200]
+            trace(f"trace-overhead section failed: {e}")
 
     print(json.dumps(out), flush=True)
     return 0

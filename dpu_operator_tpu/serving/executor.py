@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import faults
+from ..obs import trace as obs_trace
 from .api import (DEADLINE_QUEUED_ERROR, RETRIES_EXHAUSTED_ERROR,
                   GenerateRequest)
 
@@ -381,13 +382,20 @@ class ReplicaPool:
                  breaker_window_s: float = 30.0,
                  breaker_threshold: int = 5,
                  quorum: Optional[int] = None,
-                 poll_s: float = 0.02, seed: int = 0):
+                 poll_s: float = 0.02, seed: int = 0,
+                 tracer=None, flight_recorder=None):
         from .scheduler import ContinuousBatcher
 
         if not executors:
             raise ValueError("a pool needs at least one executor")
         self.queue = queue
         self.registry = registry
+        self.tracer = (tracer if tracer is not None
+                       else obs_trace.get_tracer())
+        # Armed by the serving front-end (obs.FlightRecorder): the
+        # supervisor snapshots the trace ring on wedge/death/breaker —
+        # the moment the evidence exists, not when someone reproduces.
+        self.flight_recorder = flight_recorder
         self.executors = list(executors)
         self.supervised = bool(supervise)
         self.watchdog_s = watchdog_s
@@ -425,7 +433,8 @@ class ReplicaPool:
     def _make_batcher(self, i: int, ex: Executor):
         return self._Batcher(ex, self.queue, registry=self.registry,
                              replica=f"replica{i}",
-                             crash_only=self.supervised)
+                             crash_only=self.supervised,
+                             tracer=self.tracer)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -525,6 +534,10 @@ class ReplicaPool:
 
     def _replica_down(self, i: int, batcher, why: str) -> None:
         err = batcher.failure
+        self.tracer.event(
+            "supervisor.detect",
+            attrs={"replica": f"replica{i}", "why": why,
+                   "error": str(err)[:200] if err else None})
         # _seizing flips BEFORE seize(): at no instant is a seized
         # request in none of {batcher slots, this hand-off, the queue}
         # — the same closed-accounting contract the queue's inflight
@@ -532,14 +545,24 @@ class ReplicaPool:
         with self._plock:
             self._seizing += 1
         try:
+            t0 = time.monotonic()
             seized = batcher.seize()
+            rids = [r.request_id for r in seized]
+            self.tracer.record_span(
+                "supervisor.seize", t0, time.monotonic(),
+                attrs={"replica": f"replica{i}", "why": why,
+                       "request_ids": rids})
+            self.tracer.decision("seize", replica=f"replica{i}",
+                                 why=why, request_ids=rids)
             log.warning("replica%d %s (%s); requeueing %d in-flight "
-                        "request(s)", i, why, err, len(seized))
+                        "request(s): %s", i, why, err, len(seized),
+                        rids)
             self._requeue(i, seized)
         finally:
             with self._plock:
                 self._seizing -= 1
         self._record_failure(i)
+        self._flight_snapshot(why, replica=i)
 
     def _record_failure(self, i: int) -> None:
         """Window bookkeeping shared by the death/wedge path and a
@@ -560,9 +583,17 @@ class ReplicaPool:
                     {"replica": f"replica{i}"},
                     help="1 when the replica's restart breaker is "
                          "open (replica parked)")
+            self.tracer.event(
+                "supervisor.breaker_open",
+                attrs={"replica": f"replica{i}",
+                       "failures_in_window": len(window),
+                       "window_s": self.breaker_window_s})
+            self.tracer.decision("breaker_open",
+                                 replica=f"replica{i}")
             log.error("replica%d: breaker OPEN (%d failures in %.0fs) "
                       "— parked, pool degraded",
                       i, len(window), self.breaker_window_s)
+            self._flight_snapshot("breaker_open", replica=i)
         else:
             delay = min(self.restart_backoff_cap_s,
                         self.restart_backoff_s
@@ -613,9 +644,20 @@ class ReplicaPool:
                         {"replica": replica, "outcome": outcome},
                         help="in-flight requests seized from failed "
                              "replicas, by disposition")
+            # Parented to the request's root span: the recovery chain
+            # (seize → requeue → re-decode) shows up in ITS trace, not
+            # only in replica-level series.
+            self.tracer.event(
+                "supervisor.requeue", request_id=req.request_id,
+                parent_id=req.trace_parent,
+                attrs={"replica": replica, "outcome": outcome,
+                       "attempts": req.attempts})
+            self.tracer.decision("requeue", request_id=req.request_id,
+                                 replica=replica, outcome=outcome)
 
     def _restart(self, i: int) -> None:
         ex = self.executors[i]
+        t0 = time.monotonic()
         try:
             b = self._make_batcher(i, ex)
         except Exception:
@@ -640,9 +682,32 @@ class ReplicaPool:
         self._count("serving_replica_restarts_total",
                     {"replica": f"replica{i}"},
                     help="supervisor-initiated replica restarts")
+        self.tracer.record_span(
+            "supervisor.restart", t0, time.monotonic(),
+            attrs={"replica": f"replica{i}",
+                   "restarts": self.restarts[i]})
+        self.tracer.decision("restart", replica=f"replica{i}")
         self._publish_state()
         log.info("replica%d: restarted (attempt %d)", i,
                  self.restarts[i])
+        # The recovery snapshot: by restart time the ring holds the
+        # WHOLE chain (fault → detect → seize → requeue → restart) —
+        # the wedge-time snapshot necessarily ends at the seize.
+        self._flight_snapshot("restart", replica=i)
+
+    def _flight_snapshot(self, reason: str, replica: int) -> None:
+        rec = self.flight_recorder
+        if rec is None:
+            return
+        try:
+            rec.snapshot(reason,
+                         extra={"replica": f"replica{replica}",
+                                "states": self.states()})
+        except Exception:
+            # The recorder is evidence, not a dependency: a snapshot
+            # failure must never take down the healing plane.
+            log.exception("flight recorder snapshot (%s) failed",
+                          reason)
 
     def quiesce(self, timeout: float = 30.0,
                 poll_s: float = 0.02) -> bool:

@@ -21,18 +21,21 @@ from collections import deque
 from typing import List
 
 from .. import faults
+from ..obs import trace as obs_trace
 from .api import (DEADLINE_QUEUED_ERROR, Draining, GenerateRequest,
                   QueueFull)
 
 
 class AdmissionQueue:
     def __init__(self, max_depth: int = 64, retry_after_s: float = 1.0,
-                 registry=None):
+                 registry=None, tracer=None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = max_depth
         self.retry_after_s = retry_after_s
         self._registry = registry
+        self.tracer = (tracer if tracer is not None
+                       else obs_trace.get_tracer())
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._q: deque = deque()
@@ -58,9 +61,14 @@ class AdmissionQueue:
             if len(self._q) >= self.max_depth:
                 self.rejected_full += 1
                 raise QueueFull(len(self._q), self.retry_after_s)
+            req.enqueued_at = time.monotonic()
             self._q.append(req)
+            depth = len(self._q)
             self._gauge()
             self._nonempty.notify()
+        self.tracer.event("queue.enqueue", request_id=req.request_id,
+                          parent_id=req.trace_parent,
+                          attrs={"depth": depth})
 
     def get_many(self, n: int, timeout: float = 0.0
                  ) -> List[GenerateRequest]:
@@ -69,6 +77,7 @@ class AdmissionQueue:
         steps never stall on admission). Expired entries are shed here,
         failed with the error the HTTP layer maps to a 503."""
         out: List[GenerateRequest] = []
+        shed: List[GenerateRequest] = []
         with self._lock:
             if not self._q and timeout > 0:
                 self._nonempty.wait(timeout)
@@ -78,6 +87,7 @@ class AdmissionQueue:
                 if req.deadline <= now:
                     self.shed_expired += 1
                     req.fail(DEADLINE_QUEUED_ERROR)
+                    shed.append(req)
                     continue
                 out.append(req)
             # Popped requests are invisible to depth() but not yet in a
@@ -87,6 +97,23 @@ class AdmissionQueue:
             # finished" check must see it somewhere.
             self._inflight += len(out)
             self._gauge()
+        # Trace OUTSIDE the lock: span recording is lock-light but the
+        # queue lock is on the submit hot path.
+        tr = self.tracer
+        if tr.enabled:
+            for req in shed:
+                tr.event("queue.shed", request_id=req.request_id,
+                         parent_id=req.trace_parent,
+                         attrs={"reason": "deadline_queued"})
+                tr.decision("shed", request_id=req.request_id)
+            for req in out:
+                # The wait span covers (re-)enqueue → pop — the
+                # "queue" leg of the request's timeline. enqueued_at,
+                # not arrival: a requeued request's second wait must
+                # not swallow its failed first decode attempt.
+                tr.record_span("queue.wait", req.enqueued_at, now,
+                               request_id=req.request_id,
+                               parent_id=req.trace_parent)
         return out
 
     def requeue(self, req: GenerateRequest) -> None:
@@ -98,10 +125,14 @@ class AdmissionQueue:
         client-visible overload answer even while capacity exists —
         and a drain must finish admitted work, re-admitted included."""
         with self._lock:
+            req.enqueued_at = time.monotonic()
             self._q.appendleft(req)
             self.requeued += 1
             self._gauge()
             self._nonempty.notify()
+        self.tracer.event("queue.requeue", request_id=req.request_id,
+                          parent_id=req.trace_parent,
+                          attrs={"attempts": req.attempts})
 
     def mark_placed(self, n: int) -> None:
         """The batcher finished placing (or failing) n popped requests."""

@@ -61,6 +61,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import logging as obs_logging
+from ..obs import trace as obs_trace
 from .api import GenerateRequest
 
 log = logging.getLogger(__name__)
@@ -76,10 +78,12 @@ class ContinuousBatcher:
     def __init__(self, executor, queue, registry=None,
                  replica: str = "replica0", idle_wait_s: float = 0.05,
                  pipelined: Optional[bool] = None,
-                 crash_only: bool = False):
+                 crash_only: bool = False, tracer=None):
         self.executor = executor
         self.queue = queue
         self.registry = registry
+        self.tracer = (tracer if tracer is not None
+                       else obs_trace.get_tracer())
         self.replica = replica
         self.idle_wait_s = idle_wait_s
         self.pipelined = (bool(executor.pipelined) if pipelined is None
@@ -224,11 +228,27 @@ class ContinuousBatcher:
                 req.admitted_at = time.monotonic()
                 self._slots[i] = req
                 placed.append((i, req, vec))
+                if self.tracer.enabled:
+                    # `lands_at_step` is the step whose scatter applies
+                    # the row — in the pipelined loop that is by
+                    # construction one step after the retire that freed
+                    # the slot (the ISSUE 3 hand-off, visible in the
+                    # trace instead of only in a docstring).
+                    self.tracer.event(
+                        "batcher.admit", request_id=req.request_id,
+                        parent_id=req.trace_parent,
+                        attrs={"replica": self.replica, "slot": i,
+                               "lands_at_step": self.steps + 1,
+                               "pipelined": self.pipelined})
+                    self.tracer.decision(
+                        "admit", request_id=req.request_id,
+                        replica=self.replica, slot=i)
             except Exception as e:
                 # A request popped from the queue has exactly one owner
                 # now — losing it here would park its handler thread
                 # for the full deadline.
-                log.exception("batcher %s: admit failed", self.replica)
+                log.exception("batcher %s: admit failed (request %s)",
+                              self.replica, req.request_id)
                 if self._slots[i] is req:
                     self._slots[i] = None
                 req.fail(f"admission failed: {e}")
@@ -261,6 +281,12 @@ class ContinuousBatcher:
                         by=float(len(req.tokens)),
                         help="decoded tokens")
             req.finish()
+            self.tracer.event(
+                "batcher.retire", request_id=req.request_id,
+                parent_id=req.trace_parent,
+                attrs={"replica": self.replica,
+                       "tokens": len(req.tokens),
+                       "truncated": req.truncated})
         return finished
 
     def _admit(self) -> None:
@@ -319,16 +345,36 @@ class ContinuousBatcher:
                 if n_active == 0:
                     t_gap_start = None
                     continue
+                # One clock (time.monotonic) for metrics AND spans so
+                # the step segments share the axis every other span —
+                # and the fault plan's fired_at — lives on.
+                traced = self.tracer.enabled
+                rids = ([r.request_id for r in self._slots
+                         if r is not None] if traced else None)
+                t0 = time.monotonic()
                 if t_gap_start is not None:
-                    self._observe_gap(time.perf_counter() - t_gap_start)
-                t0 = time.perf_counter()
-                self.blocked_since = time.monotonic()
+                    self._observe_gap(t0 - t_gap_start)
+                    if traced:
+                        self.tracer.record_span(
+                            "step.host", t_gap_start, t0,
+                            attrs={"replica": self.replica,
+                                   "step": self.steps + 1,
+                                   "mode": "sync",
+                                   "request_ids": rids})
+                self.blocked_since = t0
                 y = np.asarray(self.executor.step(self._x), np.float32)
                 self.blocked_since = None
-                t1 = time.perf_counter()
+                t1 = time.monotonic()
                 t_gap_start = t1
                 self.steps += 1
                 self._observe_step(t1 - t0, n_active)
+                if traced:
+                    self.tracer.record_span(
+                        "step.device", t0, t1,
+                        attrs={"replica": self.replica,
+                               "step": self.steps, "mode": "sync",
+                               "n_active": n_active,
+                               "request_ids": rids})
                 with self._settle_lock:
                     if self._abandoned:
                         return
@@ -406,12 +452,18 @@ class ContinuousBatcher:
         self.blocked_since = None
         self._dirty.clear()
         self._prezeroed.clear()
-        prev = None  # (handle, slot snapshot) of the step in flight
+        # (handle, slot snapshot, step no, occupant rids) in flight.
+        # The rids list is computed ONCE per submitted step and shared
+        # by every span that names the step's occupants — the tracing
+        # budget is a handful of µs/step and list comprehensions over
+        # the slots are the first thing to amortize.
+        prev = None
         t_gap_start = None
         while not self._stop.is_set():
             try:
                 submitted = None
                 snapshot = None
+                admit_rids: List[str] = []
                 # Admission bookkeeping runs under the settle lock: a
                 # supervisor seize() serializes against it, so an
                 # abandoned batcher can never pop the queue again.
@@ -424,12 +476,13 @@ class ContinuousBatcher:
                     # an empty queue).
                     block = self.active == 0 and prev is None
                     updates = []
-                    for i, _req, vec in self._pop_admissions(block=block):
+                    for i, req, vec in self._pop_admissions(block=block):
                         # Admission overwrites the row, whatever its
                         # state.
                         self._dirty.discard(i)
                         self._prezeroed.discard(i)
                         updates.append((i, vec))
+                        admit_rids.append(req.request_id)
                     if self.active > 0:
                         # Freed-but-unadmitted slots get explicit zero
                         # rows: idle slots must be EXACTLY zero (the MoE
@@ -440,9 +493,6 @@ class ContinuousBatcher:
                         self._dirty.clear()
                         if prev is not None:
                             self._zero_ahead(updates, prev[1])
-                        if t_gap_start is not None:
-                            self._observe_gap(
-                                time.perf_counter() - t_gap_start)
                         snapshot = list(self._slots)
                 if snapshot is not None:
                     # Dispatch OUTSIDE the settle lock, under the
@@ -453,11 +503,36 @@ class ContinuousBatcher:
                     # watchdog. A seize landing between the lock and
                     # this dispatch only wastes one step: the retire
                     # path re-checks _abandoned before settling.
-                    self.blocked_since = time.monotonic()
+                    traced = self.tracer.enabled
+                    cur_rids = ([r.request_id for r in snapshot
+                                 if r is not None] if traced else None)
+                    ts0 = time.monotonic()
+                    if t_gap_start is not None:
+                        self._observe_gap(ts0 - t_gap_start)
+                        if traced:
+                            self.tracer.record_span(
+                                "step.host", t_gap_start, ts0,
+                                attrs={"replica": self.replica,
+                                       "step": self.steps + 1,
+                                       "mode": "pipelined",
+                                       "request_ids": cur_rids})
+                    self.blocked_since = ts0
                     handle = ex.submit(updates)  # step k dispatched
                     self.blocked_since = None
                     self.steps += 1
-                    submitted = (handle, snapshot)
+                    if traced:
+                        # `admits_landing` marks the ISSUE 3 hand-off:
+                        # these rows were freed at step k-1's retire
+                        # and land in step k+1's scatter — one step
+                        # later than the sync loop, by construction.
+                        self.tracer.record_span(
+                            "executor.submit", ts0, time.monotonic(),
+                            attrs={"replica": self.replica,
+                                   "step": self.steps,
+                                   "n_updates": len(updates),
+                                   "admits_landing": admit_rids or None,
+                                   "request_ids": cur_rids})
+                    submitted = (handle, snapshot, self.steps, cur_rids)
                 # Step k runs on the device while the host settles step
                 # k-1: collect its token ids and do retire bookkeeping.
                 # collect() is the one place a wedged device parks this
@@ -465,14 +540,28 @@ class ContinuousBatcher:
                 # with blocked_since published — the supervisor's
                 # watchdog can both see the wedge and seize around it.
                 if prev is not None:
-                    h_prev, snap_prev = prev
-                    tc = time.perf_counter()
-                    self.blocked_since = time.monotonic()
+                    h_prev, snap_prev, step_prev, prev_rids = prev
+                    tc = time.monotonic()
+                    self.blocked_since = tc
                     tokens = ex.collect(h_prev)
                     self.blocked_since = None
-                    t_done = time.perf_counter()
+                    t_done = time.monotonic()
                     n_prev = sum(1 for r in snap_prev if r is not None)
                     self._observe_step(t_done - tc, n_prev)
+                    if self.tracer.enabled and prev_rids is not None:
+                        dev = self.tracer.record_span(
+                            "step.device", tc, t_done,
+                            attrs={"replica": self.replica,
+                                   "step": step_prev,
+                                   "mode": "pipelined",
+                                   "n_active": n_prev,
+                                   "request_ids": prev_rids})
+                        self.tracer.record_span(
+                            "executor.collect", tc, t_done,
+                            parent_id=dev,
+                            attrs={"replica": self.replica,
+                                   "step": step_prev,
+                                   "request_ids": prev_rids})
                     with self._settle_lock:
                         if self._abandoned:
                             return
@@ -504,15 +593,24 @@ class ContinuousBatcher:
         for i, req in enumerate(self._slots):
             if req is not None:
                 req.fail(f"executor failed: {e}")
+                self.tracer.event(
+                    "batcher.fail", request_id=req.request_id,
+                    parent_id=req.trace_parent,
+                    attrs={"replica": self.replica,
+                           "error": str(e)[:200]})
                 self._slots[i] = None
                 self._x[i] = 0.0
 
     def _run(self) -> None:
         try:
-            if self.pipelined:
-                self._run_pipelined()
-            else:
-                self._run_sync()
+            # Every record this thread emits carries its replica (the
+            # JSON-lines ContextFilter stamps it) — request ids are
+            # bound per call site, the replica once here.
+            with obs_logging.context(replica=self.replica):
+                if self.pipelined:
+                    self._run_pipelined()
+                else:
+                    self._run_sync()
         except Exception as e:
             # crash_only loops re-raise here; the recorded failure and
             # the dead thread ARE the signal the supervisor keys on.

@@ -22,6 +22,14 @@ serving-specific contract on top:
                       key on: 503 while draining, 503 "degraded" while
                       live replicas < the pool's quorum, else 200
   GET /metrics        utils/metrics.Registry exposition
+  GET /debug/traces?request_id=...
+                      span tree for one request (obs/trace.py): queue
+                      wait → admit → per-step segments → retire, plus
+                      any supervisor recovery chain. Every generate
+                      response carries its id in X-Request-Id.
+  GET /debug/flight   on-demand flight-recorder snapshot (the same
+                      JSON the supervisor writes to disk on wedge/
+                      death/breaker — see docs/observability.md)
 
 SIGTERM drain (install_signal_handlers): stop admitting (everything new
 gets 503), let queued + in-flight requests finish, then — when a
@@ -40,9 +48,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+from ..obs.flight import FlightRecorder
 from ..utils.metrics import Registry
 from .api import (DEADLINE_QUEUED_ERROR, RETRIES_EXHAUSTED_ERROR,
                   Draining, QueueFull, GenerateRequest, encode_prompt)
@@ -65,20 +76,43 @@ class ServingServer:
                  retry_after_s: float = 1.0,
                  registry: Optional[Registry] = None,
                  drainer=None, node_name: Optional[str] = None,
-                 pool_opts: Optional[dict] = None):
+                 pool_opts: Optional[dict] = None,
+                 tracer=None, flight_dir: Optional[str] = None):
         # Per-server registry by default: tests and benches run several
         # servers in one process; sharing default_registry would blend
         # their series.
         self.registry = registry if registry is not None else Registry()
+        # The tracer is process-global by default (spans carry request
+        # ids and replica names, so cross-server series disambiguate by
+        # id) — faults and the fabric transport record into the same
+        # one, which is what puts an injected fault on the same
+        # timeline as the recovery that answers it.
+        self.tracer = (tracer if tracer is not None
+                       else obs_trace.get_tracer())
+        self.flight = FlightRecorder(tracer=self.tracer,
+                                     flight_dir=flight_dir,
+                                     registry=self.registry)
         self.queue = AdmissionQueue(max_depth=max_queue_depth,
                                     retry_after_s=retry_after_s,
-                                    registry=self.registry)
+                                    registry=self.registry,
+                                    tracer=self.tracer)
         # pool_opts passes supervision knobs through (supervise,
         # watchdog_s, max_attempts, quorum, backoff/breaker tuning) —
         # the pool's defaults are the production contract.
+        opts = dict(pool_opts or {})
+        opts.setdefault("tracer", self.tracer)
+        opts.setdefault("flight_recorder", self.flight)
         self.pool = ReplicaPool(executors, self.queue,
-                                registry=self.registry,
-                                **(pool_opts or {}))
+                                registry=self.registry, **opts)
+        # serving_trace_dropped_total is published as a DELTA against
+        # the tracer's monotonic drop count at scrape time; init the
+        # series so a zero-drop run still proves the bound exists.
+        self._trace_dropped_pub = 0
+        self._trace_pub_lock = threading.Lock()
+        self.registry.counter_inc(
+            "serving_trace_dropped_total", by=0.0,
+            help="spans dropped by the tracer's bounded buffers "
+                 "(per-thread overflow + ring eviction)")
         self.default_max_tokens = default_max_tokens
         self.max_tokens_cap = max_tokens_cap
         self.default_deadline_s = default_deadline_s
@@ -157,6 +191,29 @@ class ServingServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
+                parsed = urlparse(self.path)
+                if parsed.path == "/debug/traces":
+                    # Span tree for one request: queue → admit →
+                    # per-step → retire (+ any recovery chain), JSON.
+                    rid = (parse_qs(parsed.query)
+                           .get("request_id", [None])[0])
+                    if not rid:
+                        return self._send(
+                            400, {"error": "need ?request_id="})
+                    tree = server_ref.tracer.span_tree(rid)
+                    if tree["span_count"] == 0:
+                        return self._send(
+                            404, {"error": f"no spans for request "
+                                           f"{rid!r} (evicted or "
+                                           f"unknown)"})
+                    return self._send(200, tree)
+                if parsed.path == "/debug/flight":
+                    # On-demand flight snapshot: same payload the
+                    # supervisor writes on wedge/death/breaker, served
+                    # without touching disk.
+                    return self._send(
+                        200, server_ref.flight.snapshot(
+                            "on_demand", write=False))
                 self._send(404, {"error": "not found"})
 
             def do_POST(self):
@@ -291,6 +348,22 @@ class ServingServer:
                     name, round(est, 6),
                     help=f"estimated q={q} of serving_request_seconds "
                          f"(ok outcomes)")
+        # The ring bound, proven: spans lost to either tracer bound
+        # (per-thread overflow, ring eviction) surface as a counter —
+        # published as the delta since the last scrape so the series
+        # stays monotonic per server. Read-modify-write under a lock:
+        # each connection gets its own handler thread, so two
+        # concurrent /metrics scrapes would otherwise both see the
+        # same delta and double-count the drops.
+        with self._trace_pub_lock:
+            dropped = self.tracer.dropped_total()
+            delta = dropped - self._trace_dropped_pub
+            self._trace_dropped_pub = dropped
+        if delta > 0:
+            self.registry.counter_inc(
+                "serving_trace_dropped_total", by=float(delta),
+                help="spans dropped by the tracer's bounded buffers "
+                     "(per-thread overflow + ring eviction)")
         # Per-replica host-gap share of the decode loop: the overlap
         # number an operator watches — near 0 means host scheduling
         # hides behind device steps; climbing toward 1 means the device
@@ -310,7 +383,8 @@ class ServingServer:
 
     def _finish(self, handler, code: int, body: dict, outcome: str,
                 headers: Optional[dict] = None,
-                elapsed_s: Optional[float] = None) -> None:
+                elapsed_s: Optional[float] = None,
+                req: Optional[GenerateRequest] = None) -> None:
         self.registry.counter_inc(
             "serving_requests_total", {"code": str(code),
                                        "outcome": outcome},
@@ -320,6 +394,15 @@ class ServingServer:
                 "serving_request_seconds", elapsed_s,
                 {"outcome": outcome},
                 help="end-to-end request wall time")
+        if req is not None:
+            # Every response for a request that got an id carries it —
+            # the handle a client quotes to /debug/traces.
+            headers = dict(headers or {})
+            headers["X-Request-Id"] = req.request_id
+            span = getattr(req, "_root_span", None)
+            if span is not None:
+                self.tracer.finish(span, attrs={"outcome": outcome,
+                                                "code": code})
         handler._send(code, body, headers)
 
     def handle_generate(self, handler, raw: bytes) -> None:
@@ -370,6 +453,16 @@ class ServingServer:
 
         req = GenerateRequest(prompt_vec=vec, max_tokens=max_tokens,
                               deadline=t0 + deadline_ms / 1000.0)
+        # Root span of the request's trace: every downstream span
+        # (queue, admit, retire, supervisor requeue) parents onto it
+        # through req.trace_parent; _finish closes it with the outcome.
+        span = self.tracer.start(
+            "request", request_id=req.request_id,
+            attrs={"max_tokens": max_tokens,
+                   "deadline_ms": deadline_ms})
+        if not obs_trace.is_noop(span):
+            req.trace_parent = span.span_id
+            req._root_span = span
         try:
             self.queue.submit(req)
         except QueueFull as e:
@@ -377,19 +470,21 @@ class ServingServer:
                 handler, 503,
                 {"error": "overloaded: admission queue full",
                  "queue_depth": e.depth}, "queue_full",
-                {"Retry-After": str(max(1, int(round(e.retry_after_s))))})
+                {"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+                req=req)
         except Draining:
             return self._finish(handler, 503, {"error": "draining"},
-                                "draining", retry)
+                                "draining", retry, req=req)
         except Exception as e:
             # Anything else out of the admission path (a poisoned
             # queue, an injected fault) must cost THIS request a JSON
             # 500, not the connection — the plane keeps serving.
-            log.exception("generate: admission failed")
+            log.exception("generate: admission failed (request %s)",
+                          req.request_id)
             return self._finish(
                 handler, 500,
                 {"error": f"internal: admission failed: {e}"}, "error",
-                elapsed_s=time.monotonic() - t0)
+                elapsed_s=time.monotonic() - t0, req=req)
 
         # The handler thread parks on the request event; the batcher
         # completes it. Grace past the deadline covers the final step +
@@ -400,7 +495,7 @@ class ServingServer:
             req.fail("scheduler wedged")  # unparks nothing; marks it
             return self._finish(handler, 500,
                                 {"error": "internal: request lost"},
-                                "lost", elapsed_s=elapsed)
+                                "lost", elapsed_s=elapsed, req=req)
         if req.error is not None:
             shed = req.error == DEADLINE_QUEUED_ERROR
             code = 503 if shed else 500
@@ -415,13 +510,13 @@ class ServingServer:
             return self._finish(handler, code, {"error": req.error},
                                 outcome,
                                 retry if code == 503 else None,
-                                elapsed_s=elapsed)
+                                elapsed_s=elapsed, req=req)
         self._finish(handler, 200, {
             "id": req.request_id,
             "tokens": req.tokens,
             "truncated": req.truncated,
             "timings": req.timings_ms(),
-        }, "ok", elapsed_s=elapsed)
+        }, "ok", elapsed_s=elapsed, req=req)
 
     def _prompt_vec(self, body: dict) -> np.ndarray:
         if "prompt_vec" in body:

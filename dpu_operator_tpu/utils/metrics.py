@@ -173,35 +173,54 @@ class Registry:
                     for key, state in self._hists.get(name, {}).items()}
 
     def render(self) -> str:
-        lines: List[str] = []
+        # Snapshot-then-format: the lock is held ONLY to copy the
+        # series state, never while formatting. Formatting calls
+        # str()/escape on arbitrary label values and builds a string
+        # proportional to the whole registry — held under the lock, a
+        # slow scraper (or merely a big registry) would stall every
+        # hot-path observe()/counter_inc() in the batcher for the full
+        # render (regression-tested in tests/test_obs.py with a
+        # deliberately slow label __str__).
         with self._lock:
-            for name, series in sorted(self._counters.items()):
-                if self._help.get(name):
-                    lines.append(f"# HELP {name} {self._help[name]}")
-                lines.append(f"# TYPE {name} counter")
-                for key, val in sorted(series.items()):
-                    lines.append(f"{name}{_fmt_labels(key)} {val}")
-            for name, series in sorted(self._gauges.items()):
-                if self._help.get(name):
-                    lines.append(f"# HELP {name} {self._help[name]}")
-                lines.append(f"# TYPE {name} gauge")
-                for key, val in sorted(series.items()):
-                    lines.append(f"{name}{_fmt_labels(key)} {val}")
-            for name, series in sorted(self._hists.items()):
-                if self._help.get(name):
-                    lines.append(f"# HELP {name} {self._help[name]}")
-                lines.append(f"# TYPE {name} histogram")
-                bs = self._hist_buckets.get(name, _BUCKETS)
-                for key, state in sorted(series.items()):
-                    for i, b in enumerate(bs):
-                        bl = key + (("le", _fmt_bucket_bound(b)),)
-                        lines.append(
-                            f"{name}_bucket{_fmt_labels(bl)} {state['buckets'][i]}"
-                        )
-                    bl = key + (("le", "+Inf"),)
-                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {state['count']}")
-                    lines.append(f"{name}_sum{_fmt_labels(key)} {state['sum']}")
-                    lines.append(f"{name}_count{_fmt_labels(key)} {state['count']}")
+            counters = {n: dict(s) for n, s in self._counters.items()}
+            gauges = {n: dict(s) for n, s in self._gauges.items()}
+            hists = {
+                n: {key: (list(st["buckets"]), st["sum"], st["count"])
+                    for key, st in s.items()}
+                for n, s in self._hists.items()
+            }
+            helps = dict(self._help)
+            hist_buckets = dict(self._hist_buckets)
+
+        lines: List[str] = []
+        for name, series in sorted(counters.items()):
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} counter")
+            for key, val in sorted(series.items()):
+                lines.append(f"{name}{_fmt_labels(key)} {val}")
+        for name, series in sorted(gauges.items()):
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} gauge")
+            for key, val in sorted(series.items()):
+                lines.append(f"{name}{_fmt_labels(key)} {val}")
+        for name, series in sorted(hists.items()):
+            if helps.get(name):
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} histogram")
+            bs = hist_buckets.get(name, _BUCKETS)
+            for key, (bucket_counts, total, count) in sorted(
+                    series.items()):
+                for i, b in enumerate(bs):
+                    bl = key + (("le", _fmt_bucket_bound(b)),)
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(bl)} {bucket_counts[i]}"
+                    )
+                bl = key + (("le", "+Inf"),)
+                lines.append(f"{name}_bucket{_fmt_labels(bl)} {count}")
+                lines.append(f"{name}_sum{_fmt_labels(key)} {total}")
+                lines.append(f"{name}_count{_fmt_labels(key)} {count}")
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,37 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl008_nm.py
+"""GL008 near-misses that must stay silent: a request-scoped log that
+carries req.request_id as a message arg, one that binds context via
+extra= (the JSON-lines formatter's field channel), and replica-
+LIFECYCLE logging outside the request-scoped call graph — "replica
+restarted" describes a replica, not a request, and must not be forced
+to invent one."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class Batcher:
+    def _pop_admissions(self, free):
+        for req in free:
+            try:
+                self._place(req)
+            except Exception:
+                # rid in the message args: grep-by-request works.
+                log.exception("batcher %s: admit failed (request %s)",
+                              self.replica, req.request_id)
+
+    def _settle(self, req):
+        if req.done:
+            # extra= carries the id into the JSON line's fields.
+            log.warning("evicting abandoned slot",
+                        extra={"request_id": req.request_id})
+        return req.done
+
+    def _run(self):
+        # Replica lifecycle, not request-scoped: no request exists to
+        # bind, and the function is outside the request-scoped graph.
+        log.error("batcher %s: replica failed; awaiting supervision",
+                  self.replica)
+
+    def _place(self, req):
+        raise NotImplementedError

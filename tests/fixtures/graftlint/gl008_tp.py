@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl008_tp.py
+"""GL008 true positives: log lines on the request path that bind no
+request id — the pre-ISSUE-6 serving-plane shape, where an admission
+failure logged only the replica name and the one fact that mattered
+(WHICH request) was discarded at the moment it existed. Two findings:
+one directly in a request-scoped root, one in a helper reachable from
+it."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class Batcher:
+    def _pop_admissions(self, free):
+        for req in free:
+            try:
+                self._place(req)
+            except Exception:
+                # TP 1: request-scoped, no request id anywhere.
+                log.exception("batcher %s: admit failed", self.replica)
+
+    def _settle(self, req):
+        if req.done:
+            self._evict(req)
+        return req.done
+
+    def _evict(self, req):
+        # TP 2: reachable from _settle (request-scoped), still only
+        # replica context.
+        log.warning("evicting abandoned slot on %s", self.replica)
+
+    def _place(self, req):
+        raise NotImplementedError

@@ -24,6 +24,7 @@ the scheduler-plane cases immune to CI-box noise; the LocalExecutor
 cases prove the same contracts over the real jitted model.
 """
 
+import json
 import time
 import urllib.request
 from collections import Counter
@@ -32,6 +33,8 @@ import pytest
 
 from dpu_operator_tpu import faults
 from dpu_operator_tpu.faults import FaultError, FaultPlan, FaultyExecutor
+from dpu_operator_tpu.obs import FlightRecorder
+from dpu_operator_tpu.obs import trace as obs_trace
 from dpu_operator_tpu.serving import (AdmissionQueue, GenerateRequest,
                                       LocalExecutor, ReplicaPool,
                                       ServingServer, SyntheticExecutor,
@@ -81,8 +84,11 @@ def _reqs(n, d, toks, prefix="chaos", deadline_s=60.0):
 
 
 def _run_pool(executors, reqs, *, registry=None, watchdog_s=0.25,
-              timeout=20.0, **pool_kw):
+              timeout=20.0, flight_dir=None, **pool_kw):
     q = AdmissionQueue(max_depth=len(reqs) + 1)
+    if flight_dir is not None:
+        pool_kw["flight_recorder"] = FlightRecorder(
+            flight_dir=str(flight_dir))
     pool = ReplicaPool(executors, q, registry=registry,
                        watchdog_s=watchdog_s, restart_backoff_s=0.01,
                        poll_s=0.005, **pool_kw)
@@ -301,6 +307,78 @@ def test_collect_hang_watchdog_detects_within_deadline(settle_counts):
     assert time.perf_counter() - t0 < CASE_BUDGET_S, recovery_s
 
 
+# -- flight recorder: the chaos post-mortem artifact (ISSUE 6) ----------------
+
+
+def _flight_spans(flight_dir, reason):
+    files = sorted(flight_dir.glob(f"flight-{reason}-*.json"))
+    assert files, (f"no flight snapshot for reason={reason!r} in "
+                   f"{sorted(p.name for p in flight_dir.iterdir())}")
+    return json.loads(files[-1].read_text())["spans"]
+
+
+def _assert_recovery_chain(spans, fault_point):
+    """The injected fault's span event plus the recovery chain, on one
+    monotonic timeline, with the exactly-once requeue VISIBLE in the
+    trace (not only in the settle counter)."""
+
+    def first(name, **match):
+        for s in spans:
+            if s["name"] == name and all(
+                    s["attrs"].get(k) == v for k, v in match.items()):
+                return s
+        return None
+
+    fault = first("fault.fired", site=fault_point)
+    detect = first("supervisor.detect")
+    seize = first("supervisor.seize")
+    restart = first("supervisor.restart")
+    assert fault, f"fault.fired({fault_point}) missing from snapshot"
+    assert detect and seize and restart, (
+        "recovery chain incomplete: detect=%s seize=%s restart=%s"
+        % (bool(detect), bool(seize), bool(restart)))
+    assert (fault["t0"] <= detect["t0"] <= seize["t0"]
+            <= restart["t0"]), "timeline out of order"
+    requeued = [s for s in spans if s["name"] == "supervisor.requeue"
+                and s["attrs"]["outcome"] == "requeued"]
+    rids = [s["request_id"] for s in requeued]
+    assert len(rids) == len(set(rids)), (
+        f"requeue not exactly-once in the trace: {rids}")
+    assert set(rids) == set(seize["attrs"]["request_ids"]), (
+        "every seized request must appear exactly once in the requeue "
+        "chain")
+
+
+def test_step_hang_flight_recorder_timeline(tmp_path, settle_counts):
+    """ISSUE 6 acceptance: an injected step-hang produces a
+    flight-recorder snapshot whose timeline shows fault firing →
+    watchdog wedge detection → seize → requeue → restart."""
+    t0 = time.perf_counter()
+    with obs_trace.scoped():
+        with faults.injected() as plan:
+            plan.inject("fr0.step", hang_s=1.2, at_calls=[3])
+            ex0 = FaultyExecutor(SyntheticExecutor(slots=2, d=8, seed=5),
+                                 site="fr0")
+            ex1 = SyntheticExecutor(slots=2, d=8, seed=5)
+            reqs = _reqs(8, 8, 5)
+            pool, _q = _run_pool([ex0, ex1], reqs, timeout=10.0,
+                                 flight_dir=tmp_path)
+            try:
+                _wait(lambda: pool.live_count() == 2,
+                      msg="wedged replica recovered")
+                assert sum(pool.restarts) >= 1
+            finally:
+                pool.stop()
+    # The wedge-time snapshot captured the evidence at detection...
+    assert sorted(tmp_path.glob("flight-wedged-*.json"))
+    # ...and the restart-time snapshot holds the whole chain.
+    _assert_recovery_chain(_flight_spans(tmp_path, "restart"),
+                           "fr0.step")
+    assert all(r.error is None for r in reqs)
+    assert set(settle_counts.values()) == {1}
+    assert time.perf_counter() - t0 < CASE_BUDGET_S
+
+
 # -- the chaos matrix ---------------------------------------------------------
 
 _SYNTH_CASES = [
@@ -329,11 +407,13 @@ def _arm(plan, site, fault, at_call=3):
 
 @pytest.mark.parametrize("mode,fault", _SYNTH_CASES,
                          ids=[f"{m}-{f}" for m, f in _SYNTH_CASES])
-def test_chaos_matrix_synthetic(mode, fault, settle_counts):
+def test_chaos_matrix_synthetic(mode, fault, settle_counts, tmp_path):
     """Each injection point × loop shape over SyntheticExecutor: the
     pool recovers to full strength, requeued requests complete with
-    the uninjected run's token streams, nothing settles twice, and
-    the whole case fits its wall budget."""
+    the uninjected run's token streams, nothing settles twice, the
+    whole case fits its wall budget — and (ISSUE 6) the flight
+    recorder wrote a snapshot containing the injected fault's span
+    event plus the recovery chain, exactly-once requeue included."""
     t0 = time.perf_counter()
     pipelined = mode == "pipelined"
 
@@ -351,7 +431,9 @@ def test_chaos_matrix_synthetic(mode, fault, settle_counts):
                  SyntheticExecutor(slots=2, d=8, seed=5,
                                    pipelined=pipelined)]
         reqs = _reqs(8, 8, 5)
-        pool, _q = _run_pool(execs, reqs, timeout=10.0)
+        pool, _q = _run_pool(
+            execs, reqs, timeout=10.0,
+            flight_dir=tmp_path if inject else None)
         try:
             if inject:
                 _wait(lambda: pool.live_count() == 2,
@@ -362,13 +444,16 @@ def test_chaos_matrix_synthetic(mode, fault, settle_counts):
         return [(r.error, list(r.tokens)) for r in reqs]
 
     baseline = run(inject=False)
-    with faults.injected() as plan:
-        _arm(plan, "r0dev" if fault == "worker-step-raise" else "r0",
-             fault)
-        injected = run(inject=True)
+    site = "r0dev" if fault == "worker-step-raise" else "r0"
+    with obs_trace.scoped():
+        with faults.injected() as plan:
+            _arm(plan, site, fault)
+            injected = run(inject=True)
     assert all(e is None for e, _ in injected), injected
     assert injected == baseline
     assert set(settle_counts.values()) == {1}
+    _assert_recovery_chain(_flight_spans(tmp_path, "restart"),
+                           f"{site}.{_FAULT_POINT[fault]}")
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 

@@ -1,0 +1,514 @@
+"""Observability plane (ISSUE 6): tracer, flight recorder, JSON-lines
+logging, the /metrics snapshot-render fix, and the end-to-end span-tree
+acceptance over the real serving plane.
+
+Layers:
+  * tracer unit contracts — lock-light per-thread buffers drained into
+    a bounded ring, drop accounting on BOTH bounds, implicit parenting,
+    disabled == near-free no-op;
+  * flight recorder — bounded snapshot files, pruning, span-tail cap;
+  * structured logging — JSON lines carrying request_id/replica/
+    component via extra= and thread-local context;
+  * the satellite regression: /metrics render must never hold the
+    registry lock while formatting (a slow scraper must not stall the
+    batcher's hot-path observe());
+  * ISSUE 6 acceptance: GET /debug/traces?request_id= returns a span
+    tree covering queue→admit→per-step→retire for a completed request
+    in sync AND pipelined modes, on Synthetic AND real jitted Local
+    executors; every response carries X-Request-Id.
+
+The whole lane asserts its own wall budget at the end (docs/ci.md).
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from io import StringIO
+
+import pytest
+
+from dpu_operator_tpu import faults
+from dpu_operator_tpu.obs import FlightRecorder, Tracer
+from dpu_operator_tpu.obs import logging as obs_logging
+from dpu_operator_tpu.obs import trace as obs_trace
+from dpu_operator_tpu.serving import ServingServer, SyntheticExecutor
+from dpu_operator_tpu.utils.metrics import Registry
+
+# Lane clock starts when the FIRST test in this module RUNS — not at
+# import: pytest imports every module during collection, so an
+# import-time stamp would charge this lane for every suite that runs
+# before it in a full tier-1 pass.
+_LANE_T0: list = []
+
+
+@pytest.fixture(autouse=True)
+def _lane_clock():
+    if not _LANE_T0:
+        _LANE_T0.append(time.perf_counter())
+    yield
+
+MODEL = dict(S=1, d=8, h=8, E=1)
+
+
+# -- tracer unit contracts ----------------------------------------------------
+
+
+def test_span_nesting_and_explicit_parenting():
+    tr = Tracer()
+    with tr.span("outer", request_id="r1") as outer:
+        with tr.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+        ev_id = tr.event("mark", request_id="r1",
+                         parent_id=outer.span_id, attrs={"k": 1})
+    spans = tr.spans_snapshot()
+    # Snapshot order is start-time order.
+    assert [s.name for s in spans] == ["outer", "inner", "mark"]
+    mark = next(s for s in spans if s.span_id == ev_id)
+    assert mark.kind == "event" and mark.t0 == mark.t1
+    tree = tr.span_tree("r1")
+    assert tree["span_count"] == 2  # outer + mark; inner has no rid
+
+
+def test_cross_thread_parenting_via_explicit_parent_id():
+    tr = Tracer()
+    root = tr.start("request", request_id="r2")
+    done = threading.Event()
+
+    def worker():
+        tr.event("child", request_id="r2", parent_id=root.span_id)
+        done.set()
+
+    threading.Thread(target=worker, daemon=True).start()
+    assert done.wait(2.0)
+    tr.finish(root)
+    tree = tr.span_tree("r2")
+    assert tree["tree"][0]["name"] == "request"
+    assert [c["name"] for c in tree["tree"][0]["children"]] == ["child"]
+
+
+def test_request_ids_attr_links_shared_spans_into_tree():
+    """A decode step serves many requests at once: it carries their ids
+    in a request_ids attr and the query attaches it to each occupant's
+    tree as a linked child."""
+    tr = Tracer()
+    root = tr.start("request", request_id="r3")
+    tr.finish(root)
+    tr.record_span("step.device", 1.0, 2.0,
+                   attrs={"request_ids": ["r3", "other"]})
+    tree = tr.span_tree("r3")
+    (req_root,) = tree["tree"]
+    assert [c["name"] for c in req_root["children"]] == ["step.device"]
+    assert req_root["children"][0]["linked"] is True
+    # The other occupant sees the same span in ITS tree.
+    assert tr.span_tree("other")["span_count"] == 1
+
+
+def test_ring_bound_and_dropped_counter():
+    tr = Tracer(capacity=8, per_thread_cap=4)
+    for i in range(10):
+        tr.event(f"e{i}")
+    # Per-thread cap 4: six events never made the buffer.
+    assert tr.dropped_total() == 6
+    assert len(tr.spans_snapshot()) == 4
+    # Now overflow the ring itself: drain between records so the
+    # per-thread buffer never fills.
+    for i in range(10):
+        tr.event(f"ring{i}")
+        tr.drain()
+    assert len(tr.spans_snapshot()) == 8  # ring capacity
+    assert tr.dropped_total() == 6 + 6   # 4 + 10 events into a ring of 8
+
+
+def test_dead_thread_buffers_drain_and_prune():
+    tr = Tracer()
+
+    def worker():
+        tr.event("from-dead-thread")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    spans = tr.spans_snapshot()
+    assert [s.name for s in spans] == ["from-dead-thread"]
+    # The dead thread's (now empty) buffer is pruned from the registry.
+    with tr._lock:
+        assert all(b.thread.is_alive() for b in tr._bufs)
+
+
+def test_metrics_only_scrape_path_prunes_dead_thread_buffers():
+    """A production server scraped ONLY via /metrics never calls
+    spans_snapshot() — dropped_total() (the scrape path's one tracer
+    read) must drain too, or every finished connection thread leaks a
+    _ThreadBuf in tr._bufs forever."""
+    tr = Tracer()
+    for i in range(8):
+        t = threading.Thread(target=lambda: tr.event("conn-span"))
+        t.start()
+        t.join()
+    with tr._lock:
+        n_before = len(tr._bufs)
+    assert n_before == 8  # one registered buffer per dead thread
+    assert tr.dropped_total() == 0
+    with tr._lock:
+        assert not tr._bufs  # drained into the ring AND pruned
+    assert len(tr.spans_snapshot()) == 8  # spans survived the prune
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer()
+    tr.enabled = False
+    with tr.span("s") as sp:
+        assert obs_trace.is_noop(sp)
+    assert tr.event("e") is None
+    assert tr.record_span("r", 0.0, 1.0) is None
+    tr.decision("d")
+    tr.enabled = True
+    assert tr.spans_snapshot() == []
+    assert tr.decisions_snapshot() == []
+
+
+def test_scoped_tracer_installs_and_restores():
+    before = obs_trace.get_tracer()
+    with obs_trace.scoped() as tr:
+        assert obs_trace.get_tracer() is tr is not before
+        obs_trace.event("inside")
+        assert [s.name for s in tr.spans_snapshot()] == ["inside"]
+    assert obs_trace.get_tracer() is before
+
+
+def test_decision_log_is_bounded():
+    tr = Tracer(decision_cap=4)
+    for i in range(10):
+        tr.decision("admit", slot=i)
+    decs = tr.decisions_snapshot()
+    assert len(decs) == 4 and decs[-1]["slot"] == 9
+
+
+def test_fault_firing_becomes_span_event():
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            plan.inject("obs.site", exc=faults.FaultError, at_calls=[1])
+            with pytest.raises(faults.FaultError):
+                faults.fire("obs.site")
+        (ev,) = [s for s in tr.spans_snapshot()
+                 if s.name == "fault.fired"]
+        assert ev.attrs["site"] == "obs.site"
+        assert ev.attrs["behavior"] == "raise"
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_snapshot_writes_bounded_pruned_files(tmp_path):
+    with obs_trace.scoped() as tr:
+        for i in range(10):
+            tr.event(f"pre{i}")
+        rec = FlightRecorder(flight_dir=str(tmp_path), keep=3,
+                             max_spans=5)
+        paths = [rec.snapshot(f"test{i}")["path"] for i in range(5)]
+        assert all(p for p in paths)
+        files = sorted(tmp_path.glob("flight-*.json"))
+        assert len(files) == 3  # pruned to keep
+        data = json.loads(files[-1].read_text())
+        assert data["reason"] == "test4"
+        assert len(data["spans"]) == 5  # max_spans tail
+        assert data["spans_truncated"] == 5
+        # The tail is the RECENT end of the ring.
+        assert data["spans"][-1]["name"] == "pre9"
+
+
+def test_flight_snapshot_on_demand_no_write(tmp_path):
+    with obs_trace.scoped() as tr:
+        tr.event("x")
+        rec = FlightRecorder(flight_dir=str(tmp_path))
+        data = rec.snapshot("on_demand", write=False)
+        assert "path" not in data and len(data["spans"]) == 1
+        assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_counts_snapshots_in_registry(tmp_path):
+    reg = Registry()
+    with obs_trace.scoped():
+        rec = FlightRecorder(flight_dir=str(tmp_path), registry=reg)
+        rec.snapshot("wedged", write=False)
+    assert reg.counter_value("serving_flight_snapshots_total",
+                             {"reason": "wedged"}) == 1.0
+
+
+# -- structured logging -------------------------------------------------------
+
+
+def _emit_json_line(emit):
+    buf = StringIO()
+    root = logging.getLogger()
+    prev_level = root.level
+    handler = obs_logging.setup("testcomp", stream=buf)
+    try:
+        emit(logging.getLogger("obs.under.test"))
+    finally:
+        root.removeHandler(handler)
+        root.setLevel(prev_level)
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    return lines
+
+
+def test_json_lines_formatter_fields():
+    (line,) = _emit_json_line(
+        lambda log: log.warning("hello %s", "world",
+                                extra={"request_id": "abc123"}))
+    assert line["msg"] == "hello world"
+    assert line["level"] == "WARNING"
+    assert line["component"] == "testcomp"
+    assert line["request_id"] == "abc123"
+    assert line["logger"] == "obs.under.test"
+    assert "replica" not in line  # absent != empty
+
+
+def test_context_binding_stamps_thread_records():
+    def emit(log):
+        with obs_logging.context(replica="replica7"):
+            log.info("inside")
+            # Explicit extra= wins over the bound context.
+            log.info("explicit", extra={"replica": "replica9"})
+        log.info("outside")
+
+    inside, explicit, outside = _emit_json_line(emit)
+    assert inside["replica"] == "replica7"
+    assert explicit["replica"] == "replica9"
+    assert "replica" not in outside
+
+
+def test_exception_lands_in_exc_field():
+    def emit(log):
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            log.exception("failed", extra={"request_id": "r"})
+
+    (line,) = _emit_json_line(emit)
+    assert "ValueError: boom" in line["exc"]
+    # The line itself is still one parseable JSON object (the whole
+    # point of the format).
+    assert "\n" not in json.dumps(line["msg"])
+
+
+# -- satellite: /metrics render must not hold the lock while formatting -------
+
+
+class _SlowLabel(str):
+    started = threading.Event()
+
+    def __str__(self):
+        _SlowLabel.started.set()
+        time.sleep(0.5)
+        return "slow-" + super().__str__()
+
+
+def test_slow_scraper_does_not_stall_hot_path_observe():
+    """Regression (pre-fix failure): render() formatted inside the
+    registry lock, so a scrape that was slow to stringify (or merely a
+    big registry) blocked every batcher-thread observe() for the full
+    render. Render now snapshots under the lock and formats outside:
+    an observe() racing a 0.5 s-slow render completes in
+    milliseconds."""
+    _SlowLabel.started.clear()
+    reg = Registry()
+    reg.gauge_set("obs_slow_gauge", 1.0, {"l": _SlowLabel("x")})
+    reg.observe("obs_hot_hist", 0.5)
+
+    rendered = {}
+
+    def scrape():
+        rendered["out"] = reg.render()
+
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    assert _SlowLabel.started.wait(2.0), "render never reached the label"
+    t0 = time.perf_counter()
+    reg.observe("obs_hot_hist", 0.7)
+    reg.counter_inc("obs_hot_counter")
+    blocked = time.perf_counter() - t0
+    t.join(timeout=5.0)
+    assert blocked < 0.2, (
+        f"hot-path observe blocked {blocked:.3f}s behind a slow scrape")
+    assert 'l="slow-x"' in rendered["out"]
+
+
+# -- acceptance: span trees over the real serving plane -----------------------
+
+
+def _post(url, body, timeout=15):
+    data = json.dumps(body).encode()
+    r = urllib.request.urlopen(
+        urllib.request.Request(url + "/v1/generate", data=data),
+        timeout=timeout)
+    return r, json.loads(r.read())
+
+
+def _get_json(url, timeout=5):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _tree_names(tree):
+    names = []
+
+    def walk(n):
+        names.append(n["name"])
+        for c in n["children"]:
+            walk(c)
+
+    for n in tree["tree"]:
+        walk(n)
+    return names
+
+
+# queue→admit→per-step→retire: the ISSUE 6 acceptance span chain.
+_REQUIRED = {"request", "queue.enqueue", "queue.wait", "batcher.admit",
+             "step.device", "batcher.retire"}
+
+
+def _assert_trace_contract(srv, pipelined: bool):
+    r, body = _post(srv.url, {"prompt": "trace-me", "max_tokens": 4,
+                              "deadline_ms": 20000})
+    rid = body["id"]
+    assert r.headers.get("X-Request-Id") == rid
+    code, tree = _get_json(
+        srv.url + f"/debug/traces?request_id={rid}")
+    assert code == 200
+    names = _tree_names(tree)
+    missing = _REQUIRED - set(names)
+    assert not missing, f"span tree missing {missing}: {names}"
+    if pipelined:
+        assert "executor.submit" in names
+        assert "executor.collect" in names
+    # One root: the request span, carrying the outcome.
+    (root,) = tree["tree"]
+    assert root["name"] == "request"
+    assert root["attrs"]["outcome"] == "ok"
+    assert root["attrs"]["code"] == 200
+    # Steps are ordered inside the request window and admit precedes
+    # retire.
+    by_name = {}
+    for n in root["children"]:
+        by_name.setdefault(n["name"], []).append(n)
+    admit = by_name["batcher.admit"][0]
+    retire = by_name["batcher.retire"][0]
+    assert admit["t0"] <= retire["t0"]
+    assert admit["attrs"]["pipelined"] is pipelined
+    # Every decode step span names this request as an occupant.
+    for step in by_name["step.device"]:
+        assert rid in step["attrs"]["request_ids"]
+    return tree
+
+
+@pytest.mark.parametrize("pipelined", [False, True],
+                         ids=["sync", "pipelined"])
+def test_debug_traces_synthetic(pipelined):
+    with obs_trace.scoped():
+        srv = ServingServer(
+            [SyntheticExecutor(slots=2, d=8, step_time_s=0.002,
+                               pipelined=pipelined)]).start()
+        try:
+            _assert_trace_contract(srv, pipelined)
+        finally:
+            srv.stop()
+
+
+@pytest.fixture(scope="module")
+def local_executors():
+    """One compiled LocalExecutor per mode (compile cost dominates;
+    reuse across tests is safe — each pool reset()s at start)."""
+    from dpu_operator_tpu.serving import LocalExecutor
+
+    return {"sync": LocalExecutor(slots=2, mode="sync", **MODEL),
+            "pipelined": LocalExecutor(slots=2, mode="pipelined",
+                                       **MODEL)}
+
+
+@pytest.mark.parametrize("mode", ["sync", "pipelined"])
+def test_debug_traces_local_jitted(mode, local_executors):
+    """The same queue→admit→per-step→retire tree over the REAL jitted
+    model — the trace layer must not depend on the synthetic double."""
+    with obs_trace.scoped():
+        srv = ServingServer([local_executors[mode]]).start()
+        try:
+            _assert_trace_contract(srv, mode == "pipelined")
+        finally:
+            srv.stop()
+
+
+def test_debug_traces_bad_requests():
+    with obs_trace.scoped():
+        srv = ServingServer([SyntheticExecutor(slots=1, d=8)]).start()
+        try:
+            code, body = _get_json(srv.url + "/debug/traces")
+            assert code == 400 and "request_id" in body["error"]
+            code, _body = _get_json(
+                srv.url + "/debug/traces?request_id=nope")
+            assert code == 404
+        finally:
+            srv.stop()
+
+
+def test_debug_flight_on_demand_over_http():
+    with obs_trace.scoped():
+        srv = ServingServer(
+            [SyntheticExecutor(slots=1, d=8)]).start()
+        try:
+            _post(srv.url, {"prompt": "f", "max_tokens": 2,
+                            "deadline_ms": 10000})
+            code, data = _get_json(srv.url + "/debug/flight")
+            assert code == 200
+            assert data["reason"] == "on_demand"
+            assert any(s["name"] == "request" for s in data["spans"])
+            assert any(d["kind"] == "admit"
+                       for d in data["decisions"])
+        finally:
+            srv.stop()
+
+
+def test_trace_dropped_counter_on_metrics():
+    """The ring bound is PROVEN at scrape time: a tracer sized to drop
+    must surface a nonzero serving_trace_dropped_total; an unpressured
+    one still exports the series at 0."""
+    tiny = Tracer(capacity=16, per_thread_cap=2)
+    with obs_trace.scoped(tiny):
+        srv = ServingServer(
+            [SyntheticExecutor(slots=2, d=8, step_time_s=0.001)],
+            tracer=tiny).start()
+        try:
+            _post(srv.url, {"prompt": "d", "max_tokens": 8,
+                            "deadline_ms": 10000})
+            text = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=5).read().decode()
+            val = next(
+                float(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+                if l.startswith("serving_trace_dropped_total"))
+            assert val > 0
+        finally:
+            srv.stop()
+    with obs_trace.scoped():
+        srv = ServingServer([SyntheticExecutor(slots=1, d=8)]).start()
+        try:
+            text = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=5).read().decode()
+            assert "serving_trace_dropped_total 0.0" in text
+        finally:
+            srv.stop()
+
+
+def test_obs_lane_wall_budget():
+    """The whole obs lane (tracer units + jitted-model acceptance)
+    must fit its documented tier-1 budget (docs/ci.md: ~9 s measured,
+    60 s ceiling) — an observability lane that balloons CI is the
+    overhead problem wearing a different hat. Runs last in file order
+    (tier-1 runs -p no:randomly)."""
+    elapsed = time.perf_counter() - _LANE_T0[0]
+    assert elapsed < 60.0, f"obs lane took {elapsed:.1f}s (budget 60s)"

@@ -215,8 +215,16 @@ def test_overload_503_and_bounded_p99():
     Retry-After, every ADMITTED request finishes within deadline +
     step-granularity slack, the queue never exceeds its depth, and the
     server stays healthy. SyntheticExecutor pins the per-step cost so
-    the arithmetic of 'overload' is deterministic."""
-    step_s = 0.005
+    the arithmetic of 'overload' is deterministic.
+
+    The step cost is deliberately FAT (20 ms): the executor's step is
+    a wall-clock sleep, immune to CPU throttle, while the 16 client
+    threads are GIL-bound python that IS throttled late in a long
+    tier-1 run — with a 5 ms step (100 req/s capacity) a throttled
+    client pool could fall under capacity and the storm never shed
+    (seen once at ~66% of a full suite run). At 25 req/s capacity the
+    clients stay ~an order of magnitude over it even throttled."""
+    step_s = 0.02
     ex = SyntheticExecutor(slots=4, d=16, step_time_s=step_s)
     srv = ServingServer([ex], max_queue_depth=6,
                         default_deadline_s=2.0).start()
