@@ -749,7 +749,10 @@ def bench_serving() -> dict:
             f"{out.get('serving_host_gap_frac')}); recovery "
             f"{out.get('serving_recovery_ms')} ms (goodput retention "
             f"{out.get('serving_fault_goodput_retention')}); trace "
-            f"overhead {out.get('serving_trace_overhead_frac')}",
+            f"overhead {out.get('serving_trace_overhead_frac')}; "
+            f"paged-kv {out.get('serving_tokens_per_s')} tok/s at 2x "
+            f"(prefix speedup {out.get('serving_kv_prefix_speedup')}x, "
+            f"stall frac {out.get('serving_prefill_stall_frac')})",
             file=sys.stderr,
         )
         return out
@@ -846,6 +849,15 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # (1.35x): a watchdog/backoff/restart regression moves recovery
         # time even when throughput noise hides it.
         ("serving_recovery_ms", 1.35, "serving_recovery_le_135_median"),
+        # Paged-KV decode (ISSUE 7): decode-token throughput at 2x
+        # overload with prefix sharing holds 0.85x the rolling median;
+        # the prefill-stall fraction (decode steps co-running with
+        # prefill chunks, measured on the cache-cold arm) gets the
+        # latency band — creep there means the chunked-prefill budget
+        # is rotting back toward monolithic prefill.
+        ("serving_tokens_per_s", 0.85, "serving_kv_tokens_ge_085_median"),
+        ("serving_prefill_stall_frac", 1.35,
+         "serving_prefill_stall_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -913,6 +925,11 @@ def main() -> int:
         "serving_host_gap_ms": "ms",
         "serving_trace_overhead_frac": "frac",
         "serving_traced_steps_per_s": "steps/s",
+        "serving_tokens_per_s": "tok/s",
+        "serving_tokens_per_s_user": "tok/s",
+        "serving_kv_prefix_hit_frac": "frac",
+        "serving_kv_prefix_speedup": "x",
+        "serving_prefill_stall_frac": "frac",
     }
     for key, unit in units.items():
         if key in metrics:

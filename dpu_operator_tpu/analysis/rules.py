@@ -12,6 +12,7 @@ GL006  collective/PartitionSpec axis name no analyzed mesh declares
 GL007  unbounded connect/send retry loop with no backoff sleep
        (serving/daemon/vsp/parallel)
 GL008  request-path log call that binds no request id (serving/)
+GL009  KV block acquired with no paired release or lease (serving/)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -283,8 +284,11 @@ class HostSyncInHotLoop(Rule):
             "outside the loop, or add a pragma with a measured "
             "justification")
 
-    _HOT_CLASSES = {"DecodeStep": {"__call__"}}
-    _HOT_FUNCS = {"_run_pipelined"}
+    _HOT_CLASSES = {"DecodeStep": {"__call__"},
+                    # The paged-KV sibling (serving/kvcache/paged.py):
+                    # its __call__ must stay a pure async dispatch too.
+                    "PagedDecodeStep": {"__call__"}}
+    _HOT_FUNCS = {"_run_pipelined", "_run_kv"}
     _HOT_COLLECTIVE_HINTS = ("sender", "receiver", "_run", "_pair_run",
                              "allreduce", "exchange")
 
@@ -882,8 +886,9 @@ class RequestLogWithoutContext(Rule):
     # Functions that own a specific GenerateRequest: the roots of the
     # request-scoped call graph.
     _ROOTS = {"handle_generate", "_pop_admissions", "_settle",
-              "_retire", "_retire_tokens", "_fail_occupants",
-              "_requeue"}
+              "_retire", "_retire_tokens", "_retire_kv",
+              "_fail_occupants", "_requeue", "kv_attach",
+              "kv_release_slot"}
     _LOG_METHODS = {"info", "warning", "error", "exception"}
     _LOG_OBJS = {"log", "logger", "logging"}
     _RID_NAMES = {"request_id", "rid", "req_id", "rids",
@@ -933,8 +938,87 @@ class RequestLogWithoutContext(Rule):
                         f"describes")
 
 
+# --------------------------------------------------------------------------
+# GL009 — KV block acquired with no paired release or lease
+
+
+class KVAcquireWithoutRelease(Rule):
+    """Origin: ISSUE 7's paged KV cache. Blocks come from a refcounted
+    allocator with owner-tagged leak accounting
+    (serving/kvcache/allocator.py), and the acceptance bar is ZERO
+    leaked blocks after every serving/chaos test — which only holds if
+    every acquiring call site has a visible way back. The mechanical
+    contract: a function that acquires pages
+    (``allocator.acquire``/``.fork``/``prefix.match_and_fork``) must,
+    in the SAME function, either release some
+    (``.release*``/``kv_release_slot``/``.flush`` — including the
+    error-path unwind) or register the finalizer by constructing a
+    ``KVLease`` (the lease IS the release path: every settle funnel —
+    retire, fail, shed, stop — calls its idempotent ``release()``).
+
+    Scope: serving/, EXCLUDING kvcache/allocator.py itself — the
+    allocator and prefix tree OWN the refcount machinery (the tree's
+    ``insert`` forks under the cache owner whose release lives in
+    ``evict``/``flush``); the rule polices their clients, the same
+    boundary GL002 draws around the executor seam.
+
+    Near-misses that stay silent: acquire paired with a release in the
+    same function (the OOM unwind shape), acquire whose result flows
+    into a KVLease, and ``.fork()``/``.acquire()`` on receivers with
+    no allocator pedigree (``os.fork``, a lock's ``acquire``) — the
+    receiver must look like an allocator/prefix tree."""
+
+    rule_id = "GL009"
+    severity = SEVERITY_ERROR
+    title = "KV block acquired with no paired release or lease"
+    hint = ("pair the acquire with a release on every path out of the "
+            "function, or hand the blocks to a KVLease (its idempotent "
+            "release() runs on every request-settle path); the "
+            "allocator's owner-tagged leak ledger will fail the test "
+            "teardown otherwise")
+
+    _ACQUIRE_ATTRS = {"acquire", "fork", "match_and_fork"}
+    _RECV_HINTS = ("alloc", "prefix", "tree")
+    _RELEASE_NAMES = {"kv_release_slot", "flush", "on_request_settled"}
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving"):
+            return
+        if module.relpath.endswith("kvcache/allocator.py"):
+            return
+        for fn, qual in module.functions:
+            acquires: List[ast.Call] = []
+            releases = False
+            leased = False
+            for n in _walk_through_lambdas(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                tname = _terminal_name(f)
+                if tname in self._ACQUIRE_ATTRS and \
+                        isinstance(f, ast.Attribute):
+                    recv = _terminal_name(f.value).lower()
+                    if any(h in recv for h in self._RECV_HINTS):
+                        acquires.append(n)
+                elif tname.startswith("release") or \
+                        tname in self._RELEASE_NAMES:
+                    releases = True
+                elif "Lease" in tname:
+                    leased = True
+            if not acquires or releases or leased:
+                continue
+            for n in acquires:
+                yield self.finding(
+                    module, n,
+                    f"'{ast.unparse(n.func)}(...)' acquires KV blocks "
+                    f"in '{qual}' with no paired release in the "
+                    f"function and no KVLease registered — the "
+                    f"allocator's leak ledger has no way back")
+
+
 def default_rules() -> List[Rule]:
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
             SilentBroadExcept(), UndeclaredAxisName(),
-            UnboundedRetryLoop(), RequestLogWithoutContext()]
+            UnboundedRetryLoop(), RequestLogWithoutContext(),
+            KVAcquireWithoutRelease()]

@@ -253,9 +253,13 @@ class FaultyExecutor:
         fire(f"{self.site}.reset")
         self.inner.reset()
 
-    def submit(self, updates):
+    def submit(self, updates, **meta):
+        # **meta forwards the diagnostic/guard kwargs (step,
+        # request_ids, the KV executors' gen) untouched — the wrapper
+        # must never change what the scheduler told the replica.
         fire(f"{self.site}.submit")
-        return wrap(f"{self.site}.submit", self.inner.submit(updates))
+        return wrap(f"{self.site}.submit",
+                    self.inner.submit(updates, **meta))
 
     def collect(self, handle):
         fire(f"{self.site}.collect")

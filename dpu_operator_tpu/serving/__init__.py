@@ -13,14 +13,21 @@ The request path:
       → DecodeStep (infer.py — device-resident forward-only train_step
         model on a mesh; only token ids cross PCIe)
 
+The paged-KV decode plane (ISSUE 7) lives in kvcache/: token-level
+executors over device-resident attention state with block-granular
+prefix reuse and chunked prefill, driven by the SAME queue/batcher/
+pool machinery (the batcher picks its KV loop off ``executor.kv``).
+
 Importing this package stays jax-free; jax loads only when a
-LocalExecutor is constructed.
+LocalExecutor or PagedKVExecutor is constructed.
 """
 
 from .api import (Draining, GenerateRequest, QueueFull, ServingError,
-                  encode_prompt)
+                  encode_prompt, encode_prompt_tokens)
 from .executor import (Executor, LocalExecutor, ReplicaPool,
                        SyntheticExecutor)
+from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
+                      PagedKVExecutor, PrefixTree, SyntheticKVExecutor)
 from .queue import AdmissionQueue
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
@@ -31,11 +38,18 @@ __all__ = [
     "Draining",
     "Executor",
     "GenerateRequest",
+    "KVBlockAllocator",
+    "KVCacheOOM",
+    "KVLease",
     "LocalExecutor",
+    "PagedKVExecutor",
+    "PrefixTree",
     "QueueFull",
     "ReplicaPool",
     "ServingError",
     "ServingServer",
     "SyntheticExecutor",
+    "SyntheticKVExecutor",
     "encode_prompt",
+    "encode_prompt_tokens",
 ]

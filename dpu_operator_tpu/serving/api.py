@@ -52,6 +52,12 @@ DEADLINE_QUEUED_ERROR = "deadline exceeded while queued"
 # same reasoning as above).
 RETRIES_EXHAUSTED_ERROR = "retries_exhausted"
 
+# KV admission shed: the paged allocator has no pages for this
+# request's worst case (prompt + max_tokens). Matched EXACTLY by the
+# HTTP layer → 503 + Retry-After: capacity pressure, not a replica
+# failure, and pages free as in-flight requests finish.
+KV_OOM_ERROR = "kv cache exhausted"
+
 
 def encode_prompt(text: str, d: int) -> np.ndarray:
     """Deterministic prompt → [d] model-state embedding. The serving
@@ -61,6 +67,16 @@ def encode_prompt(text: str, d: int) -> np.ndarray:
     states, so caching/batching behavior is measurable end-to-end."""
     seed = int.from_bytes(hashlib.sha256(text.encode()).digest()[:4], "big")
     return np.random.RandomState(seed).randn(d).astype(np.float32)
+
+
+def encode_prompt_tokens(text: str, n: int, vocab: int) -> List[int]:
+    """Deterministic prompt → n token ids in [0, vocab): the stand-in
+    tokenizer for the paged-KV plane (token ids, not hidden vectors —
+    the KV executors embed them on device). Same text, same ids, so
+    prefix caching across identical prompts is measurable end-to-end."""
+    seed = int.from_bytes(hashlib.sha256(text.encode()).digest()[:4], "big")
+    return [int(t) for t in
+            np.random.RandomState(seed).randint(0, vocab, size=n)]
 
 
 @dataclass
@@ -92,11 +108,29 @@ class GenerateRequest:
     # request's second wait leg doesn't swallow its failed first
     # decode attempt (seize/requeue latency has its own spans).
     enqueued_at: float = field(default_factory=time.monotonic)
+    # Paged-KV plane (ISSUE 7): token-id prompt (the KV executors
+    # embed ids on device; prompt_vec is the legacy hidden-vector
+    # plane and is None for KV requests) and the request's KV-page
+    # lease. The lease is OPAQUE here (duck-typed kvcache.KVLease —
+    # this module stays dependency-free) and rides the request through
+    # the supervisor's seize→requeue path: block-table ownership
+    # travels the queue, which is what makes retry re-attach pages
+    # instead of re-decoding the prompt.
+    prompt_tokens: Optional[List[int]] = None
+    kv_lease: Optional[object] = field(default=None, repr=False)
     _done: threading.Event = field(default_factory=threading.Event,
                                    repr=False)
 
     def finish(self) -> None:
         self.finished_at = time.monotonic()
+        # The one settle choke point for KV pages: whichever path
+        # settles this request (retire, fail, shed, server stop), the
+        # lease releases exactly once (release is idempotent — the
+        # happy retire path already released-and-cached before
+        # finishing, and this no-ops).
+        lease = self.kv_lease
+        if lease is not None:
+            lease.on_request_settled()
         self._done.set()
 
     def fail(self, error: str) -> None:

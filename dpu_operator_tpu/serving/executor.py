@@ -65,7 +65,7 @@ Update = Tuple[int, np.ndarray]  # (slot index, row[d]) applied at submit
 
 
 class _Pending:
-    """Handle for a step in flight on SyntheticExecutor's worker."""
+    """Handle for a step in flight on a synthetic executor's worker."""
 
     __slots__ = ("event", "tokens", "error")
 
@@ -73,6 +73,82 @@ class _Pending:
         self.event = threading.Event()
         self.tokens: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+
+
+class _GuardedWorker:
+    """Single-thread FIFO "device" shared by the synthetic executors
+    (row plane here, token plane in kvcache/executor.py). EVERY
+    failure path must land in the owning handle and the thread must
+    survive — an exception escaping the loop used to kill it silently,
+    so collect() on any outstanding (or future) handle blocked forever
+    and the replica wedged with no error anywhere. That discipline
+    (the PR 5 lesson) lives HERE, once, parameterized by the per-item
+    step and reset callables."""
+
+    def __init__(self, name: str, step_fn, reset_fn):
+        self._name = name
+        self._step_fn = step_fn        # payload -> tokens
+        self._reset_fn = reset_fn      # () -> None
+        self._work: Optional[_queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def started(self) -> bool:
+        return self._thread is not None
+
+    def _ensure(self) -> None:
+        if self._thread is None:
+            self._work = _queue.Queue()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=self._name)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            pending = None
+            try:
+                if item[0] == "reset":
+                    pending = item[1]
+                    self._reset_fn()
+                else:
+                    _, payload, pending = item
+                    pending.tokens = self._step_fn(payload)
+            except BaseException as e:  # surfaced by collect()/reset()
+                if pending is not None:
+                    pending.error = e
+                else:
+                    log.exception(
+                        "%s: malformed work item %r (dropped; worker "
+                        "survives)", self._name, item)
+            finally:
+                if pending is not None:
+                    pending.event.set()
+
+    def submit(self, payload) -> _Pending:
+        self._ensure()
+        pending = _Pending()
+        self._work.put(("step", payload, pending))
+        return pending
+
+    def reset(self) -> None:
+        """Serialize behind queued steps and RE-RAISE a worker-side
+        failure instead of reporting a clean session over poisoned
+        state."""
+        self._ensure()
+        pending = _Pending()
+        self._work.put(("reset", pending))
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
+
+    def close(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._work.put(None)
+            self._thread.join(timeout=timeout)
+            self._thread = None
 
 
 class Executor:
@@ -87,6 +163,10 @@ class Executor:
     #: off this flag; the base adapter below is eager (no overlap) but
     #: contract-correct for any step()-only executor.
     pipelined: bool = False
+    #: True for paged-KV executors (serving/kvcache): the scheduler
+    #: runs its token-level KV loop (attach leases, chunked prefill,
+    #: NO_TOKEN-aware retire) instead of the [slots, d] row plane.
+    kv: bool = False
     _resident: Optional[np.ndarray] = None
 
     def step(self, x: np.ndarray) -> np.ndarray:
@@ -98,10 +178,14 @@ class Executor:
         """Zero the resident slot state (decode session start)."""
         self._resident = np.zeros((self.slots, self.d), np.float32)
 
-    def submit(self, updates: Sequence[Update]):
+    def submit(self, updates: Sequence[Update], step=None,
+               request_ids=None):
         """Apply slot updates, dispatch one decode step; returns an
         opaque handle for collect(). Base implementation runs the step
-        eagerly on the caller's thread."""
+        eagerly on the caller's thread. `step`/`request_ids` are
+        diagnostic context for overflow errors (see
+        DecodeStep.__call__); the eager path has no fixed-shape limit
+        and ignores them."""
         if self._resident is None:
             self.reset()
         for i, row in updates:
@@ -194,12 +278,15 @@ class LocalExecutor(Executor):
         else:
             super().reset()
 
-    def submit(self, updates: Sequence[Update]):
+    def submit(self, updates: Sequence[Update], step=None,
+               request_ids=None):
         if not self.pipelined:
             return super().submit(updates)
         # Async dispatch: both returned arrays are futures; the state
         # stays on device (the previous buffer was donated into it).
-        self._xdev, tokens = self._decode(self._xdev, updates)
+        self._xdev, tokens = self._decode(self._xdev, updates,
+                                          step=step,
+                                          request_ids=request_ids)
         return tokens
 
     def collect(self, handle) -> np.ndarray:
@@ -236,8 +323,16 @@ class SyntheticExecutor(Executor):
         self._w = np.random.RandomState(seed).randn(d, d).astype(
             np.float32) / np.sqrt(d)
         self.steps = 0
-        self._work: Optional[_queue.Queue] = None
-        self._worker: Optional[threading.Thread] = None
+        # The base eager adapter IS one step of the contract (apply
+        # updates, step, batched argmax); the worker only moves it off
+        # the submitter's thread.
+        self._worker = _GuardedWorker(
+            "synthetic-step",
+            step_fn=lambda updates: Executor.submit(self, updates),
+            reset_fn=self._zero_resident)
+
+    def _zero_resident(self) -> None:
+        self._resident = np.zeros((self.slots, self.d), np.float32)
 
     def step(self, x: np.ndarray) -> np.ndarray:
         if self.fault_site is not None:
@@ -249,71 +344,21 @@ class SyntheticExecutor(Executor):
 
     # -- pipelined: the worker thread is the "device" -------------------------
 
-    def _ensure_worker(self) -> None:
-        if self._worker is None:
-            self._work = _queue.Queue()
-            self._worker = threading.Thread(
-                target=self._worker_run, daemon=True,
-                name="synthetic-step")
-            self._worker.start()
-
-    def _worker_run(self) -> None:
-        # EVERY failure path must land in the owning handle and the
-        # worker must survive: an exception that escaped this loop used
-        # to kill the thread silently, so collect() on any outstanding
-        # (or future) handle blocked forever — the replica wedged with
-        # no error anywhere. Guard the WHOLE body, reset included.
-        while True:
-            item = self._work.get()
-            if item is None:
-                return
-            pending = None
-            try:
-                if item[0] == "reset":
-                    pending = item[1]
-                    self._resident = np.zeros((self.slots, self.d),
-                                              np.float32)
-                else:
-                    _, updates, pending = item
-                    # The base eager adapter IS one step of the
-                    # contract (apply updates, step, batched argmax);
-                    # the worker only moves it off the submitter's
-                    # thread.
-                    pending.tokens = Executor.submit(self, updates)
-            except BaseException as e:  # surfaced by collect()/reset()
-                if pending is not None:
-                    pending.error = e
-                else:
-                    log.exception(
-                        "synthetic worker: malformed work item %r "
-                        "(dropped; worker survives)", item)
-            finally:
-                if pending is not None:
-                    pending.event.set()
-
     def reset(self) -> None:
-        if not self.pipelined or self._worker is None:
+        if not self.pipelined or not self._worker.started:
             super().reset()
             return
         # The worker owns the resident state between submit and
-        # collect; a reset must serialize behind queued steps — and
-        # must RE-RAISE a worker-side failure instead of reporting a
-        # clean session over poisoned state.
-        pending = _Pending()
-        self._work.put(("reset", pending))
-        pending.event.wait()
-        if pending.error is not None:
-            raise pending.error
+        # collect; a reset must serialize behind queued steps.
+        self._worker.reset()
 
-    def submit(self, updates: Sequence[Update]):
+    def submit(self, updates: Sequence[Update], step=None,
+               request_ids=None):
         if not self.pipelined:
             return super().submit(updates)
-        self._ensure_worker()
         if self._resident is None:
             self._resident = np.zeros((self.slots, self.d), np.float32)
-        pending = _Pending()
-        self._work.put(("step", list(updates), pending))
-        return pending
+        return self._worker.submit(list(updates))
 
     def collect(self, handle) -> np.ndarray:
         if not self.pipelined:
@@ -324,10 +369,7 @@ class SyntheticExecutor(Executor):
         return handle.tokens
 
     def close(self) -> None:
-        if self._worker is not None:
-            self._work.put(None)
-            self._worker.join(timeout=5)
-            self._worker = None
+        self._worker.close()
 
 
 REPLICA_LIVE = "live"
@@ -632,14 +674,28 @@ class ReplicaPool:
                     req.fail(RETRIES_EXHAUSTED_ERROR)
                     outcome = "retries_exhausted"
                 else:
-                    # Fresh decode from the prompt: the recurrence is
-                    # deterministic, so the retried stream is identical
-                    # to an unfailed run's — half-decoded state must
-                    # not leak into the retry.
-                    req.tokens.clear()
-                    req.truncated = False
+                    lease = getattr(req, "kv_lease", None)
+                    if lease is not None and lease.resumable:
+                        # Paged-KV retry (ISSUE 7): the lease — the
+                        # request's block-table ownership — rides the
+                        # queue with it, so the restarted replica
+                        # RE-ATTACHES the surviving pages and resumes
+                        # from the last settled token. Tokens are
+                        # KEPT: the deterministic recurrence makes the
+                        # resumed stream identical to an unfailed
+                        # run's, at a replay cost of in-flight steps
+                        # instead of prompt-length re-decode.
+                        outcome = "requeued_kv"
+                    else:
+                        # Fresh decode from the prompt: the recurrence
+                        # is deterministic, so the retried stream is
+                        # identical to an unfailed run's —
+                        # half-decoded state must not leak into the
+                        # retry.
+                        req.tokens.clear()
+                        req.truncated = False
+                        outcome = "requeued"
                     self.queue.requeue(req)
-                    outcome = "requeued"
             self._count("serving_requeue_total",
                         {"replica": replica, "outcome": outcome},
                         help="in-flight requests seized from failed "

@@ -174,6 +174,9 @@ class DecodeStep:
         if donate is None:
             donate = mesh.devices.flat[0].platform != "cpu"
         self.donate = bool(donate)
+        # Own call counter: the overflow ValueError below must name a
+        # step even when the scheduler passes none (debug callers).
+        self._calls = 0
         dn = (0,) if self.donate else ()
         x0 = jnp.zeros((self.slots, self.d), jnp.float32)
         i0 = jnp.zeros((self.slots,), jnp.int32)
@@ -193,16 +196,27 @@ class DecodeStep:
 
         return jnp.zeros((self.slots, self.d), jnp.float32)
 
-    def __call__(self, x, updates=()):
+    def __call__(self, x, updates=(), step=None, request_ids=None):
         """(x_next, token_ids), both device arrays still in flight —
         jax async dispatch returns before the step executes, which is
         what the scheduler's pipelined loop overlaps against. `updates`
-        is [(slot, row[d])]; x is consumed when donation is on."""
+        is [(slot, row[d])]; x is consumed when donation is on.
+        `step`/`request_ids` are DIAGNOSTIC context only: the batcher's
+        seize path can legally race admissions close to the slot limit,
+        and an overflow error that names neither the step nor the
+        requests being admitted is undebuggable from a flight
+        snapshot."""
+        self._calls += 1
         if not updates:
             return self._nop(x)
         if len(updates) > self.slots:
+            step_no = self._calls if step is None else step
+            rids = (", ".join(str(r) for r in request_ids)
+                    if request_ids else "unknown")
             raise ValueError(
-                f"{len(updates)} updates for {self.slots} slots")
+                f"{len(updates)} updates for {self.slots} slots at "
+                f"decode step {step_no} (admitting request_ids: "
+                f"{rids}) — at most one update per slot per step")
         idx = np.full((self.slots,), self.slots, np.int32)
         val = np.zeros((self.slots, self.d), np.float32)
         for j, (i, row) in enumerate(updates):

@@ -1,0 +1,38 @@
+"""Paged KV-cache decode (ISSUE 7): device-resident attention state,
+prefix reuse, chunked prefill.
+
+Three layers (PagedAttention / Sarathi-Serve, sized to this repo):
+
+  * host plane — allocator.py: fixed-size KV blocks with refcounts,
+    owner-tagged leak accounting, per-request ``KVLease`` block tables
+    that ride the PR 5 seize→requeue path, and a chained-hash
+    ``PrefixTree`` for block-granular prefix sharing;
+  * device plane — paged.py: one AOT-compiled fused step (embed →
+    KV-append scatter → paged attention gather → logits → argmax)
+    over ``[num_blocks, block_size, heads, d_head]`` pools that never
+    leave the device;
+  * executors — executor.py: ``PagedKVExecutor`` (real, jax) and
+    ``SyntheticKVExecutor`` (jax-free, dialable step cost) behind the
+    serving plane's two-phase submit/collect seam, with chunked
+    prefill planned per step under a decode-protecting token budget.
+
+Importing this package stays jax-free; jax loads only when a
+PagedKVExecutor is constructed (the serving/__init__ discipline).
+"""
+
+from .allocator import (CACHE_OWNER, KVBlockAllocator, KVCacheOOM,
+                        KVLease, PrefixTree)
+from .executor import (NO_TOKEN, KVExecutorBase, PagedKVExecutor,
+                       SyntheticKVExecutor)
+
+__all__ = [
+    "CACHE_OWNER",
+    "KVBlockAllocator",
+    "KVCacheOOM",
+    "KVExecutorBase",
+    "KVLease",
+    "NO_TOKEN",
+    "PagedKVExecutor",
+    "PrefixTree",
+    "SyntheticKVExecutor",
+]

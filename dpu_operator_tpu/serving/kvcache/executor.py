@@ -1,0 +1,631 @@
+"""Token-level executors over the paged KV cache.
+
+``KVExecutorBase`` is the host plane shared by every KV replica: it
+owns the block allocator + prefix tree, the per-slot decode cursors,
+and the per-step PLAN — which slots prefill how many prompt tokens
+this step (bounded by the Sarathi-style ``prefill_budget``), which
+slots decode one token, and whether each decode input chains from the
+previous step's on-device output or is host-fed (fresh attach /
+resume). Backends implement exactly two hooks — ``_dispatch(plan)``
+and ``_materialize(raw)`` — so the scheduler-facing contract is one
+class:
+
+  * ``PagedKVExecutor`` — the real thing: kvcache/paged.py's
+    AOT-compiled fused step over device-resident KV pools, decode
+    recurrence chained on device (submit returns while the step runs).
+  * ``SyntheticKVExecutor`` — the jax-free double: same allocator,
+    same leases, same plans, but the "device" is a deterministic token
+    function with a dialable step cost (optionally on a worker thread,
+    the SyntheticExecutor pipelining idiom) — the knob that makes KV
+    scheduler/chaos tests immune to CI-box noise.
+
+Scheduling properties the plan enforces (the chunked-prefill
+contract):
+
+  * decode slots ALWAYS get their one token — the prefill budget only
+    rations prefill, so a long prompt can never stall decode p99;
+  * prefill is chunked to ``prefill_chunk`` tokens per slot and
+    ``prefill_budget`` per step across slots, admitted round-robin
+    from a rotating start so one long prompt cannot starve another;
+  * every request's worst-case pages (``ceil((prompt + max_tokens) /
+    block_size)``) are reserved at attach — KV OOM is an ADMISSION
+    decision (shed with 503), never a mid-decode failure.
+
+Crash-retry (the ISSUE 7 headline): cursors are rebuilt from
+``req.tokens`` at (re-)attach — see KVLease — so a seized request
+re-attaches its pages and resumes from its last settled token. A
+lease from a DIFFERENT executor is released and the request re-prefills
+from the prompt (possibly through this replica's own prefix cache).
+
+Thread-safety: all slot-state mutation happens under ``_slock`` with a
+generation check, so a batcher thread abandoned mid-dispatch by a
+supervisor seize can never advance cursors of a restarted session
+(its stale ``gen`` turns the submit into a no-op).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import faults
+from ..executor import Executor, _GuardedWorker
+from .allocator import KVBlockAllocator, KVCacheOOM, KVLease, PrefixTree
+
+log = logging.getLogger(__name__)
+
+#: collect() sentinel for "no token emitted for this slot this step"
+#: (mid-prefill chunk, inactive slot, or stale-generation handle).
+NO_TOKEN = -1
+
+
+class _SlotState:
+    __slots__ = ("req_id", "lease", "ctx", "prefill_pos", "last_token",
+                 "chain_device", "pending_emit", "confirmed")
+
+    def __init__(self, req_id: str, lease: KVLease, ctx: int,
+                 prefill_pos: int, last_token: Optional[int]):
+        self.req_id = req_id
+        self.lease = lease
+        self.ctx = int(ctx)
+        self.prefill_pos = int(prefill_pos)
+        self.last_token = last_token
+        self.chain_device = False
+        self.pending_emit = False
+        # Positions whose KV writes a COLLECTED step has confirmed on
+        # device. ctx advances at plan time — one step ahead in the
+        # pipelined loop — so anything derived from ctx alone (the
+        # prefix-cache insert) would cover in-flight writes that a
+        # failing step never lands. Attach-time positions are genuinely
+        # written: prefix-cache hits by the cache contract, re-attach
+        # cursors by the settled tokens that imply their steps ran.
+        self.confirmed = int(ctx)
+
+
+class _StepPlan:
+    __slots__ = ("gen", "step_no", "host_tok", "use_host", "ctx",
+                 "n_new", "tables", "emit", "owners", "stale")
+
+    def __init__(self, gen, step_no, host_tok, use_host, ctx, n_new,
+                 tables, emit, owners=None, stale=False):
+        self.gen = gen
+        self.step_no = step_no
+        self.host_tok = host_tok
+        self.use_host = use_host
+        self.ctx = ctx
+        self.n_new = n_new
+        self.tables = tables
+        self.emit = emit
+        # Per-slot request id at PLAN time: collect() must attribute
+        # an emit to the state that planned it — a retire + fresh
+        # admit can rebind the slot between submit and collect.
+        self.owners = owners
+        self.stale = stale
+
+
+class _KVHandle:
+    __slots__ = ("plan", "raw")
+
+    def __init__(self, plan: _StepPlan, raw):
+        self.plan = plan
+        self.raw = raw
+
+
+class KVExecutorBase(Executor):
+    kv = True
+    #: no prompt_vec plane: KV replicas consume token ids.
+    d = 0
+
+    def __init__(self, slots: int, vocab: int = 64, block_size: int = 4,
+                 num_blocks: int = 128, max_blocks_per_req: int = 16,
+                 prefill_chunk: int = 8,
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: bool = True, pipelined: bool = True):
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.slots = int(slots)
+        self.vocab = int(vocab)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_req = int(max_blocks_per_req)
+        self.max_context = self.max_blocks_per_req * self.block_size
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_budget = int(prefill_budget
+                                  if prefill_budget is not None
+                                  else prefill_chunk)
+        if self.prefill_budget < 1:
+            raise ValueError("prefill_budget must be >= 1")
+        self.pipelined = bool(pipelined)
+        self.allocator = KVBlockAllocator(self.num_blocks,
+                                          self.block_size)
+        self.prefix: Optional[PrefixTree] = (
+            PrefixTree(self.allocator) if prefix_cache else None)
+        self._exec_id = f"kvexec-{id(self):x}"
+        self._slock = threading.RLock()
+        self._states: List[Optional[_SlotState]] = [None] * self.slots
+        self._gen = 0
+        self._rr = 0
+        self._step_no = 0
+        # Token-denominated counters for the serving_prefill/decode_
+        # tokens_total series and the bench's prefill-stall fraction.
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.steps_decode = 0
+        self.steps_mixed = 0
+        self.resumed_total = 0
+
+    # -- attach / detach (called by the batcher under its settle lock) --------
+
+    def kv_attach(self, slot: int, req) -> int:
+        """Bind `req` to `slot`: re-attach its surviving lease (resume
+        from the last settled token), or build a fresh one — prefix
+        cache hit first, worst-case pages reserved up front. Returns
+        the cached-token count (0 on resume/fresh-miss). Raises
+        KVCacheOOM (shed) or ValueError (caller bug / over-long
+        prompt). Atomic: on failure nothing stays bound or acquired."""
+        tokens = getattr(req, "prompt_tokens", None)
+        if not tokens:
+            raise ValueError(
+                f"kv executor needs prompt_tokens (request "
+                f"{req.request_id})")
+        plen = len(tokens)
+        if plen + req.max_tokens > self.max_context:
+            raise ValueError(
+                f"prompt ({plen}) + max_tokens ({req.max_tokens}) "
+                f"exceeds max context {self.max_context} (request "
+                f"{req.request_id})")
+        with self._slock:
+            if self._states[slot] is not None:
+                raise ValueError(f"slot {slot} already bound")
+            lease = getattr(req, "kv_lease", None)
+            if lease is not None and not lease.released:
+                # The released check races the settle choke point
+                # (finish() can release from the HTTP handler's thread
+                # at ANY time, including right after this line) — and
+                # that is fine, by the same argument that makes
+                # release-while-bound safe mid-decode: a settled req
+                # has req.done set, so _retire_kv evicts the binding at
+                # the first retire; at most one in-flight plan scatters
+                # into the freed blocks, and a stale write is always
+                # overwritten by a block's next owner before it can be
+                # attended (device steps execute in dispatch order, and
+                # a position is appended by the step that processes it
+                # before any later query's causal mask can reach it).
+                # Shared prefix blocks are never scatter targets at
+                # all — appends land at positions >= the block-aligned
+                # cached prefix, in the request's own fresh blocks.
+                if lease.exec_id == self._exec_id:
+                    return self._reattach(slot, req, lease)
+                # Foreign pages mean nothing in this pool: release
+                # them and restart the stream from the prompt (the
+                # deterministic recurrence makes the retried stream
+                # identical either way).
+                lease.release()
+                req.kv_lease = None
+                req.tokens.clear()
+                req.truncated = False
+            owner = req.request_id
+            cached_blocks: List[int] = []
+            cached = 0
+            if self.prefix is not None:
+                cached_blocks, cached = self.prefix.match_and_fork(
+                    tokens, owner)
+            need_total = -(-(plen + req.max_tokens) // self.block_size)
+            need = need_total - len(cached_blocks)
+            try:
+                try:
+                    fresh = self.allocator.acquire(need, owner)
+                except KVCacheOOM:
+                    # Evict LRU prefix-cache leaves to make room; a
+                    # second OOM is the real admission shed.
+                    if self.prefix is None:
+                        raise
+                    self.prefix.evict(
+                        need - self.allocator.free_count())
+                    fresh = self.allocator.acquire(need, owner)
+            except KVCacheOOM:
+                if cached_blocks:
+                    self.allocator.release(cached_blocks, owner)
+                raise
+            lease = KVLease(self.allocator, self._exec_id, owner,
+                            cached_blocks + fresh, tuple(tokens),
+                            cached)
+            req.kv_lease = lease
+            self._states[slot] = _SlotState(
+                owner, lease, ctx=cached, prefill_pos=cached,
+                last_token=None)
+            return cached
+
+    def _reattach(self, slot: int, req, lease: KVLease) -> int:
+        """Rebuild decode cursors from the request's SETTLED tokens —
+        the durable truth a kill between dispatch and settle cannot
+        skew. k settled tokens mean prompt + k-1 generated positions
+        are (re)appendable; the next step feeds tokens[-1] and emits
+        token k+1 — identical to the unfailed stream."""
+        plen = len(lease.prompt)
+        k = len(req.tokens)
+        if k > 0:
+            st = _SlotState(req.request_id, lease,
+                            ctx=plen + k - 1, prefill_pos=plen,
+                            last_token=int(req.tokens[-1]))
+        else:
+            # Killed mid-prefill: replay the prefill from the cached
+            # prefix (pages already reserved — replay re-appends
+            # identical values, overwrites are harmless).
+            st = _SlotState(req.request_id, lease,
+                            ctx=lease.cached_tokens,
+                            prefill_pos=lease.cached_tokens,
+                            last_token=None)
+        self._states[slot] = st
+        self.resumed_total += 1
+        return 0
+
+    def kv_release_slot(self, slot: int, cache: bool = True) -> None:
+        """Unbind `slot` and release its lease exactly once; when
+        `cache`, the request's full prompt blocks are inserted into
+        the prefix tree INSIDE the release (owner refs still held, so
+        the cache fork can never race a concurrent settle-path
+        release)."""
+        with self._slock:
+            st = self._states[slot]
+            self._states[slot] = None
+        if st is None:
+            return
+        # confirmed, NOT ctx: a mid-prefill truncation retires the
+        # slot while its latest chunk is dispatched but uncollected.
+        # If that step then fails (pools and prefix cache survive the
+        # reset), ctx-derived caching would publish blocks whose KV
+        # for those positions was never written — and match_and_fork
+        # would serve them as truth to every later same-prefix
+        # request.
+        written = min(len(st.lease.prompt), st.confirmed)
+        full = (written // self.block_size) * self.block_size
+        hook = None
+        if cache and self.prefix is not None and full > 0:
+            prefix_tree, bs = self.prefix, self.block_size
+
+            def hook(lease):
+                prefix_tree.insert(lease.prompt[:full],
+                                   lease.blocks[:full // bs])
+        st.lease.release(cache_hook=hook)
+
+    # -- the two-phase decode contract ----------------------------------------
+
+    def kv_gen(self) -> int:
+        return self._gen
+
+    def reset(self) -> None:
+        """New decode session: slot bindings and the step plan
+        generation reset; the KV POOLS and the prefix cache survive —
+        surviving pages are exactly what makes a post-restart
+        re-attach worth anything. Leases are owned by their requests,
+        never by the session."""
+        with self._slock:
+            self._gen += 1
+            self._states = [None] * self.slots
+            self._backend_reset()
+
+    def submit(self, updates: Sequence = (), step=None,
+               request_ids=None, gen: Optional[int] = None):
+        """Plan and dispatch one fused step. `updates` is unused (the
+        KV plane assembles its own token window from slot state);
+        `gen` (from kv_gen(), captured under the batcher's settle
+        lock) turns a submit raced by a supervisor seize→reset into a
+        no-op stale handle instead of corrupting the new session.
+
+        _dispatch runs UNDER _slock, deliberately: plan+dispatch must
+        be atomic against reset(), or an abandoned thread could
+        dispatch a stale plan AFTER the new session re-acquired its
+        freed blocks — a silent scatter into another request's KV
+        (device execution order is dispatch order only per thread).
+        The cost is that a dispatch wedged on the device holds the
+        lock and a restart's reset() blocks behind it — but reset
+        runs under the PR 5 watchdog clock, so that degrades loudly
+        to breaker-parking the replica, which is the designed outcome
+        for an unresponsive device. The realistic wedge point
+        (materialize/block_until_ready) is in collect(), which takes
+        _slock only AFTER materializing."""
+        with self._slock:
+            if gen is not None and gen != self._gen:
+                plan = _StepPlan(gen, 0, None, None, None, None, None,
+                                 np.zeros((self.slots,), bool),
+                                 stale=True)
+                return _KVHandle(plan, None)
+            plan = self._plan_step()
+            raw = self._dispatch(plan)
+            return _KVHandle(plan, raw)
+
+    def _plan_step(self) -> _StepPlan:
+        S, C, B = self.slots, self.prefill_chunk, self.max_blocks_per_req
+        host_tok = np.zeros((S, C), np.int32)
+        use_host = np.zeros((S,), bool)
+        ctx = np.zeros((S,), np.int32)
+        n_new = np.zeros((S,), np.int32)
+        tables = np.zeros((S, B), np.int32)
+        emit = np.zeros((S,), bool)
+        owners: List = [None] * S
+        budget = self.prefill_budget
+        step_prefill = 0
+        step_decode = 0
+        # Rotating start: with the budget shared across slots, a long
+        # prompt in slot 0 must not permanently starve slot 1's.
+        order = [(self._rr + j) % S for j in range(S)]
+        self._rr = (self._rr + 1) % S
+        for s in order:
+            st = self._states[s]
+            if st is None:
+                continue
+            plen = len(st.lease.prompt)
+            owners[s] = st.req_id
+            ctx[s] = st.ctx
+            tables[s, :len(st.lease.blocks)] = st.lease.blocks
+            if st.prefill_pos < plen:
+                take = min(C, plen - st.prefill_pos, budget)
+                st.pending_emit = False
+                if take <= 0:
+                    st.chain_device = False
+                    continue  # budget spent: this prompt waits a step
+                host_tok[s, :take] = st.lease.prompt[
+                    st.prefill_pos:st.prefill_pos + take]
+                use_host[s] = True
+                n_new[s] = take
+                budget -= take
+                step_prefill += take
+                finishes = st.prefill_pos + take >= plen
+                emit[s] = finishes
+                st.ctx += take
+                st.prefill_pos += take
+                st.chain_device = bool(finishes)
+                st.pending_emit = bool(finishes)
+            else:
+                # Decode: one token, NEVER budget-rationed (the
+                # bounded-prefill contract protecting decode p99).
+                n_new[s] = 1
+                emit[s] = True
+                step_decode += 1
+                if st.chain_device:
+                    use_host[s] = False  # input = previous step's
+                    # on-device emit, still in flight
+                else:
+                    if st.last_token is None:
+                        raise RuntimeError(
+                            f"slot {s}: decode with no prior token "
+                            f"(request {st.req_id})")
+                    host_tok[s, 0] = st.last_token
+                    use_host[s] = True
+                st.ctx += 1
+                st.chain_device = True
+                st.pending_emit = True
+        self._step_no += 1
+        self.prefill_tokens += step_prefill
+        if step_decode:
+            self.steps_decode += 1
+            if step_prefill:
+                self.steps_mixed += 1
+        return _StepPlan(self._gen, self._step_no, host_tok, use_host,
+                         ctx, n_new, tables, emit, owners)
+
+    def collect(self, handle: _KVHandle) -> np.ndarray:
+        """[slots] int32: the emitted token per slot, NO_TOKEN (-1)
+        where this step emitted nothing (mid-prefill chunk, idle slot,
+        stale handle). Pure — no state mutation, so an abandoned
+        batcher thread waking from a wedge cannot corrupt the
+        restarted session by collecting."""
+        out = np.full((self.slots,), NO_TOKEN, np.int32)
+        if handle.plan.stale:
+            return out
+        raw = np.asarray(self._materialize(handle.raw), np.int32)
+        emit = handle.plan.emit
+        out[emit] = raw[emit]
+        # Record last emitted tokens host-side: a re-attach after THIS
+        # generation dies feeds them back through the host path. The
+        # owner check attributes each emit to the state that PLANNED
+        # it: a retire + fresh admit can rebind the slot between
+        # submit and collect, and the old request's phantom emit must
+        # not overwrite the new state's last_token. The decode-token
+        # counter lives on the same guard, NOT at plan time — the
+        # pipelined loop plans one phantom step per retiring request
+        # whose token is dropped, so plan-time counting inflates
+        # decode throughput by ~1/max_tokens and diverges from sync
+        # mode on identical streams. A surviving owned emit is a
+        # settled token: both modes count exactly what clients
+        # receive.
+        with self._slock:
+            if handle.plan.gen == self._gen:
+                for s in range(self.slots):
+                    st = self._states[s]
+                    if st is None or st.req_id != handle.plan.owners[s]:
+                        continue
+                    if handle.plan.n_new[s]:
+                        # This step's device writes are now real:
+                        # advance the confirmed-KV watermark (mid-
+                        # prefill chunks too — they write without
+                        # emitting).
+                        st.confirmed = max(
+                            st.confirmed,
+                            int(handle.plan.ctx[s]
+                                + handle.plan.n_new[s]))
+                    if emit[s] and st.pending_emit:
+                        st.last_token = int(raw[s])
+                        self.decode_tokens += 1
+        return out
+
+    def kv_stats(self) -> dict:
+        """Scrape-time snapshot for /metrics and the bench."""
+        stats = self.allocator.stats()
+        out = {"blocks_used": stats["used"],
+               "blocks_free": stats["free"],
+               "blocks_shared": stats["shared"],
+               "prefill_tokens": self.prefill_tokens,
+               "decode_tokens": self.decode_tokens,
+               "steps_decode": self.steps_decode,
+               "steps_mixed": self.steps_mixed,
+               "resumed": self.resumed_total,
+               "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0}
+        if self.prefix is not None:
+            out["prefix_hit_tokens"] = self.prefix.hit_tokens
+            out["prefix_lookup_tokens"] = self.prefix.lookup_tokens
+        return out
+
+    # -- backend hooks --------------------------------------------------------
+
+    def _backend_reset(self) -> None:
+        raise NotImplementedError
+
+    def _dispatch(self, plan: _StepPlan):
+        raise NotImplementedError
+
+    def _materialize(self, raw) -> np.ndarray:
+        raise NotImplementedError
+
+    # step() has no meaning on the token plane.
+    def step(self, x):  # pragma: no cover - contract guard
+        raise NotImplementedError(
+            "KV executors speak the two-phase token contract only")
+
+
+class PagedKVExecutor(KVExecutorBase):
+    """Device-resident paged-attention replica (kvcache/paged.py).
+    ``mode="pipelined"`` (default) leaves submit() async — jax
+    dispatch returns while the step runs and the decode recurrence
+    chains on device; ``mode="sync"`` drives the same executable
+    through the scheduler's synchronous KV loop (the measured
+    baseline)."""
+
+    def __init__(self, slots: int = 4, vocab: int = 64, d: int = 16,
+                 heads: int = 2, block_size: int = 4,
+                 num_blocks: int = 128, max_blocks_per_req: int = 16,
+                 prefill_chunk: int = 8,
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: bool = True, seed: int = 0,
+                 mode: str = "pipelined", warmup: bool = True,
+                 donate: Optional[bool] = None):
+        if mode not in ("pipelined", "sync"):
+            raise ValueError(f"mode must be pipelined|sync, got {mode!r}")
+        super().__init__(slots, vocab=vocab, block_size=block_size,
+                         num_blocks=num_blocks,
+                         max_blocks_per_req=max_blocks_per_req,
+                         prefill_chunk=prefill_chunk,
+                         prefill_budget=prefill_budget,
+                         prefix_cache=prefix_cache,
+                         pipelined=mode == "pipelined")
+        from .paged import PagedDecodeStep
+
+        self._paged = PagedDecodeStep(
+            slots=slots, vocab=vocab, d=d, heads=heads,
+            block_size=block_size, num_blocks=num_blocks,
+            max_blocks_per_req=max_blocks_per_req, chunk=prefill_chunk,
+            seed=seed, donate=donate)
+        self._kpool, self._vpool = self._paged.init_pools()
+        self._prev = self._paged.init_prev()
+        if warmup:
+            # One dispatched no-op step: first-execution lazy init is
+            # paid here, not under the supervisor's watchdog.
+            self.collect(self.submit((), gen=self._gen))
+            self.reset()
+
+    def _backend_reset(self) -> None:
+        # Pools are kept (re-attach depends on surviving pages); only
+        # the token recurrence restarts.
+        self._prev = self._paged.init_prev()
+
+    def _dispatch(self, plan: _StepPlan):
+        import jax.numpy as jnp
+
+        self._kpool, self._vpool, out = self._paged(
+            self._kpool, self._vpool, self._prev,
+            jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
+            jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
+            jnp.asarray(plan.tables))
+        self._prev = out
+        return out
+
+    def _materialize(self, raw) -> np.ndarray:
+        return np.asarray(raw)
+
+
+class SyntheticKVExecutor(KVExecutorBase):
+    """Jax-free KV replica: same allocator/lease/plan machinery, but
+    the "device" is ``next = (31 * last_token + 7 * position + seed)
+    % vocab`` — deterministic AND position-dependent, so a resume that
+    rewinds cursors wrong produces a visibly different stream. With
+    ``pipelined=True`` steps run FIFO on a worker thread with a
+    dialable ``step_time_s`` (the SyntheticExecutor overlap idiom);
+    ``fault_site`` names the in-device chaos seam."""
+
+    def __init__(self, slots: int = 4, vocab: int = 64,
+                 block_size: int = 4, num_blocks: int = 128,
+                 max_blocks_per_req: int = 16, prefill_chunk: int = 8,
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: bool = True, step_time_s: float = 0.0,
+                 seed: int = 0, pipelined: bool = True,
+                 fault_site: Optional[str] = None):
+        super().__init__(slots, vocab=vocab, block_size=block_size,
+                         num_blocks=num_blocks,
+                         max_blocks_per_req=max_blocks_per_req,
+                         prefill_chunk=prefill_chunk,
+                         prefill_budget=prefill_budget,
+                         prefix_cache=prefix_cache, pipelined=pipelined)
+        self.step_time_s = float(step_time_s)
+        self.seed = int(seed)
+        self.fault_site = fault_site
+        self._dev_prev = np.zeros((self.slots,), np.int32)
+        self._worker = _GuardedWorker(
+            "synthetic-kv-step", step_fn=self._device_step,
+            reset_fn=self._zero_dev_prev)
+
+    def _zero_dev_prev(self) -> None:
+        self._dev_prev = np.zeros((self.slots,), np.int32)
+
+    # -- the "device" ---------------------------------------------------------
+
+    def _device_step(self, plan: _StepPlan) -> np.ndarray:
+        if self.fault_site is not None:
+            faults.fire(f"{self.fault_site}.step")
+        if self.step_time_s:
+            time.sleep(self.step_time_s)
+        out = np.zeros((self.slots,), np.int32)
+        for s in range(self.slots):
+            n = int(plan.n_new[s])
+            if n <= 0:
+                out[s] = self._dev_prev[s]
+                continue
+            if plan.use_host[s]:
+                last_in = int(plan.host_tok[s, n - 1])
+            else:
+                last_in = int(self._dev_prev[s])
+            last_pos = int(plan.ctx[s]) + n - 1
+            out[s] = (31 * last_in + 7 * last_pos + self.seed) \
+                % self.vocab
+        self._dev_prev = out
+        return out
+
+    def _backend_reset(self) -> None:
+        # _GuardedWorker.reset serializes behind queued steps and
+        # re-raises worker-side failures (the PR 5 discipline, shared
+        # with the row-plane SyntheticExecutor).
+        if not self.pipelined or not self._worker.started:
+            self._zero_dev_prev()
+            return
+        self._worker.reset()
+
+    def _dispatch(self, plan: _StepPlan):
+        if not self.pipelined:
+            return self._device_step(plan)
+        return self._worker.submit(plan)
+
+    def _materialize(self, raw) -> np.ndarray:
+        if not self.pipelined:
+            return raw
+        raw.event.wait()
+        if raw.error is not None:
+            raise raw.error
+        return raw.tokens
+
+    def close(self) -> None:
+        self._worker.close()

@@ -1,0 +1,184 @@
+"""Device-resident paged-attention decode step (PagedAttention-style).
+
+The PR 3 ``DecodeStep`` keeps a ``[slots, d]`` hidden vector on device;
+this is its KV-bearing sibling: attention state lives in one flat
+``[num_blocks, block_size, heads, d_head]`` K pool and one V pool that
+NEVER leave the device, indexed through per-slot block tables the host
+allocator (kvcache/allocator.py) hands out. One compiled executable —
+one compile, ever — fuses, per step:
+
+  * token embedding of a fixed ``[slots, chunk]`` token window
+    (decode = 1 valid token, chunked prefill = up to ``chunk``);
+  * KV APPEND by scatter: each new token's K/V lands at
+    ``pool[table[pos // bs], pos % bs]``; padding rows use an
+    out-of-range block id and drop (the PR 3 ``mode="drop"`` scatter
+    discipline, extended from row indices to (block, offset) pairs);
+  * paged attention: gather the slot's pages through its block table,
+    causal-mask to each query's own position, softmax, weighted sum;
+  * a small residual MLP and tied-embedding logits, argmax → the
+    ``[slots]`` int32 token ids — the only thing that crosses PCIe.
+
+The fixed shapes are the whole contract: occupancy, prefill progress
+and prompt length vary, ``[slots, chunk]``/``[slots, max_blocks]``
+never do, so admissions and chunked prefill re-use the same executable
+as pure decode. The decode recurrence chains ON DEVICE: the previous
+step's (possibly still in-flight) token output feeds the next step's
+input through ``prev_tokens``, gated per slot by ``use_host`` — the
+pipelined scheduler can dispatch step k+1 before step k's tokens ever
+reach the host (the ISSUE 3 overlap, now with KV state).
+
+Donation follows DecodeStep's measured platform policy: the two pools
+are donated on accelerator backends (the decode session allocates its
+KV memory once); on CPU donation is off by default because the CPU
+runtime blocks dispatch on donated-input producers (~500us/step,
+measured in PR 3 — it serializes exactly the pipeline this exists
+for). ``donate=`` overrides.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class PagedDecodeStep:
+    """AOT-compiled fused chunk step over the paged KV pools. Params
+    bind as executable constants (the DecodeStep discipline: per-step
+    python dispatch never re-flattens a pytree; a weight swap means a
+    new PagedDecodeStep)."""
+
+    def __init__(self, slots: int, vocab: int, d: int, heads: int,
+                 block_size: int, num_blocks: int,
+                 max_blocks_per_req: int, chunk: int,
+                 hidden: Optional[int] = None, seed: int = 0,
+                 donate: Optional[bool] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if d % heads:
+            raise ValueError(f"d={d} must divide by heads={heads}")
+        self.slots = int(slots)
+        self.vocab = int(vocab)
+        self.d = int(d)
+        self.heads = int(heads)
+        self.d_head = d // heads
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_req = int(max_blocks_per_req)
+        self.chunk = int(chunk)
+        hidden = int(hidden or 2 * d)
+
+        rng = np.random.RandomState(seed)
+
+        def w(*shape):
+            return jnp.asarray(
+                rng.randn(*shape).astype(np.float32)
+                / np.sqrt(shape[0]))
+
+        embed = w(vocab, d)
+        # Absolute positional embedding: decode output must depend on
+        # WHERE in the sequence a token sits, or the argmax recurrence
+        # collapses to a fixed point and every resume/prefix test is
+        # vacuously green. Positions are absolute, so cached prefix KV
+        # (computed at the same positions) stays bit-identical on
+        # reuse.
+        wpos = w(max_blocks_per_req * block_size, d)
+        wq, wk, wv, wo = w(d, d), w(d, d), w(d, d), w(d, d)
+        w1, w2 = w(d, hidden), w(hidden, d)
+        # UNTIED output head: with logits = y @ embed.T the residual
+        # stream's own embedding dominates and argmax collapses to a
+        # fixed point (token t forever) — which would make every
+        # stream-equality test in the suite vacuously green.
+        wout = w(d, vocab)
+
+        S, C = self.slots, self.chunk
+        B, bs = self.max_blocks_per_req, self.block_size
+        H, dh = self.heads, self.d_head
+        N, T = self.num_blocks, B * bs
+
+        def step(kpool, vpool, prev_tok, host_tok, use_host, ctx,
+                 n_new, tables):
+            # Slot 0 of the token window is the only position the
+            # device recurrence can feed (decode is always one token);
+            # prefill chunks come from the host wholesale.
+            tok0 = jnp.where(use_host, host_tok[:, 0], prev_tok)
+            toks = jnp.concatenate([tok0[:, None], host_tok[:, 1:]],
+                                   axis=1)
+            pos_ids = jnp.clip(
+                ctx[:, None] + jnp.arange(C)[None, :], 0, T - 1)
+            x = embed[toks] + wpos[pos_ids]              # [S, C, d]
+            q = (x @ wq).reshape(S, C, H, dh)
+            k = (x @ wk).reshape(S, C, H, dh)
+            v = (x @ wv).reshape(S, C, H, dh)
+            pos = ctx[:, None] + jnp.arange(C)[None, :]   # [S, C]
+            valid = jnp.arange(C)[None, :] < n_new[:, None]
+            blk = jnp.take_along_axis(
+                tables, jnp.clip(pos // bs, 0, B - 1), axis=1)
+            # Invalid positions scatter to block id N — out of range,
+            # dropped (never a masked-multiply: the pool must keep
+            # exact prior contents at untouched positions).
+            blk = jnp.where(valid, blk, N)
+            off = pos % bs
+            kpool = kpool.at[blk, off].set(k, mode="drop")
+            vpool = vpool.at[blk, off].set(v, mode="drop")
+            keys = kpool[tables].reshape(S, T, H, dh)
+            vals = vpool[tables].reshape(S, T, H, dh)
+            scores = jnp.einsum("schd,sthd->shct", q, keys) / np.sqrt(dh)
+            tpos = jnp.arange(T)
+            causal = ((tpos[None, None, :] <= pos[:, :, None])
+                      & valid[:, :, None])               # [S, C, T]
+            scores = jnp.where(causal[:, None, :, :], scores,
+                               jnp.float32(-1e30))
+            attn = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("shct,sthd->schd", attn, vals).reshape(
+                S, C, H * dh)
+            y = x + o @ wo
+            y = y + jax.nn.relu(y @ w1) @ w2
+            last = jnp.clip(n_new - 1, 0, C - 1)
+            yl = jnp.take_along_axis(
+                y, last[:, None, None], axis=1)[:, 0]    # [S, d]
+            logits = yl @ wout
+            out = jnp.argmax(logits, axis=1).astype(jnp.int32)
+            return kpool, vpool, out
+
+        if donate is None:
+            donate = jax.devices()[0].platform != "cpu"
+        self.donate = bool(donate)
+        dn = (0, 1) if self.donate else ()
+        kp = jnp.zeros((N, bs, H, dh), jnp.float32)
+        vp = jnp.zeros((N, bs, H, dh), jnp.float32)
+        pt = jnp.zeros((S,), jnp.int32)
+        ht = jnp.zeros((S, C), jnp.int32)
+        uh = jnp.zeros((S,), jnp.bool_)
+        i32 = jnp.zeros((S,), jnp.int32)
+        tb = jnp.zeros((S, B), jnp.int32)
+        # AOT compile in the constructor (the LocalExecutor contract
+        # since PR 2): admission latency never includes XLA, and the
+        # supervisor's watchdog never reads a cold compile as a wedge.
+        self._step = jax.jit(step, donate_argnums=dn).lower(
+            kp, vp, pt, ht, uh, i32, i32, tb).compile()
+
+    def init_pools(self):
+        """Fresh zeroed (kpool, vpool) device arrays."""
+        import jax.numpy as jnp
+
+        shape = (self.num_blocks, self.block_size, self.heads,
+                 self.d_head)
+        return (jnp.zeros(shape, jnp.float32),
+                jnp.zeros(shape, jnp.float32))
+
+    def init_prev(self):
+        """Zeroed [slots] int32 device array for the token recurrence."""
+        import jax.numpy as jnp
+
+        return jnp.zeros((self.slots,), jnp.int32)
+
+    def __call__(self, kpool, vpool, prev_tok, host_tok, use_host,
+                 ctx, n_new, tables):
+        """(kpool', vpool', out_tokens) — all device arrays still in
+        flight (jax async dispatch); the scheduler's pipelined loop
+        overlaps host bookkeeping against them. The pools are consumed
+        when donation is on: thread them linearly."""
+        return self._step(kpool, vpool, prev_tok, host_tok, use_host,
+                          ctx, n_new, tables)

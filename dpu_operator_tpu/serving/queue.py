@@ -18,7 +18,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import List
+from typing import List, Tuple
 
 from .. import faults
 from ..obs import trace as obs_trace
@@ -74,20 +74,42 @@ class AdmissionQueue:
                  ) -> List[GenerateRequest]:
         """Pop up to n requests; blocks up to `timeout` only while the
         queue is empty (a busy batcher polls with timeout=0 so decode
-        steps never stall on admission). Expired entries are shed here,
-        failed with the error the HTTP layer maps to a 503."""
+        steps never stall on admission). Expired entries settle here:
+        a 503-mapped fail — or, when a requeued request already
+        carries settled tokens, the truncated-200 mid-decode contract
+        (same disposition as the supervisor's _requeue)."""
         out: List[GenerateRequest] = []
-        shed: List[GenerateRequest] = []
+        shed: List[Tuple[GenerateRequest, str]] = []
         with self._lock:
             if not self._q and timeout > 0:
                 self._nonempty.wait(timeout)
             now = time.monotonic()
             while self._q and len(out) < n:
                 req = self._q.popleft()
+                if req.done:
+                    # Settled elsewhere while queued (e.g. the HTTP
+                    # handler's wedge-timeout 500): drop. Settling
+                    # again would mutate truncated/finished_at after
+                    # the response was written — the same double-
+                    # settle the supervisor's _requeue guards against.
+                    continue
                 if req.deadline <= now:
-                    self.shed_expired += 1
-                    req.fail(DEADLINE_QUEUED_ERROR)
-                    shed.append(req)
+                    if req.tokens:
+                        # A requeued resumable-lease request keeps its
+                        # settled tokens (ISSUE 7): its deadline
+                        # lapsing HERE is the same mid-decode
+                        # truncation as lapsing mid-failure in the
+                        # supervisor's _requeue — 200 with what was
+                        # decoded, never a 503 that discards it.
+                        # finish() releases the lease via the settle
+                        # choke point.
+                        req.truncated = True
+                        req.finish()
+                        shed.append((req, "deadline_truncated"))
+                    else:
+                        self.shed_expired += 1
+                        req.fail(DEADLINE_QUEUED_ERROR)
+                        shed.append((req, "deadline_queued"))
                     continue
                 out.append(req)
             # Popped requests are invisible to depth() but not yet in a
@@ -101,10 +123,10 @@ class AdmissionQueue:
         # queue lock is on the submit hot path.
         tr = self.tracer
         if tr.enabled:
-            for req in shed:
+            for req, reason in shed:
                 tr.event("queue.shed", request_id=req.request_id,
                          parent_id=req.trace_parent,
-                         attrs={"reason": "deadline_queued"})
+                         attrs={"reason": reason})
                 tr.decision("shed", request_id=req.request_id)
             for req in out:
                 # The wait span covers (re-)enqueue → pop — the
@@ -130,9 +152,17 @@ class AdmissionQueue:
             self.requeued += 1
             self._gauge()
             self._nonempty.notify()
-        self.tracer.event("queue.requeue", request_id=req.request_id,
-                          parent_id=req.trace_parent,
-                          attrs={"attempts": req.attempts})
+        # kv_blocks records block-table ownership riding the queue
+        # (ISSUE 7): a resumable lease means the next admit re-attaches
+        # these pages instead of re-prefilling the prompt.
+        lease = getattr(req, "kv_lease", None)
+        self.tracer.event(
+            "queue.requeue", request_id=req.request_id,
+            parent_id=req.trace_parent,
+            attrs={"attempts": req.attempts,
+                   "kv_blocks": (len(lease.blocks)
+                                 if lease is not None
+                                 and lease.resumable else 0)})
 
     def mark_placed(self, n: int) -> None:
         """The batcher finished placing (or failing) n popped requests."""

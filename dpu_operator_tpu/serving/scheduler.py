@@ -63,7 +63,8 @@ import numpy as np
 
 from ..obs import logging as obs_logging
 from ..obs import trace as obs_trace
-from .api import GenerateRequest
+from .api import KV_OOM_ERROR, GenerateRequest
+from .kvcache.allocator import KVCacheOOM
 
 log = logging.getLogger(__name__)
 
@@ -88,6 +89,10 @@ class ContinuousBatcher:
         self.idle_wait_s = idle_wait_s
         self.pipelined = (bool(executor.pipelined) if pipelined is None
                           else bool(pipelined))
+        # Paged-KV executors (serving/kvcache) speak tokens, not
+        # [slots, d] rows: admission binds a block-table lease and the
+        # loop is _run_kv (chunked prefill + NO_TOKEN-aware retire).
+        self.kv_mode = bool(getattr(executor, "kv", False))
         # crash_only (Candea & Fox): an executor failure EXITS the loop
         # with the occupants left in their slots and the error on
         # self.failure — the supervisor (ReplicaPool) seizes, requeues
@@ -220,11 +225,20 @@ class ContinuousBatcher:
         for req in self.queue.get_many(len(free), timeout=timeout):
             i = free.pop(0)
             try:
-                vec = np.asarray(req.prompt_vec, np.float32)
-                if vec.shape != (self.executor.d,):
-                    raise ValueError(
-                        f"prompt_vec shape {vec.shape} != "
-                        f"({self.executor.d},)")
+                kv_cached = None
+                if self.kv_mode:
+                    # Bind (or re-attach) the request's KV lease: the
+                    # executor reserves its worst-case pages here, so
+                    # OOM is an admission decision, never a mid-decode
+                    # failure.
+                    vec = None
+                    kv_cached = self.executor.kv_attach(i, req)
+                else:
+                    vec = np.asarray(req.prompt_vec, np.float32)
+                    if vec.shape != (self.executor.d,):
+                        raise ValueError(
+                            f"prompt_vec shape {vec.shape} != "
+                            f"({self.executor.d},)")
                 req.admitted_at = time.monotonic()
                 self._slots[i] = req
                 placed.append((i, req, vec))
@@ -234,15 +248,32 @@ class ContinuousBatcher:
                     # construction one step after the retire that freed
                     # the slot (the ISSUE 3 hand-off, visible in the
                     # trace instead of only in a docstring).
+                    attrs = {"replica": self.replica, "slot": i,
+                             "lands_at_step": self.steps + 1,
+                             "pipelined": self.pipelined}
+                    if kv_cached is not None:
+                        attrs["kv_cached_tokens"] = kv_cached
                     self.tracer.event(
                         "batcher.admit", request_id=req.request_id,
-                        parent_id=req.trace_parent,
-                        attrs={"replica": self.replica, "slot": i,
-                               "lands_at_step": self.steps + 1,
-                               "pipelined": self.pipelined})
+                        parent_id=req.trace_parent, attrs=attrs)
                     self.tracer.decision(
                         "admit", request_id=req.request_id,
                         replica=self.replica, slot=i)
+            except KVCacheOOM as e:
+                # Capacity shed, not a replica failure: pages free as
+                # in-flight work finishes, so the HTTP layer answers
+                # 503 + Retry-After (KV_OOM_ERROR matched exactly).
+                log.warning("batcher %s: kv admission shed "
+                            "(request %s): %s", self.replica,
+                            req.request_id, e)
+                req.fail(KV_OOM_ERROR)
+                self._count("serving_kv_admission_shed_total",
+                            {"replica": self.replica},
+                            help="requests shed at admission because "
+                                 "the KV allocator had no pages")
+                self.tracer.decision("shed_kv_oom",
+                                     request_id=req.request_id,
+                                     replica=self.replica)
             except Exception as e:
                 # A request popped from the queue has exactly one owner
                 # now — losing it here would park its handler thread
@@ -251,6 +282,14 @@ class ContinuousBatcher:
                               self.replica, req.request_id)
                 if self._slots[i] is req:
                     self._slots[i] = None
+                if self.kv_mode:
+                    # kv_attach may have bound the slot before a later
+                    # admit statement raised; leaving it bound poisons
+                    # the slot ("already bound" for every future admit)
+                    # and keeps planning decode for a ghost state.
+                    # No-op when nothing is bound; lease release is
+                    # idempotent against fail()'s finish hook.
+                    self.executor.kv_release_slot(i, cache=False)
                 req.fail(f"admission failed: {e}")
             finally:
                 # In a slot (or failed) — no longer "in flight between
@@ -517,7 +556,13 @@ class ContinuousBatcher:
                                        "mode": "pipelined",
                                        "request_ids": cur_rids})
                     self.blocked_since = ts0
-                    handle = ex.submit(updates)  # step k dispatched
+                    # step/request_ids are diagnostic context: an
+                    # update-overflow ValueError out of the device
+                    # step must name the step and the admitting
+                    # requests (the seize path can race admissions
+                    # close to the slot limit).
+                    handle = ex.submit(updates, step=self.steps + 1,
+                                       request_ids=admit_rids or None)
                     self.blocked_since = None
                     self.steps += 1
                     if traced:
@@ -589,6 +634,188 @@ class ContinuousBatcher:
                     log.exception("batcher %s: executor reset failed",
                                   self.replica)
 
+    # -- paged-KV loop (ISSUE 7: token-level executors) ------------------------
+
+    def _retire_kv(self, tokens, snapshot) -> None:
+        """KV-aware retire against the submit-time snapshot. NO_TOKEN
+        (-1) marks a slot whose step emitted nothing — a mid-prefill
+        chunk (the request stays, its prompt still filling under the
+        chunk budget) or a stale post-seize handle. Emitted tokens
+        settle like the row plane, except the lease is
+        released-AND-cached before finish() so the settle hook no-ops
+        and the prompt's full blocks enter the prefix tree while the
+        owner refs still hold them."""
+        ex = self.executor
+        now = time.monotonic()
+        for i, req in enumerate(snapshot):
+            if req is None or self._slots[i] is not req:
+                continue
+            if req.done:
+                # Abandoned by the handler (wait timeout → 500): the
+                # finish hook already released the lease, so no cache
+                # insert — just evict the zombie slot.
+                ex.kv_release_slot(i, cache=False)
+                self._slots[i] = None
+                continue
+            t = int(tokens[i])
+            emitted = t >= 0
+            if emitted:
+                req.tokens.append(t)
+            finished = emitted and len(req.tokens) >= req.max_tokens
+            if not finished and now >= req.deadline:
+                # Deadline mid-decode OR mid-prefill: return whatever
+                # exists, marked truncated, at the step boundary —
+                # the PR 2 bounded-p99 contract extended to prompts
+                # still prefilling (possibly zero tokens).
+                req.truncated = True
+                finished = True
+            if finished:
+                ex.kv_release_slot(i, cache=True)
+                self._count("serving_tokens_total",
+                            {"replica": self.replica},
+                            by=float(len(req.tokens)),
+                            help="decoded tokens")
+                req.finish()
+                self.tracer.event(
+                    "batcher.retire", request_id=req.request_id,
+                    parent_id=req.trace_parent,
+                    attrs={"replica": self.replica,
+                           "tokens": len(req.tokens),
+                           "truncated": req.truncated, "kv": True})
+                self._slots[i] = None
+
+    def _collect_retire_kv(self, submitted) -> Optional[float]:
+        """Collect one in-flight KV step and settle it; returns the
+        device-done timestamp (the gap clock's start), or None when a
+        supervisor seize landed — the loop must exit without touching
+        anything further."""
+        handle, snap, step_no, rids = submitted
+        ex = self.executor
+        tc = time.monotonic()
+        self.blocked_since = tc
+        tokens = ex.collect(handle)
+        self.blocked_since = None
+        t_done = time.monotonic()
+        n_active = sum(1 for r in snap if r is not None)
+        self._observe_step(t_done - tc, n_active)
+        if self.tracer.enabled and rids is not None:
+            dev = self.tracer.record_span(
+                "step.device", tc, t_done,
+                attrs={"replica": self.replica, "step": step_no,
+                       "mode": "kv", "n_active": n_active,
+                       "request_ids": rids})
+            self.tracer.record_span(
+                "executor.collect", tc, t_done, parent_id=dev,
+                attrs={"replica": self.replica, "step": step_no,
+                       "request_ids": rids})
+        with self._settle_lock:
+            if self._abandoned:
+                return None
+            self._retire_kv(tokens, snap)
+        return t_done
+
+    def _run_kv(self) -> None:
+        """Token-level loop over a paged-KV executor. Same skeleton
+        and seize/watchdog contracts as _run_pipelined — admissions
+        and settling under the settle lock, dispatch and collect
+        outside it with blocked_since published — but the step payload
+        is the EXECUTOR's chunked-prefill/decode plan (no row
+        scatter), admission binds a KV lease, and retire understands
+        NO_TOKEN. `pipelined` picks the shape: True settles step k-1
+        while step k runs on the device (the decode recurrence chains
+        on device, so dispatch needs no host token); False collects
+        every step before the next dispatch — the measured baseline.
+        Token STREAMS are identical either way: rows decode
+        independently and the plan depends only on committed cursors
+        (the ISSUE 3 equivalence argument, carried to tokens).
+
+        The `gen` captured under the settle lock makes the
+        documented dispatch-outside-the-lock window safe on the KV
+        plane: a submit raced by a seize→reset lands with a stale
+        generation and becomes a no-op handle instead of advancing
+        the restarted session's cursors."""
+        ex = self.executor
+        self.blocked_since = time.monotonic()
+        ex.reset()
+        self.blocked_since = None
+        prev = None  # (handle, slot snapshot, step no, occupant rids)
+        t_gap_start = None
+        while not self._stop.is_set():
+            try:
+                submitted = None
+                admit_rids: List[str] = []
+                with self._settle_lock:
+                    if self._abandoned:
+                        return
+                    block = self.active == 0 and prev is None
+                    for _i, req, _vec in self._pop_admissions(
+                            block=block):
+                        admit_rids.append(req.request_id)
+                    snapshot = (list(self._slots) if self.active > 0
+                                else None)
+                    gen = ex.kv_gen()
+                if snapshot is not None:
+                    traced = self.tracer.enabled
+                    cur_rids = ([r.request_id for r in snapshot
+                                 if r is not None] if traced else None)
+                    ts0 = time.monotonic()
+                    if t_gap_start is not None:
+                        self._observe_gap(ts0 - t_gap_start)
+                        if traced:
+                            self.tracer.record_span(
+                                "step.host", t_gap_start, ts0,
+                                attrs={"replica": self.replica,
+                                       "step": self.steps + 1,
+                                       "mode": "kv",
+                                       "request_ids": cur_rids})
+                    self.blocked_since = ts0
+                    handle = ex.submit((), step=self.steps + 1,
+                                       request_ids=admit_rids or None,
+                                       gen=gen)
+                    self.blocked_since = None
+                    self.steps += 1
+                    if traced:
+                        self.tracer.record_span(
+                            "executor.submit", ts0, time.monotonic(),
+                            attrs={"replica": self.replica,
+                                   "step": self.steps, "mode": "kv",
+                                   "admits_landing": admit_rids or None,
+                                   "request_ids": cur_rids})
+                    submitted = (handle, snapshot, self.steps, cur_rids)
+                if not self.pipelined:
+                    # Sync shape: settle THIS step before the next
+                    # dispatch; nothing ever carries across iterations.
+                    if submitted is not None:
+                        t_gap_start = self._collect_retire_kv(submitted)
+                        if t_gap_start is None:
+                            return
+                    else:
+                        t_gap_start = None
+                    continue
+                if prev is not None:
+                    t_done = self._collect_retire_kv(prev)
+                    if t_done is None:
+                        return
+                    t_gap_start = t_done
+                if submitted is None:
+                    t_gap_start = None  # pipeline drained: idle queue
+                    # waits must not masquerade as host gap
+                prev = submitted
+            except Exception as e:
+                self.blocked_since = None
+                if self.crash_only:
+                    raise
+                log.exception("batcher %s: kv step failed",
+                              self.replica)
+                self._fail_occupants(e)
+                prev = None
+                t_gap_start = None
+                try:
+                    ex.reset()  # unbind poisoned slot states
+                except Exception:
+                    log.exception("batcher %s: executor reset failed",
+                                  self.replica)
+
     def _fail_occupants(self, e: Exception) -> None:
         for i, req in enumerate(self._slots):
             if req is not None:
@@ -607,7 +834,9 @@ class ContinuousBatcher:
             # JSON-lines ContextFilter stamps it) — request ids are
             # bound per call site, the replica once here.
             with obs_logging.context(replica=self.replica):
-                if self.pipelined:
+                if self.kv_mode:
+                    self._run_kv()
+                elif self.pipelined:
                     self._run_pipelined()
                 else:
                     self._run_sync()

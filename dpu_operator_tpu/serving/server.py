@@ -55,8 +55,9 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.flight import FlightRecorder
 from ..utils.metrics import Registry
-from .api import (DEADLINE_QUEUED_ERROR, RETRIES_EXHAUSTED_ERROR,
-                  Draining, QueueFull, GenerateRequest, encode_prompt)
+from .api import (DEADLINE_QUEUED_ERROR, KV_OOM_ERROR,
+                  RETRIES_EXHAUSTED_ERROR, Draining, QueueFull,
+                  GenerateRequest, encode_prompt, encode_prompt_tokens)
 from .executor import Executor, ReplicaPool
 from .queue import AdmissionQueue
 
@@ -116,6 +117,26 @@ class ServingServer:
         self.default_max_tokens = default_max_tokens
         self.max_tokens_cap = max_tokens_cap
         self.default_deadline_s = default_deadline_s
+        kvs = {bool(getattr(ex, "kv", False)) for ex in executors}
+        if len(kvs) != 1:
+            # One front door, one request vocabulary: a pool mixing
+            # token-plane and row-plane replicas could not validate a
+            # prompt once at admission.
+            raise ValueError("pool mixes paged-KV and row-plane "
+                             "replicas")
+        self.kv = kvs.pop()
+        if self.kv:
+            vocabs = {ex.vocab for ex in executors}
+            ctxs = {ex.max_context for ex in executors}
+            if len(vocabs) != 1 or len(ctxs) != 1:
+                raise ValueError(
+                    f"all KV replicas must share one vocab/max_context,"
+                    f" got {sorted(vocabs)}/{sorted(ctxs)}")
+            self.vocab = executors[0].vocab
+            self.max_context = executors[0].max_context
+            # Scrape-time delta state for the kv token counters
+            # (published like serving_trace_dropped_total).
+            self._kv_pub: dict = {}
         dims = {ex.d for ex in executors}
         if len(dims) != 1:
             # prompt_vec width is validated once at the front door; a
@@ -364,6 +385,48 @@ class ServingServer:
                 "serving_trace_dropped_total", by=float(delta),
                 help="spans dropped by the tracer's bounded buffers "
                      "(per-thread overflow + ring eviction)")
+        # Paged-KV plane (ISSUE 7): allocator occupancy, prefix-cache
+        # effectiveness, and the prefill/decode token counters —
+        # executor-authoritative values published at scrape time
+        # (gauges as snapshots, counters as deltas so the series stay
+        # monotonic per server).
+        if self.kv:
+            agg = {"used": 0, "free": 0, "shared": 0,
+                   "hit": 0, "lookup": 0}
+            deltas = {"prefill": 0, "decode": 0}
+            with self._trace_pub_lock:
+                for idx, ex in enumerate(self.pool.executors):
+                    st = ex.kv_stats()
+                    agg["used"] += st["blocks_used"]
+                    agg["free"] += st["blocks_free"]
+                    agg["shared"] += st["blocks_shared"]
+                    agg["hit"] += st["prefix_hit_tokens"]
+                    agg["lookup"] += st["prefix_lookup_tokens"]
+                    last = self._kv_pub.get(idx, (0, 0))
+                    deltas["prefill"] += st["prefill_tokens"] - last[0]
+                    deltas["decode"] += st["decode_tokens"] - last[1]
+                    self._kv_pub[idx] = (st["prefill_tokens"],
+                                         st["decode_tokens"])
+            for state in ("used", "free", "shared"):
+                self.registry.gauge_set(
+                    "serving_kv_blocks", float(agg[state]),
+                    {"state": state},
+                    help="paged KV blocks by allocator state "
+                         "(shared = refcount > 1)")
+            self.registry.gauge_set(
+                "serving_kv_prefix_hit_frac",
+                round(agg["hit"] / agg["lookup"], 6)
+                if agg["lookup"] else 0.0,
+                help="fraction of looked-up prompt tokens served from "
+                     "the prefix cache")
+            self.registry.counter_inc(
+                "serving_prefill_tokens_total", by=float(
+                    max(0, deltas["prefill"])),
+                help="prompt tokens processed through chunked prefill")
+            self.registry.counter_inc(
+                "serving_decode_tokens_total", by=float(
+                    max(0, deltas["decode"])),
+                help="decode tokens emitted by paged-KV steps")
         # Per-replica host-gap share of the decode loop: the overlap
         # number an operator watches — near 0 means host scheduling
         # hides behind device steps; climbing toward 1 means the device
@@ -421,7 +484,7 @@ class ServingServer:
             return self._finish(handler, 400,
                                 {"error": "body must be an object"}, "bad")
         try:
-            vec = self._prompt_vec(body)
+            vec = self._prompt_vec(body) if not self.kv else None
         except (ValueError, TypeError) as e:
             # TypeError too: np.asarray raises it for non-numeric JSON
             # (e.g. prompt_vec as an object) — that's a client error,
@@ -451,8 +514,17 @@ class ServingServer:
                 {"error": f"deadline_ms must be a finite number in "
                           f"(0, {_DEADLINE_CAP_MS:.0f}]"}, "bad")
 
+        toks = None
+        if self.kv:
+            try:
+                toks = self._prompt_tokens(body, max_tokens)
+            except (ValueError, TypeError) as e:
+                return self._finish(handler, 400, {"error": str(e)},
+                                    "bad")
+
         req = GenerateRequest(prompt_vec=vec, max_tokens=max_tokens,
-                              deadline=t0 + deadline_ms / 1000.0)
+                              deadline=t0 + deadline_ms / 1000.0,
+                              prompt_tokens=toks)
         # Root span of the request's trace: every downstream span
         # (queue, admit, retire, supervisor requeue) parents onto it
         # through req.trace_parent; _finish closes it with the outcome.
@@ -497,10 +569,14 @@ class ServingServer:
                                 {"error": "internal: request lost"},
                                 "lost", elapsed_s=elapsed, req=req)
         if req.error is not None:
-            shed = req.error == DEADLINE_QUEUED_ERROR
+            shed = req.error in (DEADLINE_QUEUED_ERROR, KV_OOM_ERROR)
             code = 503 if shed else 500
-            if shed:
+            if req.error == DEADLINE_QUEUED_ERROR:
                 outcome = "deadline_queue"
+            elif req.error == KV_OOM_ERROR:
+                # KV admission shed: pages free as in-flight requests
+                # finish — back off and retry, like queue_full.
+                outcome = "kv_oom"
             elif req.error == RETRIES_EXHAUSTED_ERROR:
                 # The supervisor's give-up: the request rode its full
                 # attempts budget through replica failures.
@@ -511,12 +587,51 @@ class ServingServer:
                                 outcome,
                                 retry if code == 503 else None,
                                 elapsed_s=elapsed, req=req)
-        self._finish(handler, 200, {
+        body_out = {
             "id": req.request_id,
             "tokens": req.tokens,
             "truncated": req.truncated,
             "timings": req.timings_ms(),
-        }, "ok", elapsed_s=elapsed, req=req)
+        }
+        lease = req.kv_lease
+        if lease is not None:
+            # How much prefill the prefix cache skipped — the client-
+            # visible proof that sharing worked (bench section 8 keys
+            # on it).
+            body_out["kv"] = {"cached_tokens": lease.cached_tokens,
+                              "blocks": len(lease.blocks)}
+        self._finish(handler, 200, body_out, "ok", elapsed_s=elapsed,
+                     req=req)
+
+    def _prompt_tokens(self, body: dict, max_tokens: int) -> list:
+        """Token-plane prompt parsing (paged-KV pools): explicit
+        ``prompt_tokens`` (ints in [0, vocab)) or a ``prompt`` string
+        through the deterministic stand-in tokenizer. Validated once
+        at the front door, like prompt_vec: width AND the worst-case
+        context (prompt + max_tokens must fit the replicas' block
+        tables)."""
+        if "prompt_tokens" in body:
+            toks = body["prompt_tokens"]
+            if (not isinstance(toks, list) or not toks
+                    or not all(isinstance(t, int)
+                               and not isinstance(t, bool)
+                               and 0 <= t < self.vocab for t in toks)):
+                raise ValueError(
+                    f"prompt_tokens must be a non-empty list of ints "
+                    f"in [0, {self.vocab})")
+        else:
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError(
+                    "need 'prompt' (string) or 'prompt_tokens'")
+            n = min(16, max(1, self.max_context - max_tokens))
+            toks = encode_prompt_tokens(prompt, n, self.vocab)
+        if len(toks) + max_tokens > self.max_context:
+            raise ValueError(
+                f"prompt ({len(toks)} tokens) + max_tokens "
+                f"({max_tokens}) exceeds max context "
+                f"{self.max_context}")
+        return toks
 
     def _prompt_vec(self, body: dict) -> np.ndarray:
         if "prompt_vec" in body:

@@ -1,0 +1,49 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl009_nm.py
+"""GL009 near-misses that must stay silent: the OOM-unwind shape
+(acquire paired with a release in the same function), acquire handed
+to a KVLease (the registered finalizer — release() runs on every
+settle path), lease release through the settle hook, and acquire/fork
+on receivers with no allocator pedigree (a lock, os.fork)."""
+
+import os
+import threading
+
+from dpu_operator_tpu.serving.kvcache.allocator import KVCacheOOM, KVLease
+
+
+class Batcher:
+    def attach(self, req):
+        # Registered finalizer: the blocks flow into a KVLease.
+        cached, n = self.prefix.match_and_fork(req.prompt_tokens,
+                                               req.request_id)
+        try:
+            fresh = self.allocator.acquire(4, req.request_id)
+        except KVCacheOOM:
+            # Error-path unwind: paired release.
+            self.allocator.release(cached, req.request_id)
+            raise
+        req.kv_lease = KVLease(self.allocator, "ex", req.request_id,
+                               cached + fresh, req.prompt_tokens, n)
+        return n
+
+    def scratch(self):
+        # Acquire paired with release in the same function.
+        blocks = self.allocator.acquire(1, "probe")
+        try:
+            return list(blocks)
+        finally:
+            self.allocator.release(blocks, "probe")
+
+    def settle(self, req):
+        # Settle-hook release counts: the lease's choke point.
+        req.kv_lease.on_request_settled()
+
+    def unrelated(self):
+        # No allocator pedigree: a lock's acquire and a process fork.
+        lock = threading.Lock()
+        lock.acquire()
+        try:
+            pid = os.fork() if hasattr(os, "fork") else 0
+        finally:
+            lock.release()
+        return pid

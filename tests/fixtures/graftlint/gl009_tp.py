@@ -1,0 +1,19 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl009_tp.py
+"""GL009 true positives: KV pages acquired with no way back. Two
+findings: a bare allocator.acquire whose blocks are stashed on an ad
+hoc attribute (no release, no lease — the leak ledger fails the
+teardown), and a prefix-tree fork held the same way."""
+
+
+class Batcher:
+    def admit(self, req):
+        # TP 1: acquired, stashed, never released, no KVLease.
+        blocks = self.allocator.acquire(4, req.request_id)
+        self.tables[req.request_id] = blocks
+
+    def warm(self, req):
+        # TP 2: prefix fork with the same bare-stash shape.
+        cached, n = self.prefix.match_and_fork(req.prompt_tokens,
+                                               req.request_id)
+        self.tables[req.request_id] = cached
+        return n

@@ -179,7 +179,7 @@ def test_synthetic_worker_survives_poison_item():
     ex = SyntheticExecutor(slots=2, d=8, pipelined=True)
     try:
         ex.collect(ex.submit([]))          # spin the worker up
-        ex._work.put(("bogus",))           # the pre-fix killer
+        ex._worker._work.put(("bogus",))   # the pre-fix killer
         h = ex.submit([])
         assert h.event.wait(2.0), \
             "worker died on the poison item: collect() would hang forever"
@@ -505,6 +505,98 @@ def test_chaos_matrix_local(mode, fault, local_executors, settle_counts):
     assert all(e is None for e, _ in injected), injected
     assert injected == baseline
     assert set(settle_counts.values()) == {1}
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
+# -- paged-KV re-attach (ISSUE 7): retry without re-decode --------------------
+
+
+@pytest.mark.parametrize("backend", ["synthetic", "paged"])
+def test_kv_kill_mid_decode_reattaches_pages_instead_of_redecoding(
+        backend, settle_counts, tmp_path):
+    """Chaos-matrix extension: a replica killed MID-DECODE of a
+    paged-KV request recovers by re-attaching the victim's KV pages —
+    the supervisor's seize/requeue carries block-table ownership
+    through the queue. Must hold: byte-identical token streams vs an
+    uninjected run, exactly-once settle, ZERO leaked blocks, and the
+    recovery trace shows strictly fewer replayed steps than a full
+    re-decode from the prompt (the whole point of keeping the pages)."""
+    t0 = time.perf_counter()
+    plen, chunk, max_toks = 32, 8, 6
+    prompt = [int(x) for x in range(plen)]
+    if backend == "synthetic":
+        from dpu_operator_tpu.serving import SyntheticKVExecutor
+
+        inner = SyntheticKVExecutor(slots=2, block_size=4,
+                                    num_blocks=64,
+                                    max_blocks_per_req=16,
+                                    prefill_chunk=chunk, pipelined=True)
+    else:
+        from dpu_operator_tpu.serving import PagedKVExecutor
+
+        inner = PagedKVExecutor(slots=2, block_size=4, num_blocks=64,
+                                max_blocks_per_req=16,
+                                prefill_chunk=chunk, d=16, heads=2,
+                                vocab=32, mode="pipelined")
+
+    def run(inject, flight_dir=None):
+        ex = FaultyExecutor(inner, site="kv0") if inject else inner
+        reqs = [GenerateRequest(prompt_vec=None, max_tokens=max_toks,
+                                deadline=time.monotonic() + 60.0,
+                                prompt_tokens=list(prompt))]
+        pool, _q = _run_pool([ex], reqs, timeout=20.0,
+                             flight_dir=flight_dir)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        inner.allocator.assert_clean()
+        return [(r.error, list(r.tokens)) for r in reqs], reqs
+
+    baseline, _ = run(inject=False)
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            # The baseline primed the prefix cache, so prefill is one
+            # chunk step; submit 4 lands mid-decode (a few tokens
+            # settled, more to go).
+            plan.inject("kv0.submit", exc=RuntimeError("injected kill"),
+                        at_calls=[4])
+            injected, reqs = run(inject=True, flight_dir=tmp_path)
+        spans = tr.spans_snapshot()
+    assert injected == baseline, (injected, baseline)
+    assert all(e is None for e, _ in injected)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    victim = reqs[0].request_id
+    assert getattr(inner, "resumed_total") >= 1
+
+    # The trace proves the cheap retry: the requeue rode with KV
+    # blocks, and the victim appears in strictly fewer post-requeue
+    # steps than a full re-decode (prefill chunks + every token again)
+    # would need.
+    requeues = [s for s in spans if s.name == "supervisor.requeue"
+                and s.attrs.get("outcome") == "requeued_kv"]
+    assert [s.request_id for s in requeues] == [victim]
+    queue_rq = [s for s in spans if s.name == "queue.requeue"
+                and s.request_id == victim]
+    assert queue_rq and queue_rq[0].attrs.get("kv_blocks", 0) > 0, \
+        "block-table ownership did not ride the queue"
+    requeue_t = requeues[0].t0
+    replayed = sum(
+        1 for s in spans
+        if s.name == "step.device" and s.t0 > requeue_t
+        and victim in (s.attrs.get("request_ids") or ()))
+    full_redecode = -(-plen // chunk) + max_toks
+    assert 0 < replayed < full_redecode, (replayed, full_redecode)
+    # Flight recorder: the restart snapshot carries the same chain.
+    flight = _flight_spans(tmp_path, "restart")
+    assert any(s["name"] == "supervisor.requeue"
+               and s["attrs"].get("outcome") == "requeued_kv"
+               for s in flight)
+    if hasattr(inner, "close"):
+        inner.close()
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 

@@ -56,7 +56,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 8
+    assert len(set(ids)) == len(ids) == 9
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -72,6 +72,7 @@ _EXPECT = {
     "GL006": 1,  # psum over the 'pd' typo
     "GL007": 1,  # while-True connect retry, no bound, no sleep
     "GL008": 2,  # bare replica-only logs in the request-scoped graph
+    "GL009": 2,  # acquire and prefix-fork with no release, no lease
 }
 
 

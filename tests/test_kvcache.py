@@ -1,0 +1,623 @@
+"""Paged KV-cache decode (ISSUE 7): allocator/prefix-tree contracts,
+paged-attention invariances, chunked prefill, scheduler integration,
+retry re-attach plumbing, and the /metrics exposition of the new
+series.
+
+Correctness strategy for the device step: INVARIANCE, not a duplicated
+reference model — the same prompt must decode the same stream under
+every scheduling decomposition (sync vs pipelined loop, chunk=1 vs
+chunk=8 prefill, block_size 2 vs 8 paging, prefix cache on vs off).
+Those axes are exactly where paged attention can go wrong (append
+offsets, causal masks, table gathers, cache reuse), and any bug in one
+of them breaks cross-decomposition equality.
+
+Every test that touches an allocator asserts ZERO leaked blocks at the
+end — the ISSUE 7 acceptance contract, enforced here as teardown."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      GenerateRequest, ReplicaPool,
+                                      SyntheticKVExecutor)
+from dpu_operator_tpu.serving.api import KV_OOM_ERROR
+from dpu_operator_tpu.serving.kvcache import (CACHE_OWNER,
+                                              KVBlockAllocator,
+                                              KVCacheOOM, KVLease,
+                                              PrefixTree)
+
+MODEL = dict(vocab=32, d=16, heads=2)
+
+
+def _req(prompt, max_tokens=5, deadline_s=60.0):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + deadline_s,
+                           prompt_tokens=list(prompt))
+
+
+def _drive(ex, reqs, timeout=30.0):
+    """Run requests through a real ContinuousBatcher over `ex`."""
+    q = AdmissionQueue(max_depth=len(reqs) + 1)
+    b = ContinuousBatcher(ex, q)
+    for r in reqs:
+        q.submit(r)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=timeout), "request lost"
+    finally:
+        b.stop()
+    for r in reqs:
+        assert r.error is None, r.error
+    return [list(r.tokens) for r in reqs]
+
+
+# -- allocator ---------------------------------------------------------------
+
+
+def test_allocator_acquire_release_refcount_and_oom():
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    b1 = a.acquire(2, "r1")
+    assert len(b1) == 2 and a.free_count() == 2
+    a.fork(b1, "r2")                      # shared: ref 2 each
+    assert a.stats() == {"used": 2, "free": 2, "shared": 2}
+    assert a.release(b1, "r1") == 0       # r2 still holds them
+    assert a.release(b1, "r2") == 2       # now they free
+    assert a.free_count() == 4
+    with pytest.raises(KVCacheOOM):
+        a.acquire(5, "r3")
+    # Atomic OOM: the failed grant must not have consumed anything.
+    assert a.free_count() == 4
+    a.assert_clean()
+
+
+def test_allocator_leak_ledger_names_owner_and_double_free_raises():
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    blocks = a.acquire(2, "leaky")
+    assert a.leaked() == {"leaky": sorted(blocks)}
+    with pytest.raises(AssertionError, match="leaky"):
+        a.assert_clean()
+    a.release(blocks, "leaky")
+    with pytest.raises(ValueError, match="not held"):
+        a.release(blocks, "leaky")        # the double free
+    a.assert_clean()
+
+
+def test_lease_release_idempotent_and_settle_hook_fires():
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    blocks = a.acquire(2, "r1")
+    lease = KVLease(a, "ex", "r1", blocks, (1, 2, 3), 0)
+    req = _req([1, 2, 3])
+    req.kv_lease = lease
+    # Any settle path (here: a failure) must return the pages via the
+    # finish hook — and a second release must no-op, not double-free.
+    req.fail("boom")
+    assert not lease.resumable
+    assert a.free_count() == 4
+    assert lease.release() is False
+    a.assert_clean()
+
+
+# -- prefix tree -------------------------------------------------------------
+
+
+def test_prefix_tree_matches_full_blocks_and_never_whole_prompt():
+    a = KVBlockAllocator(num_blocks=8, block_size=4)
+    t = PrefixTree(a)
+    toks = list(range(12))
+    blocks = a.acquire(3, "r1")
+    t.insert(toks, blocks)                # 3 full blocks cached
+    # Identical prompt: the cap leaves the LAST token to recompute, so
+    # only 2 of 3 full blocks match (12 tokens → limit (12-1)//4 = 2).
+    got, n = t.match_and_fork(toks, "r2")
+    assert n == 8 and got == blocks[:2]
+    a.release(got, "r2")
+    # Diverging second block: only the first matches.
+    other = toks[:4] + [99, 98, 97, 96] + toks[8:]
+    got2, n2 = t.match_and_fork(other, "r3")
+    assert n2 == 4 and got2 == blocks[:1]
+    a.release(got2, "r3")
+    a.release(blocks, "r1")
+    assert t.flush() == 3
+    a.assert_clean(ignore=())
+
+
+def test_prefix_tree_evicts_lru_leaves_only():
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    t = PrefixTree(a)
+    b = a.acquire(2, "r1")
+    t.insert([1, 2, 3, 4], b)             # chain: b0 -> b1
+    a.release(b, "r1")
+    assert a.free_count() == 2            # cache holds both
+    # One block wanted: the LEAF (b1) goes first, never the interior.
+    assert t.evict(1) == 1
+    got, n = t.match_and_fork([1, 2, 9, 9, 9], CACHE_OWNER + "x")
+    assert n == 2 and got == [b[0]]       # b0 survived
+    a.release(got, CACHE_OWNER + "x")
+    t.flush()
+    a.assert_clean(ignore=())
+
+
+# -- scheduling: chunked prefill protects decode -----------------------------
+
+
+def test_decode_never_stalls_behind_chunked_prefill():
+    """The Sarathi property, asserted at plan granularity: a slot in
+    decode emits a token EVERY step even while a long prompt prefills
+    in another slot under the shared token budget."""
+    ex = SyntheticKVExecutor(slots=2, prefill_chunk=4, pipelined=False,
+                            num_blocks=64)
+    ra = _req([1, 2, 3], max_tokens=32)
+    assert ex.kv_attach(0, ra) == 0
+    # Drive A to decode phase.
+    toks = ex.collect(ex.submit((), gen=ex.kv_gen()))
+    assert toks[0] >= 0
+    ra.tokens.append(int(toks[0]))
+    # Long prompt lands mid-run in slot 1.
+    rb = _req(list(np.arange(24) % 7), max_tokens=4)
+    ex.kv_attach(1, rb)
+    for _ in range(5):                    # B prefills for 24/4 steps
+        toks = ex.collect(ex.submit((), gen=ex.kv_gen()))
+        assert toks[0] >= 0, "decode starved by prefill"
+        ra.tokens.append(int(toks[0]))
+    assert ex.steps_mixed >= 5            # prefill really co-ran
+    ex.kv_release_slot(0)
+    ex.kv_release_slot(1)
+    ra.finish()
+    rb.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_prefill_budget_round_robin_makes_progress_for_all_prompts():
+    ex = SyntheticKVExecutor(slots=2, prefill_chunk=4, prefill_budget=4,
+                            pipelined=False, num_blocks=64)
+    r0 = _req(list(np.arange(16) % 5), max_tokens=2)
+    r1 = _req(list(np.arange(16) % 3), max_tokens=2)
+    streams = _drive(ex, [r0, r1])
+    assert all(len(s) == 2 for s in streams)
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- invariance: the same stream under every decomposition -------------------
+
+
+def _paged(**kw):
+    from dpu_operator_tpu.serving import PagedKVExecutor
+
+    args = dict(slots=2, block_size=4, num_blocks=64,
+                max_blocks_per_req=8, prefill_chunk=8, seed=0, **MODEL)
+    args.update(kw)
+    return PagedKVExecutor(**args)
+
+
+@pytest.fixture(scope="module")
+def paged_pair():
+    """One compiled executor per loop shape (compile cost dominates;
+    reuse is safe — each batcher reset()s at start)."""
+    return {"pipelined": _paged(mode="pipelined"),
+            "sync": _paged(mode="sync")}
+
+
+# The 26-token prompt makes plen + max_tokens == 32 == the FULL
+# 8-block table at block_size 4: the pipelined loop's one phantom
+# plan after the final emitted token appends at position 31 — the
+# last reserved slot — and any off-by-one there would walk off the
+# block table into the zero tail (= real block 0) instead.
+PROMPTS = [list(np.arange(25) % 13), [3, 1, 4, 1, 5], [9] * 12,
+           list(np.arange(26) % 13)]
+
+
+def test_paged_sync_and_pipelined_streams_byte_identical(paged_pair):
+    """ISSUE 7 acceptance: the pipelined paged-KV loop (device-chained
+    recurrence, one-step-later admissions) produces byte-identical
+    token streams to the sync loop on a fixed trace that includes a
+    long prompt chunk-prefilled mid-run."""
+    streams = {}
+    for mode, ex in paged_pair.items():
+        streams[mode] = _drive(ex, [_req(p, max_tokens=6)
+                                    for p in PROMPTS])
+        ex.allocator.assert_clean()
+    assert streams["pipelined"] == streams["sync"]
+    assert any(len(set(s)) > 1 for s in streams["sync"]), \
+        "degenerate streams would make this equality vacuous"
+
+
+def test_synthetic_sync_and_pipelined_streams_byte_identical():
+    streams = {}
+    for pipelined in (True, False):
+        ex = SyntheticKVExecutor(slots=2, pipelined=pipelined,
+                                num_blocks=64)
+        streams[pipelined] = _drive(
+            ex, [_req(p, max_tokens=6) for p in PROMPTS])
+        ex.allocator.assert_clean()
+        ex.close()
+    assert streams[True] == streams[False]
+
+
+def test_paged_stream_invariant_under_chunk_and_block_size():
+    """Paging must be invisible: chunk=1 (token-at-a-time prefill) vs
+    chunk=8, and block_size 2 vs 8 (same total context so the weights
+    match), all decode the identical stream — the axes where append
+    offsets, causal masks and table gathers would break."""
+    prompt = list(np.arange(13) % 7)
+    golden = None
+    for kw in (dict(prefill_chunk=8, block_size=4, max_blocks_per_req=8),
+               dict(prefill_chunk=1, block_size=4, max_blocks_per_req=8),
+               dict(prefill_chunk=8, block_size=2, max_blocks_per_req=16),
+               dict(prefill_chunk=8, block_size=8, max_blocks_per_req=4)):
+        ex = _paged(mode="sync", **kw)
+        (stream,) = _drive(ex, [_req(prompt, max_tokens=6)])
+        ex.allocator.assert_clean()
+        if golden is None:
+            golden = stream
+        assert stream == golden, (kw, stream, golden)
+    assert len(set(golden)) > 1
+
+
+def test_paged_prefix_cache_hit_reproduces_uncached_stream(paged_pair):
+    ex = paged_pair["pipelined"]
+    prompt = list(np.arange(21) % 11)
+    (first,) = _drive(ex, [_req(prompt, max_tokens=5)])
+    hits0 = ex.prefix.hit_tokens
+    req = _req(prompt, max_tokens=5)
+    (second,) = _drive(ex, [req])
+    assert second == first
+    assert req.kv_lease.cached_tokens > 0
+    assert ex.prefix.hit_tokens > hits0
+    ex.allocator.assert_clean()
+    # And with the cache disabled the stream is still the same.
+    nocache = _paged(mode="sync", prefix_cache=False)
+    (third,) = _drive(nocache, [_req(prompt, max_tokens=5)])
+    assert third == first
+    nocache.allocator.assert_clean()
+
+
+# -- retry re-attach plumbing ------------------------------------------------
+
+
+def test_reattach_resumes_from_settled_tokens():
+    """The rewind contract: k settled tokens → re-attach replays ONLY
+    the in-flight remainder, and the resumed stream equals an
+    uninterrupted run's (the synthetic token fn is position-dependent,
+    so a wrong rewind shows)."""
+    prompt = list(np.arange(16) % 9)
+    ref = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    (golden,) = _drive(ref, [_req(prompt, max_tokens=6)])
+    ref.allocator.assert_clean()
+    ref.close()
+
+    ex = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    req = _req(prompt, max_tokens=6)
+    ex.kv_attach(0, req)
+    steps = 0
+    while len(req.tokens) < 3:            # decode part-way, then "die"
+        t = int(ex.collect(ex.submit((), gen=ex.kv_gen()))[0])
+        steps += 1
+        if t >= 0:
+            req.tokens.append(t)
+    ex.reset()                            # replica restart
+    assert req.kv_lease.resumable
+    ex.kv_attach(0, req)                  # re-attach, not re-prefill
+    assert ex.resumed_total == 1
+    resumed_steps = 0
+    while len(req.tokens) < 6:
+        t = int(ex.collect(ex.submit((), gen=ex.kv_gen()))[0])
+        resumed_steps += 1
+        if t >= 0:
+            req.tokens.append(t)
+    assert list(req.tokens) == golden
+    # Strictly fewer replayed steps than prompt re-decode: resume cost
+    # is the remaining tokens only, never the prefill again.
+    assert resumed_steps == 3 < steps + resumed_steps
+    ex.kv_release_slot(0)
+    req.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_foreign_lease_released_and_stream_restarts_identically():
+    """A lease seized from replica A means nothing in replica B's
+    pool: B releases it (via A's allocator — no leak on EITHER side),
+    clears the partial tokens, and re-decodes from the prompt to the
+    same deterministic stream."""
+    prompt = list(np.arange(12) % 5)
+    a = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    b = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    (golden,) = _drive(SyntheticKVExecutor(slots=1, pipelined=False,
+                                          num_blocks=64),
+                       [_req(prompt, max_tokens=4)])
+    req = _req(prompt, max_tokens=4)
+    a.kv_attach(0, req)
+    while not req.tokens:
+        t = int(a.collect(a.submit((), gen=a.kv_gen()))[0])
+        if t >= 0:
+            req.tokens.append(t)
+    lease_a = req.kv_lease
+    a.reset()                             # A's replica died
+    b.kv_attach(0, req)                   # B picks the requeue up
+    assert req.kv_lease is not lease_a and not lease_a.resumable
+    assert req.tokens == []               # fresh decode, no half state
+    while len(req.tokens) < 4:
+        t = int(b.collect(b.submit((), gen=b.kv_gen()))[0])
+        if t >= 0:
+            req.tokens.append(t)
+    assert list(req.tokens) == golden
+    b.kv_release_slot(0)
+    req.finish()
+    a.allocator.assert_clean()
+    b.allocator.assert_clean()
+    a.close()
+    b.close()
+
+
+def test_stale_generation_submit_is_noop():
+    """The seize-race guard: a submit carrying a pre-reset generation
+    must neither advance cursors nor emit (NO_TOKEN everywhere)."""
+    ex = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    req = _req([1, 2, 3], max_tokens=4)
+    ex.kv_attach(0, req)
+    stale_gen = ex.kv_gen()
+    ex.reset()
+    out = ex.collect(ex.submit((), gen=stale_gen))
+    assert (out == -1).all()
+    req.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_decode_token_counter_matches_delivered(pipelined):
+    """Regression: decode_tokens was counted at PLAN time, so the
+    pipelined loop's phantom post-retire step (submit(k+1) precedes
+    retire(k)) inflated the counter — and the bench's headline
+    serving_tokens_per_s — by one step per request, while sync mode
+    under-counted the prefill-finish emit. Both modes must now report
+    exactly the tokens clients received."""
+    ex = SyntheticKVExecutor(slots=2, pipelined=pipelined,
+                             num_blocks=64, prefix_cache=False)
+    reqs = [_req([1 + i, 2, 3, 4, 5], max_tokens=4) for i in range(5)]
+    streams = _drive(ex, reqs)
+    delivered = sum(len(s) for s in streams)
+    assert delivered == 5 * 4
+    assert ex.kv_stats()["decode_tokens"] == delivered
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_admit_unwind_releases_executor_slot_binding():
+    """Regression: when a statement AFTER a successful kv_attach in
+    the admit path raised (here: the tracer's admit event), the
+    generic unwind cleared the batcher slot but left the executor's
+    slot state bound — poisoning the slot ("already bound" for every
+    future admit on it) and planning decode for a ghost state."""
+
+    class _AdmitBoom:
+        enabled = True
+
+        def event(self, name, **kw):
+            if name == "batcher.admit":
+                raise RuntimeError("trace plane down")
+
+        def decision(self, *a, **kw):
+            pass
+
+        def record_span(self, *a, **kw):
+            pass
+
+    ex = SyntheticKVExecutor(slots=1, pipelined=False, num_blocks=64)
+    q = AdmissionQueue(max_depth=4)
+    b = ContinuousBatcher(ex, q)
+    real_tracer = b.tracer
+    b.tracer = _AdmitBoom()
+    doomed = _req([1, 2, 3], max_tokens=3)
+    q.submit(doomed)
+    b.start()
+    try:
+        assert doomed.wait(10)
+        assert doomed.error and "admission failed" in doomed.error
+        b.tracer = real_tracer
+        ok = _req([1, 2, 3], max_tokens=3)
+        q.submit(ok)
+        assert ok.wait(10)
+    finally:
+        b.stop()
+    assert ok.error is None, ok.error
+    assert len(ok.tokens) == 3
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- admission control -------------------------------------------------------
+
+
+def test_kv_oom_sheds_request_with_exact_error():
+    """Worst-case pages are reserved at attach: a pool too small for
+    prompt+max_tokens sheds THIS request with KV_OOM_ERROR (503 at the
+    front door) and the batcher keeps serving the rest."""
+    ex = SyntheticKVExecutor(slots=2, num_blocks=4, block_size=4,
+                            pipelined=False)
+    big = _req(list(np.arange(10) % 3), max_tokens=10)  # needs 5 blocks
+    ok = _req([1, 2, 3], max_tokens=3)                  # needs 2
+    q = AdmissionQueue(max_depth=4)
+    b = ContinuousBatcher(ex, q)
+    q.submit(big)
+    q.submit(ok)
+    b.start()
+    try:
+        assert big.wait(10) and ok.wait(10)
+    finally:
+        b.stop()
+    assert big.error == KV_OOM_ERROR
+    assert ok.error is None and len(ok.tokens) == 3
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_queued_deadline_lapse_truncates_kept_token_requeue():
+    """Regression: the pop-side deadline shed 503'd requeued KV
+    requests that CARRY settled tokens, discarding them — while the
+    identical state lapsing a moment earlier inside the supervisor's
+    _requeue settles as a truncated 200 (the mid-decode truncation
+    contract). Unreachable before ISSUE 7 (requeue always cleared
+    tokens); resumable leases keep them, so the queue must apply the
+    same disposition. The truncated settle must also release the
+    lease through the finish() choke point."""
+    from dpu_operator_tpu.serving.api import DEADLINE_QUEUED_ERROR
+
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    q = AdmissionQueue(max_depth=4)
+    req = _req([1, 2, 3], max_tokens=6, deadline_s=0.02)
+    req.tokens.extend([7, 8])
+    req.kv_lease = KVLease(a, "pool", req.request_id,
+                           a.acquire(2, req.request_id), (1, 2, 3), 0)
+    q.requeue(req)
+    time.sleep(0.03)
+    assert q.get_many(4) == []
+    assert req.done and req.error is None and req.truncated
+    assert req.tokens == [7, 8]
+    a.assert_clean()
+    # A token-less lapsed request still sheds with the queued 503.
+    bare = _req([1, 2, 3], max_tokens=6, deadline_s=0.0)
+    q.requeue(bare)
+    assert q.get_many(4) == []
+    assert bare.error == DEADLINE_QUEUED_ERROR
+    # An already-settled request popped later is DROPPED — a second
+    # settle would rewrite the response after it was sent.
+    settled = _req([1, 2, 3], max_tokens=6, deadline_s=0.0)
+    settled.fail("wedged")
+    q.requeue(settled)
+    assert q.get_many(4) == []
+    assert settled.error == "wedged"
+
+
+def test_pool_requeue_keeps_tokens_only_for_resumable_lease():
+    """Unit check on the supervisor's requeue disposition (the chaos
+    matrix proves it end-to-end): a resumable lease keeps the decoded
+    tokens and rides the queue; without one the retry re-decodes."""
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    q = AdmissionQueue(max_depth=4)
+    ex = SyntheticKVExecutor(slots=1, pipelined=False)
+    pool = ReplicaPool([ex], q, supervise=False)
+    req = _req([1, 2, 3], max_tokens=6)
+    req.tokens.extend([7, 8])
+    req.kv_lease = KVLease(a, "elsewhere", req.request_id,
+                           a.acquire(2, req.request_id), (1, 2, 3), 0)
+    pool._requeue(0, [req])
+    assert req.tokens == [7, 8] and q.depth() == 1
+    plain = _req([1, 2, 3], max_tokens=6)
+    plain.tokens.extend([7, 8])
+    pool._requeue(0, [plain])
+    assert plain.tokens == [] and q.depth() == 2
+    req.kv_lease.release()
+    a.assert_clean()
+    ex.close()
+
+
+def test_uncollected_prefill_chunk_never_enters_prefix_cache():
+    """Regression: a mid-prefill deadline truncation retires a slot
+    while its latest chunk is dispatched but UNCOLLECTED; ctx advances
+    at plan time, so a ctx-derived cache insert published blocks whose
+    KV a failing step never wrote — and match_and_fork would serve
+    them as truth to every later same-prefix request (pools and the
+    prefix cache deliberately survive reset). The insert must cover
+    only collect-confirmed positions."""
+    prompt = list(range(1, 9))                     # 2 full blocks
+    ex = SyntheticKVExecutor(slots=1, block_size=4, num_blocks=64,
+                             prefill_chunk=4, pipelined=False)
+    req = _req(prompt, max_tokens=2)
+    ex.kv_attach(0, req)
+    ex.submit(gen=ex.kv_gen())      # chunk 1 dispatched, NOT collected
+    ex.kv_release_slot(0, cache=True)
+    assert len(ex.prefix) == 0, "uncollected positions were cached"
+    req.finish()
+    # Collected prefill caches normally — and a later request hits it.
+    req2 = _req(prompt, max_tokens=2)
+    ex.kv_attach(0, req2)
+    for _ in range(2):
+        ex.collect(ex.submit(gen=ex.kv_gen()))
+    ex.kv_release_slot(0, cache=True)
+    assert len(ex.prefix) == 2
+    req2.finish()
+    req3 = _req(prompt, max_tokens=2)
+    assert ex.kv_attach(0, req3) == 4      # capped at plen-1 blocks
+    ex.kv_release_slot(0, cache=True)
+    req3.finish()
+    ex.prefix.flush()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- satellite: DecodeStep overflow error names step + request ids -----------
+
+
+@pytest.mark.parametrize("via", ["direct", "executor"])
+def test_decode_step_overflow_error_names_step_and_requests(via):
+    """Regression (ISSUE 7 satellite): the >slots update rejection
+    used to be a bare ValueError — useless against a flight snapshot
+    when the seize path races admissions near the limit. It must name
+    the step and the admitting request ids, through the executor seam
+    too."""
+    from dpu_operator_tpu.serving import LocalExecutor
+
+    ex = LocalExecutor(slots=2, S=1, d=8, h=8, E=1, warmup=False)
+    rows = [(i, np.zeros(8, np.float32)) for i in range(3)]
+    with pytest.raises(ValueError) as ei:
+        if via == "direct":
+            ex._decode(ex._decode.init_state(), rows, step=7,
+                       request_ids=["req-a", "req-b", "req-c"])
+        else:
+            ex.submit(rows, step=7,
+                      request_ids=["req-a", "req-b", "req-c"])
+    msg = str(ei.value)
+    assert "step 7" in msg and "req-a" in msg and "req-c" in msg
+    # Without caller context it still names its own call count.
+    with pytest.raises(ValueError, match="step"):
+        ex._decode(ex._decode.init_state(), rows)
+
+
+# -- /metrics exposition -----------------------------------------------------
+
+
+def test_metrics_exposition_of_kv_series():
+    """Satellite: the new counters/gauges appear in a real /metrics
+    scrape — prefill/decode token counters, the per-state block gauge,
+    and the scrape-time prefix-hit fraction."""
+    import urllib.request
+
+    from dpu_operator_tpu.serving import ServingServer
+
+    ex = SyntheticKVExecutor(slots=2, pipelined=True, num_blocks=64)
+    srv = ServingServer([ex]).start()
+    try:
+        import json as _json
+        body = _json.dumps({"prompt_tokens": [1, 2, 3, 4, 5, 6, 7, 8,
+                                              9],
+                            "max_tokens": 4,
+                            "deadline_ms": 10000}).encode()
+        for _ in range(2):
+            urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/v1/generate",
+                                       data=body), timeout=10).read()
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=5).read().decode()
+    finally:
+        srv.stop()
+    assert "serving_prefill_tokens_total" in text
+    assert "serving_decode_tokens_total" in text
+    for state in ("used", "free", "shared"):
+        assert f'serving_kv_blocks{{state="{state}"}}' in text
+    assert "serving_kv_prefix_hit_frac" in text
+    # The counters carry real values (9 prompt tokens prefilled twice
+    # minus the second run's cache hit; 4 decode tokens each).
+    pre = [l for l in text.splitlines()
+           if l.startswith("serving_prefill_tokens_total")]
+    dec = [l for l in text.splitlines()
+           if l.startswith("serving_decode_tokens_total")]
+    assert float(pre[0].split()[-1]) >= 9
+    assert float(dec[0].split()[-1]) >= 8
+    ex.allocator.assert_clean()
+    ex.close()
