@@ -752,7 +752,11 @@ def bench_serving() -> dict:
             f"overhead {out.get('serving_trace_overhead_frac')}; "
             f"paged-kv {out.get('serving_tokens_per_s')} tok/s at 2x "
             f"(prefix speedup {out.get('serving_kv_prefix_speedup')}x, "
-            f"stall frac {out.get('serving_prefill_stall_frac')})",
+            f"stall frac {out.get('serving_prefill_stall_frac')}); "
+            f"sharded {out.get('serving_sharded_steps_per_s')} steps/s "
+            f"(collective frac "
+            f"{out.get('serving_shard_collective_frac')}, vs local "
+            f"{out.get('serving_sharded_vs_local_frac')}x)",
             file=sys.stderr,
         )
         return out
@@ -858,6 +862,17 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         ("serving_tokens_per_s", 0.85, "serving_kv_tokens_ge_085_median"),
         ("serving_prefill_stall_frac", 1.35,
          "serving_prefill_stall_le_135_median"),
+        # Fabric-sharded replicas (ISSUE 8): useful steps/s through a
+        # FabricExecutor over the synthetic shard plane holds 0.85x
+        # the rolling median; the collective's share of the run wall
+        # gets the latency band (1.35x) — creep there means the
+        # coordinator is serializing around the reduce (broadcast or
+        # gather rotting back into the step's critical path) even
+        # when steps/s noise masks it.
+        ("serving_sharded_steps_per_s", 0.85,
+         "serving_sharded_steps_ge_085_median"),
+        ("serving_shard_collective_frac", 1.35,
+         "serving_shard_collective_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -930,6 +945,11 @@ def main() -> int:
         "serving_kv_prefix_hit_frac": "frac",
         "serving_kv_prefix_speedup": "x",
         "serving_prefill_stall_frac": "frac",
+        "serving_sharded_steps_per_s": "steps/s",
+        "serving_sharded_tok_per_s": "tok/s",
+        "serving_shard_collective_frac": "frac",
+        "serving_shard_step_skew_ms": "ms",
+        "serving_sharded_vs_local_frac": "frac",
     }
     for key, unit in units.items():
         if key in metrics:

@@ -13,6 +13,8 @@ GL007  unbounded connect/send retry loop with no backoff sleep
        (serving/daemon/vsp/parallel)
 GL008  request-path log call that binds no request id (serving/)
 GL009  KV block acquired with no paired release or lease (serving/)
+GL010  blocking fabric recv/collect in a transport loop with no
+       deadline (serving/parallel)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1016,9 +1018,124 @@ class KVAcquireWithoutRelease(Rule):
                     f"allocator's leak ledger has no way back")
 
 
+# --------------------------------------------------------------------------
+# GL010 — blocking fabric recv/collect with no deadline
+
+
+class UnboundedTransportRecv(Rule):
+    """Origin: ISSUE 8's sharded serving replicas. A replica's step
+    now spans shard workers reached over the fabric, so the serving
+    plane's oldest invariant — "a hung device must be watchdog-
+    visible, never an unbounded block" (PR 5) — extends to every
+    receive leg: a coordinator collect() or a transport recv() that
+    can wait forever on a dead peer wedges the replica in a state no
+    deadline will ever fire on. The mechanical contract: a
+    recv/collect in a serving/ or parallel/ TRANSPORT LOOP must carry
+    a bound.
+
+    Fires on: a call whose terminal name is recv/recv_into/recvfrom/
+    recv_msg/accept/collect, enclosed by a while/for loop in the same
+    function, in serving/ or parallel/, when ALL of these are absent:
+
+      * a timeout-ish keyword on the call itself (``timeout``,
+        ``deadline``, ``timeout_s``, ``io_timeout``);
+      * a socket deadline discipline anywhere in the MODULE (a
+        ``settimeout``/``setdefaulttimeout`` call — fabric transports
+        arm their sockets once at connect time, which statically
+        bounds every later recv on them);
+      * a ``blocked_since`` publication in the enclosing function —
+        the scheduler's watchdog hook (PR 5): a collect bracketed by
+        ``self.blocked_since = ...`` is exactly the bounded-by-the-
+        supervisor shape this rule exists to enforce.
+
+    Near-misses that stay silent: one-shot receives outside loops
+    (constructor warmups), ``gc.collect()`` (no pedigree), and every
+    bounded shape above."""
+
+    rule_id = "GL010"
+    severity = SEVERITY_ERROR
+    title = "blocking transport recv/collect with no deadline"
+    hint = ("bound the wait: pass timeout=/deadline=, arm the socket "
+            "with settimeout at connect time, or publish "
+            "blocked_since around the call so the supervisor's "
+            "watchdog owns the deadline — a hung peer must surface "
+            "in bounded time, never as an invisible wedge")
+
+    _RECV_NAMES = {"recv", "recv_into", "recvfrom", "recv_msg",
+                   "accept", "collect"}
+    _TIMEOUT_KWARGS = {"timeout", "deadline", "timeout_s",
+                       "io_timeout"}
+    _SOCKET_DISCIPLINE = {"settimeout", "setdefaulttimeout"}
+
+    def _module_has_socket_deadline(self, module: Module) -> bool:
+        for n in ast.walk(module.tree):
+            if isinstance(n, ast.Call) and \
+                    _terminal_name(n.func) in self._SOCKET_DISCIPLINE:
+                return True
+        return False
+
+    @staticmethod
+    def _publishes_blocked_since(fn: ast.AST) -> bool:
+        for n in _walk_same_function(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "blocked_since":
+                        return True
+        return False
+
+    def _bounded_call(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg in self._TIMEOUT_KWARGS:
+                return True
+        return False
+
+    @staticmethod
+    def _loops_enclosing(fn: ast.AST) -> Iterator[ast.AST]:
+        for n in _walk_same_function(fn):
+            if isinstance(n, (ast.While, ast.For)):
+                yield n
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving", "parallel"):
+            return
+        if self._module_has_socket_deadline(module):
+            return
+        for fn, qual in module.functions:
+            # Unique loop-enclosed calls (nested loops must not
+            # duplicate a finding).
+            in_loop: dict = {}
+            for loop in self._loops_enclosing(fn):
+                for n in _walk_same_function(loop):
+                    if isinstance(n, ast.Call) and \
+                            _terminal_name(n.func) in self._RECV_NAMES:
+                        in_loop[id(n)] = n
+            if not in_loop:
+                continue
+            watchdogged = None  # computed lazily per function
+            for n in in_loop.values():
+                if isinstance(n.func, ast.Attribute) and \
+                        _terminal_name(n.func.value) == "gc":
+                    continue  # gc.collect has no peer to hang on
+                if self._bounded_call(n):
+                    continue
+                if watchdogged is None:
+                    watchdogged = self._publishes_blocked_since(fn)
+                if watchdogged:
+                    continue
+                yield self.finding(
+                    module, n,
+                    f"'{ast.unparse(n.func)}(...)' blocks in a "
+                    f"transport loop in '{qual}' with no timeout "
+                    f"argument, no module socket deadline, and "
+                    f"no blocked_since publication — a hung peer "
+                    f"becomes an unbounded, watchdog-invisible "
+                    f"block")
+
+
 def default_rules() -> List[Rule]:
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
             SilentBroadExcept(), UndeclaredAxisName(),
             UnboundedRetryLoop(), RequestLogWithoutContext(),
-            KVAcquireWithoutRelease()]
+            KVAcquireWithoutRelease(), UnboundedTransportRecv()]

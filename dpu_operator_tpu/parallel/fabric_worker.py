@@ -43,9 +43,36 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import sys
 import time
+
+
+def protocol_stdout():
+    """Reserve the REAL stdout for the one-line JSON protocol.
+
+    The worker's contract is "exactly one JSON object on stdout" — but
+    library logging (jax's absl handlers, any logging.basicConfig a
+    transitively imported module ran, a stray debug print) defaults to
+    stdout and INTERLEAVES with the protocol line, corrupting the
+    parse on the coordinator side. The fix is structural, not
+    discipline: swap ``sys.stdout`` for stderr so every later
+    print()/handler write lands on the diagnostic stream, repoint any
+    ALREADY-INSTALLED stream handlers that captured the old stdout,
+    and hand the caller the real stdout for the single protocol
+    write. The shard worker (serving/sharded/shard_worker.py) inherits
+    the same guard."""
+    real = sys.stdout
+    sys.stdout = sys.stderr
+    for h in logging.getLogger().handlers:
+        if isinstance(h, logging.StreamHandler) and \
+                getattr(h, "stream", None) is real:
+            h.setStream(sys.stderr)
+    # Late-configured loggers inherit this root handler (stderr);
+    # force=False keeps any handlers a harness deliberately installed.
+    logging.basicConfig(stream=sys.stderr)
+    return real
 
 
 def _pin_cpu_backend(bind_ip: str | None) -> None:
@@ -229,6 +256,8 @@ def main(argv=None) -> int:
     ap.add_argument("--ring-port", type=int, default=9411)
     args = ap.parse_args(argv)
 
+    proto_out = protocol_stdout()  # everything else goes to stderr
+
     def trace(msg):  # progress to stderr so a hang is attributable
         print(f"fabric-worker[{args.process_id}] {msg}",
               file=sys.stderr, flush=True)
@@ -312,7 +341,7 @@ def main(argv=None) -> int:
         ok = ok and matches and descends
 
     result["ok"] = ok
-    print(json.dumps(result), flush=True)
+    print(json.dumps(result), file=proto_out, flush=True)
     jax.distributed.shutdown()
     return 0 if ok else 1
 

@@ -199,6 +199,48 @@ class SliceTopology:
         }
 
 
+# -- ring-order selection (sharded serving replicas, ISSUE 8) ----------------
+
+
+def _ring_sort_key(addr: str):
+    """Canonical sort key for one rendezvous address ("ip" or
+    "ip:port" or "host:port"): numeric IPv4 octets when the host
+    parses as dotted-quad (so 10.0.0.2 orders before 10.0.0.10 —
+    lexical order would interleave hosts across racks), else the
+    host string; port breaks ties for several shards on one host."""
+    host, _, port = str(addr).partition(":")
+    octets = host.split(".")
+    if len(octets) == 4 and all(o.isdigit() and int(o) < 256
+                                for o in octets):
+        hkey = (0, tuple(int(o) for o in octets))
+    else:
+        hkey = (1, host)
+    return (hkey, int(port) if port.isdigit() else 0, port)
+
+
+def ring_order(addresses) -> List[str]:
+    """Deterministic TOTAL order over a shard set's rendezvous
+    addresses — the ring the FabricExecutor coordinator wires its
+    shard workers into (each rank dials the next entry, wrapping).
+
+    Contract (tests/test_topology.py): the result contains every
+    input exactly once (total), is identical across runs
+    (deterministic), and is STABLE UNDER PERMUTATION of the input —
+    two coordinators (or a coordinator and the supervisor restarting
+    it) that discover the same shard set in different orders must
+    still agree on the ring, or the re-rendezvoused replica would
+    deadlock dialing a neighbour that is dialing someone else.
+    Duplicate addresses are rejected: two shards cannot share a
+    rendezvous endpoint, and silently deduping would shrink the
+    world size."""
+    addrs = [str(a) for a in addresses]
+    if len(set(addrs)) != len(addrs):
+        dupes = sorted({a for a in addrs if addrs.count(a) > 1})
+        raise ValueError(f"duplicate shard rendezvous addresses: "
+                         f"{dupes}")
+    return sorted(addrs, key=_ring_sort_key)
+
+
 # -- helpers -----------------------------------------------------------------
 
 

@@ -31,12 +31,15 @@ from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
 from .queue import AdmissionQueue
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
+from .sharded import (FabricExecutor, ShardProcessSet,
+                      SyntheticShardSet)
 
 __all__ = [
     "AdmissionQueue",
     "ContinuousBatcher",
     "Draining",
     "Executor",
+    "FabricExecutor",
     "GenerateRequest",
     "KVBlockAllocator",
     "KVCacheOOM",
@@ -48,8 +51,10 @@ __all__ = [
     "ReplicaPool",
     "ServingError",
     "ServingServer",
+    "ShardProcessSet",
     "SyntheticExecutor",
     "SyntheticKVExecutor",
+    "SyntheticShardSet",
     "encode_prompt",
     "encode_prompt_tokens",
 ]

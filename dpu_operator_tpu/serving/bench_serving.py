@@ -53,6 +53,19 @@ Sections:
      (decode steps that co-ran with prefill chunks; gated <= 1.35x
      rolling median — creeping stall means the chunk budget is
      rotting).
+  9. sharded-vs-local decode decomposition (ISSUE 8): the REAL
+     scheduler over a FabricExecutor whose replica spans a
+     SyntheticShardSet (fixed per-shard compute + collective cost —
+     the shard plane's accelerator cost model, same reasoning as the
+     fixed-cost headline figures: the numbers move on
+     coordinator/shard-plane scheduling regressions and nothing
+     else), vs the single-host SyntheticExecutor paying only the
+     compute. → serving_sharded_steps_per_s (gated >= 0.85x rolling
+     median), serving_shard_collective_frac (share of the run wall
+     the step spent inside the collective; gated <= 1.35x — creep
+     means the coordinator is serializing around the reduce),
+     serving_sharded_vs_local_frac and serving_shard_step_skew_ms
+     (informational: the fabric tax and the shard imbalance).
 
 Protocol: exactly one JSON object on stdout; progress on stderr.
 """
@@ -609,6 +622,105 @@ def kv_paged_serving(slots: int, step_s: float, trace,
     return out
 
 
+def sharded_decode(slots: int, trace, world: int = 3, n_req: int = 48,
+                   toks: int = 16, step_ms: float = 2.0,
+                   coll_ms: float = 1.0, repeats: int = 3) -> dict:
+    """Section 9 (ISSUE 8): one replica sharded across `world` shard
+    workers vs the same decode single-host, through the REAL
+    ContinuousBatcher (queue preloaded, no HTTP). The shard plane is
+    the SyntheticShardSet with a fixed per-shard compute cost and a
+    fixed modelled collective cost — the deterministic cost model, so
+    the figures regress on coordinator/scheduler changes (broadcast
+    fan-out, collect gather, pipelined overlap) and nothing else.
+
+    serving_sharded_steps_per_s is USEFUL steps/s (decoded tokens ÷
+    slots per second — hand-off steps count against it, same
+    definition as section 5). serving_shard_collective_frac is the
+    share of the best run's wall the step spent inside the collective
+    (sum of per-step slowest-shard collective time / wall): with a
+    preloaded queue the shard plane is near-saturated, so the ratio
+    is the decode decomposition, not an idle-time artifact."""
+    import time as _time
+
+    from ..utils.metrics import Registry
+    from .api import GenerateRequest, encode_prompt
+    from .executor import SyntheticExecutor
+    from .queue import AdmissionQueue
+    from .scheduler import ContinuousBatcher
+    from .sharded import FabricExecutor, SyntheticShardSet
+
+    out: dict = {}
+    d = 16
+    tok_total = n_req * toks
+    step_s, coll_s = step_ms / 1000.0, coll_ms / 1000.0
+
+    def one_run(kind):
+        reg = Registry()
+        if kind == "sharded":
+            ex = FabricExecutor(
+                SyntheticShardSet(world=world, slots=slots, d=d,
+                                  seed=7, step_time_s=step_s,
+                                  collective_time_s=coll_s),
+                mode="pipelined", registry=reg, name="bench")
+        else:
+            # The single-host twin pays the compute but no collective
+            # — the delta is the fabric tax at this cost model.
+            ex = SyntheticExecutor(slots=slots, d=d, seed=7,
+                                   step_time_s=step_s, pipelined=True)
+        q = AdmissionQueue(max_depth=n_req + 1)
+        b = ContinuousBatcher(ex, q, registry=reg)
+        reqs = [GenerateRequest(
+            prompt_vec=encode_prompt(f"sh-{i}", d),
+            max_tokens=toks, deadline=_time.monotonic() + 600.0)
+            for i in range(n_req)]
+        for r in reqs:
+            q.submit(r)
+        t0 = _time.perf_counter()
+        b.start()
+        ok = all(r.wait(timeout=600) for r in reqs)
+        wall = _time.perf_counter() - t0
+        b.stop()
+        ex.close()
+        if not ok or any(r.error for r in reqs):
+            raise RuntimeError(next(
+                (r.error for r in reqs if r.error), "request lost"))
+        return (tok_total / slots) / wall, wall, reg
+
+    # No warm-up arm: every run constructs its own executor/shard set
+    # (spawns included in its wall), so runs are iid and best-of-N
+    # already discards any first-call python/allocator cold cost.
+    best: dict = {}
+    for rep in range(repeats):
+        for kind in ("sharded", "local"):
+            rate, wall, reg = one_run(kind)
+            trace(f"sharded-decode {kind} rep{rep}: {rate:.0f} "
+                  f"useful steps/s")
+            if kind not in best or rate > best[kind][0]:
+                best[kind] = (rate, wall, reg)
+
+    sh_rate, sh_wall, sh_reg = best["sharded"]
+    out["serving_sharded_steps_per_s"] = round(sh_rate, 1)
+    out["serving_sharded_tok_per_s"] = round(sh_rate * slots, 1)
+    coll = sh_reg.histogram_totals("serving_shard_collective_seconds")
+    coll_sum = sum(s for s, _ in coll.values())
+    out["serving_shard_collective_frac"] = round(
+        coll_sum / sh_wall, 3)
+    skew = sh_reg.histogram_totals("serving_shard_step_skew_seconds")
+    skew_sum = sum(s for s, _ in skew.values())
+    skew_n = sum(n for _, n in skew.values())
+    if skew_n:
+        out["serving_shard_step_skew_ms"] = round(
+            skew_sum / skew_n * 1000, 3)
+    if best["local"][0] > 0:
+        out["serving_sharded_vs_local_frac"] = round(
+            sh_rate / best["local"][0], 3)
+    trace(f"sharded decode: {out['serving_sharded_steps_per_s']} "
+          f"useful steps/s over {world} shards, collective frac "
+          f"{out['serving_shard_collective_frac']}, vs local "
+          f"{out.get('serving_sharded_vs_local_frac')}x")
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -727,6 +839,15 @@ def main(argv: Optional[list] = None) -> int:
     except Exception as e:
         out["serving_kv_error"] = str(e)[:200]
         trace(f"paged-kv section failed: {e}")
+
+    # 9: sharded-vs-local decode decomposition (ISSUE 8). Synthetic
+    # shard plane (fixed compute + collective cost): the figures move
+    # on coordinator/shard scheduling regressions, nothing else.
+    try:
+        out.update(sharded_decode(args.slots, trace))
+    except Exception as e:
+        out["serving_sharded_error"] = str(e)[:200]
+        trace(f"sharded-decode section failed: {e}")
 
     # 4: the real jitted path — forward-only train_step model on a mesh.
     if not args.skip_local:

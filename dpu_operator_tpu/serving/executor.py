@@ -167,6 +167,11 @@ class Executor:
     #: runs its token-level KV loop (attach leases, chunked prefill,
     #: NO_TOKEN-aware retire) instead of the [slots, d] row plane.
     kv: bool = False
+    #: True when this replica's step spans multiple fabric shard
+    #: workers (serving/sharded FabricExecutor): the pool publishes it
+    #: as the `sharded` dimension on serving_pool_replicas so a
+    #: dashboard separates single-host from fabric-sharded capacity.
+    sharded: bool = False
     _resident: Optional[np.ndarray] = None
 
     def step(self, x: np.ndarray) -> np.ndarray:
@@ -432,6 +437,15 @@ class ReplicaPool:
             raise ValueError("a pool needs at least one executor")
         self.queue = queue
         self.registry = registry
+        if registry is not None:
+            # Executors that keep their own step-internal series (the
+            # FabricExecutor's shard collective/skew histograms) adopt
+            # the pool's registry so a ServingServer-built pool
+            # exposes them on /metrics with no extra wiring.
+            for ex in executors:
+                bind = getattr(ex, "bind_registry", None)
+                if bind is not None:
+                    bind(registry)
         self.tracer = (tracer if tracer is not None
                        else obs_trace.get_tracer())
         # Armed by the serving front-end (obs.FlightRecorder): the
@@ -528,15 +542,21 @@ class ReplicaPool:
     def _publish_state(self) -> None:
         if self.registry is None:
             return
+        shard_dim = ["true" if getattr(ex, "sharded", False)
+                     else "false" for ex in self.executors]
         with self._plock:
-            counts = {REPLICA_LIVE: 0, REPLICA_BACKOFF: 0,
-                      REPLICA_PARKED: 0}
-            for s in self._state:
-                counts[s] += 1
-        for st, n in counts.items():
+            counts = {(st, sh): 0.0
+                      for st in (REPLICA_LIVE, REPLICA_BACKOFF,
+                                 REPLICA_PARKED)
+                      for sh in ("true", "false")}
+            for i, s in enumerate(self._state):
+                counts[(s, shard_dim[i])] += 1
+        for (st, sh), n in counts.items():
             self.registry.gauge_set(
-                "serving_pool_replicas", float(n), {"state": st},
-                help="replicas by supervision state")
+                "serving_pool_replicas", float(n),
+                {"state": st, "sharded": sh},
+                help="replicas by supervision state and whether the "
+                     "replica is fabric-sharded")
 
     def _count(self, name: str, labels: dict, help: str = "") -> None:
         if self.registry is not None:

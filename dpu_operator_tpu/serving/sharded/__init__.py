@@ -1,0 +1,34 @@
+"""Fabric-sharded serving replicas (ISSUE 8).
+
+One replica's decode step spans many shard workers: the
+``FabricExecutor`` coordinator speaks the serving plane's two-phase
+``submit/collect`` contract upward (scheduler, supervisor, bench all
+unchanged) and a tiny shard-set contract downward, with two backends —
+``SyntheticShardSet`` (in-process shard threads with controlled step
+and collective cost: tier-1's deterministic double) and
+``ShardProcessSet`` (real ``shard_worker`` processes reducing over
+parallel/fabric_collectives, ring order from
+parallel/topology.ring_order: the multiworker lane). The shard-side
+math lives once in ``shard_math`` so every backend decodes the same
+token streams.
+
+Importing this package stays jax-free (the real worker jits only
+inside its own process)."""
+
+from .executor import FabricExecutor
+from .procset import ShardProcessSet
+from .synthetic import (ShardAborted, ShardCollectiveStall, ShardError,
+                        ShardStepError, ShardTimeout, StepOutput,
+                        SyntheticShardSet)
+
+__all__ = [
+    "FabricExecutor",
+    "ShardAborted",
+    "ShardCollectiveStall",
+    "ShardError",
+    "ShardProcessSet",
+    "ShardStepError",
+    "ShardTimeout",
+    "StepOutput",
+    "SyntheticShardSet",
+]

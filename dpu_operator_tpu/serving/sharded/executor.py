@@ -1,0 +1,145 @@
+"""FabricExecutor — one serving replica sharded across many workers.
+
+The third Executor implementation serving/executor.py documented in
+PR 2: the replica's decode step spans ``world`` shard workers, each
+holding one tensor-parallel slice of the params (shard_math) and a
+replica of the [slots, d] decode state. The coordinator implements
+the existing async two-phase contract UNCHANGED — the PR 3 pipelined
+batcher loop and the PR 5 supervisor drive it exactly as they drive a
+LocalExecutor:
+
+  * ``submit(updates)`` broadcasts the step's scatter updates to every
+    shard and returns while the shards compute (the broadcast is a
+    queue put / small socket write — the step itself runs on the
+    shard plane, which is what the pipelined loop overlaps against);
+  * ``collect(handle)`` gathers the per-slot token ids off the shard
+    plane under a hard ``step_timeout_s`` deadline (the GL010
+    contract: a hung shard surfaces in bounded time; the batcher's
+    ``blocked_since`` keeps it watchdog-visible well before that);
+  * ``step(x)`` (mode="sync") is the PR 2 full-state round trip: load
+    every row, run one step, materialize the next state from shard 0
+    — the measured baseline the bench prices the sharded pipeline
+    against.
+
+Shard backends speak one duck contract (``reset`` / ``submit(step,
+updates, want_state)→handle`` / ``collect(handle, timeout)→
+StepOutput`` / ``close``): SyntheticShardSet (thread shards, tier-1)
+and ShardProcessSet (real shard_worker processes over the fabric
+transport, multiworker lane).
+
+Per-step observability (the executor sees what the scheduler cannot):
+``serving_shard_collective_seconds`` (slowest shard's time inside the
+allreduce — the step pays the slowest) and
+``serving_shard_step_skew_seconds`` (fastest-vs-slowest shard local
+compute: imbalance that manifests as collective wait). The ReplicaPool
+binds its registry via ``bind_registry`` so a ServingServer-built pool
+exposes both on /metrics without extra wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..executor import Executor
+
+# Collective/skew distributions live at decode-step scale, same as the
+# scheduler's step histograms.
+_SHARD_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                  0.05, 0.1, 0.25, 1.0)
+
+
+class FabricExecutor(Executor):
+    """Coordinator for one sharded replica. ``shards`` is any shard
+    set speaking the duck contract above; ``mode`` picks the scheduler
+    loop exactly as LocalExecutor's does."""
+
+    sharded = True
+
+    def __init__(self, shards, mode: str = "pipelined",
+                 step_timeout_s: float = 60.0, registry=None,
+                 name: str = "sharded0"):
+        if mode not in ("pipelined", "sync"):
+            raise ValueError(f"mode must be pipelined|sync, got "
+                             f"{mode!r}")
+        self.shards = shards
+        self.slots = int(shards.slots)
+        self.d = int(shards.d)
+        self.pipelined = mode == "pipelined"
+        self.step_timeout_s = step_timeout_s
+        self.name = name
+        self._registry = registry
+        self._step_no = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def bind_registry(self, registry) -> None:
+        """ReplicaPool hook: adopt the pool's registry unless the
+        constructor already bound one (explicit wins)."""
+        if self._registry is None:
+            self._registry = registry
+
+    # -- the two-phase decode contract ----------------------------------------
+
+    def reset(self) -> None:
+        self._step_no = 0
+        self.shards.reset()
+
+    def submit(self, updates: Sequence, step=None, request_ids=None):
+        self._step_no += 1
+        handle = self.shards.submit(self._step_no, list(updates),
+                                    want_state=False)
+        if self.pipelined:
+            return handle
+        # Sync-shape two-phase callers (the base adapter contract):
+        # eager — the step completes before submit returns.
+        return self._gather(handle)
+
+    def collect(self, handle):
+        if not self.pipelined:
+            return handle  # already token ids (eager submit)
+        return self._gather(handle)
+
+    def step(self, x: np.ndarray) -> np.ndarray:
+        """The sync loop's full-state round trip: every row loads as
+        an update, the next state materializes from shard 0."""
+        rows = np.asarray(x, np.float32)
+        self._step_no += 1
+        handle = self.shards.submit(self._step_no,
+                                    list(enumerate(rows)),
+                                    want_state=True)
+        out = self.shards.collect(handle, timeout=self.step_timeout_s)
+        self._observe(out)
+        if out.state is None:
+            raise RuntimeError("shard plane returned no state for a "
+                               "sync step")
+        return out.state
+
+    def close(self) -> None:
+        self.shards.close()
+
+    # -- internals ------------------------------------------------------------
+
+    def _gather(self, handle) -> np.ndarray:
+        out = self.shards.collect(handle, timeout=self.step_timeout_s)
+        self._observe(out)
+        return out.tokens
+
+    def _observe(self, out) -> None:
+        reg = self._registry
+        if reg is None or not out.compute_s:
+            return
+        labels = {"replica": self.name}
+        reg.observe(
+            "serving_shard_collective_seconds",
+            max(out.collective_s), labels,
+            help="slowest shard's time inside the per-step collective "
+                 "(the step pays the slowest ring member)",
+            buckets=_SHARD_BUCKETS)
+        reg.observe(
+            "serving_shard_step_skew_seconds",
+            max(out.compute_s) - min(out.compute_s), labels,
+            help="fastest-vs-slowest shard local compute per step — "
+                 "imbalance that surfaces as collective wait",
+            buckets=_SHARD_BUCKETS)

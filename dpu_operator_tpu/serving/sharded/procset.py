@@ -1,0 +1,396 @@
+"""ShardProcessSet — real shard workers behind the ShardSet contract.
+
+Spawns ``world`` shard_worker processes, wires their collective ring
+(ring order from parallel/topology.ring_order over the allocated
+rendezvous addresses — the coordinator and any restarted incarnation
+derive the SAME ring from the same address set), accepts their control
+dials, and speaks the framed protocol. Byte-for-byte the same
+contract the SyntheticShardSet serves in-process, so a FabricExecutor
+cannot tell thread shards from fabric workers — tier-1 proves the
+scheduling/chaos contracts on threads, the multiworker lane proves the
+rendezvous and the real collective with THIS class.
+
+Failure surfaces in bounded time everywhere: worker spawn/hello under
+``spawn_timeout_s``, every control receive under the caller's collect
+deadline, and recovery is always the full kill + respawn (a real
+re-rendezvous) — the control stream is positional, so any failed or
+abandoned step leaves unread frames behind and no polite path exists.
+
+Supervision safety mirrors SyntheticShardSet's generation discipline:
+every handle carries the generation it was submitted under, a collect
+against a torn-down generation fails fast with ``ShardAborted``, a
+blocked collect snapshots its generation's sockets (a restarted
+incarnation's fresh sockets are invisible to it), and the
+failure-path teardown only acts when the failing handle still IS the
+current generation — an abandoned wedged collect waking after the
+supervisor restarted the replica can never kill the respawned set."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...parallel.topology import ring_order
+from .protocol import ProtocolError, recv_msg, send_msg
+from .shard_math import segment_bounds
+from .synthetic import (ShardAborted, ShardError, ShardStepError,
+                        ShardTimeout, StepOutput)
+
+
+def _distinct_ports(n: int) -> List[int]:
+    """n distinct loopback ports, all bound SIMULTANEOUSLY before any
+    is released — sequential bind-then-close can hand the same port
+    out twice. The close→worker-bind window remains (inherent to
+    pre-agreed ring addresses on one host); a stolen port surfaces as
+    a bounded spawn timeout, never a hang."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _reap(procs: Sequence[subprocess.Popen],
+          socks: Dict[int, socket.socket],
+          listener: Optional[socket.socket], kill: bool) -> None:
+    """Close an incarnation's control sockets and reap its worker
+    processes (polite close op unless `kill`)."""
+    for s in socks.values():
+        try:
+            if not kill:
+                send_msg(s, {"op": "close"})
+        except OSError:
+            pass
+        s.close()
+    if listener is not None:
+        listener.close()
+    for p in procs:
+        if kill:
+            p.kill()
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=5)
+
+
+class _ProcHandle:
+    """One submitted step's ledger token: just the generation it
+    belongs to and its step identity — the replies live on the
+    sockets, not here (unlike the synthetic set's per-rank reply
+    board, which this deliberately is NOT)."""
+
+    __slots__ = ("gen", "step_no", "want_state")
+
+    def __init__(self, gen: int, step_no: int, want_state: bool):
+        self.gen = gen
+        self.step_no = step_no
+        self.want_state = want_state
+
+
+class ShardProcessSet:
+    """``world`` shard_worker subprocesses on loopback (the same
+    program runs unchanged inside operator-attached pod netns — only
+    the addresses differ; see docs/serving.md)."""
+
+    def __init__(self, world: int, slots: int, d: int = 16, *,
+                 params: Optional[dict] = None, seed: int = 0,
+                 jit: bool = True, spawn_timeout_s: float = 60.0,
+                 python: str = sys.executable):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.slots = slots
+        self.params = params
+        self.d = (int(np.asarray(params["w1"]).shape[1])
+                  if params is not None else d)
+        self.seed = seed
+        self.jit = jit
+        self.spawn_timeout_s = spawn_timeout_s
+        self.python = python
+        self.segments = segment_bounds(slots, world)
+        self._procs: List[subprocess.Popen] = []
+        self._socks: Dict[int, socket.socket] = {}
+        self._listener: Optional[socket.socket] = None
+        self._params_path: Optional[str] = None
+        self._up = False
+        # Generation discipline: bumped on every teardown; handles
+        # are stamped at submit and checked at collect, so a stale
+        # (pre-restart) caller can neither read a fresh socket nor
+        # tear the fresh generation down. TWO locks, two jobs:
+        # `_lock` guards the gen/socks/outstanding bookkeeping and is
+        # NEVER held across a blocking call, so collect's fast
+        # gen-check exit and the leak-ledger read stay fail-fast even
+        # while a 60 s respawn is in flight; `_life` serializes the
+        # lifecycle operations themselves (spawn/teardown/reset/
+        # close/submit) whose socket work legitimately blocks.
+        self._gen = 0
+        self._lock = threading.Lock()
+        self._life = threading.RLock()
+        self._outstanding: set = set()
+        self.respawns = 0
+
+    # -- rendezvous -----------------------------------------------------------
+
+    def _spawn(self) -> None:
+        """Caller holds ``_life``. All blocking socket work happens on
+        locals; the new incarnation commits under ``_lock`` at the
+        end, so bookkeeping readers never wait on a rendezvous."""
+        if self.params is not None and self._params_path is None:
+            fd, self._params_path = tempfile.mkstemp(
+                prefix="shard-params-", suffix=".npz")
+            os.close(fd)
+            np.savez(self._params_path,
+                     **{k: np.asarray(v, np.float32)
+                        for k, v in self.params.items()})
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET,
+                            socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(self.world + 2)
+        listener.settimeout(self.spawn_timeout_s)
+        cport = listener.getsockname()[1]
+        # The ring the shards reduce over: allocate one fabric address
+        # per shard, then let topology.ring_order pick the canonical
+        # order — rank r of the spawned set IS ring position r.
+        addrs = [f"127.0.0.1:{p}"
+                 for p in _distinct_ports(self.world)]
+        ring = ring_order(addrs)
+        procs: List[subprocess.Popen] = []
+        socks: Dict[int, socket.socket] = {}
+        for rank in range(self.world):
+            cmd = [self.python, "-m",
+                   "dpu_operator_tpu.serving.sharded.shard_worker",
+                   "--rank", str(rank), "--world", str(self.world),
+                   "--slots", str(self.slots), "--d", str(self.d),
+                   "--coordinator", f"127.0.0.1:{cport}",
+                   "--bind-ip", "127.0.0.1",
+                   "--peers", ",".join(ring),
+                   "--seed", str(self.seed),
+                   "--connect-timeout", str(self.spawn_timeout_s)]
+            if self._params_path:
+                cmd += ["--params-npz", self._params_path]
+            if self.jit:
+                cmd.append("--jit")
+            procs.append(subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        deadline = time.monotonic() + self.spawn_timeout_s
+        try:
+            while len(socks) < self.world:
+                if time.monotonic() > deadline:
+                    raise ShardTimeout(
+                        f"only {len(socks)}/{self.world} shards "
+                        f"dialed in within {self.spawn_timeout_s}s")
+                c, _ = listener.accept()
+                msg, _ = recv_msg(c, timeout=self.spawn_timeout_s)
+                if msg.get("op") != "hello":
+                    c.close()
+                    continue
+                socks[int(msg["rank"])] = c
+        except (OSError, ProtocolError, ShardError):
+            _reap(procs, socks, listener, kill=True)
+            raise
+        with self._lock:
+            self._listener = listener
+            self._procs = procs
+            self._socks = socks
+            self._up = True
+
+    def _teardown(self, kill: bool) -> None:
+        """Caller holds ``_life``. Bumps the generation and detaches
+        the incarnation's resources under ``_lock`` FIRST — handles
+        submitted against the old incarnation fail fast at collect()
+        and a stale blocked reader (its per-recv deadline bounds the
+        wake-up) finds its snapshot sockets dead, never the
+        successor's — then does the blocking close/kill/reap work on
+        the detached locals."""
+        with self._lock:
+            self._gen += 1
+            socks = self._socks
+            self._socks = {}
+            listener = self._listener
+            self._listener = None
+            procs = self._procs
+            self._procs = []
+            self._up = False
+        _reap(procs, socks, listener, kill=kill)
+
+    # -- the ShardSet contract ------------------------------------------------
+
+    def reset(self) -> None:
+        """Zero every shard's decode state. Any outstanding step (or
+        any miss on the reset ack) forces kill + respawn — the real
+        re-rendezvous: a submitted-never-collected step left unread
+        frames on the positional control stream, so the polite path
+        would desync even if every worker were healthy."""
+        with self._life:
+            with self._lock:
+                stale = list(self._outstanding)
+                # Generation-orphaned handles are settled (collect
+                # raises ShardAborted on the gen mismatch), so
+                # exactly these leave the ledger.
+                self._outstanding.difference_update(stale)
+                up = self._up
+                socks = dict(self._socks)
+            if not up:
+                self._spawn()
+                return
+            if stale:
+                self._teardown(kill=True)
+                self.respawns += 1
+                self._spawn()
+                return
+            try:
+                for s in socks.values():
+                    send_msg(s, {"op": "reset"})
+                for rank, s in socks.items():
+                    msg, _ = recv_msg(s, timeout=self.spawn_timeout_s)
+                    if msg.get("op") != "ack":
+                        raise ProtocolError(
+                            f"shard {rank}: expected reset ack, got "
+                            f"{msg.get('op')!r}")
+            except (OSError, ProtocolError, ShardError):
+                self._teardown(kill=True)
+                self.respawns += 1
+                self._spawn()
+
+    def submit(self, step_no: int, updates: Sequence,
+               want_state: bool = False) -> _ProcHandle:
+        idx = [int(i) for i, _row in updates]
+        rows = (np.stack([np.asarray(r, np.float32)
+                          for _i, r in updates])
+                if updates else np.empty((0, self.d), np.float32))
+        msg = {"op": "step", "step": step_no, "slots": idx,
+               "want_state": bool(want_state)}
+        payload = rows.tobytes()
+        with self._life:
+            with self._lock:
+                up = self._up
+            if not up:
+                self._spawn()
+            with self._lock:
+                handle = _ProcHandle(self._gen, step_no, want_state)
+                # On the ledger BEFORE the broadcast: a partial
+                # broadcast leaves a poisoned positional stream, and
+                # the ledger entry is what routes the next reset() to
+                # kill+respawn.
+                self._outstanding.add(handle)
+                socks = dict(self._socks)
+            try:
+                for s in socks.values():
+                    send_msg(s, msg, payload)
+            except OSError as e:
+                raise ShardStepError(f"broadcast failed: {e!r}")
+            return handle
+
+    def collect(self, handle: _ProcHandle,
+                timeout: float) -> StepOutput:
+        with self._lock:
+            if handle.gen != self._gen:
+                self._outstanding.discard(handle)
+                raise ShardAborted(
+                    "shard set re-rendezvoused mid-step; this handle "
+                    "belongs to a torn-down generation")
+            # Snapshot THIS generation's sockets: if the set restarts
+            # while we block below, the fresh sockets are invisible
+            # to us — we fail on our own closed snapshot.
+            socks = dict(self._socks)
+        deadline = time.monotonic() + timeout
+        tokens = np.empty((self.slots,), np.int32)
+        state = None
+        compute, coll = [0.0] * self.world, [0.0] * self.world
+        try:
+            for rank in range(self.world):
+                lo, hi = self.segments[rank]
+                s = socks.get(rank)
+                if s is None:
+                    raise ShardAborted(
+                        f"shard {rank} gone (set torn down mid-step)",
+                        rank=rank)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ShardTimeout(
+                        f"shard {rank} never replied to step "
+                        f"{handle.step_no} within {timeout}s",
+                        rank=rank)
+                try:
+                    msg, payload = recv_msg(s, timeout=remaining)
+                except socket.timeout:
+                    raise ShardTimeout(
+                        f"shard {rank} silent past the step deadline "
+                        f"({timeout}s)", rank=rank)
+                except (OSError, ProtocolError) as e:
+                    raise ShardStepError(
+                        f"shard {rank} control channel failed: "
+                        f"{e!r}", rank=rank)
+                if msg.get("op") != "tokens" or \
+                        msg.get("step") != handle.step_no:
+                    raise ShardStepError(
+                        f"shard {rank}: unexpected reply "
+                        f"{msg.get('op')!r} (step "
+                        f"{msg.get('step')} != {handle.step_no})",
+                        rank=rank)
+                seg = np.frombuffer(payload[:4 * (hi - lo)], np.int32)
+                tokens[lo:hi] = seg
+                compute[rank] = float(msg.get("compute_s", 0.0))
+                coll[rank] = float(msg.get("collective_s", 0.0))
+                if msg.get("state"):
+                    state = np.frombuffer(
+                        payload[4 * (hi - lo):],
+                        np.float32).reshape(self.slots, self.d).copy()
+            return StepOutput(tokens, state, compute, coll)
+        except ShardError:
+            # A failed step leaves unread frames on the positional
+            # control stream, so the only safe recovery is the
+            # respawn path — but ONLY for our own generation: an
+            # abandoned pre-restart collect waking here must not kill
+            # the supervisor's freshly restarted incarnation (the
+            # gen check runs under _lock AFTER _life is held, so a
+            # concurrent lifecycle op cannot slip a new incarnation
+            # in between the check and the teardown).
+            with self._life:
+                with self._lock:
+                    current = handle.gen == self._gen
+                if current:
+                    self._teardown(kill=True)
+            raise
+        finally:
+            with self._lock:
+                self._outstanding.discard(handle)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)
+
+    def close(self) -> None:
+        with self._life:
+            with self._lock:
+                stale = list(self._outstanding)
+                self._outstanding.difference_update(stale)
+                up = self._up or self._procs
+            if up:
+                # An uncollected step means a possibly-blocked reader
+                # and unread frames: kill, don't wait on a polite
+                # close of a desynced stream.
+                self._teardown(kill=bool(stale))
+            if self._params_path:
+                try:
+                    os.unlink(self._params_path)
+                except OSError:
+                    pass
+                self._params_path = None

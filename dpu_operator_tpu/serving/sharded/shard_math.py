@@ -1,0 +1,166 @@
+"""The per-shard slice of a serving replica's decode step.
+
+One FabricExecutor replica spans `world` shard workers; each worker
+holds ONE tensor-parallel slice of the params and the (replicated)
+[slots, d] decode state, computes its partial contribution per stage,
+and closes the contraction with an allreduce over whatever collective
+plane the backend provides — the in-process reduce board of the
+SyntheticShardSet, or parallel/fabric_collectives.RingTransport in the
+real shard worker. This module is that slice's MATH, in plain numpy
+(optionally another array module via ``xp`` — the real worker jits the
+same functions), shared by every backend so the token-equivalence
+contract ("a sharded replica decodes the same streams as a local one")
+has exactly one definition to hold against.
+
+Two slice families:
+
+  * ``TpShardSlice`` — the Megatron pairing over the REAL
+    train_step.init_params layout: w1 column-sharded, w2 row-sharded,
+    so ``relu(x @ w1_r) @ w2_r`` summed over ranks equals
+    ``relu(x @ w1) @ w2`` exactly (relu is elementwise on DISJOINT
+    column slices — the decomposition is exact in real arithmetic;
+    only the final sum's fp order differs, which argmax tolerates).
+    After the reduce every rank computes the identical tanh + MoE
+    residual, so the replicated states stay bit-identical across
+    shards. E must be 1: the MoE all_to_all is not carried across
+    shards (the expert block replicates; tp shards only the dense
+    contraction — the serving projection of the Megatron pairing).
+  * ``DoubleShardSlice`` — the SyntheticExecutor double
+    (``tanh(x @ W)``) with W row-sharded over the input dim: partials
+    ``x[:, lo:hi] @ W[lo:hi]`` sum to the full product. The jax-free
+    slice for scheduler/chaos tests with dialable costs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+# Token ownership (shard r reports slots seg[r]) and weight slicing use
+# the SAME even-contiguous split the fabric ring uses for its
+# collective segments — imported, not re-implemented, so the two can
+# never silently diverge. fabric_collectives is jax-free.
+from ...parallel.fabric_collectives import (
+    _segment_bounds as segment_bounds)
+
+
+class ShardSlice:
+    """One rank's compute: per-stage ``partial`` (pre-reduce) and
+    ``finish`` (post-reduce), plus the stage loop. ``reduce_fn(partial,
+    stage)`` is the collective seam the backend injects. ``xp`` is the
+    array module the stage math runs on — numpy by default; the real
+    worker swaps in jax.numpy before jitting the SAME methods (the
+    ufunc calls must trace, so they go through ``self.xp``)."""
+
+    stages: int = 1
+    d: int = 0
+    xp = np
+
+    def partial(self, x: np.ndarray, stage: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def finish(self, x: np.ndarray, dense: np.ndarray,
+               stage: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def forward(self, x: np.ndarray,
+                reduce_fn: Callable[[np.ndarray, int], np.ndarray],
+                partial_fn: Optional[Callable] = None,
+                finish_fn: Optional[Callable] = None,
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """One decode step on the replicated state: per stage, local
+        partial → allreduce → local finish. Returns (x_next, tokens);
+        tokens are the FULL [slots] argmax (identical on every rank —
+        callers report only their owned segment). ``partial_fn``/
+        ``finish_fn`` override the local math (the real worker passes
+        jitted wrappers over the same methods)."""
+        pf = partial_fn if partial_fn is not None else self.partial
+        ff = finish_fn if finish_fn is not None else self.finish
+        for s in range(self.stages):
+            dense = reduce_fn(np.asarray(pf(x, s), np.float32), s)
+            x = np.asarray(ff(x, dense, s), np.float32)
+        return x, np.argmax(x, axis=1).astype(np.int32)
+
+
+class TpShardSlice(ShardSlice):
+    """Rank r's Megatron slice of the stage-stacked train_step params
+    (the LocalExecutor model): w1 [S, d, h] column slice, w2 [S, h, d]
+    row slice, router/MoE weights replicated. The idle-slot contract
+    holds by arithmetic: a zero row stays zero through relu/matmul/
+    tanh and contributes zero MoE residual, so no row mask is needed
+    at E == 1 (capacity ≥ rows means no token ever drops)."""
+
+    def __init__(self, params: dict, rank: int, world: int):
+        if not (0 <= rank < world):
+            raise ValueError(f"bad shard shape rank={rank} "
+                             f"world={world}")
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        S, d, h = p["w1"].shape
+        if p["router"].shape[2] != 1 or p["moe_w1"].shape[1] != 1:
+            raise ValueError(
+                "tensor-parallel serving shards require E == 1: the "
+                "MoE all_to_all is not carried across the shard "
+                "fabric (tp shards the dense contraction; experts "
+                "replicate)")
+        if "wq" in p:
+            raise ValueError("attention params are not supported by "
+                             "the serving shard slice (decode state "
+                             "has no sequence axis)")
+        self.rank, self.world = rank, world
+        self.stages, self.d, self.h = S, d, h
+        lo, hi = segment_bounds(h, world)[rank]
+        # Empty slices are legal (world > h): the rank contributes a
+        # zero partial and still participates in every collective.
+        self.w1 = p["w1"][:, :, lo:hi]            # [S, d, h_r]
+        self.w2 = p["w2"][:, lo:hi, :]            # [S, h_r, d]
+        self.moe_w1 = p["moe_w1"][:, 0]           # [S, d, h]
+        self.moe_w2 = p["moe_w2"][:, 0]           # [S, h, d]
+
+    def partial(self, x: np.ndarray, stage: int) -> np.ndarray:
+        xp = self.xp
+        if self.w1.shape[2] == 0:
+            return xp.zeros((x.shape[0], self.d), np.float32)
+        return xp.maximum(x @ self.w1[stage], 0.0) @ self.w2[stage]
+
+    def finish(self, x: np.ndarray, dense: np.ndarray,
+               stage: int) -> np.ndarray:
+        xp = self.xp
+        y = xp.tanh(dense)
+        # Switch MoE at E == 1: softmax over one expert is exactly 1.0
+        # and capacity (ceil(rows · cf) ≥ rows) never drops a token,
+        # so the block reduces to the expert body as a residual —
+        # verified token-equivalent against the jitted
+        # switch_moe_local path in tests/test_sharded.py.
+        moe = xp.maximum(y @ self.moe_w1[stage], 0.0) \
+            @ self.moe_w2[stage]
+        return y + moe
+
+
+class DoubleShardSlice(ShardSlice):
+    """Rank r's row slice of the SyntheticExecutor double: partials
+    ``x[:, lo:hi] @ W[lo:hi]`` allreduce to ``x @ W``; finish is the
+    elementwise tanh. Same seeded W construction as SyntheticExecutor
+    so token streams compare 1:1."""
+
+    stages = 1
+
+    def __init__(self, d: int, seed: int, rank: int, world: int):
+        if not (0 <= rank < world):
+            raise ValueError(f"bad shard shape rank={rank} "
+                             f"world={world}")
+        self.rank, self.world, self.d = rank, world, d
+        w = np.random.RandomState(seed).randn(d, d).astype(
+            np.float32) / np.sqrt(d)
+        lo, hi = segment_bounds(d, world)[rank]
+        self._lo, self._hi = lo, hi
+        self.w = w[lo:hi, :]                      # [d_r, d]
+
+    def partial(self, x: np.ndarray, stage: int) -> np.ndarray:
+        if self._hi == self._lo:
+            return self.xp.zeros((x.shape[0], self.d), np.float32)
+        return x[:, self._lo:self._hi] @ self.w
+
+    def finish(self, x: np.ndarray, dense: np.ndarray,
+               stage: int) -> np.ndarray:
+        return self.xp.tanh(dense)

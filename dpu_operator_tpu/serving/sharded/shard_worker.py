@@ -1,0 +1,235 @@
+"""One shard worker of a fabric-sharded serving replica.
+
+The forward-only sibling of parallel/fabric_worker.py: where that
+program proves the fabric with a training slice, this one IS the
+serving dataplane — it holds rank r's tensor-parallel slice of the
+decode params (shard_math.TpShardSlice, or the seeded double) plus a
+replica of the [slots, d] decode state, and runs the per-step tp
+collective through parallel/fabric_collectives.RingTransport over the
+fabric addresses the coordinator wired into a ring (ring order chosen
+by parallel/topology.ring_order — every participant derives the SAME
+ring from the same address set).
+
+Control plane: the worker dials the coordinator, says hello, then
+serves framed step/reset messages (protocol.py). Per step it applies
+the scatter updates, computes its stage partials (jitted via jax when
+``--jit`` and jax imports; numpy otherwise — same shard_math either
+way), allreduces each stage over the ring, and replies with its OWNED
+token segment plus compute/collective timings (the coordinator's
+skew/collective metrics).
+
+Protocol: prints exactly ONE JSON object on stdout at exit
+(fabric_worker.protocol_stdout guards the stream — all logging and
+diagnostics go to stderr); rc 0 iff the session ended cleanly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import select
+import socket
+import sys
+import time
+
+import numpy as np
+
+from ...parallel.fabric_collectives import RingTransport
+from ...parallel.fabric_worker import protocol_stdout
+from .protocol import ProtocolError, recv_msg, send_msg
+from .shard_math import (DoubleShardSlice, TpShardSlice,
+                         segment_bounds)
+
+
+def _load_slice(args):
+    if args.params_npz:
+        with np.load(args.params_npz) as z:
+            params = {k: z[k] for k in z.files}
+        return TpShardSlice(params, args.rank, args.world)
+    return DoubleShardSlice(args.d, args.seed, args.rank, args.world)
+
+
+def _maybe_jit(sl, want_jit: bool, slots: int):
+    """(partial_fn, finish_fn, jitted?) — jax.jit over the SAME
+    shard_math methods when requested and importable (the numpy
+    params bind as executable constants); numpy fallback otherwise so
+    the worker runs in images without jax."""
+    if not want_jit:
+        return None, None, False
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jax.config.update("jax_platforms", "cpu")
+        # The stage math must TRACE: swap the slice's array module to
+        # jax.numpy before jitting (numpy ufuncs over tracers raise).
+        sl.xp = jnp
+        partial = jax.jit(sl.partial, static_argnums=1)
+        finish = jax.jit(sl.finish, static_argnums=2)
+        # Compile EVERY stage up front (the stage index is a static
+        # arg — each value is its own executable): step latency never
+        # includes XLA (the LocalExecutor constructor contract).
+        x0 = np.zeros((slots, sl.d), np.float32)
+        for s in range(sl.stages):
+            d0 = np.asarray(partial(x0, s))
+            np.asarray(finish(x0, d0, s))
+        # finish's output becomes the next decode state, which the
+        # step loop SCATTERS updates into — np.asarray over a jax
+        # array is a read-only view, so copy to a writable buffer
+        # ([slots, d]: negligible next to the collective).
+        return ((lambda x, s: np.asarray(partial(x, s))),
+                (lambda x, dense, s: np.array(finish(x, dense, s),
+                                              np.float32)),
+                True)
+    except Exception as e:  # fall back loudly, not silently
+        sl.xp = np  # the numpy path must not trip over a half-swap
+        print(f"shard-worker: jit unavailable ({e!r}); numpy math",
+              file=sys.stderr, flush=True)
+        return None, None, False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rank", type=int, required=True,
+                    help="ring rank (the coordinator applies "
+                         "topology.ring_order before spawning)")
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--slots", type=int, required=True)
+    ap.add_argument("--d", type=int, default=16)
+    ap.add_argument("--coordinator", required=True,
+                    help="ip:port of the FabricExecutor's control "
+                         "listener")
+    ap.add_argument("--bind-ip", default="127.0.0.1",
+                    help="this shard's fabric address (ring listener)")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated ip:port ring addresses of "
+                         "ALL shards, indexed by ring rank")
+    ap.add_argument("--params-npz", default="",
+                    help="train_step params (E=1) for the real model "
+                         "slice; empty = the seeded double")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--jit", action="store_true",
+                    help="jit the local stage math via jax (numpy "
+                         "fallback when jax is unavailable)")
+    ap.add_argument("--connect-timeout", type=float, default=30.0)
+    ap.add_argument("--idle-timeout", type=float, default=300.0,
+                    help="control-socket wait interval: idle is NOT "
+                         "death (a quiet serving replica submits "
+                         "nothing between requests), so silence just "
+                         "re-arms the wait — a DEAD coordinator "
+                         "closes the socket (the kernel does, even "
+                         "on a crash) and TCP keepalive surfaces a "
+                         "half-open partition, either ending the "
+                         "worker in bounded time")
+    args = ap.parse_args(argv)
+
+    proto_out = protocol_stdout()  # stdout carries ONLY the summary
+
+    def trace(msg):
+        print(f"shard-worker[{args.rank}] {msg}", file=sys.stderr,
+              flush=True)
+
+    sl = _load_slice(args)
+    partial_fn, finish_fn, jitted = _maybe_jit(sl, args.jit,
+                                               args.slots)
+    lo, hi = segment_bounds(args.slots, args.world)[args.rank]
+    result = {"rank": args.rank, "world": args.world,
+              "jitted": jitted, "steps": 0, "resets": 0, "ok": False}
+
+    peers = [p for p in args.peers.split(",") if p]
+    ring = None
+    csock = socket.socket()
+    try:
+        if args.world > 1:
+            bind_port = int(peers[args.rank].rpartition(":")[2])
+            ring = RingTransport(args.rank, args.world, args.bind_ip,
+                                 peers, port=bind_port)
+            trace(f"connecting ring ({args.world} ranks)")
+            ring.connect(timeout=args.connect_timeout)
+        trace(f"dialing coordinator {args.coordinator}")
+        chost, _, cport = args.coordinator.rpartition(":")
+        csock.settimeout(args.connect_timeout)
+        csock.connect((chost, int(cport)))
+        # Half-open partition coverage for the idle loop below: with
+        # keepalive armed, a coordinator host that vanished without a
+        # FIN surfaces as an OSError instead of eternal silence.
+        csock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        send_msg(csock, {"op": "hello", "rank": args.rank})
+
+        x = np.zeros((args.slots, sl.d), np.float32)
+        out = np.empty((args.slots, sl.d), np.float32)
+        scratch = np.empty(args.slots * sl.d, np.float32)
+
+        def reduce_fn(part, stage):
+            t0 = time.monotonic()
+            if ring is None:
+                total = part
+            else:
+                total = ring.allreduce(part, out, scratch)
+            reduce_fn.collective_s += time.monotonic() - t0
+            return total
+
+        reduce_fn.collective_s = 0.0
+
+        while True:
+            # Idle is not death: a drained serving replica submits
+            # nothing between requests, and a worker that exited on
+            # silence would make every lull cost a spurious replica
+            # failure + re-rendezvous. So the IDLE wait (select, no
+            # bytes consumed) re-arms freely — but once the frame's
+            # first byte is on the wire, the whole frame must land
+            # under a FRESH deadline and a mid-frame timeout is
+            # FATAL: catching it would desync the positional stream
+            # (the next "header" would be this frame's json body).
+            # Coordinator death still ends the worker via the closed
+            # socket (ProtocolError/OSError).
+            readable, _, _ = select.select([csock], [], [],
+                                           args.idle_timeout)
+            if not readable:
+                continue
+            msg, payload = recv_msg(csock, timeout=args.idle_timeout)
+            op = msg["op"]
+            if op == "close":
+                break
+            if op == "reset":
+                x = np.zeros((args.slots, sl.d), np.float32)
+                result["resets"] += 1
+                send_msg(csock, {"op": "ack", "reset": True})
+                continue
+            if op != "step":
+                raise ProtocolError(f"unknown op {op!r}")
+            t0 = time.monotonic()
+            idx = msg["slots"]
+            rows = np.frombuffer(payload, np.float32).reshape(
+                len(idx), sl.d) if idx else None
+            for j, i in enumerate(idx):
+                x[i] = rows[j]
+            reduce_fn.collective_s = 0.0
+            x, tokens = sl.forward(x, reduce_fn,
+                                   partial_fn=partial_fn,
+                                   finish_fn=finish_fn)
+            total = time.monotonic() - t0
+            coll = reduce_fn.collective_s
+            reply = {"op": "tokens", "step": msg["step"],
+                     "compute_s": round(max(0.0, total - coll), 6),
+                     "collective_s": round(coll, 6)}
+            body = tokens[lo:hi].astype(np.int32).tobytes()
+            if msg.get("want_state") and args.rank == 0:
+                reply["state"] = True
+                body += np.ascontiguousarray(x, np.float32).tobytes()
+            send_msg(csock, reply, body)
+            result["steps"] += 1
+        result["ok"] = True
+    except Exception as e:
+        result["error"] = repr(e)[:300]
+        trace(f"failed: {e!r}")
+    finally:
+        if ring is not None:
+            ring.close()
+        csock.close()
+    print(json.dumps(result), file=proto_out, flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,438 @@
+"""SyntheticShardSet — the FabricExecutor's jax-free shard backend.
+
+N shard threads (the `_GuardedWorker` discipline from
+serving/executor.py, extended to a SET: every failure path lands in
+the owning step handle and a thread must never die silently) stand in
+for N fabric worker processes. The collective plane is an in-process
+reduce board with a CONTROLLED cost and a deadline, so overlap, chaos
+and scheduling tests are deterministic on shared CI boxes without a
+real multi-process rendezvous:
+
+  * ``step_time_s`` — per-rank (scalar or per-shard sequence) local
+    compute cost: the skew knob (`serving_shard_step_skew_seconds`
+    must move when one shard is slower).
+  * ``collective_time_s`` — added wire cost per reduce: the
+    collective-fraction knob.
+  * ``collective_timeout_s`` — every shard's wait at the reduce board
+    carries this deadline (the GL010 contract: a hung peer surfaces
+    as ``ShardCollectiveStall`` in bounded time, never an unbounded
+    block — and the coordinator's ``collect`` is watchdog-visible in
+    the meantime).
+  * ``fault_site`` — rank r fires ``{fault_site}{r}.step`` inside its
+    shard thread before computing, so a chaos plan can kill or hang
+    ONE shard of the replica (the new failure domain) exactly as
+    `faults` kills whole replicas.
+
+Failure propagation is eager: a shard that raises poisons its
+GENERATION on the board, so peers blocked in the reduce raise
+``ShardStepError`` immediately instead of waiting out the stall
+deadline. ``reset()`` bumps the generation, aborts every outstanding
+handle, abandons busy (possibly hung) shard threads and spawns fresh
+ones with zeroed state — the in-process model of the restarted
+replica's re-rendezvous.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ... import faults
+from .shard_math import (DoubleShardSlice, ShardSlice, TpShardSlice,
+                         segment_bounds)
+
+
+class ShardError(RuntimeError):
+    """Base of the shard plane's failures; carries the origin rank."""
+
+    def __init__(self, msg: str, rank: Optional[int] = None):
+        super().__init__(msg)
+        self.rank = rank
+
+
+class ShardStepError(ShardError):
+    """One shard's step raised; the whole replica step is poisoned
+    (every peer needs the missing partial)."""
+
+
+class ShardCollectiveStall(ShardError):
+    """A peer never deposited its partial inside the collective
+    deadline — the bounded-time spelling of 'one shard is hung'."""
+
+
+class ShardAborted(ShardError):
+    """The step's generation was torn down (reset/close) before the
+    result landed — the owner must not retry against this handle."""
+
+
+class ShardTimeout(ShardError):
+    """collect() deadline expired before every shard replied."""
+
+
+class StepOutput:
+    """What one replica step produced, assembled across shards."""
+
+    __slots__ = ("tokens", "state", "compute_s", "collective_s")
+
+    def __init__(self, tokens: np.ndarray,
+                 state: Optional[np.ndarray],
+                 compute_s: List[float], collective_s: List[float]):
+        self.tokens = tokens
+        self.state = state
+        self.compute_s = compute_s
+        self.collective_s = collective_s
+
+
+class _StepHandle:
+    """Per-step reply board: one slot per rank, an event per rank.
+    Every shard failure path deposits SOMETHING here — the owner's
+    collect() must never block past its own deadline on silence."""
+
+    __slots__ = ("gen", "step_no", "want_state", "events", "tokens",
+                 "errors", "compute_s", "collective_s", "state",
+                 "_updates")
+
+    def __init__(self, gen: int, step_no: int, world: int,
+                 want_state: bool):
+        self.gen = gen
+        self.step_no = step_no
+        self.want_state = want_state
+        self.events = [threading.Event() for _ in range(world)]
+        self.tokens: List[Optional[np.ndarray]] = [None] * world
+        self.errors: List[Optional[BaseException]] = [None] * world
+        self.compute_s = [0.0] * world
+        self.collective_s = [0.0] * world
+        self.state: Optional[np.ndarray] = None
+
+    def deliver(self, rank: int, tokens: np.ndarray, compute_s: float,
+                collective_s: float,
+                state: Optional[np.ndarray]) -> None:
+        self.tokens[rank] = tokens
+        self.compute_s[rank] = compute_s
+        self.collective_s[rank] = collective_s
+        if state is not None:
+            self.state = state
+        self.events[rank].set()
+
+    def deliver_error(self, rank: int, exc: BaseException) -> None:
+        self.errors[rank] = exc
+        self.events[rank].set()
+
+
+class _ReduceBoard:
+    """The in-process allreduce: rank-ordered deterministic sum with a
+    modelled wire cost and a hard deadline. One board per set; cells
+    are keyed by (generation, step, stage) so stale deposits from an
+    abandoned shard thread can never reach a restarted session."""
+
+    def __init__(self, world: int, cost_s: float, timeout_s: float):
+        self.world = world
+        self.cost_s = cost_s
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._cells: Dict[tuple, dict] = {}
+        self._poisoned: Dict[int, BaseException] = {}
+
+    def poison(self, gen: int, exc: BaseException) -> None:
+        """Fail every current and future wait of this generation —
+        eager error propagation (a peer must not wait out the stall
+        deadline for a partial that provably never comes) AND the
+        reset/close abort path. Poison is PERMANENT for its
+        generation: a hung shard thread waking long after a reset
+        must fail fast against its stale generation, never squat a
+        fresh cell for the full stall deadline."""
+        with self._lock:
+            self._poisoned.setdefault(gen, exc)
+            for key in [k for k in self._cells if k[0] == gen]:
+                del self._cells[key]
+            self._ready.notify_all()
+
+    def reduce(self, gen: int, step_no: int, stage: int, rank: int,
+               part: np.ndarray) -> np.ndarray:
+        # The same fault site the REAL transport fires per chunk
+        # (fabric_collectives sender loops): a chaos plan targeting
+        # fabric.send breaks the synthetic collective identically, so
+        # the collective failure domain is testable without sockets.
+        faults.fire("fabric.send")
+        key = (gen, step_no, stage)
+        deadline = time.monotonic() + self.timeout_s
+        with self._lock:
+            if gen in self._poisoned:
+                raise self._poisoned[gen]
+            cell = self._cells.setdefault(key,
+                                          {"parts": {}, "left": 0})
+            cell["parts"][rank] = part
+            cell["left"] += 1
+            self._ready.notify_all()
+            while len(cell["parts"]) < self.world:
+                if gen in self._poisoned:
+                    raise self._poisoned[gen]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = [r for r in range(self.world)
+                               if r not in cell["parts"]]
+                    raise ShardCollectiveStall(
+                        f"rank {rank}: peers {missing} never "
+                        f"deposited for step {step_no} stage {stage} "
+                        f"within {self.timeout_s}s", rank=rank)
+                self._ready.wait(remaining)
+            # Rank-ordered sum: every shard computes the IDENTICAL
+            # float result, so the replicated states stay equal.
+            parts = cell["parts"]
+            total = parts[0].astype(np.float32, copy=True)
+            for r in range(1, self.world):
+                total = total + parts[r]
+            cell["left"] -= 1
+            if cell["left"] == 0 and len(parts) == self.world:
+                # Last leaver only: an early leaver deleting the cell
+                # would strand slower ranks re-creating it half-full.
+                self._cells.pop(key, None)
+        if self.cost_s:
+            time.sleep(self.cost_s)  # modelled wire time
+        return total
+
+
+class _Shard:
+    """One shard worker thread: FIFO over its own queue, guarded like
+    _GuardedWorker — an exception lands in the step handle (and
+    poisons the board generation), never kills the thread."""
+
+    def __init__(self, owner: "SyntheticShardSet", rank: int,
+                 gen: int):
+        self.owner = owner
+        self.rank = rank
+        self.gen = gen
+        self.slice: ShardSlice = owner._make_slice(rank)
+        self.x = np.zeros((owner.slots, owner.d), np.float32)
+        self.q: _queue.Queue = _queue.Queue()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"shard{rank}-g{gen}")
+        self.thread.start()
+
+    def _run(self) -> None:
+        owner, rank = self.owner, self.rank
+        lo, hi = owner.segments[rank]
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            handle: _StepHandle = item
+            if handle.gen != self.gen:
+                # A stale item from before a reset raced onto this
+                # queue: the handle was already aborted — ignore.
+                continue
+            try:
+                t0 = time.monotonic()
+                if owner.fault_site is not None:
+                    faults.fire(f"{owner.fault_site}{rank}.step")
+                for i, row in handle._updates:  # type: ignore[attr-defined]
+                    self.x[i] = row
+                if owner.step_time_s[rank]:
+                    time.sleep(owner.step_time_s[rank])
+                coll = [0.0]
+
+                def reduce_fn(part, stage,
+                              _h=handle, _c=coll):
+                    t = time.monotonic()
+                    out = owner.board.reduce(self.gen, _h.step_no,
+                                             stage, rank, part)
+                    _c[0] += time.monotonic() - t
+                    return out
+
+                self.x, tokens = self.slice.forward(self.x, reduce_fn)
+                total = time.monotonic() - t0
+                handle.deliver(
+                    rank, tokens[lo:hi],
+                    compute_s=max(0.0, total - coll[0]),
+                    collective_s=coll[0],
+                    state=(self.x.copy()
+                           if handle.want_state and rank == 0
+                           else None))
+            except BaseException as e:
+                if isinstance(e, ShardError):
+                    typed = e
+                else:
+                    # Wrap: the owner's collect() must raise the
+                    # shard plane's typed error naming the origin
+                    # rank, with the real failure chained.
+                    typed = ShardStepError(
+                        f"shard {rank} step failed: {e!r}", rank=rank)
+                    typed.__cause__ = e
+                # Poison FIRST: peers blocked in the reduce must fail
+                # fast with the origin error, not a generic stall.
+                owner.board.poison(self.gen, typed)
+                handle.deliver_error(rank, typed)
+
+    def stop(self) -> None:
+        self.q.put(None)
+
+
+def _per_rank(value: Union[float, Sequence[float]],
+              world: int) -> List[float]:
+    if isinstance(value, (int, float)):
+        return [float(value)] * world
+    vals = [float(v) for v in value]
+    if len(vals) != world:
+        raise ValueError(f"need {world} per-rank values, got "
+                         f"{len(vals)}")
+    return vals
+
+
+class SyntheticShardSet:
+    """N in-process shard threads behind the ShardSet contract the
+    FabricExecutor drives (``reset`` / ``submit(step, updates,
+    want_state)→handle`` / ``collect(handle, timeout)→StepOutput`` /
+    ``close``). With ``params`` (train_step.init_params layout, E=1)
+    the shards run the REAL model math tensor-parallel — the tier-1
+    stand-in for jitted fabric workers; without, the SyntheticExecutor
+    double with dialable costs."""
+
+    def __init__(self, world: int, slots: int, d: int = 16, *,
+                 params: Optional[dict] = None, seed: int = 0,
+                 step_time_s: Union[float, Sequence[float]] = 0.0,
+                 collective_time_s: float = 0.0,
+                 collective_timeout_s: float = 5.0,
+                 fault_site: Optional[str] = None):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.slots = slots
+        self.params = params
+        self.seed = seed
+        self.d = (int(np.asarray(params["w1"]).shape[1])
+                  if params is not None else d)
+        self.step_time_s = _per_rank(step_time_s, world)
+        self.collective_time_s = collective_time_s
+        self.fault_site = fault_site
+        self.segments = segment_bounds(slots, world)
+        self.board = _ReduceBoard(world, collective_time_s,
+                                  collective_timeout_s)
+        self._gen = 0
+        self._lock = threading.Lock()
+        self._shards: List[_Shard] = []
+        self._outstanding: set = set()
+        self.resets = 0
+
+    # -- slice construction ---------------------------------------------------
+
+    def _make_slice(self, rank: int) -> ShardSlice:
+        if self.params is not None:
+            return TpShardSlice(self.params, rank, self.world)
+        return DoubleShardSlice(self.d, self.seed, rank, self.world)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _ensure(self) -> None:
+        if not self._shards:
+            self._shards = [_Shard(self, r, self._gen)
+                            for r in range(self.world)]
+
+    def reset(self) -> None:
+        """Tear down this decode session and re-rendezvous: bump the
+        generation (stale deposits and late-waking hung threads can
+        never touch the new session), abort every outstanding handle,
+        abandon the old shard threads (a HUNG shard cannot be joined
+        — it is left to die on its poison pill) and spawn fresh ones
+        with zeroed state."""
+        with self._lock:
+            old_gen = self._gen
+            self._gen += 1
+            old = self._shards
+            self._shards = []
+            outstanding = list(self._outstanding)
+        abort = ShardAborted(
+            f"shard set reset (generation {old_gen} torn down)")
+        self.board.poison(old_gen, abort)
+        for h in outstanding:
+            for r, ev in enumerate(h.events):
+                if not ev.is_set():
+                    h.deliver_error(r, abort)
+        for sh in old:
+            sh.stop()
+        with self._lock:
+            # Aborted handles are SETTLED, not leaked: discard exactly
+            # the snapshot (never clear() — a handle submitted
+            # concurrently with this reset must stay on the ledger
+            # until collected or aborted, or outstanding() could hide
+            # a real leak).
+            self._outstanding.difference_update(outstanding)
+            self._ensure()
+            self.resets += 1
+
+    def close(self) -> None:
+        with self._lock:
+            old = self._shards
+            self._shards = []
+            gen = self._gen
+            outstanding = list(self._outstanding)
+        abort = ShardAborted("shard set closed")
+        self.board.poison(gen, abort)
+        for h in outstanding:
+            for r, ev in enumerate(h.events):
+                if not ev.is_set():
+                    h.deliver_error(r, abort)
+        for sh in old:
+            sh.stop()
+        with self._lock:
+            # Same discipline as reset(): only the handles this close
+            # actually aborted leave the ledger, so the chaos
+            # teardowns' outstanding() == 0 assertion stays a REAL
+            # invariant (an un-aborted in-flight step survives it).
+            self._outstanding.difference_update(outstanding)
+
+    def live_shards(self) -> int:
+        with self._lock:
+            return sum(1 for sh in self._shards
+                       if sh.thread.is_alive())
+
+    def outstanding(self) -> int:
+        """Submitted steps not yet collected — the shard plane's leak
+        ledger (chaos teardowns assert 0 after close)."""
+        with self._lock:
+            return len(self._outstanding)
+
+    # -- the step plane -------------------------------------------------------
+
+    def submit(self, step_no: int, updates: Sequence,
+               want_state: bool = False) -> _StepHandle:
+        with self._lock:
+            self._ensure()
+            handle = _StepHandle(self._gen, step_no, self.world,
+                                 want_state)
+            # Rows are copied at apply time; the handle only carries
+            # the references across the queue hop.
+            handle._updates = [(int(i), np.asarray(row, np.float32))
+                               for i, row in updates]
+            self._outstanding.add(handle)
+            shards = list(self._shards)
+        for sh in shards:
+            sh.q.put(handle)
+        return handle
+
+    def collect(self, handle: _StepHandle,
+                timeout: float) -> StepOutput:
+        deadline = time.monotonic() + timeout
+        try:
+            for r, ev in enumerate(handle.events):
+                if not ev.wait(max(0.0, deadline - time.monotonic())):
+                    raise ShardTimeout(
+                        f"shard {r} never replied to step "
+                        f"{handle.step_no} within {timeout}s", rank=r)
+            for r, err in enumerate(handle.errors):
+                if err is not None:
+                    raise err
+            tokens = np.empty((self.slots,), np.int32)
+            for r, (lo, hi) in enumerate(self.segments):
+                tokens[lo:hi] = handle.tokens[r]
+            return StepOutput(tokens, handle.state,
+                              list(handle.compute_s),
+                              list(handle.collective_s))
+        finally:
+            with self._lock:
+                self._outstanding.discard(handle)

@@ -15,6 +15,8 @@ class DecodeStep:
 def _run_pipelined(ex, state):
     while True:
         tok = ex.submit(state)
+        ex.blocked_since = 0.0  # watchdog bracket (GL010's near-miss)
         state = np.asarray(ex.collect(tok))  # materializes every step
+        ex.blocked_since = None
         if tok.item() < 0:  # device round-trip per step
             return state

@@ -1,0 +1,21 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl010_tp.py
+"""GL010 true positives: blocking transport receives in a loop with no
+deadline anywhere — no timeout argument on the call, no settimeout
+discipline in the module, no blocked_since publication in the
+function. A dead or wedged peer parks these threads forever, invisibly
+to the supervisor's watchdog."""
+
+
+def pump_frames(sock, frames):
+    while True:
+        data = sock.recv(65536)        # unbounded: peer gone = forever
+        if not data:
+            return
+        frames.append(data)
+
+
+def drive_decode(executor, steps):
+    tokens = []
+    for handle in steps:
+        tokens.append(executor.collect(handle))  # unbounded collect
+    return tokens

@@ -508,6 +508,97 @@ def test_chaos_matrix_local(mode, fault, local_executors, settle_counts):
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 
+# -- sharded replicas (ISSUE 8): one shard of a replica dies/hangs ------------
+
+
+_SHARD_CASES = [
+    ("pipelined", "shard-step-raise"),
+    ("pipelined", "shard-step-hang"),
+    ("sync", "shard-step-raise"),
+    ("pipelined", "collective-send-raise"),
+]
+
+
+@pytest.mark.parametrize("mode,fault", _SHARD_CASES,
+                         ids=[f"{m}-{f}" for m, f in _SHARD_CASES])
+def test_chaos_matrix_sharded(mode, fault, settle_counts, tmp_path):
+    """The new failure domain: ONE shard of a fabric-sharded replica
+    killed or hung mid-decode (the `shard{r}.step` site inside the
+    shard thread, or the reused `fabric.send` site inside the
+    collective). Must hold: the watchdog/death-detector sees it, the
+    supervisor seizes and requeues exactly-once (proven in the
+    flight-recorder trace), the restarted replica RE-RENDEZVOUSES
+    (fresh shard generation — `resets` moves past the startup one),
+    token streams are byte-identical to an uninjected run, and the
+    shard plane's outstanding-step leak ledger reads clean at
+    teardown. (Sharded replicas are row-plane: the paged-KV leak
+    ledger is covered by the KV chaos case below, which keeps its
+    assert_clean teardown.)"""
+    from dpu_operator_tpu.serving import FabricExecutor, SyntheticShardSet
+
+    t0 = time.perf_counter()
+    pipelined = mode == "pipelined"
+
+    def run(inject):
+        # Equal nonzero step cost on BOTH replicas: replica0 pays a
+        # shard-thread spawn at reset, and with free steps replica1
+        # would drain the whole preloaded queue before replica0's
+        # first pop — the fault site would never even be called.
+        shards = SyntheticShardSet(
+            world=3, slots=2, d=8, seed=5, step_time_s=0.005,
+            fault_site="c0shard" if inject else None)
+        ex0 = FabricExecutor(shards, mode=mode, step_timeout_s=5.0)
+        ex1 = SyntheticExecutor(slots=2, d=8, seed=5,
+                                step_time_s=0.005,
+                                pipelined=pipelined)
+        reqs = _reqs(8, 8, 5)
+        pool, _q = _run_pool(
+            [ex0, ex1], reqs, timeout=10.0,
+            flight_dir=tmp_path if inject else None)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 2,
+                      msg="full live-replica count")
+                assert sum(pool.restarts) >= 1
+                # Re-rendezvous: the restarted batcher's reset tears
+                # down the wounded shard generation and spawns a
+                # fresh one (startup reset is #1; the LIVE flip
+                # precedes the new thread's reset, so wait for it).
+                _wait(lambda: shards.resets >= 2,
+                      msg="shard set re-rendezvous")
+        finally:
+            pool.stop()
+        assert shards.outstanding() == 0, \
+            "shard plane leaked an un-aborted in-flight step"
+        return [(r.error, list(r.tokens)) for r in reqs]
+
+    baseline = run(inject=False)
+    if fault == "collective-send-raise":
+        point = "fabric.send"
+    else:
+        point = "c0shard1.step"
+    with obs_trace.scoped():
+        with faults.injected() as plan:
+            if fault == "shard-step-hang":
+                plan.inject(point, hang_s=1.2, at_calls=[3])
+            elif fault == "collective-send-raise":
+                # fabric.send fires once per shard per reduce (world
+                # = 3): call 7 lands inside the third decode step.
+                plan.inject(point,
+                            exc=RuntimeError("injected send fail"),
+                            at_calls=[7])
+            else:
+                plan.inject(point,
+                            exc=RuntimeError("injected shard kill"),
+                            at_calls=[3])
+            injected = run(inject=True)
+    assert all(e is None for e, _ in injected), injected
+    assert injected == baseline
+    assert set(settle_counts.values()) == {1}, settle_counts
+    _assert_recovery_chain(_flight_spans(tmp_path, "restart"), point)
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
 # -- paged-KV re-attach (ISSUE 7): retry without re-decode --------------------
 
 
@@ -682,8 +773,9 @@ def test_breaker_parks_flapping_replica_healthz_red_at_zero_live():
             # Parked means parked: no further restarts accrue.
             time.sleep(0.1)
             assert sum(srv.pool.restarts) == restarts_at_park
-            assert reg.gauge_value("serving_pool_replicas",
-                                   {"state": "parked"}) == 1.0
+            assert reg.gauge_value(
+                "serving_pool_replicas",
+                {"state": "parked", "sharded": "false"}) == 1.0
         finally:
             srv.stop()
 

@@ -56,7 +56,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 9
+    assert len(set(ids)) == len(ids) == 10
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -73,6 +73,7 @@ _EXPECT = {
     "GL007": 1,  # while-True connect retry, no bound, no sleep
     "GL008": 2,  # bare replica-only logs in the request-scoped graph
     "GL009": 2,  # acquire and prefix-fork with no release, no lease
+    "GL010": 2,  # loop recv and loop collect, no deadline anywhere
 }
 
 
@@ -118,6 +119,36 @@ def test_gl003_fires_at_module_level(tmp_path):
     assert any(f.rule == "GL003" and "'sock'" in f.message
                for f in report.findings), [
         f.format() for f in report.findings]
+
+
+def test_gl010_module_settimeout_grant_silences(tmp_path):
+    """The module-wide near-miss that cannot share the nm fixture: a
+    transport module that arms its sockets with settimeout at connect
+    time (fabric_collectives' discipline) statically bounds every
+    later recv — the SAME loop that fires without the grant must stay
+    silent with it."""
+    loop = (
+        "def pump(sock, frames):\n"
+        "    while True:\n"
+        "        data = sock.recv(65536)\n"
+        "        if not data:\n"
+        "            return\n"
+        "        frames.append(data)\n")
+    header = ("# graftlint-fixture-path: "
+              "dpu_operator_tpu/parallel/fx_gl010_grant.py\n")
+    fired = _analyze_source(tmp_path, header + loop, name="a.py")
+    assert any(f.rule == "GL010" for f in fired.findings), [
+        f.format() for f in fired.findings]
+    granted = _analyze_source(
+        tmp_path,
+        header
+        + "def connect(sock, addr, io_timeout):\n"
+          "    sock.connect(addr)\n"
+          "    sock.settimeout(io_timeout)\n"
+        + loop,
+        name="b.py")
+    assert not any(f.rule == "GL010" for f in granted.findings), [
+        f.format() for f in granted.findings]
 
 
 # -- pragma suppression -------------------------------------------------------

@@ -290,3 +290,54 @@ def test_multislice_env_parsed():
     assert (topo.slice_id, topo.num_slices) == (1, 2)
     topo = SliceTopology.from_env(dict(base, TPU_SLICE_ID="1"))
     assert (topo.slice_id, topo.num_slices) == (0, 1)
+
+
+# -- ring-order selection (sharded serving replicas, ISSUE 8) -----------------
+
+
+def test_ring_order_is_total_and_deterministic():
+    from dpu_operator_tpu.parallel.topology import ring_order
+
+    addrs = ["10.0.0.3:9411", "10.0.0.1:9411", "10.0.0.2:9411"]
+    order = ring_order(addrs)
+    assert sorted(order) == sorted(addrs)          # total: nothing lost
+    assert order == ring_order(list(addrs))        # deterministic
+
+
+def test_ring_order_stable_under_permutation():
+    """Two coordinators discovering the same shard set in different
+    orders (or a supervisor re-rendezvousing a restarted replica) must
+    agree on the ring, or neighbours dial past each other forever."""
+    import itertools
+
+    from dpu_operator_tpu.parallel.topology import ring_order
+
+    addrs = ["10.0.0.2:9500", "10.0.0.10:9500", "127.0.0.1:9001",
+             "127.0.0.1:9002"]
+    want = ring_order(addrs)
+    for perm in itertools.permutations(addrs):
+        assert ring_order(list(perm)) == want
+
+
+def test_ring_order_numeric_ip_not_lexical():
+    """10.0.0.10 sorts AFTER 10.0.0.2 (numeric octets): lexical order
+    would interleave hosts across racks and churn the ring whenever a
+    two-digit host joins."""
+    from dpu_operator_tpu.parallel.topology import ring_order
+
+    assert ring_order(["10.0.0.10:1", "10.0.0.2:1"]) == [
+        "10.0.0.2:1", "10.0.0.10:1"]
+    # Same host: port breaks the tie (several shards stacked on
+    # loopback in tests).
+    assert ring_order(["127.0.0.1:9002", "127.0.0.1:9001"]) == [
+        "127.0.0.1:9001", "127.0.0.1:9002"]
+    # Hostnames fall back to string order, after numeric IPs.
+    assert ring_order(["shard-b:1", "10.9.9.9:1", "shard-a:1"]) == [
+        "10.9.9.9:1", "shard-a:1", "shard-b:1"]
+
+
+def test_ring_order_rejects_duplicate_addresses():
+    from dpu_operator_tpu.parallel.topology import ring_order
+
+    with pytest.raises(ValueError):
+        ring_order(["10.0.0.1:9411", "10.0.0.1:9411"])
