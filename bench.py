@@ -393,6 +393,27 @@ def bench_jax_over_fabric() -> dict:
         if gloo:
             out["fabric_gloo_allreduce_gbps"] = round(
                 sum(gloo) / len(gloo), 3)
+        # Quantized ring (ISSUE 9): effective fp32-equivalent Gb/s of
+        # the int8 allreduce (same payload, quarter the wire bytes),
+        # with the measured max-abs error and its documented bound —
+        # the bandwidth claim is only honest next to the rounding it
+        # bought. Paired per-worker with the fp32 ring figure.
+        q = [r["fabric_quantized_allreduce_gbps"] for r in results
+             if "fabric_quantized_allreduce_gbps" in r]
+        if q:
+            out["fabric_quantized_allreduce_gbps"] = round(
+                sum(q) / len(q), 3)
+            out["fabric_quantized_allreduce_maxerr"] = max(
+                r.get("fabric_quantized_allreduce_maxerr", 0.0)
+                for r in results)
+            out["fabric_quantized_err_bound"] = max(
+                r.get("fabric_quantized_err_bound", 0.0)
+                for r in results)
+            sp = [r["fabric_quantized_speedup"] for r in results
+                  if "fabric_quantized_speedup" in r]
+            if sp:
+                out["fabric_quantized_speedup"] = round(
+                    sum(sp) / len(sp), 2)
         out["fabric_jax_train_step_ok"] = all(
             bool(r.get("train_matches_dense"))
             and bool(r.get("train_loss_descends")) for r in results)
@@ -401,6 +422,9 @@ def bench_jax_over_fabric() -> dict:
         print(f"jax-over-fabric decomposition: raw ring "
               f"{out.get('fabric_ring_raw_gbps')} Gb/s ceiling, "
               f"{out['fabric_collective_transport']} allreduce {gbps} Gb/s, "
+              f"int8 allreduce {out.get('fabric_quantized_allreduce_gbps')} "
+              f"Gb/s effective ({out.get('fabric_quantized_speedup')}x, "
+              f"maxerr {out.get('fabric_quantized_allreduce_maxerr')}), "
               f"gloo allreduce {out.get('fabric_gloo_allreduce_gbps')} Gb/s; "
               f"train-step losses {results[0].get('train_losses')}",
               file=sys.stderr)
@@ -832,6 +856,12 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # capstone — the jax collective now fails the round like a tcp
         # regression always has.
         ("fabric_jax_allreduce_gbps", 0.85, "allreduce_ge_085_median"),
+        # Quantized ring (ISSUE 9): the int8 collective's EFFECTIVE
+        # fp32-equivalent bandwidth holds 0.85x its rolling median —
+        # a silent fall back to fp32 framing or a codec-cost
+        # regression halves the figure and fails the round.
+        ("fabric_quantized_allreduce_gbps", 0.85,
+         "quantized_allreduce_ge_085_median"),
         ("fabric_udp_gbps", 0.85, "fabric_udp_ge_085_median"),
         ("fabric_clusterip_tcp_gbps", 0.85, "clusterip_ge_085_median"),
         ("pod_attach_concurrent_per_s", 0.85,
@@ -919,6 +949,9 @@ def main() -> int:
         "fabric_ring_raw_gbps": "Gb/s",
         "fabric_jax_allreduce_gbps": "Gb/s",
         "fabric_gloo_allreduce_gbps": "Gb/s",
+        "fabric_quantized_allreduce_gbps": "Gb/s",
+        "fabric_quantized_speedup": "x",
+        "fabric_quantized_allreduce_maxerr": "abs",
         "serving_reqs_per_s": "req/s",
         "serving_serial_reqs_per_s": "req/s",
         "serving_batching_speedup": "x",
@@ -946,8 +979,12 @@ def main() -> int:
         "serving_kv_prefix_speedup": "x",
         "serving_prefill_stall_frac": "frac",
         "serving_sharded_steps_per_s": "steps/s",
+        "serving_sharded_steps_per_s_overlap": "steps/s",
+        "serving_sharded_steps_per_s_off": "steps/s",
+        "serving_shard_overlap_speedup": "x",
         "serving_sharded_tok_per_s": "tok/s",
         "serving_shard_collective_frac": "frac",
+        "serving_shard_collective_frac_off": "frac",
         "serving_shard_step_skew_ms": "ms",
         "serving_sharded_vs_local_frac": "frac",
     }

@@ -15,6 +15,8 @@ GL008  request-path log call that binds no request id (serving/)
 GL009  KV block acquired with no paired release or lease (serving/)
 GL010  blocking fabric recv/collect in a transport loop with no
        deadline (serving/parallel)
+GL011  full-copy array materialization (.tobytes()/np.copy) inside a
+       serving/parallel transport hot loop
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1133,9 +1135,85 @@ class UnboundedTransportRecv(Rule):
                     f"block")
 
 
+# GL011 — full array copy inside a transport hot loop
+
+
+class CopyInTransportLoop(Rule):
+    """Origin: ISSUE 9's quantized-collective transport work. The ring
+    transport's whole overlap budget lives or dies on the hot loop
+    staying zero-copy: a ``.tobytes()`` (or ``np.copy``/``numpy.copy``)
+    on a payload array inside a per-chunk/per-step send loop
+    materializes a full second buffer per iteration — at 16 MiB
+    payloads that is page-fault time on the critical path, and it is
+    invisible in review because the copy LOOKS like serialization.
+    The shard worker's reply path shipped exactly this shape
+    (``tokens.astype(...).tobytes()`` + ``state.tobytes()`` per step)
+    until the zero-copy protocol landed.
+
+    Fires on: a call whose terminal name is ``tobytes``, or a
+    ``np.copy``/``numpy.copy`` call, inside a loop that ALSO performs
+    transport I/O (a call named send/sendall/sendmsg/sendto/send_msg/
+    recv/recv_into/recvfrom/recv_msg in the same loop body), in a
+    serving/ or parallel/ module.
+
+    Near-misses that stay silent: the same copies OUTSIDE a loop
+    (one-shot setup/teardown serialization is fine), copies in loops
+    with no transport call (a scheduler materializing state is not a
+    wire path), and the ``.copy()`` METHOD (often a deliberate
+    defensive copy of a received buffer — the rule polices the send
+    side's serialization idiom, not ownership discipline)."""
+
+    rule_id = "GL011"
+    severity = SEVERITY_ERROR
+    title = "full array copy inside a transport hot loop"
+    hint = ("send the array itself: memoryview/buffer-protocol parts "
+            "(protocol.send_msg takes them), np.ascontiguousarray for "
+            "layout (no copy when already contiguous), np.frombuffer "
+            "to decode — a per-iteration tobytes() pays a full "
+            "payload copy on the wire path")
+
+    _IO_NAMES = {"send", "sendall", "sendmsg", "sendto", "send_msg",
+                 "recv", "recv_into", "recvfrom", "recv_msg"}
+    _NP_MODULES = {"np", "numpy"}
+
+    def _is_copy_call(self, call: ast.Call) -> bool:
+        name = _terminal_name(call.func)
+        if name == "tobytes":
+            return True
+        if name == "copy" and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id in self._NP_MODULES:
+            return True
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving", "parallel"):
+            return
+        seen: Set[int] = set()
+        for fn, qual in module.functions:
+            for loop in (n for n in _walk_same_function(fn)
+                         if isinstance(n, (ast.While, ast.For))):
+                calls = [n for n in _walk_same_function(loop)
+                         if isinstance(n, ast.Call)]
+                if not any(_terminal_name(c.func) in self._IO_NAMES
+                           for c in calls):
+                    continue
+                for c in calls:
+                    if id(c) in seen or not self._is_copy_call(c):
+                        continue
+                    seen.add(id(c))
+                    yield self.finding(
+                        module, c,
+                        f"'{ast.unparse(c.func)}(...)' materializes a "
+                        f"full array copy inside a transport loop in "
+                        f"'{qual}' — every iteration pays a payload-"
+                        f"sized allocation+copy on the wire path")
+
+
 def default_rules() -> List[Rule]:
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
             SilentBroadExcept(), UndeclaredAxisName(),
             UnboundedRetryLoop(), RequestLogWithoutContext(),
-            KVAcquireWithoutRelease(), UnboundedTransportRecv()]
+            KVAcquireWithoutRelease(), UnboundedTransportRecv(),
+            CopyInTransportLoop()]

@@ -29,9 +29,11 @@ transport, multiworker lane).
 
 Per-step observability (the executor sees what the scheduler cannot):
 ``serving_shard_collective_seconds`` (slowest shard's time inside the
-allreduce — the step pays the slowest) and
-``serving_shard_step_skew_seconds`` (fastest-vs-slowest shard local
-compute: imbalance that manifests as collective wait). The ReplicaPool
+allreduce — the step pays the slowest; under overlap, only the
+NON-HIDDEN wait) and ``serving_shard_step_skew_seconds``
+(fastest-vs-slowest shard local compute: imbalance that manifests as
+collective wait), both labelled ``{replica, codec}`` so a quantized
+replica's latencies never aggregate with an fp32 one's. The ReplicaPool
 binds its registry via ``bind_registry`` so a ServingServer-built pool
 exposes both on /metrics without extra wiring.
 """
@@ -66,6 +68,11 @@ class FabricExecutor(Executor):
         self.shards = shards
         self.slots = int(shards.slots)
         self.d = int(shards.d)
+        # The wire codec the shard plane reduces over, stamped on the
+        # shard metrics: a quantized and an fp32 replica must never
+        # aggregate into one latency series (they are different
+        # physical collectives).
+        self.codec_name = str(getattr(shards, "codec_name", "fp32"))
         self.pipelined = mode == "pipelined"
         self.step_timeout_s = step_timeout_s
         self.name = name
@@ -130,7 +137,7 @@ class FabricExecutor(Executor):
         reg = self._registry
         if reg is None or not out.compute_s:
             return
-        labels = {"replica": self.name}
+        labels = {"replica": self.name, "codec": self.codec_name}
         reg.observe(
             "serving_shard_collective_seconds",
             max(out.collective_s), labels,

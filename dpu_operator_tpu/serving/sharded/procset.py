@@ -110,7 +110,9 @@ class ShardProcessSet:
     def __init__(self, world: int, slots: int, d: int = 16, *,
                  params: Optional[dict] = None, seed: int = 0,
                  jit: bool = True, spawn_timeout_s: float = 60.0,
-                 python: str = sys.executable):
+                 python: str = sys.executable,
+                 codec: str = "fp32", overlap: bool = False,
+                 overlap_blocks: int = 2):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         self.world = world
@@ -122,6 +124,12 @@ class ShardProcessSet:
         self.jit = jit
         self.spawn_timeout_s = spawn_timeout_s
         self.python = python
+        # Quantized-collective + overlap knobs, handed verbatim to
+        # every shard_worker (a ring must agree on its codec — the
+        # hello handshake refuses a mixed ring typed).
+        self.codec_name = str(codec or "fp32")
+        self.overlap = bool(overlap)
+        self.overlap_blocks = int(overlap_blocks)
         self.segments = segment_bounds(slots, world)
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
@@ -186,6 +194,11 @@ class ShardProcessSet:
                 cmd += ["--params-npz", self._params_path]
             if self.jit:
                 cmd.append("--jit")
+            if self.codec_name != "fp32":
+                cmd += ["--codec", self.codec_name]
+            if self.overlap:
+                cmd += ["--overlap", "--overlap-blocks",
+                        str(self.overlap_blocks)]
             procs.append(subprocess.Popen(
                 cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True))
@@ -197,6 +210,10 @@ class ShardProcessSet:
                         f"only {len(socks)}/{self.world} shards "
                         f"dialed in within {self.spawn_timeout_s}s")
                 c, _ = listener.accept()
+                # Control frames are a small header write + zero-copy
+                # payload parts: NODELAY so the parts never wait out a
+                # delayed-ACK exchange between the two sendalls.
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 msg, _ = recv_msg(c, timeout=self.spawn_timeout_s)
                 if msg.get("op") != "hello":
                     c.close()
@@ -277,7 +294,7 @@ class ShardProcessSet:
                 if updates else np.empty((0, self.d), np.float32))
         msg = {"op": "step", "step": step_no, "slots": idx,
                "want_state": bool(want_state)}
-        payload = rows.tobytes()
+        payload = rows  # buffer-protocol part: sent without a copy
         with self._life:
             with self._lock:
                 up = self._up

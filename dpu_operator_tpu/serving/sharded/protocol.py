@@ -29,10 +29,31 @@ class ProtocolError(RuntimeError):
     """Framing violation or peer gone mid-frame."""
 
 
-def send_msg(sock: socket.socket, obj: dict,
-             payload: bytes = b"") -> None:
+def send_msg(sock: socket.socket, obj: dict, *parts) -> None:
+    """One frame. ``parts`` are bytes-like payload pieces — bytes, a
+    memoryview, or any buffer-protocol object (a contiguous numpy
+    array passes as-is). Each part is written straight from its own
+    memory, never concatenated into a fresh buffer: the bulk payload
+    of a step/tokens frame must not pay a ``tobytes()`` copy in the
+    per-step hot loop (the GL011 contract). Callers that interleave
+    small frames on a long-lived control socket arm TCP_NODELAY at
+    connect so the header write and a small payload part never sit
+    out a Nagle/delayed-ACK exchange."""
+    views = []
+    total = 0
+    for p in parts:
+        v = p if isinstance(p, memoryview) else memoryview(p)
+        if v.nbytes == 0:
+            continue  # empty parts frame as zero bytes (cast chokes)
+        if v.format != "B":
+            v = v.cast("B")
+        views.append(v)
+        total += len(v)
     body = json.dumps(obj).encode()
-    sock.sendall(_HDR.pack(len(body), len(payload)) + body + payload)
+    sock.sendall(_HDR.pack(len(body), total) + body)
+    for v in views:
+        if len(v):
+            sock.sendall(v)
 
 
 def _recv_exact(sock: socket.socket, n: int,

@@ -82,6 +82,122 @@ class ShardSlice:
             x = np.asarray(ff(x, dense, s), np.float32)
         return x, np.argmax(x, axis=1).astype(np.int32)
 
+    def forward_overlapped(self, x: np.ndarray,
+                           reduce_submit: Callable,
+                           reduce_wait: Callable,
+                           blocks: int = 2,
+                           partial_fn: Optional[Callable] = None,
+                           finish_fn: Optional[Callable] = None,
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """The same step with the per-stage partial→allreduce→finish
+        sequence RESTRUCTURED so the collective overlaps compute: slots
+        split into ``blocks`` row blocks (legal because every piece of
+        the stage math is row-independent — each slot's matmuls and the
+        MoE residual touch only that slot's row), and the collective
+        seam split into ``reduce_submit(partial, stage, block) →
+        ticket`` / ``reduce_wait(ticket) → dense`` so a block's reduce
+        runs on the backend's collective plane while this thread
+        computes the NEXT block's partial — and, across the stage
+        boundary, stage k's still-in-flight reduces overlap stage k+1's
+        partials (the double-buffered schedule: at steady state one
+        block is always on the wire while another is on the ALU).
+
+        Every rank MUST issue submits in the identical (stage, block)
+        order — the loop below is deterministic, and backends key their
+        collective cells/allreduces on (stage, block), so the schedule
+        is the ordering contract.
+
+        Numerics contract: on the synthetic board (rank-ordered cell
+        sum) block-splitting changes nothing — per element the same
+        contributions add in the same order, so streams are
+        token-identical to the unoverlapped path. On the REAL ring
+        the block-wise allreduces re-segment the payload, so an
+        element's ring addition ORDER can differ from the whole-array
+        reduce — exact in real arithmetic, last-ulp fp deltas
+        possible, which argmax tolerates (the same caveat as
+        TpShardSlice's cross-rank sum): equivalence there is
+        token-level, not bit-level."""
+        pf = partial_fn if partial_fn is not None else self.partial
+        ff = finish_fn if finish_fn is not None else self.finish
+        x = np.array(x, np.float32)  # mutated per block below
+        bounds = [b for b in segment_bounds(x.shape[0],
+                                            max(1, blocks))
+                  if b[1] > b[0]]
+        pending: list = []  # (ticket, lo, hi) in (stage, block) order
+        for s in range(self.stages):
+            for bi, (lo, hi) in enumerate(bounds):
+                if s > 0:
+                    t, plo, phi = pending.pop(0)
+                    x[plo:phi] = np.asarray(
+                        ff(x[plo:phi], reduce_wait(t), s - 1),
+                        np.float32)
+                part = np.asarray(pf(x[lo:hi], s), np.float32)
+                pending.append((reduce_submit(part, s, bi), lo, hi))
+        for t, lo, hi in pending:
+            x[lo:hi] = np.asarray(
+                ff(x[lo:hi], reduce_wait(t), self.stages - 1),
+                np.float32)
+        return x, np.argmax(x, axis=1).astype(np.int32)
+
+
+def make_mesh_stage_fn(mesh, params: dict, axis: str = "tp",
+                       overlap: bool = True):
+    """The jax-shard form of the overlapped stage: when the
+    tensor-parallel slices live as shards ON A JAX MESH (one process,
+    the virtual-device or real-TPU case) the collective doesn't need a
+    reducer thread at all — ``collective_matmul.make_allgather_matmul``
+    DECOMPOSES the slot-gather into ring steps inside the w1 matmul,
+    so each block's transfer hides behind the previous block's dot
+    (pallas RDMA on real multi-chip meshes, XLA async collective-
+    permute elsewhere), and the w2 contraction closes with an explicit
+    psum. This is the same partial→reduce→finish sequence
+    ``forward_overlapped`` pipelines by hand for process shards,
+    expressed in the compiler's overlap vocabulary; ``overlap=False``
+    keeps the naive gather-then-matmul for A/B comparison.
+
+    Returns ``step(x[slots, d]) -> (x_next, tokens)``; slots must
+    divide the axis size (the shard_map even-shard contract).
+    Token-equivalent to ``TpShardSlice`` at any world (verified in
+    tests/test_sharded.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel._compat import shard_map
+    from ...parallel.collective_matmul import make_allgather_matmul
+
+    p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+    if p["router"].shape[2] != 1 or p["moe_w1"].shape[1] != 1:
+        raise ValueError(
+            "mesh-stage serving shards require E == 1 (tp shards the "
+            "dense contraction; experts replicate)")
+    S = p["w1"].shape[0]
+    n = mesh.shape[axis]
+    ag_mm = make_allgather_matmul(mesh, axis, overlap=overlap)
+    close = jax.jit(shard_map(
+        lambda h_loc, w2_loc: jax.lax.psum(
+            jnp.maximum(h_loc, 0.0) @ w2_loc, axis),
+        mesh=mesh, in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(None, None), check_vma=False))
+    finish = jax.jit(
+        lambda dense, m1, m2: (lambda y: y + jnp.maximum(
+            y @ m1, 0.0) @ m2)(jnp.tanh(dense)))
+
+    def step(x: np.ndarray):
+        x = np.ascontiguousarray(x, np.float32)
+        if x.shape[0] % n:
+            raise ValueError(
+                f"slots {x.shape[0]} must divide the {axis!r} axis "
+                f"size {n} (shard_map even-shard contract)")
+        for s in range(S):
+            h_col = ag_mm(x, p["w1"][s])          # gather ∥ matmul
+            dense = close(h_col, p["w2"][s])      # psum closes w2
+            x = finish(dense, p["moe_w1"][s, 0], p["moe_w2"][s, 0])
+        x = np.asarray(x, np.float32)
+        return x, np.argmax(x, axis=1).astype(np.int32)
+
+    return step
+
 
 class TpShardSlice(ShardSlice):
     """Rank r's Megatron slice of the stage-stacked train_step params

@@ -16,7 +16,17 @@ the scatter updates, computes its stage partials (jitted via jax when
 ``--jit`` and jax imports; numpy otherwise — same shard_math either
 way), allreduces each stage over the ring, and replies with its OWNED
 token segment plus compute/collective timings (the coordinator's
-skew/collective metrics).
+skew/collective metrics) as zero-copy buffer parts.
+
+ISSUE 9 knobs: ``--codec int8|bf16`` runs the ring collective
+quantized (every ring member must agree — the hello handshake
+refuses a mixed ring typed); ``--overlap`` restructures each stage
+through shard_math.forward_overlapped — block reduces run on a
+dedicated collective thread in (stage, block) order (identical on
+every rank, so the sequential ring allreduces pair up) while this
+thread computes the next block's partial, and the reported
+collective_s becomes the time compute actually BLOCKED (the
+non-hidden remainder).
 
 Protocol: prints exactly ONE JSON object on stdout at exit
 (fabric_worker.protocol_stdout guards the stream — all logging and
@@ -34,11 +44,35 @@ import time
 
 import numpy as np
 
-from ...parallel.fabric_collectives import RingTransport
+from ...parallel.fabric_collectives import RingError, RingTransport
 from ...parallel.fabric_worker import protocol_stdout
 from .protocol import ProtocolError, recv_msg, send_msg
 from .shard_math import (DoubleShardSlice, TpShardSlice,
                          segment_bounds)
+from .synthetic import GuardedReducer
+
+
+def _ring_reducer(ring) -> GuardedReducer:
+    """The worker's collective thread (overlap mode): block reduces
+    queue in (stage, block) order — identical on every rank, so the
+    sequential ring allreduces pair up — while the compute thread
+    runs the NEXT block's partial. One GuardedReducer (shared with
+    the synthetic shard plane: every failure lands in the owning
+    ticket) over a ring-allreduce fn with per-size scratch reuse; the
+    OUT buffer stays fresh each call — it escapes through the ticket
+    and the compute thread may not have consumed block b when block
+    b+1 reduces."""
+    scratch = {}
+
+    def reduce_fn(part):
+        if ring is None:
+            return part
+        s = scratch.get(part.size)
+        if s is None:
+            s = scratch[part.size] = np.empty(part.size, np.float32)
+        return ring.allreduce(part, scratch=s)
+
+    return GuardedReducer(reduce_fn, name="ring-reducer")
 
 
 def _load_slice(args):
@@ -111,6 +145,21 @@ def main(argv=None) -> int:
     ap.add_argument("--jit", action="store_true",
                     help="jit the local stage math via jax (numpy "
                          "fallback when jax is unavailable)")
+    ap.add_argument("--codec", choices=["fp32", "bf16", "int8"],
+                    default="fp32",
+                    help="wire codec for the ring collective "
+                         "(quantized collectives — every rank of a "
+                         "ring must agree; a mismatch fails typed at "
+                         "connect)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="overlap the stage-k collective with "
+                         "stage-k+1 compute: block reduces run on a "
+                         "dedicated collective thread while this "
+                         "thread computes the next block's partial "
+                         "(shard_math.forward_overlapped)")
+    ap.add_argument("--overlap-blocks", type=int, default=2,
+                    help="row blocks per stage in overlap mode (2 = "
+                         "double buffering)")
     ap.add_argument("--connect-timeout", type=float, default=30.0)
     ap.add_argument("--idle-timeout", type=float, default=300.0,
                     help="control-socket wait interval: idle is NOT "
@@ -138,13 +187,16 @@ def main(argv=None) -> int:
 
     peers = [p for p in args.peers.split(",") if p]
     ring = None
+    reducer = None
     csock = socket.socket()
     try:
         if args.world > 1:
             bind_port = int(peers[args.rank].rpartition(":")[2])
             ring = RingTransport(args.rank, args.world, args.bind_ip,
-                                 peers, port=bind_port)
-            trace(f"connecting ring ({args.world} ranks)")
+                                 peers, port=bind_port,
+                                 codec=args.codec)
+            trace(f"connecting ring ({args.world} ranks, "
+                  f"codec={args.codec})")
             ring.connect(timeout=args.connect_timeout)
         trace(f"dialing coordinator {args.coordinator}")
         chost, _, cport = args.coordinator.rpartition(":")
@@ -154,6 +206,10 @@ def main(argv=None) -> int:
         # keepalive armed, a coordinator host that vanished without a
         # FIN surfaces as an OSError instead of eternal silence.
         csock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        # The reply frame is a small header write followed by the
+        # zero-copy token/state parts: NODELAY so the parts never sit
+        # out a Nagle/delayed-ACK round trip between sendalls.
+        csock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_msg(csock, {"op": "hello", "rank": args.rank})
 
         x = np.zeros((args.slots, sl.d), np.float32)
@@ -170,6 +226,40 @@ def main(argv=None) -> int:
             return total
 
         reduce_fn.collective_s = 0.0
+
+        # Overlap mode: the collective rides its own thread; the
+        # per-step collective_s is the time the COMPUTE thread
+        # actually blocked waiting for a reduce — the non-hidden
+        # remainder, which is the number overlap exists to shrink.
+        coll_box = [0.0]
+        if args.overlap:
+            reducer = _ring_reducer(ring)
+
+            def reduce_submit(part, stage, block):
+                return reducer.submit(part)
+
+            def reduce_wait(tkt):
+                # No AGGREGATE ceiling: a chunked allreduce's total
+                # time is only bounded per socket op (io_timeout) and
+                # per chunk dependency (the 60 s event waits), so a
+                # fixed wall here could spuriously fail a healthy-but-
+                # slow ring the serialized path would have finished.
+                # The wait re-arms in slices; a genuine hang still
+                # surfaces in bounded time because every ring op is
+                # deadline-armed and the guarded reducer ALWAYS sets
+                # the event — the liveness check below covers only a
+                # dead reducer thread (can't set anything again).
+                t0 = time.monotonic()
+                while not tkt.event.wait(60.0):
+                    if not reducer.thread.is_alive():
+                        coll_box[0] += time.monotonic() - t0
+                        raise RingError(
+                            "ring reducer thread died with the "
+                            "reduce outstanding")
+                coll_box[0] += time.monotonic() - t0
+                if tkt.error is not None:
+                    raise tkt.error
+                return tkt.value
 
         while True:
             # Idle is not death: a drained serving replica submits
@@ -204,26 +294,39 @@ def main(argv=None) -> int:
                 len(idx), sl.d) if idx else None
             for j, i in enumerate(idx):
                 x[i] = rows[j]
-            reduce_fn.collective_s = 0.0
-            x, tokens = sl.forward(x, reduce_fn,
-                                   partial_fn=partial_fn,
-                                   finish_fn=finish_fn)
+            if args.overlap:
+                coll_box[0] = 0.0
+                x, tokens = sl.forward_overlapped(
+                    x, reduce_submit, reduce_wait,
+                    blocks=args.overlap_blocks,
+                    partial_fn=partial_fn, finish_fn=finish_fn)
+                coll = coll_box[0]
+            else:
+                reduce_fn.collective_s = 0.0
+                x, tokens = sl.forward(x, reduce_fn,
+                                       partial_fn=partial_fn,
+                                       finish_fn=finish_fn)
+                coll = reduce_fn.collective_s
             total = time.monotonic() - t0
-            coll = reduce_fn.collective_s
             reply = {"op": "tokens", "step": msg["step"],
                      "compute_s": round(max(0.0, total - coll), 6),
                      "collective_s": round(coll, 6)}
-            body = tokens[lo:hi].astype(np.int32).tobytes()
+            # Zero-copy reply: the token segment and the state ship as
+            # buffer-protocol parts straight out of their arrays — no
+            # tobytes() copies in the per-step loop (GL011).
+            parts = [np.ascontiguousarray(tokens[lo:hi], np.int32)]
             if msg.get("want_state") and args.rank == 0:
                 reply["state"] = True
-                body += np.ascontiguousarray(x, np.float32).tobytes()
-            send_msg(csock, reply, body)
+                parts.append(np.ascontiguousarray(x, np.float32))
+            send_msg(csock, reply, *parts)
             result["steps"] += 1
         result["ok"] = True
     except Exception as e:
         result["error"] = repr(e)[:300]
         trace(f"failed: {e!r}")
     finally:
+        if reducer is not None:
+            reducer.stop()
         if ring is not None:
             ring.close()
         csock.close()

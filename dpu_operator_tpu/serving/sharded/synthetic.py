@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ... import faults
+from ...parallel import quantize
 from .shard_math import (DoubleShardSlice, ShardSlice, TpShardSlice,
                          segment_bounds)
 
@@ -129,14 +130,24 @@ class _ReduceBoard:
     are keyed by (generation, step, stage) so stale deposits from an
     abandoned shard thread can never reach a restarted session."""
 
-    def __init__(self, world: int, cost_s: float, timeout_s: float):
+    def __init__(self, world: int, cost_s: float, timeout_s: float,
+                 codec=None):
         self.world = world
         self.cost_s = cost_s
         self.timeout_s = timeout_s
+        # Codec model: the transport's quantized allreduce quantizes
+        # each rank's CONTRIBUTION once and reduces decoded fp32 —
+        # the board mirrors that as a roundtrip on deposit, so token
+        # equivalence under int8/bf16 is testable without sockets and
+        # the rounding the serving plane sees is the codec's real one.
+        self.codec = codec
         self._lock = threading.Lock()
         self._ready = threading.Condition(self._lock)
         self._cells: Dict[tuple, dict] = {}
         self._poisoned: Dict[int, BaseException] = {}
+        # Per-thread wire busy-clock for the modelled cost: see
+        # _charge_wire.
+        self._wire_clock = threading.local()
 
     def poison(self, gen: int, exc: BaseException) -> None:
         """Fail every current and future wait of this generation —
@@ -153,13 +164,20 @@ class _ReduceBoard:
             self._ready.notify_all()
 
     def reduce(self, gen: int, step_no: int, stage: int, rank: int,
-               part: np.ndarray) -> np.ndarray:
+               part: np.ndarray, block: int = 0,
+               cost_frac: float = 1.0) -> np.ndarray:
         # The same fault site the REAL transport fires per chunk
         # (fabric_collectives sender loops): a chaos plan targeting
         # fabric.send breaks the synthetic collective identically, so
         # the collective failure domain is testable without sockets.
         faults.fire("fabric.send")
-        key = (gen, step_no, stage)
+        if self.codec is not None:
+            part = self.codec.roundtrip(np.asarray(part, np.float32))
+        # Cells key on the BLOCK too: the overlapped schedule runs one
+        # collective per (stage, block) and every rank issues them in
+        # the same order, so block-keyed cells are what keeps a rank's
+        # block-1 deposit from polluting a peer's block-0 reduce.
+        key = (gen, step_no, stage, block)
         deadline = time.monotonic() + self.timeout_s
         with self._lock:
             if gen in self._poisoned:
@@ -193,14 +211,84 @@ class _ReduceBoard:
                 # would strand slower ranks re-creating it half-full.
                 self._cells.pop(key, None)
         if self.cost_s:
-            time.sleep(self.cost_s)  # modelled wire time
+            self._charge_wire(self.cost_s * cost_frac)
         return total
+
+    def _charge_wire(self, cost: float) -> None:
+        """Modelled wire time as BUSY-TIME accounting, not independent
+        sleeps: each charge extends a per-thread deadline from the
+        previous charge's scheduled end (or now, after an idle gap)
+        and sleeps to it. Back-to-back block reduces therefore cost
+        their SUM plus one sleep quantum — with independent sleeps,
+        the ~0.5 ms kernel overshoot per sleep() multiplies by the
+        block count and the overlapped schedule would be billed fake
+        wire time the real transport never pays."""
+        clock = self._wire_clock
+        now = time.monotonic()
+        deadline = max(getattr(clock, "deadline", 0.0), now) + cost
+        clock.deadline = deadline
+        if deadline > now:
+            time.sleep(deadline - now)
+
+
+class ReduceTicket:
+    """One in-flight overlapped block reduce: the compute thread's
+    wait handle against its shard's reducer thread."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class GuardedReducer:
+    """The overlap schedule's collective thread, ONE copy for every
+    backend (the synthetic shard plane here, the real shard worker's
+    ring): a FIFO of (ticket, payload) drained by ``fn(payload)``,
+    with the _GuardedWorker discipline — every failure lands in the
+    owning ticket's ``error`` and the thread never dies silently;
+    ``stop()`` is the None sentinel; ``thread`` is exposed so a
+    waiter can bound on liveness (a dead reducer can never set
+    another event)."""
+
+    def __init__(self, fn, name: str = "reducer"):
+        self.fn = fn
+        self.q: _queue.Queue = _queue.Queue()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=name)
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            ticket, payload = item
+            try:
+                ticket.value = self.fn(payload)
+            except BaseException as e:
+                ticket.error = e
+            ticket.event.set()
+
+    def submit(self, payload) -> ReduceTicket:
+        ticket = ReduceTicket()
+        self.q.put((ticket, payload))
+        return ticket
+
+    def stop(self) -> None:
+        self.q.put(None)
 
 
 class _Shard:
     """One shard worker thread: FIFO over its own queue, guarded like
     _GuardedWorker — an exception lands in the step handle (and
-    poisons the board generation), never kills the thread."""
+    poisons the board generation), never kills the thread. In overlap
+    mode a SECOND thread per shard (the reducer) drains block reduces
+    off a FIFO so the compute thread's next-block partial runs while
+    the previous block sits at the board — the in-process model of
+    the shard worker's collective thread."""
 
     def __init__(self, owner: "SyntheticShardSet", rank: int,
                  gen: int):
@@ -210,10 +298,20 @@ class _Shard:
         self.slice: ShardSlice = owner._make_slice(rank)
         self.x = np.zeros((owner.slots, owner.d), np.float32)
         self.q: _queue.Queue = _queue.Queue()
+        self._reducer: Optional[GuardedReducer] = None
+        if owner.overlap:
+            self._reducer = GuardedReducer(
+                self._board_reduce, name=f"shard{rank}-red-g{gen}")
         self.thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"shard{rank}-g{gen}")
         self.thread.start()
+
+    def _board_reduce(self, payload):
+        step_no, stage, block, part, frac = payload
+        return self.owner.board.reduce(
+            self.gen, step_no, stage, self.rank, part,
+            block=block, cost_frac=frac)
 
     def _run(self) -> None:
         owner, rank = self.owner, self.rank
@@ -233,19 +331,24 @@ class _Shard:
                     faults.fire(f"{owner.fault_site}{rank}.step")
                 for i, row in handle._updates:  # type: ignore[attr-defined]
                     self.x[i] = row
-                if owner.step_time_s[rank]:
-                    time.sleep(owner.step_time_s[rank])
                 coll = [0.0]
+                if owner.overlap:
+                    self.x, tokens = self._step_overlapped(handle,
+                                                           coll)
+                else:
+                    if owner.step_time_s[rank]:
+                        time.sleep(owner.step_time_s[rank])
 
-                def reduce_fn(part, stage,
-                              _h=handle, _c=coll):
-                    t = time.monotonic()
-                    out = owner.board.reduce(self.gen, _h.step_no,
-                                             stage, rank, part)
-                    _c[0] += time.monotonic() - t
-                    return out
+                    def reduce_fn(part, stage,
+                                  _h=handle, _c=coll):
+                        t = time.monotonic()
+                        out = owner.board.reduce(self.gen, _h.step_no,
+                                                 stage, rank, part)
+                        _c[0] += time.monotonic() - t
+                        return out
 
-                self.x, tokens = self.slice.forward(self.x, reduce_fn)
+                    self.x, tokens = self.slice.forward(self.x,
+                                                        reduce_fn)
                 total = time.monotonic() - t0
                 handle.deliver(
                     rank, tokens[lo:hi],
@@ -269,8 +372,57 @@ class _Shard:
                 owner.board.poison(self.gen, typed)
                 handle.deliver_error(rank, typed)
 
+    def _step_overlapped(self, handle: "_StepHandle", coll):
+        """One step through forward_overlapped: block reduces queue to
+        the reducer thread (submit returns immediately), the modelled
+        compute cost rides INSIDE each block partial, and collective_s
+        counts only the time the compute thread actually BLOCKED in
+        wait — the non-hidden remainder, which is the number overlap
+        exists to shrink."""
+        owner, rank = self.owner, self.rank
+        n_blocks = max(1, min(owner.overlap_blocks, owner.slots))
+        stages = max(1, self.slice.stages)
+        per_partial = owner.step_time_s[rank] / (stages * n_blocks)
+        full = float(owner.slots * owner.d)
+        wait_ceiling = owner.board.timeout_s + 5.0
+
+        def submit(part, stage, block, _h=handle):
+            return self._reducer.submit(
+                (_h.step_no, stage, block, part,
+                 part.size / full if full else 1.0))
+
+        def wait(t, _c=coll):
+            t0 = time.monotonic()
+            if not t.event.wait(wait_ceiling):
+                raise ShardCollectiveStall(
+                    f"rank {rank}: overlapped reduce never settled "
+                    f"within {wait_ceiling}s", rank=rank)
+            _c[0] += time.monotonic() - t0
+            if t.error is not None:
+                raise t.error
+            return t.value
+
+        # Compute cost as busy-time accounting too (same reasoning as
+        # _charge_wire: per-block sleeps must cost their sum, not
+        # sum + a kernel overshoot per block).
+        comp_clock = [0.0]
+
+        def pf(xb, stage):
+            if per_partial:
+                now = time.monotonic()
+                deadline = max(comp_clock[0], now) + per_partial
+                comp_clock[0] = deadline
+                if deadline > now:
+                    time.sleep(deadline - now)
+            return self.slice.partial(xb, stage)
+
+        return self.slice.forward_overlapped(
+            self.x, submit, wait, blocks=n_blocks, partial_fn=pf)
+
     def stop(self) -> None:
         self.q.put(None)
+        if self._reducer is not None:
+            self._reducer.stop()
 
 
 def _per_rank(value: Union[float, Sequence[float]],
@@ -298,7 +450,9 @@ class SyntheticShardSet:
                  step_time_s: Union[float, Sequence[float]] = 0.0,
                  collective_time_s: float = 0.0,
                  collective_timeout_s: float = 5.0,
-                 fault_site: Optional[str] = None):
+                 fault_site: Optional[str] = None,
+                 overlap: bool = False, overlap_blocks: int = 2,
+                 codec: Optional[str] = None):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         self.world = world
@@ -310,9 +464,18 @@ class SyntheticShardSet:
         self.step_time_s = _per_rank(step_time_s, world)
         self.collective_time_s = collective_time_s
         self.fault_site = fault_site
+        # Overlap (ISSUE 9): forward_overlapped's double-buffered
+        # block schedule with a reducer thread per shard. Codec: the
+        # transport's quantized-collective rounding, modelled at the
+        # board (opt-in, exactly like the RingTransport knob).
+        self.overlap = bool(overlap)
+        self.overlap_blocks = max(1, int(overlap_blocks))
+        self.codec = quantize.get_codec(codec)
+        self.codec_name = self.codec.name if self.codec else "fp32"
         self.segments = segment_bounds(slots, world)
         self.board = _ReduceBoard(world, collective_time_s,
-                                  collective_timeout_s)
+                                  collective_timeout_s,
+                                  codec=self.codec)
         self._gen = 0
         self._lock = threading.Lock()
         self._shards: List[_Shard] = []

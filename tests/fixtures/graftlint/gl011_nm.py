@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl011_nm.py
+"""GL011 near-misses that must stay silent: the zero-copy send idiom
+(memoryview/ascontiguousarray parts), a one-shot tobytes OUTSIDE any
+loop (setup serialization), a loop that copies but never touches the
+wire (scheduler bookkeeping, not a transport path), and the .copy()
+METHOD (a deliberate defensive copy of a received buffer)."""
+import numpy as np
+
+
+def reply_loop_zero_copy(sock, states, send_msg):
+    for state in states:
+        part = np.ascontiguousarray(state, np.float32)  # view when
+        send_msg(sock, {"op": "tokens"}, part)          # contiguous
+
+
+def save_params_once(path, params):
+    blob = params.tobytes()                # one-shot, not a loop
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def snapshot_states(states, out):
+    for state in states:
+        out.append(np.copy(state))         # no transport in the loop
+
+
+def recv_loop_defensive_copy(sock, recv_msg, frames):
+    while True:
+        msg, payload = recv_msg(sock, timeout=5.0)
+        if msg is None:
+            return
+        arr = np.frombuffer(payload, np.float32)
+        frames.append(arr.copy())          # ownership, not send-path

@@ -1,0 +1,20 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl011_tp.py
+"""GL011 true positives: full array copies materialized inside
+transport hot loops — a per-iteration tobytes() on the send path (the
+shard worker's shipped shape) and an np.copy ahead of a recv decode.
+Every iteration pays a payload-sized allocation+copy on the wire
+path."""
+import numpy as np
+
+
+def reply_loop(sock, states, send_msg):
+    for state in states:
+        payload = state.tobytes()          # full copy per reply
+        send_msg(sock, {"op": "tokens"}, payload)
+
+
+def pump_chunks(sock, chunks, scratch):
+    while chunks:
+        arr = chunks.pop()
+        staged = np.copy(arr)              # full copy per chunk
+        sock.sendall(staged)

@@ -512,16 +512,28 @@ def test_chaos_matrix_local(mode, fault, local_executors, settle_counts):
 
 
 _SHARD_CASES = [
-    ("pipelined", "shard-step-raise"),
-    ("pipelined", "shard-step-hang"),
-    ("sync", "shard-step-raise"),
-    ("pipelined", "collective-send-raise"),
+    ("pipelined", "shard-step-raise", {}),
+    ("pipelined", "shard-step-hang", {}),
+    ("sync", "shard-step-raise", {}),
+    ("pipelined", "collective-send-raise", {}),
+    # ISSUE 9 acceptance: the matrix must hold UNCHANGED with the
+    # quantized collective + overlapped schedule enabled — the codec
+    # rounds deterministically (streams still compare byte-identical
+    # injected-vs-not) and a poisoned generation must fail the
+    # overlapped reducer threads exactly like the serialized path.
+    ("pipelined", "shard-step-raise",
+     {"codec": "int8", "overlap": True}),
+    ("pipelined", "collective-send-raise",
+     {"codec": "int8", "overlap": True}),
 ]
 
 
-@pytest.mark.parametrize("mode,fault", _SHARD_CASES,
-                         ids=[f"{m}-{f}" for m, f in _SHARD_CASES])
-def test_chaos_matrix_sharded(mode, fault, settle_counts, tmp_path):
+@pytest.mark.parametrize(
+    "mode,fault,shard_opts", _SHARD_CASES,
+    ids=[f"{m}-{f}" + ("-int8-overlap" if o else "")
+         for m, f, o in _SHARD_CASES])
+def test_chaos_matrix_sharded(mode, fault, shard_opts, settle_counts,
+                              tmp_path):
     """The new failure domain: ONE shard of a fabric-sharded replica
     killed or hung mid-decode (the `shard{r}.step` site inside the
     shard thread, or the reused `fabric.send` site inside the
@@ -546,7 +558,7 @@ def test_chaos_matrix_sharded(mode, fault, settle_counts, tmp_path):
         # first pop — the fault site would never even be called.
         shards = SyntheticShardSet(
             world=3, slots=2, d=8, seed=5, step_time_s=0.005,
-            fault_site="c0shard" if inject else None)
+            fault_site="c0shard" if inject else None, **shard_opts)
         ex0 = FabricExecutor(shards, mode=mode, step_timeout_s=5.0)
         ex1 = SyntheticExecutor(slots=2, d=8, seed=5,
                                 step_time_s=0.005,

@@ -6,6 +6,7 @@ thread per rank — no netns, no root: the transport is plain TCP, so
 everything but the veth underneath is the production code path."""
 
 import json
+import socket
 import subprocess
 import sys
 import threading
@@ -14,22 +15,27 @@ import numpy as np
 import pytest
 
 from dpu_operator_tpu.parallel.fabric_collectives import (
-    FabricConnectError, RingError, RingTransport, _segment_bounds,
-    bench_ring)
+    CodecMismatch, FabricConnectError, RingError, RingTransport,
+    _segment_bounds, bench_ring, quantized_error_bound)
 
 PORTS = iter(range(29500, 29900, 10))
 
 
-def _ring(world, fn, streams=1, chunk_bytes=64 << 10, timeout=20.0):
+def _ring(world, fn, streams=1, chunk_bytes=64 << 10, timeout=20.0,
+          codec=None, error_feedback=False):
     """Run fn(transport, rank) on every rank concurrently; returns the
-    per-rank results, re-raising the first rank failure."""
+    per-rank results, re-raising the first rank failure. ``codec`` may
+    be per-rank (a list) for the mismatch contract."""
     base = next(PORTS)
     peers = [f"127.0.0.1:{base + r}" for r in range(world)]
     results, errors = [None] * world, []
 
     def rank(r):
         t = RingTransport(r, world, "127.0.0.1", peers, streams=streams,
-                          chunk_bytes=chunk_bytes)
+                          chunk_bytes=chunk_bytes,
+                          codec=(codec[r] if isinstance(codec, list)
+                                 else codec),
+                          error_feedback=error_feedback)
         try:
             t.connect(timeout=timeout)
             results[r] = fn(t, r)
@@ -174,6 +180,149 @@ def test_dead_peer_typed_error_with_backoff_not_busy_spin():
     # The typed error still IS a RingError: the gloo-fallback callers
     # keep working unchanged.
     assert isinstance(e, RingError)
+
+
+# -- quantized collectives (ISSUE 9) ------------------------------------------
+
+
+@pytest.mark.parametrize("world,elems,codec", [
+    (2, 40000, "int8"),       # pair fast path, quarter wire bytes
+    (2, 40000, "bf16"),       # pair fast path, half wire bytes
+    (3, 40007, "int8"),       # general ring, ragged payload
+    (3, 2, "int8"),           # world > n_elems: zero-length segments
+    (2, (64 << 10) + 17, "int8"),  # odd count vs int8 wire chunking
+])
+def test_quantized_allreduce_within_bound_and_bit_identical(
+        world, elems, codec):
+    """The quantized ring reduces in fp32 after decode: the result
+    stays inside `quantized_error_bound` of the exact sum, and every
+    rank lands on BIT-IDENTICAL floats (the sharded-serving
+    replicated-state contract — the final segment encodes once and
+    every rank decodes the same wire bytes)."""
+    base = (np.arange(elems, dtype=np.float64) * 0.6180339887
+            % 2.0 - 1.0).astype(np.float32)
+
+    def fn(t, r):
+        return t.allreduce(base * (r + 1))
+
+    results = _ring(world, fn, codec=codec)
+    want = base * sum(range(1, world + 1))
+    bound = quantized_error_bound(world, float(world), codec)
+    for out in results:
+        assert float(np.max(np.abs(out - want))) <= bound
+    for out in results[1:]:
+        assert np.array_equal(results[0], out), \
+            "ranks diverged: replicated decode states would fork"
+
+
+def test_quantized_allreduce_input_untouched_and_error_feedback():
+    """The caller's array survives a quantized allreduce, and the
+    error-feedback knob keeps the repeated-payload mean error below
+    the plain codec's fixed rounding (the per-step serving shape)."""
+    def fn(t, r):
+        src = np.full(5000, 0.7003 * (r + 1), np.float32)
+        outs = [t.allreduce(src) for _ in range(16)]
+        assert np.all(src == np.float32(0.7003 * (r + 1))), \
+            "allreduce clobbered its input"
+        return float(np.mean([o[0] for o in outs]))
+
+    want = 0.7003 * 3
+    plain = _ring(2, fn, codec="int8")[0]
+    ef = _ring(2, fn, codec="int8", error_feedback=True)[0]
+    assert abs(ef - want) < abs(plain - want) or \
+        abs(ef - want) < 1e-4, (ef, plain)
+
+
+def test_mixed_codec_ring_fails_typed_at_connect():
+    """A ring whose members disagree on the wire codec must refuse at
+    the hello handshake with the typed CodecMismatch — decoding int8
+    payload bytes as fp32 is silent corruption, the one failure mode
+    worse than an outage."""
+    with pytest.raises(CodecMismatch):
+        _ring(2, lambda t, r: t.allreduce(np.ones(64, np.float32)),
+              codec=["int8", "fp32"])
+
+
+def test_bench_ring_quantized_reports_effective_gbps_and_error():
+    """bench_ring on a quantized transport: effective fp32-equivalent
+    Gb/s (same wire denominator as the raw ring — the numbers compare
+    1:1), measured max-abs error, and the documented bound it was
+    verified against."""
+    res = _ring(2, lambda t, r: bench_ring(t, 1 << 18, 2,
+                                           mode="allreduce"),
+                codec="int8")
+    for r in res:
+        assert r["ok"] and r["codec"] == "int8" and r["gbps"] > 0
+        assert 0.0 <= r["max_abs_err"] <= r["err_bound"]
+
+
+# -- close() hardening (ISSUE 9 satellite) ------------------------------------
+
+
+def test_close_after_half_connect_releases_listener_port():
+    """Regression: a transport whose dial SUCCEEDED but whose accept
+    never completed (the peer listens but never dials back) must fail
+    typed inside the deadline, release every socket — the listener
+    port is immediately rebindable, not squatted for the process
+    lifetime — and tolerate a second close()."""
+    base = next(PORTS)
+    my_port, peer_port = base, base + 1
+    peer = socket.socket()
+    peer.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    peer.bind(("127.0.0.1", peer_port))
+    peer.listen(2)  # accepts rank 0's dial, never dials back
+    t = RingTransport(0, 2, "127.0.0.1",
+                      [f"127.0.0.1:{my_port}",
+                       f"127.0.0.1:{peer_port}"])
+    try:
+        with pytest.raises(RingError, match="never dialled in"):
+            t.connect(timeout=1.0)
+        t.close()
+        t.close()  # idempotent: detach-then-close
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", my_port))  # leaked listener -> EADDRINUSE
+        finally:
+            s.close()
+    finally:
+        peer.close()
+
+
+def test_close_tracks_socket_that_died_mid_hello():
+    """The dial-side socket joins _send BEFORE the hello write: a peer
+    that accepts then drops mid-hello must not leak the dialled
+    socket through close()."""
+    base = next(PORTS)
+    my_port, peer_port = base, base + 1
+    accepted = []
+    peer = socket.socket()
+    peer.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    peer.bind(("127.0.0.1", peer_port))
+    peer.listen(2)
+
+    def accept_and_hold():
+        try:
+            c, _ = peer.accept()
+            accepted.append(c)
+        except OSError:
+            pass
+
+    th = threading.Thread(target=accept_and_hold, daemon=True)
+    th.start()
+    t = RingTransport(0, 2, "127.0.0.1",
+                      [f"127.0.0.1:{my_port}",
+                       f"127.0.0.1:{peer_port}"])
+    try:
+        with pytest.raises(RingError):
+            t.connect(timeout=1.0)
+        # The failed connect's own cleanup already ran: nothing left.
+        assert t._send == [] and t._recv == [] and t._listener is None
+    finally:
+        t.close()
+        peer.close()
+        for c in accepted:
+            c.close()
+        th.join(timeout=5)
 
 
 def test_cli_raw_mode_prints_json_result():

@@ -56,7 +56,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 10
+    assert len(set(ids)) == len(ids) == 11
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -74,6 +74,7 @@ _EXPECT = {
     "GL008": 2,  # bare replica-only logs in the request-scoped graph
     "GL009": 2,  # acquire and prefix-fork with no release, no lease
     "GL010": 2,  # loop recv and loop collect, no deadline anywhere
+    "GL011": 2,  # loop-send tobytes and loop-send np.copy
 }
 
 

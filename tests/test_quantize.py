@@ -1,0 +1,191 @@
+"""Wire codecs for the fabric collectives (parallel/quantize.py):
+round-trip error bounds (the documented contract), jittable
+encode/decode twins, the error-feedback residual, the self-describing
+frame headers, and the segment/chunk edges the quantized ring leans on
+(world > n_elems, zero-length segments, odd element counts vs int8
+chunking)."""
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.parallel.fabric_collectives import _segment_bounds
+from dpu_operator_tpu.parallel.quantize import (Bf16Codec, CodecError,
+                                                ErrorFeedback,
+                                                Int8Codec,
+                                                bf16_decode_xp,
+                                                bf16_encode_xp,
+                                                get_codec,
+                                                int8_decode_xp,
+                                                int8_encode_xp)
+
+
+# -- round-trip error bounds (the documented contract) ------------------------
+
+
+def test_int8_roundtrip_error_at_most_half_scale():
+    """Symmetric per-chunk int8: scale = max|x|/127, per-element
+    absolute error <= scale/2 — the bound BASELINE.md documents and
+    the bench verifies against the allreduce."""
+    rng = np.random.RandomState(0)
+    for n in (1, 7, 1000, 4097):
+        x = (rng.randn(n) * rng.uniform(0.01, 50)).astype(np.float32)
+        c = Int8Codec()
+        wire, scale = c.encode(x)
+        assert wire.dtype == np.int8 and wire.shape == (n,)
+        assert scale == pytest.approx(np.max(np.abs(x)) / 127.0)
+        back = c.decode(wire, n, scale)
+        assert np.max(np.abs(back - x)) <= scale / 2 + 1e-9
+
+
+def test_int8_all_zero_chunk_decodes_exact_zero():
+    c = Int8Codec()
+    wire, scale = c.encode(np.zeros(16, np.float32))
+    assert scale == 1.0  # not 0/0
+    assert np.all(c.decode(wire, 16, scale) == 0.0)
+
+
+def test_bf16_exact_range_roundtrips_bitwise():
+    """bf16 round-trips EXACTLY any value already representable in
+    its 7-bit mantissa: small integers, powers of two, and their sums
+    up to 256 — the exact-range half of the documented bound."""
+    vals = np.array([0.0, 1.0, -1.0, 2.0, 0.5, 0.25, 96.0, 255.0,
+                     -256.0, 1.5, -3.75], np.float32)
+    c = Bf16Codec()
+    wire, scale = c.encode(vals)
+    assert wire.dtype == np.uint16 and scale == 1.0
+    assert np.array_equal(c.decode(wire, vals.size, scale), vals)
+
+
+def test_bf16_general_relative_error_bound():
+    rng = np.random.RandomState(1)
+    x = (rng.randn(5000) * 100).astype(np.float32)
+    c = Bf16Codec()
+    wire, scale = c.encode(x)
+    back = c.decode(wire, x.size, scale)
+    rel = np.abs(back - x) / np.maximum(np.abs(x), 1e-30)
+    # Round-to-nearest on bf16's 7-bit mantissa: half an ulp = 2^-8.
+    assert np.max(rel) <= 2.0 ** -8 + 1e-7
+
+
+# -- jittable twins -----------------------------------------------------------
+
+
+def test_codec_twins_jit_under_jax_and_match_numpy():
+    """The encode/decode twins take the array module as ``xp`` and use
+    only traceable ufuncs — the SAME math must jit under jax and
+    produce the numpy results bit-for-bit (int8 codes and bf16 code
+    words are integer, so equality is exact)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    x = (rng.randn(257) * 3).astype(np.float32)
+
+    q_np, s_np = int8_encode_xp(x)
+    q_j, s_j = jax.jit(lambda a: int8_encode_xp(a, xp=jnp))(x)
+    assert np.array_equal(q_np, np.asarray(q_j))
+    assert float(s_np) == pytest.approx(float(s_j), rel=1e-6)
+    d_j = jax.jit(lambda q, s: int8_decode_xp(q, s, xp=jnp))(
+        np.asarray(q_j), np.float32(s_j))
+    assert np.allclose(int8_decode_xp(q_np, np.float32(s_np)),
+                       np.asarray(d_j), rtol=1e-6, atol=1e-7)
+
+    w_np = bf16_encode_xp(x)
+    w_j = jax.jit(lambda a: bf16_encode_xp(a, xp=jnp))(x)
+    assert np.array_equal(w_np, np.asarray(w_j))
+    b_j = jax.jit(lambda w: bf16_decode_xp(w, xp=jnp))(np.asarray(w_j))
+    assert np.array_equal(bf16_decode_xp(w_np), np.asarray(b_j))
+
+
+# -- error feedback -----------------------------------------------------------
+
+
+def test_error_feedback_residual_converges_repeated_payload():
+    """EF keeps what rounding dropped and feeds it to the next call:
+    for a REPEATED payload the running mean of decodes converges on
+    the true value, where the plain codec repeats the identical
+    rounding forever. The per-step serving collective is exactly this
+    shape (same buffer, every step)."""
+    c = Int8Codec()
+    ef = ErrorFeedback(c)
+    # A value deliberately between two int8 levels at this scale.
+    x = np.full(64, 0.7003, np.float32)
+    x[0] = 127.0 / 127.0  # pins scale = 1/127 ... max is 1.0
+    plain = c.roundtrip(x)[1]
+    plain_err = abs(plain - 0.7003)
+    decs = []
+    for _ in range(64):
+        wire, scale = ef.encode(x)
+        decs.append(float(c.decode(wire, x.size, scale)[1]))
+    ef_err = abs(np.mean(decs) - 0.7003)
+    assert ef_err < plain_err / 4, (ef_err, plain_err)
+    # And every individual decode stays within the one-shot bound of
+    # the FED value (residual <= scale/2 keeps it inside ~1.5 scale).
+    assert np.max(np.abs(np.asarray(decs) - 0.7003)) <= 1.5 * scale
+
+
+# -- framing + registry -------------------------------------------------------
+
+
+def test_frame_header_mismatch_is_typed():
+    i8, b16 = Int8Codec(), Bf16Codec()
+    hdr = i8.frame_header(0.5)
+    assert i8.parse_header(hdr) == pytest.approx(0.5)
+    with pytest.raises(CodecError, match="mismatch"):
+        b16.parse_header(hdr)
+
+
+def test_get_codec_registry():
+    assert get_codec(None) is None
+    assert get_codec("fp32") is None  # the identity: raw path intact
+    assert isinstance(get_codec("bf16"), Bf16Codec)
+    assert isinstance(get_codec("int8"), Int8Codec)
+    with pytest.raises(CodecError, match="unknown"):
+        get_codec("int4")  # typed, never a silent fp32 fallback
+
+
+def test_empty_chunk_encodes_and_decodes():
+    """Zero-length segments are legal (world > n_elems): the empty
+    chunk frames with scale 1.0 and no payload."""
+    for c in (Int8Codec(), Bf16Codec()):
+        wire, scale = c.encode(np.empty(0, np.float32))
+        assert c.decode(wire, 0, scale).size == 0
+
+
+# -- segment/chunk edges the quantized ring leans on --------------------------
+
+
+def test_segment_bounds_world_larger_than_elems():
+    """world > n_elems: the first n_elems ranks get one element each,
+    the rest get ZERO-LENGTH segments — still world entries, still an
+    exact cover (an empty-segment rank participates in every
+    collective with empty chunks)."""
+    bounds = _segment_bounds(3, 5)
+    assert bounds == [(0, 1), (1, 2), (2, 3), (3, 3), (3, 3)]
+    assert _segment_bounds(0, 4) == [(0, 0)] * 4
+
+
+def test_int8_chunking_covers_odd_element_counts():
+    """Odd element counts vs int8 chunking: wire-sized chunks (1 byte
+    per element) must tile a ragged segment exactly — encode/decode
+    per chunk and reassemble, no element dropped or double-counted."""
+    from dpu_operator_tpu.parallel.fabric_collectives import RingTransport
+
+    t = RingTransport(0, 3, "127.0.0.1", ["a", "b", "c"],
+                      chunk_bytes=64 << 10, codec="int8")
+    n = (64 << 10) * 2 + 17  # two full wire chunks + a ragged tail
+    covered = []
+    for lo, hi in t._codec_chunks((0, n)):
+        assert hi - lo <= 64 << 10
+        covered.append((lo, hi))
+    assert covered[0][0] == 0 and covered[-1][1] == n
+    for (a, b), (c_, d) in zip(covered, covered[1:]):
+        assert b == c_
+    # int8 chunks carry 4x the ELEMENTS of an fp32 chunk of the same
+    # wire size — the striping answer to quarter-size payloads.
+    t_fp = RingTransport(0, 3, "127.0.0.1", ["a", "b", "c"],
+                         chunk_bytes=64 << 10)
+    fp_chunk = max(1, t_fp.chunk_bytes // 4)
+    assert covered[0][1] == 4 * fp_chunk
+    t.close()
+    t_fp.close()

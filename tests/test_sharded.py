@@ -162,6 +162,33 @@ def test_protocol_recv_deadline_covers_whole_frame():
         b.close()
 
 
+def test_protocol_multipart_zero_copy_payload():
+    """send_msg takes buffer-protocol parts (ISSUE 9 zero-copy path):
+    numpy arrays and bytes interleave into ONE frame whose payload is
+    their concatenation on the receiving side — and an empty array
+    part frames as zero bytes instead of tripping memoryview.cast."""
+    import socket as _socket
+
+    from dpu_operator_tpu.serving.sharded.protocol import (recv_msg,
+                                                           send_msg)
+
+    a, b = _socket.socketpair()
+    try:
+        toks = np.arange(3, dtype=np.int32)
+        state = np.full((2, 2), 7.0, np.float32)
+        send_msg(a, {"op": "tokens", "step": 9}, toks,
+                 np.empty(0, np.float32), state)
+        msg, payload = recv_msg(b, timeout=5.0)
+        assert msg == {"op": "tokens", "step": 9}
+        assert payload == toks.tobytes() + state.tobytes()
+        send_msg(a, {"op": "ack"})  # no parts at all
+        msg2, payload2 = recv_msg(b, timeout=5.0)
+        assert msg2 == {"op": "ack"} and payload2 == b""
+    finally:
+        a.close()
+        b.close()
+
+
 # -- token-stream equivalence (the acceptance contract) -----------------------
 
 
@@ -344,9 +371,10 @@ def test_shard_step_error_lands_typed_in_collect():
 
 def test_shard_metrics_exposition():
     """serving_shard_collective_seconds (histogram) and
-    serving_shard_step_skew_seconds appear with the replica label,
-    and the skew series MOVES when one shard is slower than the
-    other (per-rank step_time_s)."""
+    serving_shard_step_skew_seconds appear with the {replica, codec}
+    labels (ISSUE 9: a quantized replica's latencies must never
+    aggregate with an fp32 one's), and the skew series MOVES when one
+    shard is slower than the other (per-rank step_time_s)."""
     reg = Registry()
     ex = FabricExecutor(
         SyntheticShardSet(world=2, slots=2, d=8,
@@ -362,13 +390,36 @@ def test_shard_metrics_exposition():
     text = reg.render()
     assert 'serving_shard_collective_seconds_bucket' in text
     assert 'replica="shardtest"' in text
+    assert 'codec="fp32"' in text
+    labels = {"replica": "shardtest", "codec": "fp32"}
     # The slow shard's 30 ms compute gap dominates the skew median.
-    skew = reg.quantile("serving_shard_step_skew_seconds", 0.5,
-                        {"replica": "shardtest"})
+    skew = reg.quantile("serving_shard_step_skew_seconds", 0.5, labels)
     assert skew is not None and skew >= 0.01, skew
-    coll = reg.quantile("serving_shard_collective_seconds", 0.5,
-                        {"replica": "shardtest"})
+    coll = reg.quantile("serving_shard_collective_seconds", 0.5, labels)
     assert coll is not None and coll >= 0.005, coll
+
+
+def test_shard_metrics_codec_label_tracks_transport():
+    """A quantized shard set stamps its codec on the shard series: the
+    int8 replica's observations land on codec="int8", never the fp32
+    series."""
+    reg = Registry()
+    ex = FabricExecutor(
+        SyntheticShardSet(world=2, slots=2, d=8, codec="int8",
+                          collective_time_s=0.002),
+        registry=reg, name="qshard")
+    try:
+        ex.reset()
+        for _ in range(2):
+            ex.collect(ex.submit([]))
+    finally:
+        ex.close()
+    assert 'codec="int8"' in reg.render()
+    coll = reg.quantile("serving_shard_collective_seconds", 0.5,
+                        {"replica": "qshard", "codec": "int8"})
+    assert coll is not None and coll >= 0.002, coll
+    assert reg.quantile("serving_shard_collective_seconds", 0.5,
+                        {"replica": "qshard", "codec": "fp32"}) is None
 
 
 def test_pool_publishes_sharded_replica_dimension():
@@ -504,6 +555,184 @@ def test_procset_ring_ports_are_distinct():
 
     ports = _distinct_ports(16)
     assert len(set(ports)) == 16
+
+
+# -- compute/communication overlap + quantized collectives (ISSUE 9) ----------
+
+
+@pytest.mark.parametrize("mode", ["sync", "pipelined"])
+def test_overlap_token_equivalence_synthetic_double(mode):
+    """forward_overlapped's double-buffered block schedule decodes the
+    SAME streams as the single-host SyntheticExecutor: row-splitting
+    never reorders a row's rank-ordered sum, so overlap is a latency
+    schedule, not a numerics change."""
+    streams = {}
+    for kind in ("local", "sharded"):
+        if kind == "local":
+            ex = SyntheticExecutor(slots=4, d=16, seed=3,
+                                   pipelined=(mode == "pipelined"))
+        else:
+            ex = FabricExecutor(
+                SyntheticShardSet(world=3, slots=4, d=16, seed=3,
+                                  overlap=True),
+                mode=mode)
+        reqs = _trace_reqs(10, 16, 5)
+        _drive(ex, reqs)
+        streams[kind] = [(r.error, list(r.tokens)) for r in reqs]
+    assert all(e is None for e, _ in streams["sharded"])
+    assert streams["local"] == streams["sharded"]
+
+
+def test_overlap_token_equivalence_vs_local_jitted_multistage():
+    """Overlap across the STAGE boundary (S > 1: stage k's in-flight
+    reduces overlap stage k+1's partials) still decodes byte-identical
+    streams to the jitted LocalExecutor on the same real params —
+    quantization OFF, so the acceptance byte-identity contract holds
+    with overlap enabled."""
+    model = dict(S=2, d=8, h=8, E=1)
+    params = _real_params(**model)
+    streams = {}
+    for kind in ("local", "sharded"):
+        if kind == "local":
+            ex = LocalExecutor(slots=4, mode="pipelined", seed=0,
+                               **model)
+        else:
+            ex = FabricExecutor(
+                SyntheticShardSet(world=2, slots=4, params=params,
+                                  overlap=True, overlap_blocks=2),
+                mode="pipelined")
+        reqs = _trace_reqs(8, model["d"], 5)
+        _drive(ex, reqs)
+        streams[kind] = [(r.error, list(r.tokens)) for r in reqs]
+    assert all(e is None for e, _ in streams["sharded"])
+    assert streams["local"] == streams["sharded"]
+
+
+def test_overlap_blocks_exceeding_slots_degrades_to_per_row():
+    """blocks > slots: empty row blocks drop out and the schedule
+    degrades to per-row pipelining — same tokens, no empty reduce."""
+    from dpu_operator_tpu.serving.sharded.shard_math import \
+        DoubleShardSlice
+
+    sl = DoubleShardSlice(8, seed=1, rank=0, world=1)
+    x = np.random.RandomState(2).randn(3, 8).astype(np.float32)
+    calls = []
+
+    def submit(part, stage, block):
+        calls.append((stage, block, part.shape[0]))
+        return part
+
+    x_ref, tok_ref = sl.forward(x.copy(), lambda p, s: p)
+    x_ov, tok_ov = sl.forward_overlapped(x.copy(), submit,
+                                         lambda t: t, blocks=8)
+    assert tok_ref.tolist() == tok_ov.tolist()
+    assert np.allclose(x_ref, x_ov)
+    assert [c[2] for c in calls] == [1, 1, 1]  # one row per block
+
+
+def test_quantized_sharded_streams_deterministic_and_isolated():
+    """int8-quantized sharded decode is DETERMINISTIC (two identical
+    runs produce identical streams — the codec rounds the same way
+    every time) while quantization stays opt-in: the fp32 set on the
+    same trace still matches the unsharded executor byte-for-byte
+    (proven by the equivalence tests above — never silently on)."""
+    def run():
+        ex = FabricExecutor(
+            SyntheticShardSet(world=3, slots=4, d=16, seed=3,
+                              codec="int8", overlap=True),
+            mode="pipelined")
+        reqs = _trace_reqs(8, 16, 5)
+        _drive(ex, reqs)
+        assert all(r.error is None for r in reqs)
+        return [list(r.tokens) for r in reqs]
+
+    assert run() == run()
+
+
+def test_overlap_lowers_blocked_collective_wait():
+    """The overlap contract at the executor seam: with compute to hide
+    behind (step cost ≈ collective cost), the overlapped schedule's
+    reported collective_s — the time the compute thread actually
+    BLOCKED — is measurably below the serialized schedule's, which
+    pays compute + full wire serially. Costs are chosen an order of
+    magnitude above scheduler noise."""
+    def median_coll(overlap):
+        ex = FabricExecutor(
+            SyntheticShardSet(world=2, slots=4, d=16, seed=7,
+                              step_time_s=0.04,
+                              collective_time_s=0.04,
+                              overlap=overlap))
+        try:
+            ex.reset()
+            samples = []
+            for _ in range(7):
+                h = ex.submit([])
+                out = ex.shards.collect(h, timeout=10.0)
+                samples.append(max(out.collective_s))
+            return sorted(samples)[len(samples) // 2]
+        finally:
+            ex.close()
+
+    off, on = median_coll(False), median_coll(True)
+    # Serialized: ~40 ms blocked at the board. Overlapped: each 20 ms
+    # block reduce hides behind the other block's 20 ms compute, so
+    # the blocked wait collapses toward the un-hideable tail (~20 ms
+    # ideal — the margin below leaves ~2x headroom for a busy box).
+    assert on < 0.85 * off, (on, off)
+
+
+def test_mesh_stage_fn_matches_slice_and_uses_collective_matmul():
+    """The jax-shard form of the overlapped stage: make_mesh_stage_fn
+    (collective_matmul.make_allgather_matmul inside the w1 matmul, a
+    psum closing w2) decodes the same tokens as TpShardSlice at
+    world=1 on the same stage-stacked params, overlap on and off."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dpu_operator_tpu.serving.sharded.shard_math import (
+        TpShardSlice, make_mesh_stage_fn)
+
+    model = dict(S=2, d=8, h=8, E=1)
+    params = _real_params(**model)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    ref = TpShardSlice(params, 0, 1)
+    x0 = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    for overlap in (True, False):
+        step = make_mesh_stage_fn(mesh, params, overlap=overlap)
+        x_ref, x_mesh = x0.copy(), x0.copy()
+        for _ in range(3):
+            x_ref, tok_ref = ref.forward(x_ref, lambda p, s: p)
+            x_mesh, tok_mesh = step(x_mesh)
+            assert tok_ref.tolist() == tok_mesh.tolist()
+            np.testing.assert_allclose(x_ref, x_mesh, rtol=1e-4,
+                                       atol=1e-5)
+    with pytest.raises(ValueError, match="divide"):
+        step(np.zeros((3, 8), np.float32))
+
+
+def test_procset_codec_and_overlap_over_real_workers():
+    """ShardProcessSet threads the codec/overlap knobs to real
+    shard_worker subprocesses: an int8+overlap set (numpy math — no
+    jax import cost in tier-1) serves steps, reports collective
+    timings, and tears down with a clean ledger."""
+    from dpu_operator_tpu.serving import ShardProcessSet
+
+    procs = ShardProcessSet(world=2, slots=4, d=8, jit=False,
+                            codec="int8", overlap=True,
+                            spawn_timeout_s=60.0)
+    assert procs.codec_name == "int8"
+    try:
+        procs.reset()
+        out = procs.collect(
+            procs.submit(1, [(0, np.ones(8, np.float32))]),
+            timeout=30.0)
+        assert out.tokens.shape == (4,)
+        out2 = procs.collect(procs.submit(2, [], want_state=True),
+                             timeout=30.0)
+        assert out2.state is not None and out2.state.shape == (4, 8)
+    finally:
+        procs.close()
+    assert procs.outstanding() == 0
 
 
 # -- the real multi-process rendezvous (multiworker/slow lane) ----------------
