@@ -1,12 +1,17 @@
 """graftlint CLI.
 
     python -m dpu_operator_tpu.analysis [paths...]
-        [--format text|json] [--baseline FILE | --no-baseline]
+        [--format text|json|sarif] [--rules GL004,GL013]
+        [--baseline FILE | --no-baseline] [--ratchet-report]
         [--list-rules]
 
 Exit codes: 0 clean (stale baseline entries are notes, not failures),
 1 findings, 2 usage/config error. The tier-1 gate and `make lint` both
-run exactly this entry point.
+run exactly this entry point. ``--format sarif`` emits SARIF 2.1.0 so
+CI can annotate PRs per finding; ``--rules`` restricts the run to a
+comma-separated rule-id list (one lane per rule class).
+``--ratchet-report`` appends the per-(rule, path) baseline-vs-current
+table that makes fix-then-delete progress visible.
 """
 
 from __future__ import annotations
@@ -20,6 +25,117 @@ from . import DEFAULT_BASELINE, run_analysis
 from .baseline import BaselineError
 from .rules import default_rules
 
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def _to_sarif(report, rules, elapsed: float) -> dict:
+    """Minimal SARIF 2.1.0: one run, one result per finding, rule
+    metadata from the registry. Paths stay repo-relative (the baseline
+    key), which is what CI annotation wants."""
+    rule_meta = [
+        {
+            "id": r.rule_id,
+            "shortDescription": {"text": r.title},
+            "help": {"text": r.hint},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(r.severity, "warning")},
+        }
+        for r in rules
+    ]
+    results = []
+    for f in report.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": (f.message if not f.func
+                                 else f"[{f.func}] {f.message}")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/static-analysis.md",
+                "rules": rule_meta,
+            }},
+            "results": results,
+            "properties": {
+                "checkedFiles": report.checked_files,
+                "suppressedBaseline": report.suppressed_baseline,
+                "elapsedS": round(elapsed, 3),
+            },
+        }],
+    }
+
+
+def _toml_block(entry: dict) -> str:
+    lines = ["    [[suppress]]",
+             f'    rule = "{entry["rule"]}"',
+             f'    path = "{entry["path"]}"',
+             f'    func = "{entry["func"]}"']
+    if entry.get("count", 1) != 1:
+        lines.append(f'    count = {entry["count"]}')
+    return "\n".join(lines)
+
+
+def _print_stale(stale: list, selected: set) -> None:
+    # Under --rules, entries for rules that DID NOT RUN always look
+    # unused — advising their deletion would have a per-rule CI lane
+    # telling developers to delete live suppressions.
+    stale = [s for s in stale if s["rule"] in selected]
+    for s in stale:
+        if s["used"] == 0:
+            print(f"note: stale baseline entry {s['rule']} {s['path']} "
+                  f"[{s['func']}] matched nothing — fixed? delete this "
+                  f"from baseline.toml:")
+            print(_toml_block(s))
+        else:
+            print(f"note: stale baseline entry {s['rule']} {s['path']} "
+                  f"[{s['func']}] (unused {s['unused']}) — lower its "
+                  f"count to {s['used']}")
+
+
+def _print_ratchet(report, selected: set) -> None:
+    """Per-(rule, path): how many findings the baseline tolerates vs
+    how many the tree currently produces (absorbed + still reported).
+    Shrinking `current` below `baselined` is ratchet progress; the
+    stale notes above say which TOML lines the progress retires.
+    Scoped to the rules that actually ran (--rules)."""
+    rows = {}
+    for e in report.baseline_usage:
+        if e["rule"] not in selected:
+            continue
+        row = rows.setdefault((e["rule"], e["path"]), [0, 0])
+        row[0] += e["count"]
+        row[1] += e["used"]
+    for f in report.findings:
+        row = rows.setdefault((f.rule, f.path), [0, 0])
+        row[1] += 1
+    if not rows:
+        print("ratchet: no baseline entries and no findings — "
+              "nothing grandfathered")
+        return
+    width = max(len(p) for _r, p in rows)
+    print(f"ratchet: {'rule':6s} {'path':{width}s} "
+          f"{'baselined':>9s} {'current':>7s}")
+    for (rule, path), (count, cur) in sorted(rows.items()):
+        marker = ""
+        if cur < count:
+            marker = "  <- shrink/delete entries (see notes)"
+        elif cur > count:
+            marker = "  <- OVER baseline (reported above)"
+        print(f"ratchet: {rule:6s} {path:{width}s} "
+              f"{count:9d} {cur:7d}{marker}")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -29,24 +145,44 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", default=["dpu_operator_tpu"],
                     help="files or directories to analyze "
                          "(default: dpu_operator_tpu)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--rules", default=None, metavar="GLxxx,GLyyy",
+                    help="run only these rule ids (comma-separated)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline.toml path (default: the checked-in "
                          "analysis/baseline.toml)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report grandfathered findings too")
+    ap.add_argument("--ratchet-report", action="store_true",
+                    help="append per-(rule,path) baseline-vs-current "
+                         "counts (text format only)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
+    registry = default_rules()
     if args.list_rules:
-        for rule in default_rules():
+        for rule in registry:
             print(f"{rule.rule_id}  {rule.severity:7s}  {rule.title}")
         return 0
+
+    rules = registry
+    if args.rules:
+        wanted = [r.strip().upper() for r in args.rules.split(",")
+                  if r.strip()]
+        known = {r.rule_id for r in registry}
+        bad = [w for w in wanted if w not in known]
+        if bad or not wanted:
+            print(f"graftlint: unknown rule id(s) {bad or args.rules!r}"
+                  f" (known: {', '.join(sorted(known))})",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in registry if r.rule_id in wanted]
 
     t0 = time.perf_counter()
     try:
         report = run_analysis(
-            args.paths,
+            args.paths, rules=rules,
             baseline=None if args.no_baseline else args.baseline)
     except BaselineError as e:
         print(f"graftlint: bad baseline: {e}", file=sys.stderr)
@@ -65,15 +201,15 @@ def main(argv=None) -> int:
         out = report.as_json()
         out["elapsed_s"] = round(elapsed, 3)
         print(json.dumps(out, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(_to_sarif(report, rules, elapsed), indent=2))
     else:
+        selected = {r.rule_id for r in rules}
         for f in report.findings:
             print(f.format())
-        for s in report.stale_baseline:
-            advice = ("fixed? delete it from baseline.toml"
-                      if s["used"] == 0
-                      else f"lower its count to {s['used']}")
-            print(f"note: stale baseline entry {s['rule']} {s['path']} "
-                  f"[{s['func']}] (unused {s['unused']}) — {advice}")
+        _print_stale(report.stale_baseline, selected)
+        if args.ratchet_report:
+            _print_ratchet(report, selected)
         print(f"graftlint: {len(report.findings)} finding(s), "
               f"{report.suppressed_baseline} baselined, "
               f"{report.checked_files} files in {elapsed:.2f}s")

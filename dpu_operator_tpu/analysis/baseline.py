@@ -133,6 +133,16 @@ class Baseline:
         gate red on the remaining findings. Informational only."""
         return [
             {"rule": e.rule, "path": e.path, "func": e.func,
-             "used": e.used, "unused": e.count - e.used}
+             "used": e.used, "unused": e.count - e.used,
+             "count": e.count}
             for e in self.entries if e.used < e.count
+        ]
+
+    def usage(self) -> List[dict]:
+        """Every entry with its absorbed-findings count — the ratchet
+        report's raw material (run after filter())."""
+        return [
+            {"rule": e.rule, "path": e.path, "func": e.func,
+             "count": e.count, "used": e.used, "reason": e.reason}
+            for e in self.entries
         ]

@@ -1,0 +1,32 @@
+"""graftlint concurrency analysis (GL012/GL013).
+
+Whole-program passes over the serving/obs/daemon planes, layered on
+analysis/core's per-module model:
+
+  * callgraph  — a project-wide function index + conservative call
+    resolution (self-calls through the class hierarchy, plain names
+    through module/import scope, duck-typed ``obj.m()`` by method name
+    when the name is specific enough) and reachability;
+  * threads    — thread-root discovery: every concurrent entry point
+    (``threading.Thread(target=...)``, ``_GuardedWorker``/
+    ``GuardedReducer`` bodies, timer callbacks, per-connection HTTP
+    handler methods, ``# graftlint: thread-root`` annotations) plus a
+    synthetic "main" root for the public control-plane surface, and
+    the per-function root attribution every rule keys on;
+  * locks      — the lock model: construction-typed lock attributes,
+    intraprocedural held-set tracking through ``with``/acquire/release,
+    and the interprocedural may-/must-hold fixpoints that give every
+    attribute access and call site its held-lock set;
+  * rules_conc — GL012 (inconsistent lock discipline over multi-root
+    attributes) and GL013 (lock-order inversion + blocking while
+    holding a cross-root lock — the GL004 set promoted to whole-held-
+    set awareness).
+
+The analysis is computed once per Project and memoized; the rules
+re-slice the shared result per module. docs/static-analysis.md has
+the thread-root model and both rule catalog entries.
+"""
+
+from .rules_conc import InconsistentLockDiscipline, LockOrderInversion
+
+__all__ = ["InconsistentLockDiscipline", "LockOrderInversion"]

@@ -1,0 +1,240 @@
+"""Project-wide call graph for the concurrency passes.
+
+The per-rule reachability helpers in analysis/rules.py are same-module
+by design (their rules police one file's hot loops). The lock rules
+cannot afford that: the PR 8 bug class IS a lock held in one module
+while a thread rooted in another module blocks on it. This module
+builds the cross-module function index and a deliberately conservative
+call resolution:
+
+  * ``self.m()`` / ``cls.m()`` — methods named ``m`` anywhere in the
+    enclosing class's hierarchy (ancestors and descendants), so a base
+    class template method reaches its subclass hooks (``_dispatch``)
+    and vice versa;
+  * ``Klass.m(self, ...)`` — the explicit-class form (the
+    ``Executor.submit(self, updates)`` lambda idiom);
+  * plain ``f()`` — enclosing-function locals first (nested defs),
+    then same-module functions, then project-wide plain functions
+    (the ``from .protocol import send_msg`` case);
+  * ``obj.m()`` — duck-typed: every scope method named ``m``, but ONLY
+    when at most ``MAX_DUCK_OWNERS`` distinct classes define one.
+    Seam names stay specific (``kv_attach``, ``get_many``, ``seize``,
+    the executor duck contract) while stdlib-shaped names (``close``,
+    ``items``, ``read``) resolve to nothing instead of to everything.
+
+Unresolved calls are opaque: they propagate no held locks and no
+may-block pedigree. That under-approximates reachability (documented
+in docs/static-analysis.md § thread-root model); the safe direction
+for a ratcheting gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Module
+
+#: Duck-typed obj.m() resolution cap: a method name defined by more
+#: distinct classes than this is treated as stdlib-shaped noise. The
+#: executor/shard duck contract (submit/collect/reset/step across the
+#: executor tree and both shard sets) sits just under it.
+MAX_DUCK_OWNERS = 10
+
+FnKey = Tuple[str, str]  # (module relpath, function qualname)
+
+
+class FnInfo:
+    __slots__ = ("module", "qual", "node", "cls", "key", "name")
+
+    def __init__(self, module: Module, qual: str, node: ast.AST):
+        self.module = module
+        self.qual = qual
+        self.node = node
+        self.cls = module.owner_class.get(qual, "")
+        self.key: FnKey = (module.relpath, qual)
+        self.name = qual.rsplit(".", 1)[-1]
+
+
+def walk_own(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function/statement subtree without descending into nested
+    function or class definitions (their code runs later, elsewhere).
+    Lambdas ARE descended: a lambda argument evaluated here still runs
+    on some thread, and the thread-root pass resolves which."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class CallGraph:
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+        self.fns: Dict[FnKey, FnInfo] = {}
+        self.by_module: Dict[str, List[FnInfo]] = {}
+        self._plain_by_name: Dict[str, List[FnKey]] = {}
+        self._methods_by_name: Dict[str, List[FnKey]] = {}
+        self._method_owner_count: Dict[str, Set[str]] = {}
+        # class name -> base names (merged across modules; name
+        # collisions union their bases — conservative).
+        self.bases: Dict[str, Set[str]] = {}
+        self._derived: Dict[str, Set[str]] = {}
+        for m in modules:
+            rows = self.by_module.setdefault(m.relpath, [])
+            for fn, qual in m.functions:
+                info = FnInfo(m, qual, fn)
+                self.fns[info.key] = info
+                rows.append(info)
+                if info.cls:
+                    self._methods_by_name.setdefault(
+                        info.name, []).append(info.key)
+                    self._method_owner_count.setdefault(
+                        info.name, set()).add(info.cls)
+                else:
+                    self._plain_by_name.setdefault(
+                        info.name, []).append(info.key)
+            for cls, bs in m.class_bases.items():
+                self.bases.setdefault(cls, set()).update(
+                    b for b in bs if b)
+        for cls, bs in self.bases.items():
+            for b in bs:
+                self._derived.setdefault(b, set()).add(cls)
+        self._hier_cache: Dict[str, Set[str]] = {}
+
+    # -- hierarchy -------------------------------------------------------------
+
+    def ancestors(self, cls: str) -> Set[str]:
+        out: Set[str] = set()
+        frontier = [cls]
+        while frontier:
+            c = frontier.pop()
+            for b in self.bases.get(c, ()):
+                if b not in out:
+                    out.add(b)
+                    frontier.append(b)
+        return out
+
+    def hierarchy(self, cls: str) -> Set[str]:
+        """cls + ancestors + descendants (the family a self-call can
+        land in)."""
+        got = self._hier_cache.get(cls)
+        if got is not None:
+            return got
+        fam = {cls} | self.ancestors(cls)
+        frontier = [cls]
+        while frontier:
+            c = frontier.pop()
+            for d in self._derived.get(c, ()):
+                if d not in fam:
+                    fam.add(d)
+                    frontier.append(d)
+        self._hier_cache[cls] = fam
+        return fam
+
+    def hierarchy_root(self, cls: str) -> str:
+        """Topmost in-scope ancestor — the canonical owner for
+        attribute identity (``self._resident`` written in Executor and
+        a subclass is ONE attribute)."""
+        cur, seen = cls, {cls}
+        while True:
+            ups = sorted(b for b in self.bases.get(cur, ())
+                         if b in self._class_names() and b not in seen)
+            if not ups:
+                return cur
+            cur = ups[0]
+            seen.add(cur)
+
+    def _class_names(self) -> Set[str]:
+        got = getattr(self, "_cls_names", None)
+        if got is None:
+            got = {i.cls for i in self.fns.values() if i.cls}
+            got |= set(self.bases)
+            self._cls_names = got
+        return got
+
+    # -- resolution ------------------------------------------------------------
+
+    def _family_methods(self, cls: str, name: str) -> List[FnKey]:
+        fam = self.hierarchy(cls)
+        return [k for k in self._methods_by_name.get(name, ())
+                if self.fns[k].cls in fam]
+
+    def resolve_ref(self, caller: FnInfo,
+                    expr: ast.AST) -> List[FnKey]:
+        """Resolve a callable REFERENCE (a thread target, a worker-
+        wrapper fn argument) to function keys."""
+        if isinstance(expr, ast.Name):
+            return self._resolve_plain(caller, expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and caller.cls:
+                    return self._family_methods(caller.cls, expr.attr)
+                if base.id in self._class_names():
+                    return [k for k in self._methods_by_name.get(
+                                expr.attr, ())
+                            if self.fns[k].cls in
+                            ({base.id} | self.ancestors(base.id))]
+            return self._resolve_duck(expr.attr)
+        return []
+
+    def resolve_call(self, caller: FnInfo,
+                     call: ast.Call) -> List[FnKey]:
+        return self.resolve_ref(caller, call.func)
+
+    def resolve_call_strict(self, caller: FnInfo,
+                            call: ast.Call) -> List[FnKey]:
+        """Like resolve_call but duck-typed ``obj.m()`` only resolves
+        when the method name has at most 2 owning classes. Held-lock
+        and may-block propagation use THESE edges: a 10-owner duck
+        name (``submit``, ``close``) is fine for root reachability but
+        would smear one class's held locks over every duck sibling."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and not (
+                isinstance(f.value, ast.Name)
+                and (f.value.id in ("self", "cls")
+                     or f.value.id in self._class_names())):
+            owners = self._method_owner_count.get(f.attr, ())
+            if len(owners) > 2:
+                return []
+        return self.resolve_ref(caller, f)
+
+    def _resolve_plain(self, caller: FnInfo, name: str) -> List[FnKey]:
+        # Nested defs of the caller (and its enclosing chain) win.
+        prefix_chain = caller.qual.split(".")
+        for depth in range(len(prefix_chain), 0, -1):
+            prefix = ".".join(prefix_chain[:depth]) + "."
+            local = [i.key for i in self.by_module.get(
+                        caller.module.relpath, ())
+                     if i.name == name and i.qual.startswith(prefix)]
+            if local:
+                return local
+        same_mod = [i.key for i in self.by_module.get(
+                        caller.module.relpath, ())
+                    if i.name == name and "." not in i.qual]
+        if same_mod:
+            return same_mod
+        return list(self._plain_by_name.get(name, ()))
+
+    def _resolve_duck(self, name: str) -> List[FnKey]:
+        owners = self._method_owner_count.get(name, ())
+        if not owners or len(owners) > MAX_DUCK_OWNERS:
+            return []
+        return list(self._methods_by_name.get(name, ()))
+
+    # -- reachability ----------------------------------------------------------
+
+    def reachable(self, roots: Iterable[FnKey],
+                  edges: Dict[FnKey, Set[FnKey]]) -> Set[FnKey]:
+        seen: Set[FnKey] = set()
+        frontier = [k for k in roots if k in self.fns]
+        while frontier:
+            k = frontier.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            frontier.extend(edges.get(k, ()))
+        return seen

@@ -1,0 +1,631 @@
+"""The lock model: who holds what, where, on which thread.
+
+Lock identity is (owning class, attribute): ``self._lock`` in
+ShardProcessSet and ``self._lock`` in AdmissionQueue are different
+locks; ``self._slock`` used in a subclass method canonicalizes to the
+base class that constructs it. Locks are discovered by CONSTRUCTION
+(``self.X = threading.Lock()/RLock()/Condition(...)``, dataclass
+``field(default_factory=threading.Lock)``) — not by name, which is how
+GL004 missed ``_life`` for three PRs — with the GL004 name hints kept
+only as a fallback for attributes assigned out of sight.
+
+Held sets are tracked intraprocedurally through ``with self.X:``
+blocks and stmt-level ``.acquire()``/``.release()`` pairs, then two
+interprocedural fixpoints extend them through the call graph:
+
+  * ``entry_must[f]`` — locks held on EVERY resolved path into f
+    (intersection over call sites). GL012 uses must-hold: an access is
+    "under the lock" only when no caller reaches it bare.
+  * ``entry_may[f]`` — locks held on SOME path (union). GL013 uses
+    may-hold: a lock possibly held across a blocking call or a nested
+    acquisition is already worth flagging.
+
+A third fixpoint marks MAY-BLOCK functions: syntactically blocking
+calls (the GL004 set, construction-aware: socket send/recv/accept,
+``subprocess``/``Popen``, queue ``get``, bare ``join``/``wait``,
+``sleep``) seed it; callers inherit it through resolved edges. A call
+carrying a timeout-ish keyword is BOUNDED and neither seeds nor
+propagates — a deadline-armed ``recv_msg(s, timeout=...)`` is the
+fixed PR 8 shape, not the bug.
+
+Everything here runs on AST only; no imports of analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, FnInfo, FnKey
+
+LockId = Tuple[str, str]  # (owner class, attr name)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+#: Constructions whose attributes are synchronization/thread-safe
+#: machinery, exempt from GL012 (their thread-safety is the point).
+_SAFE_TYPE_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore", "Event", "Queue", "SimpleQueue",
+                    "LifoQueue", "PriorityQueue", "local", "Barrier"}
+_DEQUE_CTORS = {"deque"}
+_LOCK_NAME_HINTS = ("lock", "mutex", "_mu")
+
+#: Single-bytecode (GIL-atomic) container mutations: the audited-atomic
+#: allowlist of GL012. ``deque.append`` is the documented poster child
+#: (obs/trace.py's per-thread span buffers and decision log).
+ATOMIC_METHODS = {"append", "appendleft", "popleft", "pop", "add",
+                  "discard", "clear", "update", "setdefault", "put",
+                  "put_nowait", "get", "get_nowait", "set",
+                  "task_done", "remove"}
+
+_TIMEOUT_KWARGS = {"timeout", "deadline", "timeout_s", "io_timeout"}
+_SOCK_HINTS = ("sock", "conn", "sk", "listener", "peer")
+_QUEUE_HINTS = ("queue", "_q", "work", "jobs")
+_THREAD_HINTS = ("thread", "thr", "worker", "proc")
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output",
+                   "Popen", "getoutput", "getstatusoutput"}
+#: Blocking no matter the receiver: these names don't exist off sockets
+#: / process handles.
+_UNAMBIGUOUS_BLOCK = {"sendall", "recv_into", "recvfrom", "accept",
+                      "select", "serve_forever"}
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """X for ``self.X`` / ``cls.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+class AccessEvent:
+    __slots__ = ("fn", "attr", "kind", "node", "held")
+
+    def __init__(self, fn: FnKey, attr: LockId, kind: str,
+                 node: ast.AST, held: FrozenSet[LockId]):
+        self.fn = fn
+        self.attr = attr
+        self.kind = kind  # assign | aug | subscript | mutate | atomic
+        self.node = node
+        self.held = held  # intra-held at the site
+
+
+class AcquireEvent:
+    __slots__ = ("fn", "lock", "node", "held_before")
+
+    def __init__(self, fn: FnKey, lock: LockId, node: ast.AST,
+                 held_before: FrozenSet[LockId]):
+        self.fn = fn
+        self.lock = lock
+        self.node = node
+        self.held_before = held_before
+
+
+class CallEvent:
+    __slots__ = ("fn", "callees", "strict_callees", "node", "held",
+                 "bounded", "syn_block", "cond_release")
+
+    def __init__(self, fn: FnKey, callees: List[FnKey],
+                 strict_callees: List[FnKey], node: ast.Call,
+                 held: FrozenSet[LockId], bounded: bool,
+                 syn_block: Optional[str],
+                 cond_release: Optional[LockId]):
+        self.fn = fn
+        self.callees = callees              # reachability edges
+        self.strict_callees = strict_callees  # held/may-block edges
+        self.node = node
+        self.held = held
+        self.bounded = bounded
+        self.syn_block = syn_block  # why this call blocks, or None
+        self.cond_release = cond_release
+
+
+class FnSummary:
+    __slots__ = ("accesses", "acquires", "calls")
+
+    def __init__(self):
+        self.accesses: List[AccessEvent] = []
+        self.acquires: List[AcquireEvent] = []
+        self.calls: List[CallEvent] = []
+
+
+class LockModel:
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        # Per-class attribute facts, keyed by DECLARING class name.
+        self.lock_attrs: Dict[str, Set[str]] = {}
+        self.cond_wraps: Dict[Tuple[str, str], str] = {}
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        self._class_names = {i.cls for i in graph.fns.values() if i.cls}
+        self._discover_attr_facts()
+        self._owner_cache: Dict[Tuple[str, str, bool],
+                                Optional[str]] = {}
+        self.summaries: Dict[FnKey, FnSummary] = {}
+        for info in graph.fns.values():
+            self.summaries[info.key] = self._summarize(info)
+        self.edges: Dict[FnKey, Set[FnKey]] = {}
+        for key, summ in self.summaries.items():
+            outs = self.edges.setdefault(key, set())
+            for ev in summ.calls:
+                outs.update(ev.callees)
+        self.entry_may: Dict[FnKey, FrozenSet[LockId]] = {}
+        self.entry_must: Dict[FnKey, FrozenSet[LockId]] = {}
+        # Functions ENTERED bare by a thread root: even when every
+        # resolved call site holds a lock, the root path doesn't —
+        # their must-hold entry set is pinned empty once the root
+        # model exists (pin_entries).
+        self._pinned: FrozenSet[FnKey] = frozenset()
+        self._fix_entry_sets()
+        self.may_block: Dict[FnKey, str] = {}
+        self._fix_may_block()
+
+    def pin_entries(self, keys) -> None:
+        """Pin thread-root entry functions to an empty must-hold set
+        and re-run the fixpoint: a function that is both a Thread
+        target and called from under a lock is NOT must-locked — the
+        root enters it bare, which is exactly the racing path GL012
+        exists to see."""
+        self._pinned = frozenset(keys)
+        self._fix_entry_sets()
+
+    # -- attribute/lock discovery ---------------------------------------------
+
+    def effective_class(self, info: FnInfo) -> str:
+        """The class whose ``self`` a function's body sees: its own for
+        methods, the enclosing method's class for defs nested inside
+        one (the closure-over-self idiom: ReplicaPool.quiesce.idle)."""
+        if info.cls:
+            return info.cls
+        for part in info.qual.split("."):
+            if part in self._class_names:
+                return part
+        return ""
+
+    def _discover_attr_facts(self) -> None:
+        for m in self.graph.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                cls = node.name
+                # Dataclass-style annotated fields in the class body.
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        self._classify_field(cls, stmt)
+            for fn, qual in m.functions:
+                cls = m.owner_class.get(qual, "")
+                if not cls:
+                    continue
+                for stmt in ast.walk(fn):
+                    if isinstance(stmt, ast.Assign) and \
+                            len(stmt.targets) == 1:
+                        attr = _self_attr(stmt.targets[0])
+                        if attr is not None and \
+                                isinstance(stmt.value, ast.Call):
+                            self._classify_ctor(cls, attr, stmt.value)
+
+    def _classify_field(self, cls: str, stmt: ast.AnnAssign) -> None:
+        attr = stmt.target.id
+        ann = ast.unparse(stmt.annotation)
+        value = stmt.value
+        factory = None
+        if isinstance(value, ast.Call) and \
+                _terminal(value.func) == "field":
+            for kw in value.keywords:
+                if kw.arg == "default_factory":
+                    factory = _terminal(kw.value)
+        tname = factory or ann.rsplit(".", 1)[-1]
+        if tname in _LOCK_CTORS:
+            self.lock_attrs.setdefault(cls, set()).add(attr)
+        if tname in _SAFE_TYPE_CTORS or tname in _DEQUE_CTORS:
+            self.attr_types.setdefault((cls, attr), tname)
+
+    def _classify_ctor(self, cls: str, attr: str,
+                       call: ast.Call) -> None:
+        tname = _terminal(call.func)
+        if tname in _LOCK_CTORS:
+            self.lock_attrs.setdefault(cls, set()).add(attr)
+            if tname == "Condition" and call.args:
+                under = _self_attr(call.args[0])
+                if under:
+                    self.cond_wraps[(cls, attr)] = under
+        if tname in _SAFE_TYPE_CTORS or tname in _DEQUE_CTORS:
+            self.attr_types.setdefault((cls, attr), tname)
+
+    def lock_owner(self, cls: str, attr: str,
+                   hint_ok: bool = False) -> Optional[str]:
+        """The class that declares ``attr`` as a lock, searched up the
+        hierarchy from ``cls``. The GL004 name-hint fallback applies
+        ONLY where the attribute is being USED like a lock (``with
+        self.X`` / ``.acquire()`` — hint_ok=True): `blocked_since`
+        contains "lock" as a substring and must stay a data attribute
+        everywhere else."""
+        key = (cls, attr, hint_ok)
+        if key in self._owner_cache:
+            return self._owner_cache[key]
+        owner: Optional[str] = None
+        family = [cls] + sorted(self.graph.ancestors(cls))
+        declaring = [c for c in family
+                     if attr in self.lock_attrs.get(c, ())]
+        if declaring:
+            # Topmost declaring ancestor wins (base-constructed locks
+            # used from subclasses are one lock).
+            order = {c: i for i, c in enumerate(
+                [cls] + self._mro_ish(cls))}
+            owner = max(declaring, key=lambda c: order.get(c, 0))
+        elif hint_ok and any(h in attr.lower()
+                             for h in _LOCK_NAME_HINTS):
+            owner = self.graph.hierarchy_root(cls)
+        self._owner_cache[key] = owner
+        return owner
+
+    def _mro_ish(self, cls: str) -> List[str]:
+        out: List[str] = []
+        frontier = [cls]
+        while frontier:
+            c = frontier.pop(0)
+            for b in sorted(self.graph.bases.get(c, ())):
+                if b not in out:
+                    out.append(b)
+                    frontier.append(b)
+        return out
+
+    def attr_type(self, cls: str, attr: str) -> Optional[str]:
+        for c in [cls] + self._mro_ish(cls):
+            t = self.attr_types.get((c, attr))
+            if t is not None:
+                return t
+        return None
+
+    def cond_underlying(self, cls: str, attr: str) -> Optional[LockId]:
+        for c in [cls] + self._mro_ish(cls):
+            under = self.cond_wraps.get((c, attr))
+            if under is not None:
+                owner = self.lock_owner(c, under)
+                return (owner or c, under)
+        return None
+
+    def canonical_attr(self, cls: str, attr: str) -> LockId:
+        return (self.graph.hierarchy_root(cls), attr)
+
+    # -- per-function summaries -----------------------------------------------
+
+    def _summarize(self, info: FnInfo) -> FnSummary:
+        summ = FnSummary()
+        cls = self.effective_class(info)
+        body = getattr(info.node, "body", [])
+        self._walk_body(info, cls, body, frozenset(), summ)
+        return summ
+
+    def _lock_of_expr(self, cls: str,
+                      expr: ast.AST) -> Optional[LockId]:
+        attr = _self_attr(expr)
+        if attr is None or not cls:
+            return None
+        under = self.cond_underlying(cls, attr)
+        if under is not None:
+            return under
+        owner = self.lock_owner(cls, attr, hint_ok=True)
+        if owner is not None:
+            return (owner, attr)
+        return None
+
+    def _walk_body(self, info: FnInfo, cls: str,
+                   body: Sequence[ast.stmt],
+                   held: FrozenSet[LockId], summ: FnSummary) -> None:
+        manual: List[LockId] = []
+        for stmt in body:
+            cur = held | frozenset(manual)
+            lockop = self._stmt_lock_op(cls, stmt)
+            if lockop is not None:
+                op, lock = lockop
+                if op == "acquire":
+                    summ.acquires.append(
+                        AcquireEvent(info.key, lock, stmt, cur))
+                    if lock not in cur:
+                        manual.append(lock)
+                elif op == "release" and lock in manual:
+                    manual.remove(lock)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set()
+                for item in stmt.items:
+                    self._collect(info, cls, item.context_expr, cur,
+                                  summ)
+                    lock = self._lock_of_expr(cls, item.context_expr)
+                    if lock is not None:
+                        summ.acquires.append(AcquireEvent(
+                            info.key, lock, item.context_expr,
+                            cur | frozenset(inner)))
+                        inner.add(lock)
+                self._walk_body(info, cls, stmt.body,
+                                cur | frozenset(inner), summ)
+            elif isinstance(stmt, (ast.If,)):
+                self._collect(info, cls, stmt.test, cur, summ)
+                self._walk_body(info, cls, stmt.body, cur, summ)
+                self._walk_body(info, cls, stmt.orelse, cur, summ)
+            elif isinstance(stmt, (ast.While,)):
+                self._collect(info, cls, stmt.test, cur, summ)
+                self._walk_body(info, cls, stmt.body, cur, summ)
+                self._walk_body(info, cls, stmt.orelse, cur, summ)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._collect(info, cls, stmt.iter, cur, summ)
+                self._classify_store(info, cls, stmt.target, cur, summ)
+                self._walk_body(info, cls, stmt.body, cur, summ)
+                self._walk_body(info, cls, stmt.orelse, cur, summ)
+            elif isinstance(stmt, ast.Try):
+                self._walk_body(info, cls, stmt.body, cur, summ)
+                for h in stmt.handlers:
+                    self._walk_body(info, cls, h.body, cur, summ)
+                self._walk_body(info, cls, stmt.orelse, cur, summ)
+                self._walk_body(info, cls, stmt.finalbody, cur, summ)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # summarized separately; runs elsewhere
+            else:
+                self._collect_stmt(info, cls, stmt, cur, summ)
+
+    def _stmt_lock_op(self, cls: str, stmt: ast.stmt
+                      ) -> Optional[Tuple[str, LockId]]:
+        """Recognize stmt-level ``self.X.acquire(...)`` (bare or
+        ``got = ...``) and ``self.X.release()``."""
+        expr = None
+        if isinstance(stmt, ast.Expr):
+            expr = stmt.value
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            expr = stmt.value
+        if not (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in ("acquire", "release")):
+            return None
+        lock = self._lock_of_expr(cls, expr.func.value)
+        if lock is None:
+            return None
+        return (expr.func.attr, lock)
+
+    # -- expression-level collection ------------------------------------------
+
+    def _collect_stmt(self, info: FnInfo, cls: str, stmt: ast.stmt,
+                      held: FrozenSet[LockId],
+                      summ: FnSummary) -> None:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._classify_store(info, cls, t, held, summ)
+            self._collect(info, cls, stmt.value, held, summ)
+        elif isinstance(stmt, ast.AnnAssign):
+            self._classify_store(info, cls, stmt.target, held, summ)
+            if stmt.value is not None:
+                self._collect(info, cls, stmt.value, held, summ)
+        elif isinstance(stmt, ast.AugAssign):
+            t = stmt.target
+            attr = _self_attr(t)
+            if attr is not None:
+                self._access(info, cls, attr, "aug", t, held, summ)
+            elif isinstance(t, ast.Subscript):
+                base_attr = _self_attr(t.value)
+                if base_attr is not None:
+                    self._access(info, cls, base_attr, "subscript", t,
+                                 held, summ)
+                self._collect(info, cls, t.slice, held, summ)
+            self._collect(info, cls, stmt.value, held, summ)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    self._access(info, cls, attr, "mutate", t, held,
+                                 summ)
+                elif isinstance(t, ast.Subscript):
+                    base_attr = _self_attr(t.value)
+                    if base_attr is not None:
+                        self._access(info, cls, base_attr, "subscript",
+                                     t, held, summ)
+        else:
+            self._collect(info, cls, stmt, held, summ)
+
+    def _classify_store(self, info: FnInfo, cls: str, target: ast.AST,
+                        held: FrozenSet[LockId],
+                        summ: FnSummary) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._classify_store(info, cls, e, held, summ)
+            return
+        if isinstance(target, ast.Starred):
+            self._classify_store(info, cls, target.value, held, summ)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            self._access(info, cls, attr, "assign", target, held, summ)
+            return
+        if isinstance(target, ast.Subscript):
+            base_attr = _self_attr(target.value)
+            if base_attr is not None:
+                self._access(info, cls, base_attr, "subscript", target,
+                             held, summ)
+            self._collect(info, cls, target.slice, held, summ)
+
+    def _access(self, info: FnInfo, cls: str, attr: str, kind: str,
+                node: ast.AST, held: FrozenSet[LockId],
+                summ: FnSummary) -> None:
+        if not cls:
+            return
+        atype = self.attr_type(cls, attr)
+        if atype in _SAFE_TYPE_CTORS:
+            return  # locks/events/queues guard themselves
+        if self.lock_owner(cls, attr) is not None:
+            return
+        summ.accesses.append(AccessEvent(
+            info.key, self.canonical_attr(cls, attr), kind, node,
+            held))
+
+    def _collect(self, info: FnInfo, cls: str, root: ast.AST,
+                 held: FrozenSet[LockId], summ: FnSummary) -> None:
+        """Collect accesses + calls in an expression subtree, skipping
+        deferred bodies (nested defs, lambdas, comprehensions run now —
+        comprehensions kept, lambdas skipped)."""
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                self._call_event(info, cls, n, held, summ)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _call_event(self, info: FnInfo, cls: str, call: ast.Call,
+                    held: FrozenSet[LockId],
+                    summ: FnSummary) -> None:
+        f = call.func
+        # self.X.m(...) — lock op or container mutation on an attr.
+        if isinstance(f, ast.Attribute):
+            recv_attr = _self_attr(f.value)
+            if recv_attr is not None and cls:
+                if f.attr in ("acquire", "release", "locked") and \
+                        self.lock_owner(cls, recv_attr,
+                                        hint_ok=True) is not None:
+                    # acquire/release handled at stmt level; lock
+                    # methods are not call edges.
+                    return
+                if f.attr in ATOMIC_METHODS or \
+                        f.attr in _NON_ATOMIC_MUTATORS:
+                    kind = ("atomic" if f.attr in ATOMIC_METHODS
+                            else "mutate")
+                    # dict/deque/list method mutation of self.X.
+                    self._access(info, cls, recv_attr, kind, call,
+                                 held, summ)
+        callees = self.graph.resolve_call(info, call)
+        strict = self.graph.resolve_call_strict(info, call)
+        bounded = any(kw.arg in _TIMEOUT_KWARGS
+                      for kw in call.keywords)
+        syn, cond_rel = self._syntactic_block(info, cls, call)
+        if bounded:
+            syn = None
+        summ.calls.append(CallEvent(
+            info.key, callees, strict, call, held, bounded, syn,
+            cond_rel))
+
+    def _syntactic_block(self, info: FnInfo, cls: str, call: ast.Call
+                         ) -> Tuple[Optional[str], Optional[LockId]]:
+        f = call.func
+        name = _terminal(f)
+        recv = f.value if isinstance(f, ast.Attribute) else None
+        recv_name = _terminal(recv).lower() if recv is not None else ""
+        if name in _UNAMBIGUOUS_BLOCK or name == "Popen":
+            return (f"{ast.unparse(f)}()", None)
+        if name in _SUBPROCESS_FNS and recv is not None and \
+                _terminal(recv) == "subprocess":
+            return (f"subprocess.{name}()", None)
+        if name in ("send", "recv", "connect", "connect_ex"):
+            if any(h in recv_name for h in _SOCK_HINTS):
+                return (f"{ast.unparse(f)}()", None)
+            return (None, None)
+        if name == "get":
+            q_typed = False
+            if recv is not None and cls:
+                ra = _self_attr(recv)
+                q_typed = ra is not None and self.attr_type(
+                    cls, ra) in ("Queue", "LifoQueue",
+                                 "PriorityQueue", "SimpleQueue")
+            if q_typed or any(h in recv_name for h in _QUEUE_HINTS):
+                return (f"{ast.unparse(f)}()", None)
+            return (None, None)
+        if name == "join":
+            if call.args or call.keywords:
+                return (None, None)
+            if any(h in recv_name for h in _THREAD_HINTS):
+                return (f"{ast.unparse(f)}()", None)
+            return (None, None)
+        if name == "wait":
+            if call.args or call.keywords:
+                return (None, None)
+            cond_rel = None
+            if recv is not None and cls:
+                ra = _self_attr(recv)
+                if ra is not None:
+                    cond_rel = self.cond_underlying(cls, ra)
+            return (f"{ast.unparse(f)}()", cond_rel)
+        if name == "sleep":
+            return (f"{ast.unparse(f)}()", None)
+        return (None, None)
+
+    # -- interprocedural fixpoints --------------------------------------------
+
+    def _fix_entry_sets(self) -> None:
+        universe = frozenset(
+            ev.lock for s in self.summaries.values()
+            for ev in s.acquires)
+        callers: Dict[FnKey, List[Tuple[FnKey, FrozenSet[LockId]]]] = {}
+        for key, summ in self.summaries.items():
+            for ev in summ.calls:
+                for callee in ev.strict_callees:
+                    callers.setdefault(callee, []).append(
+                        (key, ev.held))
+        for key in self.summaries:
+            self.entry_may[key] = frozenset()
+            self.entry_must[key] = (
+                universe if key in callers and key not in self._pinned
+                else frozenset())
+        changed = True
+        while changed:
+            changed = False
+            for key, ins in callers.items():
+                may = frozenset().union(*(
+                    self.entry_may[c] | h for c, h in ins))
+                if may != self.entry_may[key]:
+                    self.entry_may[key] = may
+                    changed = True
+                if key in self._pinned:
+                    continue  # a bare root path caps must at empty
+                must_parts = [self.entry_must[c] | h for c, h in ins]
+                must = must_parts[0]
+                for p in must_parts[1:]:
+                    must &= p
+                if must != self.entry_must[key]:
+                    self.entry_must[key] = must
+                    changed = True
+
+    def _fix_may_block(self) -> None:
+        for key, summ in self.summaries.items():
+            for ev in summ.calls:
+                if ev.syn_block and not ev.bounded:
+                    self.may_block.setdefault(key, ev.syn_block)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for key, summ in self.summaries.items():
+                if key in self.may_block:
+                    continue
+                for ev in summ.calls:
+                    if ev.bounded:
+                        continue
+                    hit = next((c for c in ev.strict_callees
+                                if c in self.may_block), None)
+                    if hit is not None:
+                        name = self.graph.fns[hit].name
+                        self.may_block[key] = \
+                            f"{name} -> {self.may_block[hit]}"
+                        changed = True
+                        break
+
+    # -- site-level queries ---------------------------------------------------
+
+    def held_must_at(self, ev) -> FrozenSet[LockId]:
+        return ev.held | self.entry_must.get(ev.fn, frozenset())
+
+
+#: Container mutations that are NOT single-bytecode-atomic (or that
+#: invalidate concurrent iteration in a way the atomic set does not).
+_NON_ATOMIC_MUTATORS = {"insert", "extend", "extendleft", "sort",
+                        "reverse", "difference_update",
+                        "intersection_update", "symmetric_difference_update"}

@@ -1,0 +1,356 @@
+"""GL012 + GL013: the whole-program lock-discipline rules.
+
+Both rules share one ``ConcurrencyAnalysis`` per Project (memoized on
+the project object): scope the serving/obs/daemon planes (bench
+drivers excluded — load generators race on purpose), build the call
+graph, discover thread roots, run the lock model, then slice findings
+per module so the runner/baseline machinery treats them exactly like
+every other rule's.
+
+GL012 — inconsistent lock discipline (error). Eraser's lockset
+condition adapted to what CPython actually guarantees: an attribute
+WRITTEN from >= 2 thread roots must have a nonempty intersection of
+must-held locks over its write sites, unless every write is benign —
+a whole-attribute assignment (one GIL-atomic STORE_ATTR: the
+``blocked_since`` publish idiom) or an audited-atomic container method
+(``deque.append``: obs/trace.py's lock-free hot path). What's flagged
+is the remaining compound write executed bare: an augmented
+assignment, a subscript store, or a non-atomic mutator — the
+read-modify-write a concurrent root can interleave.
+
+GL013 — lock-order inversion + cross-root blocking (warning). Two
+checks over one model: (1) the held->acquired lock-order graph across
+ALL roots has a cycle — the PR 4/PR 8 deadlock shape nobody writes in
+one function; (2) the GL004 blocking-call set promoted to whole-held-
+set awareness: a site that can block (syntactically, or via a resolved
+callee with blocking pedigree) while holding ANY lock that two or more
+thread roots acquire. One finding per (site, contended lock), so a
+second lock pinned across the same blocking call is a second finding
+— the ratchet sees lock-discipline regressions per lock, not per
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..core import Module, Project, Rule, SEVERITY_ERROR, \
+    SEVERITY_WARNING
+from .callgraph import CallGraph, FnKey
+from .locks import LockId, LockModel
+from .threads import RootModel
+
+_WRITE_KINDS = ("assign", "aug", "subscript", "mutate", "atomic")
+_COMPOUND_KINDS = ("aug", "subscript", "mutate")
+_KIND_DESC = {
+    "aug": "augmented assignment (read-modify-write)",
+    "subscript": "subscript store",
+    "mutate": "non-atomic container mutation",
+}
+
+
+def _scoped(module: Module) -> bool:
+    if not module.in_dir("serving", "obs", "daemon"):
+        return False
+    base = module.relpath.rsplit("/", 1)[-1]
+    return not base.startswith("bench")
+
+
+def _fmt_lock(lock: LockId) -> str:
+    owner, attr = lock
+    return f"{owner}.{attr}" if owner else attr
+
+
+class ConcurrencyAnalysis:
+    """Computed once per Project; findings pre-grouped by module."""
+
+    def __init__(self, project: Project):
+        self.modules = [m for m in project.modules if _scoped(m)]
+        self.graph = CallGraph(self.modules)
+        self.locks = LockModel(self.graph)
+        self.roots = RootModel(self.graph, self.locks.edges)
+        # Root entry functions are entered with NOTHING held; cap their
+        # must-hold sets before any rule reads them (the locked-call-
+        # site-plus-thread-target false-negative).
+        self.locks.pin_entries(
+            k for r in self.roots.roots for k in r.entries)
+        # (module relpath) -> [(node, message)]
+        self.gl012: Dict[str, List[Tuple[ast.AST, str]]] = {}
+        self.gl013: Dict[str, List[Tuple[ast.AST, str]]] = {}
+        self._lock_roots = self._acquiring_roots()
+        self._run_gl012()
+        self._run_gl013()
+
+    @classmethod
+    def of(cls, project: Project) -> "ConcurrencyAnalysis":
+        got = getattr(project, "_concurrency_analysis", None)
+        if got is None:
+            got = cls(project)
+            project._concurrency_analysis = got
+        return got
+
+    # -- shared ----------------------------------------------------------------
+
+    def _acquiring_roots(self) -> Dict[LockId, Set[str]]:
+        out: Dict[LockId, Set[str]] = {}
+        for key, summ in self.locks.summaries.items():
+            rids = self.roots.roots_of(key)
+            if not rids:
+                continue
+            for ev in summ.acquires:
+                out.setdefault(ev.lock, set()).update(rids)
+        return out
+
+    def _emit(self, sink: Dict[str, List[Tuple[ast.AST, str]]],
+              fn: FnKey, node: ast.AST, message: str) -> None:
+        relpath = fn[0]
+        sink.setdefault(relpath, []).append((node, message))
+
+    # -- GL012 -----------------------------------------------------------------
+
+    def _run_gl012(self) -> None:
+        by_attr: Dict[LockId, List] = {}
+        for key, summ in self.locks.summaries.items():
+            qual = self.graph.fns[key].qual
+            name = qual.rsplit(".", 1)[-1]
+            if name in ("__init__", "__post_init__"):
+                continue  # initialization happens-before every thread
+            for ev in summ.accesses:
+                if ev.kind in _WRITE_KINDS:
+                    by_attr.setdefault(ev.attr, []).append(ev)
+        for attr, events in sorted(by_attr.items()):
+            attributed = [(ev, self.roots.roots_of(ev.fn))
+                          for ev in events]
+            attributed = [(ev, r) for ev, r in attributed if r]
+            if not attributed:
+                continue
+            all_roots: Set[str] = set()
+            for _ev, r in attributed:
+                all_roots |= r
+            if self.roots.weight(all_roots) < 2:
+                continue
+            candidate: Optional[FrozenSet[LockId]] = None
+            for ev, _r in attributed:
+                held = self.locks.held_must_at(ev)
+                candidate = (held if candidate is None
+                             else candidate & held)
+            if candidate:
+                continue  # one consistent lock guards every write
+            for ev, _r in attributed:
+                if ev.kind not in _COMPOUND_KINDS:
+                    continue
+                if self.locks.held_must_at(ev):
+                    continue
+                self._emit(
+                    self.gl012, ev.fn, ev.node,
+                    f"self.{attr[1]} is written from "
+                    f"{len(all_roots)} thread roots "
+                    f"({self.roots.labels(all_roots)}) and this "
+                    f"{_KIND_DESC[ev.kind]} runs under no lock — "
+                    f"no consistent lock guards its writes")
+
+    # -- GL013 -----------------------------------------------------------------
+
+    def _run_gl013(self) -> None:
+        self._order_cycles()
+        self._cross_root_blocking()
+
+    def _order_cycles(self) -> None:
+        edges: Dict[LockId, Set[LockId]] = {}
+        sites: Dict[Tuple[LockId, LockId], List] = {}
+        for key, summ in self.locks.summaries.items():
+            if not self.roots.roots_of(key):
+                continue
+            for ev in summ.acquires:
+                held = ev.held_before | self.locks.entry_may.get(
+                    ev.fn, frozenset())
+                for h in held:
+                    if h == ev.lock:
+                        continue
+                    edges.setdefault(h, set()).add(ev.lock)
+                    sites.setdefault((h, ev.lock), []).append(ev)
+        in_cycle = self._cyclic_edges(edges)
+        for (h, l) in sorted(in_cycle):
+            cycle = self._a_cycle(edges, l, h)
+            path = " -> ".join(_fmt_lock(x) for x in cycle)
+            for ev in sites[(h, l)]:
+                self._emit(
+                    self.gl013, ev.fn, ev.node,
+                    f"acquiring {_fmt_lock(l)} while holding "
+                    f"{_fmt_lock(h)} closes a lock-order cycle "
+                    f"({path}) — two threads entering from opposite "
+                    f"ends deadlock")
+
+    @staticmethod
+    def _cyclic_edges(edges: Dict[LockId, Set[LockId]]
+                      ) -> List[Tuple[LockId, LockId]]:
+        # Tarjan SCCs; an edge inside a multi-node SCC (or a self-loop,
+        # excluded upstream) participates in a cycle.
+        index: Dict[LockId, int] = {}
+        low: Dict[LockId, int] = {}
+        comp: Dict[LockId, int] = {}
+        stack: List[LockId] = []
+        on: Set[LockId] = set()
+        counter = [0]
+        comp_n = [0]
+
+        def strongconnect(v: LockId) -> None:
+            work = [(v, iter(sorted(edges.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(edges.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if low[node] == index[node]:
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp[w] = comp_n[0]
+                        if w == node:
+                            break
+                    comp_n[0] += 1
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(edges):
+            if v not in index:
+                strongconnect(v)
+        sizes: Dict[int, int] = {}
+        for v, c in comp.items():
+            sizes[c] = sizes.get(c, 0) + 1
+        out = []
+        for h, outs in edges.items():
+            for l in outs:
+                if comp.get(h) is not None and comp.get(h) == \
+                        comp.get(l) and sizes.get(comp[h], 0) > 1:
+                    out.append((h, l))
+        return out
+
+    @staticmethod
+    def _a_cycle(edges: Dict[LockId, Set[LockId]], frm: LockId,
+                 to: LockId) -> List[LockId]:
+        """Some path frm ->* to, closing the to->frm edge (message
+        material only)."""
+        seen = {frm}
+        path = {frm: [frm]}
+        frontier = [frm]
+        while frontier:
+            v = frontier.pop(0)
+            if v == to:
+                return path[v] + [frm]
+            for w in sorted(edges.get(v, ())):
+                if w not in seen:
+                    seen.add(w)
+                    path[w] = path[v] + [w]
+                    frontier.append(w)
+        return [to, frm, to]
+
+    def _cross_root_blocking(self) -> None:
+        seen: Set[Tuple[FnKey, int, LockId]] = set()
+        for key, summ in self.locks.summaries.items():
+            if not self.roots.roots_of(key):
+                continue
+            for ev in summ.calls:
+                if ev.bounded:
+                    continue
+                reason = ev.syn_block
+                if reason is None:
+                    hit = next((c for c in ev.strict_callees
+                                if c in self.locks.may_block), None)
+                    if hit is None:
+                        continue
+                    reason = (f"{self.graph.fns[hit].name} -> "
+                              f"{self.locks.may_block[hit]}")
+                # INTRA-held only: the finding belongs to the function
+                # that visibly holds the lock around the call. A callee
+                # that blocks while its CALLER holds the lock is
+                # reported at the caller's call site (may-block
+                # propagation), not inside the shared helper.
+                held = set(ev.held)
+                if ev.cond_release is not None:
+                    # Condition.wait releases its own lock while
+                    # waiting — only the OTHER held locks stall.
+                    held.discard(ev.cond_release)
+                for lock in sorted(held):
+                    rids = self._lock_roots.get(lock, set())
+                    if self.roots.weight(rids) < 2:
+                        continue
+                    dedup = (key, getattr(ev.node, "lineno", 0), lock)
+                    if dedup in seen:
+                        continue
+                    seen.add(dedup)
+                    self._emit(
+                        self.gl013, key, ev.node,
+                        f"'{ast.unparse(ev.node.func)}(...)' can "
+                        f"block ({reason}) while holding "
+                        f"{_fmt_lock(lock)}, which "
+                        f"{len(rids)} thread roots acquire "
+                        f"({self.roots.labels(rids)}) — every "
+                        f"contender stalls behind the slow path")
+
+
+class InconsistentLockDiscipline(Rule):
+    """Origin: the bug class behind PR 5's settle-lock seize races and
+    PR 8's ShardProcessSet lifecycle split — per-function AST rules
+    structurally cannot see that a second thread root writes the same
+    attribute bare. docs/static-analysis.md § GL012."""
+
+    rule_id = "GL012"
+    severity = SEVERITY_ERROR
+    title = "multi-root attribute written without a consistent lock"
+    hint = ("pick ONE lock for the attribute and hold it at every "
+            "write (reads tolerate staleness; writes must not "
+            "interleave), or make the write benign: a whole-attribute "
+            "assignment (atomic publish) or an audited-atomic "
+            "container op (deque.append) — see the thread-root model "
+            "in docs/static-analysis.md")
+
+    def check(self, module, project):
+        if not _scoped(module):
+            return
+        analysis = ConcurrencyAnalysis.of(project)
+        for node, message in analysis.gl012.get(module.relpath, ()):
+            yield self.finding(module, node, message)
+
+
+class LockOrderInversion(Rule):
+    """Origin: PR 4's TpuVsp.Init lock-across-bring-up stall and PR 8's
+    hung-hello-pins-the-lock wedge, generalized: the held->acquired
+    graph across ALL thread roots must stay acyclic, and nothing may
+    block while holding a lock another root needs to make progress
+    (GL004's call set, whole-held-set aware).
+    docs/static-analysis.md § GL013."""
+
+    rule_id = "GL013"
+    severity = SEVERITY_WARNING
+    title = "lock-order inversion or blocking under a cross-root lock"
+    hint = ("order nested locks identically on every root; for "
+            "blocking work, snapshot under the lock, run the blocking "
+            "call outside, re-acquire to publish (the TpuVsp.Init / "
+            "ShardProcessSet._teardown discipline) — or bound the "
+            "call with a timeout and baseline the reviewed exception")
+
+    def check(self, module, project):
+        if not _scoped(module):
+            return
+        analysis = ConcurrencyAnalysis.of(project)
+        for node, message in analysis.gl013.get(module.relpath, ()):
+            yield self.finding(module, node, message)

@@ -1,0 +1,217 @@
+"""Thread-root discovery: every place the analyzed planes go concurrent.
+
+A ROOT is one kind of thread that can be alive in a process, named by
+the function it enters. Discovered shapes:
+
+  * ``threading.Thread(target=T, ...)`` — T resolved through the call
+    graph (``self._run``, a nested ``run`` def, ``self._watcher.run``);
+  * ``threading.Timer(delay, cb)`` — cb runs on the timer thread;
+  * worker wrappers — ``_GuardedWorker(name, step_fn=..., reset_fn=
+    ...)`` and ``GuardedReducer(fn)`` run their callable arguments on
+    a dedicated thread; lambdas contribute the functions their body
+    calls. New wrapper classes are added to ``WORKER_WRAPPERS``;
+  * per-connection HTTP handler methods (``do_GET``/``do_POST``/...)
+    — ThreadingHTTPServer runs one thread per connection, so these are
+    MULTI-instance roots (two requests race each other with no second
+    root involved);
+  * ``# graftlint: thread-root`` on (or directly above) a ``def`` line
+    — the explicit annotation for a root this pass cannot see (a
+    callback registered with an opaque framework).
+
+On top of the discovered roots sits one synthetic ``main`` root: the
+public control-plane surface (non-underscore functions not reachable
+from any thread root — ``stop()``, ``close()``, ``begin_drain()``...).
+That models the operator/test thread driving lifecycle against the
+plane's own threads, which is exactly where the PR 8 ShardProcessSet
+bug lived.
+
+Multiplicity: a root constructed inside a loop/comprehension, and
+every HTTP handler root, counts as TWO threads for the "written from
+>= 2 roots" test — the race needs no second root kind.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, FnInfo, FnKey, walk_own
+
+WORKER_WRAPPERS = ("_GuardedWorker", "GuardedReducer")
+_HTTP_HANDLER_METHODS = ("do_GET", "do_POST", "do_PUT", "do_DELETE",
+                         "do_PATCH")
+_ROOT_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*thread-root\b")
+
+
+class Root:
+    __slots__ = ("rid", "label", "entries", "multi")
+
+    def __init__(self, rid: str, label: str,
+                 entries: Sequence[FnKey], multi: bool):
+        self.rid = rid
+        self.label = label
+        self.entries = list(entries)
+        self.multi = multi
+
+    @property
+    def weight(self) -> int:
+        return 2 if self.multi else 1
+
+    def __repr__(self):
+        return f"Root({self.label}{'[multi]' if self.multi else ''})"
+
+
+def _loop_enclosed(fn_node: ast.AST, target: ast.AST) -> bool:
+    """Is `target` nested inside a loop/comprehension of fn_node?"""
+    loops = (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
+             ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+    def visit(node: ast.AST, in_loop: bool) -> Optional[bool]:
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                return in_loop
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            got = visit(child, in_loop or isinstance(child, loops))
+            if got is not None:
+                return got
+        return None
+
+    return bool(visit(fn_node, False))
+
+
+def _callable_args(call: ast.Call) -> List[ast.AST]:
+    """Callable-looking arguments of a worker-wrapper construction."""
+    out: List[ast.AST] = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, (ast.Attribute, ast.Name, ast.Lambda)):
+            if isinstance(a, ast.Name) and a.id in ("self", "cls"):
+                continue
+            out.append(a)
+    return out
+
+
+class RootModel:
+    def __init__(self, graph: CallGraph,
+                 edges: Dict[FnKey, Set[FnKey]]):
+        self.graph = graph
+        self.edges = edges
+        self.roots: List[Root] = []
+        self.root_of: Dict[FnKey, Set[str]] = {}
+        self.by_id: Dict[str, Root] = {}
+        self._discover()
+        self._attach_main()
+        self._attribute()
+
+    # -- discovery -------------------------------------------------------------
+
+    def _add(self, rid: str, label: str, entries: Sequence[FnKey],
+             multi: bool) -> None:
+        entries = [k for k in entries if k in self.graph.fns]
+        if not entries:
+            return
+        if rid in self.by_id:
+            # Same construction site revisited (shouldn't happen) or
+            # two shapes landing on one id: merge.
+            root = self.by_id[rid]
+            root.entries.extend(
+                k for k in entries if k not in root.entries)
+            root.multi = root.multi or multi
+            return
+        root = Root(rid, label, entries, multi)
+        self.roots.append(root)
+        self.by_id[rid] = root
+
+    def _discover(self) -> None:
+        for info in list(self.graph.fns.values()):
+            name = info.name
+            if name in _HTTP_HANDLER_METHODS:
+                self._add(
+                    f"http:{info.module.relpath}:{info.qual}",
+                    f"http handler {info.qual}", [info.key],
+                    multi=True)
+            if self._pragma_root(info):
+                self._add(
+                    f"pragma:{info.module.relpath}:{info.qual}",
+                    f"annotated root {info.qual}", [info.key],
+                    multi=False)
+            for call in walk_own(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                self._discover_call(info, call)
+
+    def _pragma_root(self, info: FnInfo) -> bool:
+        line = getattr(info.node, "lineno", 0)
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(info.module.lines) and \
+                    _ROOT_PRAGMA_RE.search(info.module.lines[ln - 1]):
+                return True
+        return False
+
+    def _discover_call(self, info: FnInfo, call: ast.Call) -> None:
+        f = call.func
+        tname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        refs: List[ast.AST] = []
+        if tname == "Thread":
+            refs = [kw.value for kw in call.keywords
+                    if kw.arg == "target"]
+        elif tname == "Timer" and len(call.args) >= 2:
+            refs = [call.args[1]]
+        elif tname in WORKER_WRAPPERS:
+            refs = _callable_args(call)
+        if not refs:
+            return
+        entries: List[FnKey] = []
+        for ref in refs:
+            if isinstance(ref, ast.Lambda):
+                for n in ast.walk(ref.body):
+                    if isinstance(n, ast.Call):
+                        entries.extend(
+                            self.graph.resolve_call(info, n))
+            else:
+                entries.extend(self.graph.resolve_ref(info, ref))
+        multi = _loop_enclosed(info.node, call)
+        label = ", ".join(sorted({self.graph.fns[k].qual
+                                  for k in entries})) or tname
+        self._add(
+            f"thread:{info.module.relpath}:{info.qual}:{call.lineno}",
+            f"{tname} -> {label}", entries, multi)
+
+    # -- the synthetic main root -----------------------------------------------
+
+    def _attach_main(self) -> None:
+        threaded: Set[FnKey] = set()
+        for root in self.roots:
+            threaded |= self.graph.reachable(root.entries, self.edges)
+        public = [
+            info.key for info in self.graph.fns.values()
+            if info.key not in threaded
+            and not info.name.startswith("_")
+            and info.name not in _HTTP_HANDLER_METHODS
+        ]
+        self._add("main", "main (public control plane)", public,
+                  multi=False)
+
+    # -- attribution -----------------------------------------------------------
+
+    def _attribute(self) -> None:
+        for root in self.roots:
+            for k in self.graph.reachable(root.entries, self.edges):
+                self.root_of.setdefault(k, set()).add(root.rid)
+
+    def roots_of(self, key: FnKey) -> Set[str]:
+        return self.root_of.get(key, set())
+
+    def weight(self, rids: Set[str]) -> int:
+        return sum(self.by_id[r].weight for r in rids
+                   if r in self.by_id)
+
+    def labels(self, rids: Set[str], cap: int = 4) -> str:
+        names = sorted(self.by_id[r].label for r in rids
+                       if r in self.by_id)
+        if len(names) > cap:
+            names = names[:cap] + [f"+{len(names) - cap} more"]
+        return ", ".join(names)

@@ -109,9 +109,16 @@ class Module:
                 self.file_disabled.update(
                     r.strip() for r in fm.group(1).split(",") if r.strip())
         # Enclosing-function qualnames and jax-importing gate, computed
-        # once per module (several rules key off both).
+        # once per module (several rules key off both). owner_class maps
+        # a function qualname to the name of its innermost enclosing
+        # class ("" for plain functions) and class_bases records each
+        # class's base-name list — the concurrency passes
+        # (analysis/concurrency/) key lock and attribute identity by
+        # owning class and resolve self-calls through the hierarchy.
         self.func_of: Dict[ast.AST, str] = {}
         self.functions: List[Tuple[ast.AST, str]] = []
+        self.owner_class: Dict[str, str] = {}
+        self.class_bases: Dict[str, List[str]] = {}
         self._annotate_functions()
         self.imports_jax = any(
             (isinstance(n, ast.Import)
@@ -121,19 +128,27 @@ class Module:
             for n in ast.walk(self.tree))
 
     def _annotate_functions(self) -> None:
-        def visit(node: ast.AST, stack: List[str]) -> None:
+        def visit(node: ast.AST, stack: List[str], cls: str) -> None:
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
                     qual = ".".join(stack + [child.name])
                     self.functions.append((child, qual))
+                    self.owner_class[qual] = cls
                     self._mark_subtree(child, qual)
-                    visit(child, stack + [child.name])
+                    # A def nested inside a method is not itself a
+                    # method: its subtree owns no class body.
+                    visit(child, stack + [child.name], "")
                 elif isinstance(child, ast.ClassDef):
-                    visit(child, stack + [child.name])
+                    self.class_bases[child.name] = [
+                        b.id if isinstance(b, ast.Name)
+                        else (b.attr if isinstance(b, ast.Attribute)
+                              else "")
+                        for b in child.bases]
+                    visit(child, stack + [child.name], child.name)
                 else:
-                    visit(child, stack)
-        visit(self.tree, [])
+                    visit(child, stack, cls)
+        visit(self.tree, [], "")
 
     def _mark_subtree(self, fn: ast.AST, qual: str) -> None:
         # Plain assignment, and _annotate_functions visits outer before
@@ -223,6 +238,9 @@ class Report:
     suppressed_baseline: int
     stale_baseline: List[dict]
     checked_files: int
+    # Per-entry (rule, path, func, count, used) after filtering — the
+    # --ratchet-report raw material.
+    baseline_usage: List[dict] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -262,4 +280,5 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     bl = Baseline.load(baseline) if baseline else Baseline([])
     kept, n_suppressed = bl.filter(raw)
     return Report(findings=kept, suppressed_baseline=n_suppressed,
-                  stale_baseline=bl.stale(), checked_files=len(files))
+                  stale_baseline=bl.stale(), checked_files=len(files),
+                  baseline_usage=bl.usage())

@@ -1,6 +1,9 @@
-"""The graftlint rule registry: six launch rules, each distilled from a
-bug class this repo already shipped (origin entries in CHANGES.md; the
-full catalog with fix-it guidance lives in docs/static-analysis.md).
+"""The graftlint rule registry, each rule distilled from a bug class
+this repo already shipped (origin entries in CHANGES.md; the full
+catalog with fix-it guidance lives in docs/static-analysis.md). The
+per-function rules live here; the whole-program concurrency rules
+(GL012/GL013) live in analysis/concurrency/ and register through
+default_rules() below.
 
 GL001  mask-multiply in gradient-bearing parallel/ code
 GL002  host-device sync inside decode/collective hot loops
@@ -17,6 +20,11 @@ GL010  blocking fabric recv/collect in a transport loop with no
        deadline (serving/parallel)
 GL011  full-copy array materialization (.tobytes()/np.copy) inside a
        serving/parallel transport hot loop
+GL012  attribute written from >= 2 thread roots without a consistent
+       lock (whole-program lockset analysis — analysis/concurrency/)
+GL013  lock-order inversion across thread roots, or blocking while
+       holding a lock another root acquires (GL004 promoted to
+       whole-held-set awareness)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1211,9 +1219,13 @@ class CopyInTransportLoop(Rule):
 
 
 def default_rules() -> List[Rule]:
+    from .concurrency import (InconsistentLockDiscipline,
+                              LockOrderInversion)
+
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
             SilentBroadExcept(), UndeclaredAxisName(),
             UnboundedRetryLoop(), RequestLogWithoutContext(),
             KVAcquireWithoutRelease(), UnboundedTransportRecv(),
-            CopyInTransportLoop()]
+            CopyInTransportLoop(), InconsistentLockDiscipline(),
+            LockOrderInversion()]

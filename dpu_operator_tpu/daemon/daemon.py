@@ -101,6 +101,13 @@ class Daemon:
         self._drain_on_setup = drain_on_setup
 
         self._managed: Dict[str, ManagedDpu] = {}
+        # Guards _managed MUTATIONS: the tick thread adds/removes
+        # entries while stop() (operator thread) empties the dict —
+        # GL012's lockset pass flagged the bare writes after a
+        # stop-vs-tick race stranded a side manager started after
+        # stop's teardown. Reads stay bare (snapshot-free iteration is
+        # safe once stop() joins the tick thread before tearing down).
+        self._mlock = threading.Lock()
         # config name -> last appliedTo state this daemon wrote (skips the
         # per-tick status read in steady state).
         self._config_status_memo: Dict[str, dict] = {}
@@ -142,7 +149,21 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
-        for md in self._managed.values():
+        # Wait out an in-flight tick BEFORE tearing anything down: the
+        # serve thread starts side managers and registers them in
+        # _managed, so a teardown racing it used to strand a manager
+        # started after this stop's cleanup — an orphan thread plus a
+        # re-created CR nobody deletes. The join is the runtime half
+        # of the GL012 fix; the _mlock on mutations is the static
+        # half.
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=30.0 + self._tick)
+        with self._mlock:
+            managed = list(self._managed.values())
+            self._managed.clear()
+        for md in managed:
             try:
                 md.plugin.close()
                 md.manager.stop()
@@ -150,9 +171,8 @@ class Daemon:
                 log.exception("side manager stop failed")
         # Deleting our CRs on clean shutdown mirrors the reference's
         # teardown path (daemon.go:219-247).
-        for md in list(self._managed.values()):
+        for md in managed:
             self._delete_cr(md.detection.cr_name())
-        self._managed.clear()
 
     # -- the tick ------------------------------------------------------------
 
@@ -174,12 +194,25 @@ class Daemon:
 
         for ident, det in by_id.items():
             if ident not in self._managed:
-                self._managed[ident] = self._start_managed(det)
+                md = self._start_managed(det)
+                with self._mlock:
+                    register = not self._stop.is_set()
+                    if register:
+                        self._managed[ident] = md
+                if not register:
+                    # stop() already ran (or outlasted its bounded join
+                    # on a wedged tick): registering now would orphan
+                    # this manager past the teardown — it is ours to
+                    # tear down instead.
+                    md.plugin.close()
+                    md.manager.stop()
+                    self._delete_cr(md.detection.cr_name())
 
         for ident in list(self._managed.keys()):
             if ident not in by_id:
                 log.info("DPU %s no longer detected; tearing down", ident)
-                md = self._managed.pop(ident)
+                with self._mlock:
+                    md = self._managed.pop(ident)
                 md.plugin.close()
                 md.manager.stop()
                 self._delete_cr(md.detection.cr_name())

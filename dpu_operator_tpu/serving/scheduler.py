@@ -817,16 +817,26 @@ class ContinuousBatcher:
                                   self.replica)
 
     def _fail_occupants(self, e: Exception) -> None:
-        for i, req in enumerate(self._slots):
-            if req is not None:
-                req.fail(f"executor failed: {e}")
-                self.tracer.event(
-                    "batcher.fail", request_id=req.request_id,
-                    parent_id=req.trace_parent,
-                    attrs={"replica": self.replica,
-                           "error": str(e)[:200]})
-                self._slots[i] = None
-                self._x[i] = 0.0
+        # Under the settle lock, like every other settle path (GL012):
+        # the legacy loops call this bare from their except handlers,
+        # and a concurrent stop() — which fails occupants itself —
+        # used to interleave with this loop and settle the same
+        # request twice (its error overwritten after the handler
+        # thread already woke). _abandoned re-checked under the lock:
+        # once a stop/seize owns the slots, they are not ours to fail.
+        with self._settle_lock:
+            if self._abandoned:
+                return
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    req.fail(f"executor failed: {e}")
+                    self.tracer.event(
+                        "batcher.fail", request_id=req.request_id,
+                        parent_id=req.trace_parent,
+                        attrs={"replica": self.replica,
+                               "error": str(e)[:200]})
+                    self._slots[i] = None
+                    self._x[i] = 0.0
 
     def _run(self) -> None:
         try:

@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl012_nm.py
+"""GL012 near-miss: two thread roots write shared attributes, but
+every write is benign — whole-attribute assignments (one GIL-atomic
+STORE_ATTR: the blocked_since publish idiom) and deque appends (the
+audited-atomic allowlist: obs/trace.py's lock-free hot path). No lock
+anywhere, and none needed."""
+
+import threading
+import time
+from collections import deque
+
+
+class Probe:
+    def __init__(self):
+        self.last_beat = None  # published whole-value, read-tolerant
+        self.events = deque()  # deque: append/popleft are atomic
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._beat, daemon=True).start()
+        threading.Thread(target=self._watch, daemon=True).start()
+
+    def _beat(self):
+        while not self._stop.is_set():
+            self.last_beat = time.monotonic()   # atomic publish
+            self.events.append(("beat", self.last_beat))
+
+    def _watch(self):
+        while not self._stop.is_set():
+            beat = self.last_beat
+            if beat is not None and time.monotonic() - beat > 5.0:
+                self.last_beat = None           # publish, second root
+                self.events.append(("stale", beat))

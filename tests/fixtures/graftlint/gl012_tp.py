@@ -1,0 +1,32 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl012_tp.py
+"""GL012 true positive: two thread roots write the same attribute and
+one side writes BARE — the drain side mutates under the lock, the fill
+side doesn't, so there is no consistent lock and both of _fill's
+compound writes (a non-atomic list insert and a read-modify-write
+counter bump) can interleave with _drain's locked pop."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []  # plain list: no atomic pedigree
+        self.total = 0
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._fill, daemon=True).start()
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _fill(self):
+        while not self._stop.is_set():
+            self.pending.insert(0, object())  # bare mutate: fires
+            self.total += 1                   # bare RMW: fires
+
+    def _drain(self):
+        while not self._stop.is_set():
+            with self._lock:
+                if self.pending:
+                    self.pending.pop()
+                    self.total -= 1

@@ -1,0 +1,52 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl013_nm.py
+"""GL013 near-misses that must stay silent: the same two locks nested
+in the SAME order on both roots (no cycle), a bounded Condition.wait
+under the shared lock (timeout + wait releases the lock it wraps),
+and wire blocking under a lock only ONE root ever takes (no
+contender to stall)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self, peer):
+        self._meta_lock = threading.Lock()
+        self._cv = threading.Condition(self._meta_lock)
+        self._data_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._peer = peer
+        self.rows = {}
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._ingest, daemon=True).start()
+        threading.Thread(target=self._flush, daemon=True).start()
+        threading.Thread(target=self._pump, daemon=True).start()
+        threading.Thread(target=self._push, daemon=True).start()
+
+    def _ingest(self):
+        while not self._stop.is_set():
+            with self._meta_lock:          # meta -> data
+                with self._data_lock:
+                    self.rows["head"] = 1
+
+    def _flush(self):
+        while not self._stop.is_set():
+            with self._meta_lock:          # same order: no cycle
+                with self._data_lock:
+                    self.rows["head"] = 0
+
+    def _pump(self):
+        while not self._stop.is_set():
+            with self._meta_lock:
+                # Bounded, and wait() releases the wrapped lock while
+                # parked — the AdmissionQueue shape, not a stall.
+                self._cv.wait(0.05)
+                self.rows["tail"] = 1
+
+    def _push(self):
+        while not self._stop.is_set():
+            # _io_lock has exactly one acquiring root: nobody queues
+            # behind the send.
+            with self._io_lock:
+                self._peer.sendall(b"rows")

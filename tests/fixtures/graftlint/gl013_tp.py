@@ -1,0 +1,39 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl013_tp.py
+"""GL013 true positives: (a) the two ingest/flush roots nest the same
+two locks in OPPOSITE orders — the classic inversion that deadlocks
+the moment both roots enter at once (one finding per closing edge);
+(b) a third root blocks on the wire while holding a lock the other
+roots need — the PR 8 ShardProcessSet shape."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self, peer):
+        self._meta_lock = threading.Lock()
+        self._data_lock = threading.Lock()
+        self._peer = peer
+        self.rows = {}
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._ingest, daemon=True).start()
+        threading.Thread(target=self._flush, daemon=True).start()
+        threading.Thread(target=self._report, daemon=True).start()
+
+    def _ingest(self):
+        while not self._stop.is_set():
+            with self._meta_lock:          # meta -> data
+                with self._data_lock:
+                    self.rows["head"] = 1
+
+    def _flush(self):
+        while not self._stop.is_set():
+            with self._data_lock:          # data -> meta: inversion
+                with self._meta_lock:
+                    self.rows["head"] = 0
+
+    def _report(self):
+        while not self._stop.is_set():
+            with self._meta_lock:
+                self._peer.sendall(b"rows")  # blocks holding meta

@@ -1,0 +1,276 @@
+"""Regression tests for the two lock-discipline bugs GL012 found
+(ISSUE 10 triage) — event-sequenced interleavings in the PR 5
+settle-race style: every ordering below is forced by events, not
+sleeps, so the pre-fix failure reproduced on every run.
+
+1. ContinuousBatcher._fail_occupants settled occupants OUTSIDE the
+   settle lock. A standalone (crash_only=False) batcher failing a step
+   while stop() runs could settle the same request TWICE: the stop
+   path failed it "server stopped" between _fail_occupants' fail and
+   its slot clear, then the batcher's own fail overwrote the error
+   AFTER the handler thread had already been woken — the exact
+   no-double-settle contract the settle lock exists for.
+
+2. Daemon.stop() raced an in-flight tick. stop() tore down and cleared
+   _managed while the serve thread was mid-tick; a detection completing
+   after the teardown started its side manager into a dict nobody
+   would ever stop again — an orphan manager thread plus a re-created
+   CR. stop() now joins the tick thread before tearing down, and
+   _managed mutations share _mlock.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.obs import trace as obs_trace
+from dpu_operator_tpu.platform.detector import DetectedDpu
+from dpu_operator_tpu.serving.api import GenerateRequest
+from dpu_operator_tpu.serving.queue import AdmissionQueue
+from dpu_operator_tpu.serving.scheduler import ContinuousBatcher
+
+
+# -- 1. batcher: _fail_occupants vs stop() ------------------------------------
+
+
+class _BoomExecutor:
+    """step() fails immediately — the batcher admits, then lands in its
+    failure path on the first decode step."""
+
+    slots = 1
+    d = 4
+    pipelined = False
+    kv = False
+
+    def step(self, x):
+        raise RuntimeError("boom")
+
+    def reset(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class _SequencedFail:
+    """Wraps req.fail: the FIRST call (the batcher's _fail_occupants)
+    parks on an event so the test can interleave stop() at the exact
+    point the race lived; later calls pass straight through."""
+
+    def __init__(self, req):
+        self.calls = 0
+        self.in_fail = threading.Event()
+        self.release = threading.Event()
+        self._orig = req.fail
+
+    def __call__(self, error):
+        self.calls += 1
+        if self.calls == 1:
+            self.in_fail.set()
+            assert self.release.wait(10), "test sequencing wedged"
+        self._orig(error)
+
+
+def test_fail_occupants_settles_exactly_once_against_stop():
+    """Pre-fix: stop() found the request still in its slot while the
+    batcher was mid-_fail_occupants (no lock held) and settled it a
+    second time (fail called twice, error overwritten after the
+    handler woke). Post-fix _fail_occupants runs under the settle lock
+    with an _abandoned re-check: exactly one settle, whoever wins."""
+    tracer = obs_trace.Tracer()
+    tracer.enabled = False
+    queue = AdmissionQueue(max_depth=4, tracer=tracer)
+    batcher = ContinuousBatcher(
+        _BoomExecutor(), queue, replica="r0", idle_wait_s=0.01,
+        crash_only=False, tracer=tracer)
+    req = GenerateRequest(
+        prompt_vec=np.zeros(4, np.float32), max_tokens=4,
+        deadline=time.monotonic() + 30.0)
+    box = _SequencedFail(req)
+    req.fail = box
+    queue.submit(req)
+    batcher.start()
+    assert box.in_fail.wait(10), "batcher never reached its fail path"
+
+    # stop() with a tiny join budget: the batcher thread is parked
+    # inside the fail wrapper, so the join always times out and stop
+    # proceeds to its settle section while the failure path is still
+    # in flight — the pre-fix double-settle window.
+    stopper = threading.Thread(target=lambda: batcher.stop(timeout=0.05))
+    stopper.start()
+    # Pre-fix stop() completes through the free lock (second settle
+    # already done); post-fix it parks on the settle lock the batcher
+    # holds. Either way, release the batcher only after stop() has
+    # committed to its path.
+    stopper.join(timeout=1.0)
+    box.release.set()
+    stopper.join(15)
+    assert not stopper.is_alive(), "stop() wedged"
+    batcher._thread.join(10)
+
+    assert box.calls == 1, (
+        f"request settled {box.calls} times — the no-double-settle "
+        f"contract broke (error now {req.error!r})")
+    assert req.error is not None and \
+        req.error.startswith("executor failed"), req.error
+
+
+# -- 2. daemon: stop() vs in-flight tick --------------------------------------
+
+
+class _FakeClient:
+    def __init__(self):
+        self.created = []
+
+    def list(self, *a, **k):
+        return []
+
+    def create(self, obj):
+        self.created.append(obj)
+        return obj
+
+    def update(self, obj):
+        return obj
+
+    def update_status(self, obj):
+        return obj
+
+    def get_or_none(self, *a, **k):
+        return None
+
+    def delete(self, *a, **k):
+        return None
+
+
+class _FakePlatform:
+    def node_name(self):
+        return "node-a"
+
+    def pci_devices(self):
+        return []
+
+
+class _FakePlugin:
+    def __init__(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+    def is_initialized(self):
+        return True
+
+    def set_num_endpoints(self, n):
+        pass
+
+
+class _FakeManager:
+    def __init__(self):
+        self.stopped = False
+
+    def start_vsp(self):
+        pass
+
+    def setup_devices(self, num_endpoints: int = 8) -> bool:
+        return True
+
+    def listen(self):
+        pass
+
+    def serve(self):
+        pass
+
+    def check_ping(self):
+        return True
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_daemon_stop_joins_inflight_tick(monkeypatch):
+    """Pre-fix: stop() returned while the tick thread was still inside
+    detect_all; the tick then started a side manager AFTER stop's
+    teardown, leaving an orphan manager nothing would ever stop.
+    Post-fix stop() joins the serve thread first, so the in-flight
+    tick's manager is torn down like any other."""
+    from dpu_operator_tpu.daemon import daemon as daemon_mod
+
+    monkeypatch.setattr(daemon_mod, "GrpcPlugin", _FakePlugin)
+    managers = []
+
+    def factory(det, plugin):
+        mgr = _FakeManager()
+        managers.append(mgr)
+        return mgr
+
+    d = daemon_mod.Daemon(
+        client=_FakeClient(), platform=_FakePlatform(),
+        detectors=[], tick_interval=0.01,
+        register_device_plugin=False, side_manager_factory=factory)
+
+    det = DetectedDpu(identifier="tpu-test-0", product_name="tpu",
+                      is_dpu_side=True, vendor="tpu",
+                      node_name="node-a")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_detect_all():
+        entered.set()
+        assert release.wait(10), "test sequencing wedged"
+        return [det]
+
+    d._detector.detect_all = blocking_detect_all
+    d.start()
+    assert entered.wait(10), "tick never started"
+
+    stopper = threading.Thread(target=d.stop)
+    stopper.start()
+    assert d._stop.wait(10)
+    # The tick is mid-flight (parked in detection) while stop() runs:
+    # pre-fix, stop() has already finished its teardown by the time
+    # the detection returns; post-fix it is joining the serve thread.
+    release.set()
+    stopper.join(15)
+    assert not stopper.is_alive(), "daemon stop() wedged"
+    d._thread.join(10)
+
+    assert managers, "the in-flight tick never started its manager"
+    assert all(m.stopped for m in managers), (
+        "a side manager started by the in-flight tick survived "
+        "stop() — orphaned thread + re-created CR")
+    assert d.managed() == {}
+
+
+def test_daemon_tick_refuses_registration_after_stop_teardown(
+        monkeypatch):
+    """The bounded-join escape hatch: a tick wedged PAST stop()'s join
+    budget resumes after the teardown — it must tear its own manager
+    down instead of registering it into the emptied dict (which would
+    recreate the orphan the join exists to prevent)."""
+    from dpu_operator_tpu.daemon import daemon as daemon_mod
+
+    monkeypatch.setattr(daemon_mod, "GrpcPlugin", _FakePlugin)
+    managers = []
+
+    def factory(det, plugin):
+        mgr = _FakeManager()
+        managers.append(mgr)
+        return mgr
+
+    d = daemon_mod.Daemon(
+        client=_FakeClient(), platform=_FakePlatform(),
+        detectors=[], tick_interval=0.01,
+        register_device_plugin=False, side_manager_factory=factory)
+    det = DetectedDpu(identifier="tpu-test-1", product_name="tpu",
+                      is_dpu_side=True, vendor="tpu",
+                      node_name="node-a")
+    # Simulate the wedged-tick case directly: stop() has fully torn
+    # down (no serve thread to join), THEN the stale tick runs.
+    d.stop()
+    d._detector.detect_all = lambda: [det]
+    d.tick()
+    assert managers and all(m.stopped for m in managers), (
+        "post-stop tick registered/orphaned its manager")
+    assert d.managed() == {}

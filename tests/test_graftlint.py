@@ -43,20 +43,22 @@ def _analyze_source(tmp_path, source, name="fx.py", baseline=None):
 
 def test_package_gate_clean_and_fast():
     """The tier-1 gate: zero non-baselined findings over the whole
-    package, in well under the 10 s lint-lane budget."""
+    package with ALL 13 rules active (including the interprocedural
+    GL012/GL013 passes), inside the 20 s lint-lane budget docs/ci.md
+    carries (measured ~6 s on the 2-cpu container)."""
     t0 = time.perf_counter()
     report = run_analysis([str(REPO / "dpu_operator_tpu")],
                           baseline=DEFAULT_BASELINE)
     elapsed = time.perf_counter() - t0
     assert report.clean, "\n".join(f.format() for f in report.findings)
     assert report.checked_files > 100  # really saw the package
-    assert elapsed < 10.0, f"analyzer took {elapsed:.1f}s (budget 10s)"
+    assert elapsed < 20.0, f"analyzer took {elapsed:.1f}s (budget 20s)"
 
 
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 11
+    assert len(set(ids)) == len(ids) == 13
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -75,6 +77,8 @@ _EXPECT = {
     "GL009": 2,  # acquire and prefix-fork with no release, no lease
     "GL010": 2,  # loop recv and loop collect, no deadline anywhere
     "GL011": 2,  # loop-send tobytes and loop-send np.copy
+    "GL012": 2,  # bare list insert + bare counter RMW, second root locked
+    "GL013": 3,  # two inversion edges + a send under a cross-root lock
 }
 
 
@@ -275,6 +279,67 @@ def test_reintroducing_pr2_mask_multiply_fails(tmp_path):
         f.format() for f in report.findings]
 
 
+_CONC_SCRATCH_FILES = (
+    # The minimal real-source set that gives procset.py its second
+    # thread root: the batcher thread (scheduler), the supervisor +
+    # worker roots (executor), the FabricExecutor bridge into the
+    # shard duck contract, and the framed protocol whose send/recv
+    # bodies carry the blocking pedigree.
+    "dpu_operator_tpu/serving/scheduler.py",
+    "dpu_operator_tpu/serving/executor.py",
+    "dpu_operator_tpu/serving/sharded/executor.py",
+    "dpu_operator_tpu/serving/sharded/protocol.py",
+    "dpu_operator_tpu/serving/sharded/procset.py",
+)
+
+
+def _write_scratch_plane(tmp_path, procset_source: str) -> None:
+    """Copy the real serving/sharded subset into a scratch dir, each
+    file declaring its real path (the concurrency rules scope by path
+    and the baseline keys on it); `procset_source` substitutes for the
+    real procset.py."""
+    for rel in _CONC_SCRATCH_FILES:
+        src = (procset_source if rel.endswith("procset.py")
+               else (REPO / rel).read_text())
+        name = rel.rsplit("/", 1)[-1]
+        (tmp_path / name).write_text(
+            f"# graftlint-fixture-path: {rel}\n" + src)
+
+
+def test_reintroducing_pr8_lock_across_reap_fails(tmp_path):
+    """The ISSUE 10 acceptance scratch-test: put PR 8's original
+    single-lifecycle-lock shape back into the REAL ShardProcessSet —
+    the teardown reap (blocking socket close + process wait) moved
+    back UNDER `_lock`, the fast-path lock the batcher-rooted
+    collect() and the main-rooted close() both need — and GL013 must
+    fail it, while the unmodified plane stays clean against the
+    checked-in baseline (which carries the reviewed `_life` entries)."""
+    real = (REPO / "dpu_operator_tpu" / "serving" / "sharded"
+            / "procset.py").read_text()
+    scratch = tmp_path / "control"
+    scratch.mkdir()
+    _write_scratch_plane(scratch, real)
+    report = _analyze(scratch, baseline=DEFAULT_BASELINE)
+    assert report.clean, "\n".join(f.format() for f in report.findings)
+
+    wanted = ("            self._up = False\n"
+              "        _reap(procs, socks, listener, kill=kill)")
+    assert wanted in real, "procset teardown detach site moved"
+    bugged = real.replace(
+        wanted,
+        "            self._up = False\n"
+        "            _reap(procs, socks, listener, kill=kill)")
+    scratch2 = tmp_path / "bugged"
+    scratch2.mkdir()
+    _write_scratch_plane(scratch2, bugged)
+    report = _analyze(scratch2, baseline=DEFAULT_BASELINE)
+    hits = [f for f in report.findings
+            if f.rule in ("GL013", "GL004")]
+    assert hits, [f.format() for f in report.findings]
+    assert any(f.func == "ShardProcessSet._teardown" for f in hits), [
+        f.format() for f in hits]
+
+
 def test_reintroducing_pr3_except_binding_fails(tmp_path):
     """Move `i = free.pop(0)` back inside the try in a scratch copy of
     the REAL scheduler: the handler's `self._slots[i]` NameErrors when
@@ -326,3 +391,124 @@ def test_cli_zero_files_is_usage_error_not_green():
         capture_output=True, text=True, cwd=str(REPO))
     assert proc.returncode == 2
     assert "no python files" in proc.stderr
+
+
+def test_cli_sarif_round_trip_with_rule_filter():
+    """`--format sarif --rules GL005`: the SARIF result carries the
+    file, line, rule id and message of a known fixture finding, the
+    driver block carries the rule metadata, and the filter keeps
+    every other rule out of the run."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         str(FIXTURES / "gl005_tp.py"), "--no-baseline",
+         "--format", "sarif", "--rules", "GL005"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 1, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == \
+        ["GL005"]
+    results = run["results"]
+    assert len(results) == _EXPECT["GL005"]
+    first = results[0]
+    assert first["ruleId"] == "GL005"
+    loc = first["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "dpu_operator_tpu/cni/fx_gl005_tp.py"
+    assert loc["region"]["startLine"] > 0
+    assert "swallows silently" in first["message"]["text"]
+
+
+def test_cli_unknown_rule_id_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         str(FIXTURES / "gl005_tp.py"), "--rules", "GL999"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 2
+    assert "GL999" in proc.stderr
+
+
+def test_cli_rules_filter_excludes_other_rules():
+    """The gl013 TP fixture analyzed with only GL001 active is clean:
+    the filter controls which rules RUN, not just which report."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis",
+         str(FIXTURES / "gl013_tp.py"), "--no-baseline",
+         "--rules", "GL001"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- ratchet report + stale TOML notes ----------------------------------------
+
+
+def _run_cli(tmp_path, fixture_src, baseline_text, *extra):
+    fx = tmp_path / "fx.py"
+    fx.write_text(fixture_src)
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(baseline_text)
+    return subprocess.run(
+        [sys.executable, "-m", "dpu_operator_tpu.analysis", str(fx),
+         "--baseline", str(bl), *extra],
+        capture_output=True, text=True, cwd=str(REPO))
+
+
+def test_ratchet_report_counts_baseline_vs_current(tmp_path):
+    """--ratchet-report: per-(rule, path) baselined vs current counts,
+    with shrink advice once the tree produces fewer findings than the
+    baseline tolerates."""
+    proc = _run_cli(
+        tmp_path, _TWO_SILENT,
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        'count = 3\n',
+        "--ratchet-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    row = next(l for l in proc.stdout.splitlines()
+               if l.startswith("ratchet: GL005"))
+    assert "dpu_operator_tpu/cni/fx_ratchet.py" in row
+    # 3 tolerated, 2 produced: progress the operator should commit.
+    assert " 3 " in row and " 2 " in row and "shrink" in row
+
+
+def test_rules_filter_scopes_stale_and_ratchet_advice(tmp_path):
+    """Under --rules, baseline entries for rules that DID NOT RUN must
+    not be reported stale (their sites weren't analyzed — advising
+    deletion would turn the full gate red) nor appear in the ratchet
+    table."""
+    proc = _run_cli(
+        tmp_path, _TWO_SILENT,
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        'count = 2\n',
+        "--rules", "GL001", "--ratchet-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "delete this from baseline.toml" not in proc.stdout
+    assert "GL005" not in proc.stdout
+    assert "nothing grandfathered" in proc.stdout
+
+
+def test_stale_note_includes_deletable_toml_block(tmp_path):
+    """A fully-unused entry's note carries the commit-able TOML block
+    to delete — fix-then-delete without hand-reconstructing the key."""
+    clean = _TWO_SILENT.replace("pass", "raise")
+    proc = _run_cli(
+        tmp_path, clean,
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        'count = 2\n')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "delete this from baseline.toml" in out
+    assert '    [[suppress]]' in out
+    assert '    rule = "GL005"' in out
+    assert '    path = "dpu_operator_tpu/cni/fx_ratchet.py"' in out
+    assert '    func = "teardown"' in out
+    assert '    count = 2' in out

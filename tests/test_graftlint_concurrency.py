@@ -1,0 +1,217 @@
+"""Unit tests for the graftlint concurrency passes (GL012/GL013):
+thread-root discovery shapes, root multiplicity, and the
+interprocedural must-hold propagation — the model docs/static-analysis
+.md § "the thread-root model" documents. The rule-level TP/NM pairs
+live in tests/fixtures/graftlint/ with the other rules'."""
+
+from dpu_operator_tpu.analysis import run_analysis
+
+_HDR = "# graftlint-fixture-path: dpu_operator_tpu/serving/fx_conc.py\n"
+
+
+def _findings(tmp_path, source, rule=None):
+    p = tmp_path / "fx.py"
+    p.write_text(_HDR + source)
+    report = run_analysis([str(p)])
+    if rule is None:
+        return report.findings
+    return [f for f in report.findings if f.rule == rule]
+
+
+def test_http_handler_root_is_multi_instance(tmp_path):
+    """ThreadingHTTPServer runs one thread per connection: a bare
+    read-modify-write in a do_* method races ANOTHER connection's —
+    one handler root must count as two threads."""
+    src = (
+        "class Handler:\n"
+        "    hits = 0\n"
+        "    def do_POST(self):\n"
+        "        self.hits += 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert len(got) == 1 and "do_POST" in got[0].func, [
+        f.format() for f in got]
+
+
+def test_loop_spawned_thread_root_is_multi_instance(tmp_path):
+    """N copies of one target racing each other need no second root
+    kind (the bench client-fleet shape)."""
+    src = (
+        "import threading\n"
+        "class Fan:\n"
+        "    def start(self):\n"
+        "        for _ in range(4):\n"
+        "            threading.Thread(target=self._work).start()\n"
+        "    def _work(self):\n"
+        "        self.done += 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert len(got) == 1 and "_work" in got[0].func, [
+        f.format() for f in got]
+
+
+def test_worker_wrapper_and_lambda_targets_are_roots(tmp_path):
+    """_GuardedWorker's callable arguments (including functions a
+    lambda argument calls) run on the worker thread — the executor
+    seam's step_fn/reset_fn idiom."""
+    src = (
+        "class Ex:\n"
+        "    def __init__(self):\n"
+        "        self._worker = _GuardedWorker(\n"
+        "            'w', step_fn=lambda p: self._step(p),\n"
+        "            reset_fn=self._zero)\n"
+        "    def _step(self, p):\n"
+        "        self.steps += 1\n"
+        "    def _zero(self):\n"
+        "        self.steps = 0\n"
+        "    def kick(self):\n"
+        "        self.steps += 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    funcs = {f.func for f in got}
+    # Both bare RMWs fire (worker root via the wrapper, main root via
+    # the public method); the _zero publish stays exempt.
+    assert funcs == {"Ex._step", "Ex.kick"}, [f.format() for f in got]
+
+
+def test_timer_callback_is_a_root(tmp_path):
+    src = (
+        "import threading\n"
+        "class Beat:\n"
+        "    def arm(self):\n"
+        "        threading.Timer(5.0, self._fire).start()\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n"
+        "    def _fire(self):\n"
+        "        self.n += 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert {f.func for f in got} == {"Beat.bump", "Beat._fire"}, [
+        f.format() for f in got]
+
+
+def test_thread_root_pragma_annotates_opaque_callbacks(tmp_path):
+    """`# graftlint: thread-root` above a def marks a root the
+    discovery pass cannot see (a callback registered with an opaque
+    framework) — the documented escape hatch for new root shapes."""
+    src = (
+        "class W:\n"
+        "    def register(self, bus):\n"
+        "        bus.subscribe(self._on_event)\n"
+        "        self.n += 1\n"
+        "    # graftlint: thread-root\n"
+        "    def _on_event(self):\n"
+        "        self.n += 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert {f.func for f in got} == {"W.register", "W._on_event"}, [
+        f.format() for f in got]
+
+
+def test_must_hold_propagates_through_shared_helpers(tmp_path):
+    """A helper ONLY ever called under the lock inherits it (entry
+    must-hold): the _retire-under-_settle_lock shape must stay clean
+    even though the helper itself never names the lock."""
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {}\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            with self._lock:\n"
+        "                self._put('a')\n"
+        "    def put_public(self):\n"
+        "        with self._lock:\n"
+        "            self._put('b')\n"
+        "    def _put(self, k):\n"
+        "        self.items[k] = 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert not got, [f.format() for f in got]
+
+
+def test_one_bare_caller_breaks_must_hold(tmp_path):
+    """Same shape, but one caller reaches the helper without the lock:
+    must-hold intersects to empty and the helper's subscript store is
+    the reported site."""
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {}\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            with self._lock:\n"
+        "                self._put('a')\n"
+        "    def put_public(self):\n"
+        "        self._put('b')\n"
+        "    def _put(self, k):\n"
+        "        self.items[k] = 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert len(got) == 1 and got[0].func == "Box._put", [
+        f.format() for f in got]
+
+
+def test_root_entry_caps_must_hold_even_with_locked_callers(tmp_path):
+    """A function that is BOTH a thread target and called from under a
+    lock is not must-locked — the root enters it bare, so its bare
+    compound write must still fire (the locked call site alone used to
+    mask it)."""
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {}\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._pump).start()\n"
+        "    def kick(self):\n"
+        "        with self._lock:\n"
+        "            self._pump()\n"
+        "    def _pump(self):\n"
+        "        self.items['k'] = 1\n"
+    )
+    got = _findings(tmp_path, src, "GL012")
+    assert len(got) == 1 and got[0].func == "Box._pump", [
+        f.format() for f in got]
+
+
+def test_blocking_pedigree_propagates_and_timeout_bounds(tmp_path):
+    """GL013's cross-root blocking sees THROUGH a helper (the
+    send_msg -> sendall chain), and a timeout-ish keyword on the call
+    bounds it — the armed-deadline near-miss stays silent."""
+    base = (
+        "import threading\n"
+        "def push(sock, data{sig}):\n"
+        "    sock.sendall(data)\n"
+        "class Tx:\n"
+        "    def __init__(self, peer):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._peer = peer\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            with self._lock:\n"
+        "                push(self._peer, b'x'{arg})\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    fired = _findings(
+        tmp_path, base.format(sig="", arg=""), "GL013")
+    assert len(fired) == 1 and fired[0].func == "Tx._run", [
+        f.format() for f in fired]
+    bounded = _findings(
+        tmp_path,
+        base.format(sig=", timeout=None", arg=", timeout=1.0"),
+        "GL013")
+    assert not bounded, [f.format() for f in bounded]
