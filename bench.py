@@ -780,7 +780,9 @@ def bench_serving() -> dict:
             f"sharded {out.get('serving_sharded_steps_per_s')} steps/s "
             f"(collective frac "
             f"{out.get('serving_shard_collective_frac')}, vs local "
-            f"{out.get('serving_sharded_vs_local_frac')}x)",
+            f"{out.get('serving_sharded_vs_local_frac')}x, trace "
+            f"overhead "
+            f"{out.get('serving_sharded_trace_overhead_frac')})",
             file=sys.stderr,
         )
         return out
@@ -845,6 +847,14 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
     tof = metrics.get("serving_trace_overhead_frac")
     if tof is not None:
         gates["serving_trace_overhead_le_002"] = bool(tof <= 0.02)
+    # Cross-process tracing (ISSUE 11): the sharded pipelined loop's
+    # traced-vs-untraced ratio carries the same absolute bar — the
+    # shard plane's span recording + the coordinator's ingest ride
+    # the decode hot path, and a rolling median would ratchet creep.
+    stof = metrics.get("serving_sharded_trace_overhead_frac")
+    if stof is not None:
+        gates["serving_sharded_trace_overhead_le_002"] = bool(
+            stof <= 0.02)
 
     for key, band, label in (
         ("fabric_tcp_gbps", 0.85, "fabric_tcp_ge_085_median"),
@@ -972,6 +982,12 @@ def main() -> int:
         "serving_step_device_ms": "ms",
         "serving_host_gap_ms": "ms",
         "serving_trace_overhead_frac": "frac",
+        "serving_sharded_trace_overhead_frac": "frac",
+        "serving_sharded_trace_cost_us": "us",
+        "serving_sharded_trace_worker_us": "us",
+        "serving_sharded_trace_coord_us": "us",
+        "serving_sharded_traced_steps_per_s": "steps/s",
+        "serving_sharded_untraced_steps_per_s": "steps/s",
         "serving_traced_steps_per_s": "steps/s",
         "serving_tokens_per_s": "tok/s",
         "serving_tokens_per_s_user": "tok/s",

@@ -25,6 +25,8 @@ GL012  attribute written from >= 2 thread roots without a consistent
 GL013  lock-order inversion across thread roots, or blocking while
        holding a lock another root acquires (GL004 promoted to
        whole-held-set awareness)
+GL014  wall-clock time.time() in span/duration/deadline arithmetic
+       where time.monotonic() is required (obs/serving/parallel)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1218,6 +1220,118 @@ class CopyInTransportLoop(Rule):
                         f"sized allocation+copy on the wire path")
 
 
+# GL014 — wall-clock arithmetic where monotonic time is required
+
+
+class WallClockDurationMath(Rule):
+    """Origin: the ISSUE 11 cross-process tracing work. Every span,
+    deadline and watchdog comparison on the serving/obs/parallel
+    planes lives on the ``time.monotonic()`` axis by contract (the
+    trace.py header): the flight recorder orders fault→detect→recover
+    on one clock, ClockSync aligns WORKER monotonic clocks onto it,
+    and the scheduler's deadline math assumes a clock that cannot
+    step. One ``time.time()`` in that arithmetic breaks all three
+    silently — NTP slews and steps make wall-clock durations
+    negative or minutes long, and a wall timestamp compared against a
+    monotonic one is garbage ALWAYS, not just during a step. The bug
+    is invisible in review because both spell ``time.???()`` and both
+    return floats in seconds.
+
+    Fires on: a ``time.time()`` call (attribute form, or bare
+    ``time()`` under ``from time import time``) in an obs/, serving/
+    or parallel/ module whose result feeds +/- arithmetic or a
+    comparison — directly, or through a name assigned from it in the
+    same scope.
+
+    Near-misses that stay silent: ``time.time()`` recorded as a VALUE
+    (a log field, a JSON wall_time stamp, a return) — wall time is
+    the right clock for human-facing timestamps; and every
+    ``time.monotonic()``/``perf_counter()`` use, obviously."""
+
+    rule_id = "GL014"
+    severity = SEVERITY_ERROR
+    title = "wall-clock time.time() in duration/deadline arithmetic"
+    hint = ("use time.monotonic() for anything subtracted, compared "
+            "or used as a deadline — wall clocks slew and step under "
+            "NTP; keep time.time() only for human-facing timestamps "
+            "that are never arithmetic operands")
+
+    def _is_wall_call(self, call: ast.Call, bare_ok: bool) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "time" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == "time":
+            return True
+        return (bare_ok and isinstance(f, ast.Name)
+                and f.id == "time")
+
+    @staticmethod
+    def _scopes(module: Module):
+        """Function bodies plus the module's top level (import-time
+        deadline math is still deadline math), GL003-style."""
+        yield module.tree, "<module>"
+        for fn, qual in module.functions:
+            yield fn, qual
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("obs", "serving", "parallel"):
+            return
+        bare_ok = any(
+            isinstance(n, ast.ImportFrom) and n.module == "time"
+            and any(a.name == "time" for a in n.names)
+            for n in ast.walk(module.tree))
+        for scope, qual in self._scopes(module):
+            calls = []
+            math_names: Set[str] = set()
+            direct: Set[int] = set()
+            assigned: Dict[str, List[ast.Call]] = {}
+            for n in _walk_through_lambdas(scope):
+                if isinstance(n, ast.Call) \
+                        and self._is_wall_call(n, bare_ok):
+                    calls.append(n)
+                elif isinstance(n, (ast.BinOp, ast.Compare,
+                                    ast.AugAssign)):
+                    if isinstance(n, ast.BinOp) and not isinstance(
+                            n.op, (ast.Add, ast.Sub)):
+                        continue
+                    if isinstance(n, ast.AugAssign) and not isinstance(
+                            n.op, (ast.Add, ast.Sub)):
+                        continue
+                    for leaf in ast.walk(n):
+                        if isinstance(leaf, ast.Call) \
+                                and self._is_wall_call(leaf, bare_ok):
+                            direct.add(id(leaf))
+                        elif isinstance(leaf, ast.Name):
+                            math_names.add(leaf.id)
+                elif isinstance(n, ast.Assign):
+                    val = n.value
+                    if isinstance(val, ast.Call) \
+                            and self._is_wall_call(val, bare_ok):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                assigned.setdefault(
+                                    t.id, []).append(val)
+            for c in calls:
+                if id(c) in direct:
+                    yield self.finding(
+                        module, c,
+                        f"time.time() result feeds duration/deadline "
+                        f"arithmetic in '{qual}' — wall clocks slew "
+                        f"and step; this axis must be "
+                        f"time.monotonic()")
+            for name, sites in assigned.items():
+                if name in math_names:
+                    for c in sites:
+                        if id(c) in direct:
+                            continue  # already reported above
+                        yield self.finding(
+                            module, c,
+                            f"'{name} = time.time()' is later used "
+                            f"in +/-/comparison arithmetic in "
+                            f"'{qual}' — durations and deadlines "
+                            f"must be time.monotonic()")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1228,4 +1342,4 @@ def default_rules() -> List[Rule]:
             UnboundedRetryLoop(), RequestLogWithoutContext(),
             KVAcquireWithoutRelease(), UnboundedTransportRecv(),
             CopyInTransportLoop(), InconsistentLockDiscipline(),
-            LockOrderInversion()]
+            LockOrderInversion(), WallClockDurationMath()]

@@ -103,7 +103,8 @@ class FaultPlan:
             else:
                 self._specs.pop(site, None)
 
-    def _record_fired(self, site: str, spec: FaultSpec) -> None:
+    def _record_fired(self, site: str, spec: FaultSpec,
+                      extra: Optional[dict] = None) -> None:
         spec.fired += 1
         self.fired[site] = self.fired.get(site, 0) + 1
         self.fired_at.setdefault(site, []).append(time.monotonic())
@@ -114,11 +115,18 @@ class FaultPlan:
         # arm time), so the event marks when the fault STARTED.
         behavior = ("raise" if spec.exc is not None
                     else "hang" if spec.hang_s else "corrupt")
-        _obs_trace.event("fault.fired",
-                         attrs={"site": site, "behavior": behavior,
-                                "hang_s": spec.hang_s or None})
+        attrs = {"site": site, "behavior": behavior,
+                 "hang_s": spec.hang_s or None}
+        if extra:
+            # Seam-site context (e.g. the shard plane's rank): the
+            # flight recorder's per-rank `shards` section groups on
+            # it, so a kill-one-shard post-mortem shows the fault
+            # firing IN the victim rank's own tail.
+            attrs.update(extra)
+        _obs_trace.event("fault.fired", attrs=attrs)
 
-    def _arm(self, site: str) -> Optional[FaultSpec]:
+    def _arm(self, site: str,
+             attrs: Optional[dict] = None) -> Optional[FaultSpec]:
         """Count the call; return the first spec that triggers on it.
         raise/hang specs are recorded as fired here; a corrupt-only
         spec is recorded only when wrap() APPLIES it — a fire-only
@@ -139,11 +147,12 @@ class FaultPlan:
                     hit = True
                 if hit:
                     if spec.exc is not None or spec.hang_s:
-                        self._record_fired(site, spec)
+                        self._record_fired(site, spec, extra=attrs)
                     return spec
             return None
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str,
+             attrs: Optional[dict] = None) -> None:
         # Drop any corruption armed by a PREVIOUS fire whose operation
         # raised before wrap() could consume it — a stale pending spec
         # must never corrupt a later, un-targeted call (and must not
@@ -151,7 +160,7 @@ class FaultPlan:
         pend = getattr(self._pending, "by_site", None)
         if pend:
             pend.pop(site, None)
-        spec = self._arm(site)
+        spec = self._arm(site, attrs=attrs)
         if spec is None:
             return
         if spec.hang_s:
@@ -204,11 +213,14 @@ def active_plan() -> Optional[FaultPlan]:
     return _plan
 
 
-def fire(site: str) -> None:
-    """Seam hook, pre-operation. No-op unless a plan is installed."""
+def fire(site: str, attrs: Optional[dict] = None) -> None:
+    """Seam hook, pre-operation. No-op unless a plan is installed.
+    ``attrs`` merge into the fault.fired span event (site context the
+    site string alone can't carry structurally — the shard plane
+    passes its rank)."""
     p = _plan
     if p is not None:
-        p.fire(site)
+        p.fire(site, attrs=attrs)
 
 
 def wrap(site: str, result):

@@ -23,6 +23,7 @@ import logging
 import os
 import tempfile
 import time
+from collections import deque
 from datetime import datetime, timezone
 from typing import Optional
 
@@ -43,7 +44,7 @@ class FlightRecorder:
     def __init__(self, tracer: Optional[Tracer] = None,
                  flight_dir: Optional[str] = None, keep: int = 24,
                  registry=None, prefix: str = "flight",
-                 max_spans: int = 2048):
+                 max_spans: int = 2048, shard_tail: int = 128):
         self._tracer = tracer
         self.flight_dir = (flight_dir if flight_dir is not None
                            else default_flight_dir())
@@ -56,6 +57,10 @@ class FlightRecorder:
         # ring would make every replica death pay a multi-hundred-ms
         # serialization bill.
         self.max_spans = int(max_spans)
+        # Per-rank span tail for the `shards` section (ISSUE 11): a
+        # chaos post-mortem needs the victim rank's last moments even
+        # when a busy coordinator flooded the main tail.
+        self.shard_tail = int(shard_tail)
 
     @property
     def tracer(self) -> Tracer:
@@ -68,6 +73,33 @@ class FlightRecorder:
                  write: bool = True) -> dict:
         tracer = self.tracer
         spans = tracer.spans_snapshot()
+        # The `shards` section (ISSUE 11): every rank-attributed span
+        # (shard.compute/reduce_blocked/encode, fabric.*, a rank-
+        # stamped fault.fired) grouped per rank, tail-bounded PER RANK
+        # and taken from the FULL snapshot before the main tail
+        # truncates — a kill-one-shard post-mortem must show the
+        # victim's fault firing and its peers' reduce stalls even when
+        # the coordinator's own spans flooded the recent end. Foreign
+        # spans arrive clock-aligned (Tracer.ingest shifted them) with
+        # their offset+uncertainty stamped, so ordering claims across
+        # the section carry their own error bars.
+        shards: dict = {}
+        for sp in spans:
+            rank = sp.attrs.get("rank") if sp.attrs else None
+            if rank is None:
+                continue
+            tail = shards.get(str(rank))
+            if tail is None:
+                # deque(maxlen): O(1) eviction, and to_dict() runs
+                # only over the KEPT tail below — this is the
+                # supervisor's synchronous failure path, where a
+                # rank-heavy 16k ring must not pay dict
+                # materialization for spans it immediately discards.
+                tail = shards[str(rank)] = deque(
+                    maxlen=self.shard_tail)
+            tail.append(sp)
+        shards = {rank: [sp.to_dict() for sp in tail]
+                  for rank, tail in shards.items()}
         truncated = len(spans) - self.max_spans
         if truncated > 0:
             spans = spans[-self.max_spans:]
@@ -83,6 +115,8 @@ class FlightRecorder:
             "spans": [sp.to_dict() for sp in spans],
             "decisions": tracer.decisions_snapshot(),
         }
+        if shards:
+            data["shards"] = shards
         if extra:
             data["extra"] = extra
         if self.registry is not None:

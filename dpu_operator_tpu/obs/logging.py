@@ -32,7 +32,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from typing import Iterator, Optional
 
-CONTEXT_FIELDS = ("request_id", "replica", "component")
+CONTEXT_FIELDS = ("request_id", "replica", "component", "rank")
 
 _ctx = threading.local()
 

@@ -224,17 +224,66 @@ class Tracer:
     def record_span(self, name: str, t0: float, t1: float,
                     request_id: Optional[str] = None,
                     parent_id: Optional[int] = None,
-                    attrs: Optional[dict] = None) -> Optional[int]:
+                    attrs: Optional[dict] = None,
+                    span_id: Optional[int] = None) -> Optional[int]:
         """Record a completed span from timestamps the caller already
         measured (time.monotonic) — the scheduler's step segments come
         in this way, so tracing adds no clock calls of its own there.
-        Returns the span id (for explicit child parenting)."""
+        Returns the span id (for explicit child parenting).
+
+        ``span_id`` takes a previously ``reserve_id()``-ed id: the
+        cross-process pattern where an id must be SHIPPED to workers at
+        submit time (they parent on it) while the span itself is only
+        recordable at collect, when its duration exists."""
         if not self.enabled:
             return None
-        sid = next(_ids)
+        sid = span_id if span_id is not None else next(_ids)
         self._record((name, sid, parent_id, request_id, "span",
                       t0, t1, attrs))
         return sid
+
+    def reserve_id(self) -> int:
+        """Allocate a span id with nothing recorded yet — the
+        cross-process parent hand-off (see record_span's span_id)."""
+        return next(_ids)
+
+    def ingest(self, wire_spans, offset: float = 0.0,
+               attrs: Optional[dict] = None) -> int:
+        """Record another process's finished spans (obs.xproc wire
+        lists: [name, sid, parent, rid, kind, t0, t1, attrs]).
+
+        Foreign span ids live in the WORKER's counter and collide with
+        local ids, so every shipped id is remapped to a fresh local
+        one; parent links INSIDE the shipment follow the map, a parent
+        id a shipment doesn't carry is dropped (its span was lost to
+        the worker's bounded buffer — a dangling link must not alias a
+        local span), and a parent in the COORDINATOR's id space rides
+        ``attrs["xparent"]`` and passes through verbatim. Timestamps
+        shift by ``-offset`` (offset = remote_clock - local_clock, the
+        ClockSync estimate) onto the local monotonic axis; ``attrs``
+        merge into every span (the offset/uncertainty stamp). Stays on
+        the lock-light tuple path — ingest is a collect-leg cost.
+        Returns the number of spans recorded."""
+        if not self.enabled or not wire_spans:
+            return 0
+        idmap = {w[1]: next(_ids) for w in wire_spans}
+        n = 0
+        for name, sid, parent, rid, kind, t0, t1, sattrs in wire_spans:
+            # The shipment's attr dicts are OWNED here (parsed off the
+            # wire, shared with nobody) — mutated in place rather than
+            # copied: ingest runs per rank per step on the collect leg.
+            a = sattrs if sattrs is not None else {}
+            xparent = a.pop("xparent", None)
+            if parent is not None:
+                parent = idmap.get(parent)
+            if parent is None and xparent is not None:
+                parent = xparent
+            if attrs:
+                a.update(attrs)
+            self._record((name, idmap[sid], parent, rid, kind,
+                          t0 - offset, t1 - offset, a))
+            n += 1
+        return n
 
     def decision(self, kind: str, **attrs) -> None:
         """Append one scheduler decision to the bounded decision log
@@ -289,6 +338,60 @@ class Tracer:
             return sorted(self._ring,
                           key=lambda s: (s.t0, s.span_id))
 
+    def drain_take(self) -> List[Span]:
+        """Drain AND consume as Span objects — the materializing
+        convenience over drain_take_wire() (one consume
+        implementation; this wrapper only shapes the result). Taken
+        spans are not 'dropped' (they were delivered); the loss
+        counters keep their meaning."""
+        out = []
+        for name, sid, parent, rid, kind, t0, t1, attrs in                 self.drain_take_wire():
+            sp = Span(name, sid, parent, rid, t0, kind=kind,
+                      attrs=attrs)
+            sp.t1 = t1
+            out.append(sp)
+        return out
+
+    def drain_take_wire(self) -> List[tuple]:
+        """drain_take for the PER-STEP ship path: consume everything
+        as wire-order tuples — (name, span_id, parent_id, request_id,
+        kind, t0, t1, attrs), exactly the hot-path record format and
+        exactly obs.xproc's wire layout — WITHOUT materializing Span
+        objects that the next json.dumps would only take apart again.
+        This runs once per worker step, so its cost is decode-loop
+        overhead (priced by bench_serving section 10)."""
+        with self._lock:
+            out: List[tuple] = []
+            live: List[_ThreadBuf] = []
+            for buf in self._bufs:
+                while True:
+                    try:
+                        item = buf.spans.popleft()
+                    except IndexError:
+                        break
+                    if type(item) is tuple:
+                        out.append(item)
+                    else:
+                        out.append((item.name, item.span_id,
+                                    item.parent_id, item.request_id,
+                                    item.kind, item.t0, item.t1,
+                                    item.attrs))
+                if buf.spans or buf.thread.is_alive():
+                    live.append(buf)
+                else:
+                    self._buf_dropped_collected += buf.dropped
+            self._bufs = live
+            while True:
+                try:
+                    sp = self._ring.popleft()
+                except IndexError:
+                    break
+                out.append((sp.name, sp.span_id, sp.parent_id,
+                            sp.request_id, sp.kind, sp.t0, sp.t1,
+                            sp.attrs))
+        out.sort(key=lambda w: (w[5], w[1]))
+        return out
+
     def dropped_total(self) -> int:
         """Monotonic count of spans lost to either bound (thread buffer
         overflow before a drain, or ring-capacity eviction). Drains
@@ -316,15 +419,62 @@ class Tracer:
 
     def request_spans(self, request_id: str) -> List[Span]:
         """Every span owned by the request (span.request_id) or linked
-        to it (request_ids attr — shared spans like decode steps)."""
-        out = []
-        for sp in self.spans_snapshot():
-            if sp.request_id == request_id:
+        to it (request_ids attr — shared spans like decode steps),
+        PLUS the descendant closure of the linked set: a shard
+        worker's ``shard.compute``/``shard.reduce_blocked`` spans
+        carry no request id of their own — they parent on the
+        coordinator's ``shard.step`` span, which carries the occupant
+        list — so the tree walks down through parent links to pull
+        them in (one snapshot; closure is bounded by tree depth)."""
+        snapshot = self.spans_snapshot()
+        out: List[Span] = []
+        have: set = set()
+        rest: List[Span] = []
+        for sp in snapshot:
+            linked = sp.attrs.get("request_ids") if sp.attrs else None
+            if sp.request_id == request_id or (
+                    linked and request_id in linked):
                 out.append(sp)
+                have.add(sp.span_id)
             else:
-                linked = sp.attrs.get("request_ids")
-                if linked and request_id in linked:
+                rest.append(sp)
+        changed = bool(have)
+        while changed and rest:
+            changed = False
+            keep = []
+            for sp in rest:
+                if sp.parent_id in have:
                     out.append(sp)
+                    have.add(sp.span_id)
+                    changed = True
+                else:
+                    keep.append(sp)
+            rest = keep
+        return out
+
+    def recent_requests(self, limit: int = 20) -> List[dict]:
+        """The /debug/traces discoverability listing: the most
+        recently active request ids still in the ring, newest first,
+        each with its span count and activity window — the handles an
+        operator who doesn't have an X-Request-Id in hand can start
+        from."""
+        info: Dict[str, dict] = {}
+        for sp in self.spans_snapshot():
+            rid = sp.request_id
+            if rid is None:
+                continue
+            d = info.get(rid)
+            if d is None:
+                d = info[rid] = {"request_id": rid, "spans": 0,
+                                 "t0": sp.t0, "t_last": sp.t1}
+            d["spans"] += 1
+            d["t0"] = min(d["t0"], sp.t0)
+            d["t_last"] = max(d["t_last"], sp.t1)
+        out = sorted(info.values(), key=lambda d: d["t_last"],
+                     reverse=True)[:max(1, int(limit))]
+        for d in out:
+            d["t0"] = round(d["t0"], 6)
+            d["t_last"] = round(d["t_last"], 6)
         return out
 
     def span_tree(self, request_id: str) -> dict:

@@ -75,7 +75,12 @@ from .quantize import FRAME_HEADER
 DEFAULT_STREAMS = int(os.environ.get("DPU_RING_STREAMS", "1"))
 DEFAULT_CHUNK_BYTES = int(os.environ.get("DPU_RING_CHUNK_KB", "1024")) << 10
 DEFAULT_SOCKBUF = int(os.environ.get("DPU_RING_SOCKBUF_KB", "4096")) << 10
-_HELLO = struct.Struct("!III")  # (rank, stream index, codec id)
+# (rank, stream index, codec id, trace parent span id; 0 = none).
+# The trace parent (ISSUE 11) is the coordinator-space span id the
+# ring session parents its fabric.connect spans on — it rides the
+# hello so every ring member agrees on the session root even when
+# only some were spawned with it.
+_HELLO = struct.Struct("!IIIQ")
 
 
 class RingError(RuntimeError):
@@ -151,7 +156,8 @@ class RingTransport:
                  sockbuf: int = DEFAULT_SOCKBUF,
                  io_timeout: float = 120.0,
                  codec: Optional[str] = None,
-                 error_feedback: bool = False):
+                 error_feedback: bool = False,
+                 trace_parent: Optional[int] = None):
         if world < 1 or not (0 <= rank < world):
             raise RingError(f"bad ring shape rank={rank} world={world}")
         if len(peer_ips) != world:
@@ -184,6 +190,12 @@ class RingTransport:
         self._ef = (quantize.ErrorFeedback(self.codec)
                     if error_feedback and self.codec else None)
         self._codec_id = self.codec.codec_id if self.codec else 0
+        # Coordinator-space parent for this session's connect span
+        # (ISSUE 11). It lives in ANOTHER process's id space, so the
+        # span carries it as attrs["xparent"] (the obs.xproc wire
+        # convention), never as parent_id.
+        self.trace_parent = (int(trace_parent)
+                             if trace_parent else None)
         self._rx_tls = threading.local()
         self._send: List[socket.socket] = []
         self._recv: List[socket.socket] = []
@@ -206,16 +218,20 @@ class RingTransport:
         try:
             self._connect(timeout)
         except BaseException as e:
-            tr.record_span(
-                "fabric.connect", t0, time.monotonic(),
-                attrs={"rank": self.rank, "world": self.world,
-                       "ok": False, "error": str(e)[:200]})
+            attrs = {"rank": self.rank, "world": self.world,
+                     "ok": False, "error": str(e)[:200]}
+            if self.trace_parent:
+                attrs["xparent"] = self.trace_parent
+            tr.record_span("fabric.connect", t0, time.monotonic(),
+                           attrs=attrs)
             self.close()
             raise
-        tr.record_span(
-            "fabric.connect", t0, time.monotonic(),
-            attrs={"rank": self.rank, "world": self.world, "ok": True,
-                   "dial_attempts": self._dial_attempts})
+        attrs = {"rank": self.rank, "world": self.world, "ok": True,
+                 "dial_attempts": self._dial_attempts}
+        if self.trace_parent:
+            attrs["xparent"] = self.trace_parent
+        tr.record_span("fabric.connect", t0, time.monotonic(),
+                       attrs=attrs)
 
     def _connect(self, timeout: float) -> None:
         nxt = self.peer_addrs[(self.rank + 1) % self.world]
@@ -268,7 +284,8 @@ class RingTransport:
             # untracked socket would leak through the close() the
             # connect() wrapper runs on failure.
             self._send.append(s)
-            s.sendall(_HELLO.pack(self.rank, idx, self._codec_id))
+            s.sendall(_HELLO.pack(self.rank, idx, self._codec_id,
+                                  self.trace_parent or 0))
         self._dial_attempts = attempts
 
         accepted: dict = {}
@@ -280,7 +297,8 @@ class RingTransport:
                     c.settimeout(self.io_timeout)
                     hello = bytearray(_HELLO.size)
                     _recv_exact(c, memoryview(hello))
-                    peer, idx, peer_codec = _HELLO.unpack(bytes(hello))
+                    peer, idx, peer_codec, peer_tp = \
+                        _HELLO.unpack(bytes(hello))
                 except BaseException:
                     c.close()
                     raise
@@ -296,6 +314,12 @@ class RingTransport:
                 if peer != prev_rank or idx in accepted:
                     c.close()
                     continue
+                if self.trace_parent is None and peer_tp:
+                    # Adopt the session root from a peer that has one:
+                    # the ring's connect spans all hang off the same
+                    # coordinator span regardless of which rank the
+                    # coordinator handed the id to.
+                    self.trace_parent = peer_tp
                 accepted[idx] = c
         except BaseException as e:
             # Any accept-phase failure (timeout, half-sent hello, …)
@@ -599,9 +623,12 @@ class RingTransport:
             [None] * len(cl) for cl in chunk_lists]
         errors: List[BaseException] = []
 
+        tr = obs_trace.get_tracer()
+
         def sender(stream: int) -> None:
             try:
                 sock = self._send[stream]
+                traced = tr.enabled
                 for k, (snd, _rcv, _red) in enumerate(items):
                     cl = self._codec_chunks(seg[snd])
                     for c in range(stream, len(cl), self.streams):
@@ -617,18 +644,38 @@ class RingTransport:
                             # only — the rank's OWN contribution, the
                             # reduction traffic whose residual repeats
                             # shape-stably across calls.
+                            ts = time.monotonic() if traced else 0.0
                             if k == 0 and self._ef is not None:
                                 wire, scale = self._ef.encode(
                                     flat[lo:hi], slot=c)
                             else:
                                 wire, scale = codec.encode(flat[lo:hi])
+                            if traced:
+                                # Per-block codec cost on the wire
+                                # path (ISSUE 11 span taxonomy: the
+                                # shard plane is this path's primary
+                                # consumer).
+                                tr.record_span(
+                                    "shard.encode", ts,
+                                    time.monotonic(),
+                                    attrs={"rank": self.rank,
+                                           "step": k, "block": c,
+                                           "codec": self.codec_name})
                             self._send_frame(sock, scale, wire)
                         elif k == n_rs:
                             # First ag hop: I own this segment's final
                             # sum. Encode once, keep the decode of my
                             # own encoding (every peer will decode the
                             # same bytes — bit-identity by sharing).
+                            ts = time.monotonic() if traced else 0.0
                             wire, scale = codec.encode(flat[lo:hi])
+                            if traced:
+                                tr.record_span(
+                                    "shard.encode", ts,
+                                    time.monotonic(),
+                                    attrs={"rank": self.rank,
+                                           "step": k, "block": c,
+                                           "codec": self.codec_name})
                             self._send_frame(sock, scale, wire)
                             codec.decode(wire, hi - lo, scale,
                                          out=flat[lo:hi])
@@ -681,17 +728,26 @@ class RingTransport:
         sent = [threading.Event() for _ in cl]
         errors: List[BaseException] = []
 
+        tr = obs_trace.get_tracer()
+
         def sender(stream: int) -> None:
             try:
                 sock = self._send[stream]
+                traced = tr.enabled
                 for c in range(stream, len(cl), self.streams):
                     lo, hi = cl[c]
                     faults.fire("fabric.send")
+                    ts = time.monotonic() if traced else 0.0
                     if self._ef is not None:
                         wire, scale = self._ef.encode(flat[lo:hi],
                                                       slot=c)
                     else:
                         wire, scale = codec.encode(flat[lo:hi])
+                    if traced:
+                        tr.record_span(
+                            "shard.encode", ts, time.monotonic(),
+                            attrs={"rank": self.rank, "block": c,
+                                   "codec": self.codec_name})
                     self._send_frame(sock, scale, wire)
                     codec.decode(wire, hi - lo, scale,
                                  out=flat[lo:hi])

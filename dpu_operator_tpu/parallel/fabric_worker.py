@@ -260,10 +260,19 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     proto_out = protocol_stdout()  # everything else goes to stderr
+    # JSON-lines diagnostics (ISSUE 11 satellite): same stderr the
+    # protocol guard just secured; rank binds once via context() so a
+    # multi-worker log merge greps by rank like the serving plane
+    # greps by request id.
+    from ..obs import logging as obs_logging
+
+    obs_logging.setup("fabric_worker", stream=sys.stderr)
+    _log = logging.getLogger("fabric_worker")
+    _rank_ctx = obs_logging.context(rank=args.process_id)
+    _rank_ctx.__enter__()  # process-lifetime binding; exits with us
 
     def trace(msg):  # progress to stderr so a hang is attributable
-        print(f"fabric-worker[{args.process_id}] {msg}",
-              file=sys.stderr, flush=True)
+        _log.info(msg)
 
     _pin_cpu_backend(args.bind_ip)
     import jax

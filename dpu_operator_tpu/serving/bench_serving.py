@@ -72,6 +72,20 @@ Sections:
      overlap claim is made against), plus
      serving_sharded_vs_local_frac and serving_shard_step_skew_ms
      (informational: the fabric tax and the shard imbalance).
+ 10. cross-process tracing overhead (ISSUE 11): a DIRECT-COST
+     decomposition — the exact per-step op sequences the traced shard
+     plane adds (worker: records + harvest + ship flush + spans json;
+     coordinator: shard.step + per-rank ClockSync + ingest, ×world)
+     measured in a deterministic tight loop, divided by the untraced
+     sharded pipelined step wall (section-9 cost model through the
+     executor seam) → serving_sharded_trace_overhead_frac (absolute
+     gate <= 0.02), with serving_sharded_trace_worker/coord_us and
+     traced/untraced seam steps/s alongside. Three throughput-ratio
+     designs were measured and rejected (GIL-convoy amplification on
+     thread shards; 3-5x cgroup-throttle swings on subprocess
+     workers) — see the section docstring. The piggyback adds zero
+     protocol round trips by construction; this section prices its
+     CPU side.
 
 Protocol: exactly one JSON object on stdout; progress on stderr.
 """
@@ -406,6 +420,179 @@ def trace_overhead(slots: int, model: dict, n_req: int, toks: int,
         max(0.0, 1.0 - statistics.median(ratios)), 4)
     trace(f"trace overhead: {out['serving_trace_overhead_frac']} "
           f"(median of {len(ratios)} paired ratios)")
+    return out
+
+
+def sharded_trace_overhead(slots: int, trace, world: int = 3,
+                           iters: int = 4000,
+                           step_ms: float = 2.0,
+                           coll_ms: float = 1.0) -> dict:
+    """Section 10 (ISSUE 11): the always-on price of CROSS-PROCESS
+    tracing, as a DIRECT-COST decomposition:
+
+      serving_sharded_trace_overhead_frac =
+          (per-step tracing cost) / (untraced sharded step wall)
+
+    The numerator is measured as a tight loop over the EXACT per-step
+    op sequences the traced plane adds — the worker side (reserve +
+    shard.compute/reduce_blocked records + tracer harvest + ship
+    flush + the reply's spans json) and the coordinator side
+    (shard.step reserve/record + per-rank ClockSync.observe/estimate
+    + per-rank Tracer.ingest of a representative shipment, ×world) —
+    deterministic CPU-bound work a throttled container measures to µs
+    precision. The denominator is the untraced sharded pipelined step
+    wall: the section-9 cost model (2 ms compute + 1 ms collective)
+    driven through the FabricExecutor seam with one step in flight,
+    median of 3 runs.
+
+    Why not a traced-vs-untraced throughput ratio like section 7?
+    Three of them were built and rejected with data: (a) synthetic
+    thread shards share the GIL with the coordinator, so µs of
+    coordinator-side recording amplify through the interpreter's 5 ms
+    switch interval into a fake ~7% "overhead" no multi-process
+    deployment pays; (b/c) real shard_worker subprocesses (world 1
+    and 2) put the effect under genuine shipping, but this
+    cpu-share-throttled container swings identical runs 3-5x, so a
+    ±2% bound is unresolvable at any affordable repeat count (pair
+    ratios observed 0.6-2.2). The direct decomposition prices every
+    op the traced plane adds to the hot path — a regression in any of
+    them (a slow record, an O(n²) ingest, a leaking harvest) moves
+    the numerator immediately — while staying deterministic. Gated
+    ABSOLUTE ≤ 0.02 in bench.py. The piggyback itself adds zero
+    protocol round trips by construction (spans/metrics/clock stamps
+    ride reply frames that exist anyway); the json term above is its
+    entire marginal wire-side CPU."""
+    import json as _json
+    import statistics
+    import time as _time
+
+    from ..obs import trace as obs_trace
+    from ..obs.xproc import ClockSync, SpanShip
+    from ..utils.metrics import Registry
+    from .sharded import FabricExecutor, SyntheticShardSet
+
+    import numpy as np
+
+    d = 16
+    out: dict = {}
+
+    # -- numerator: per-step tracing cost, worker side ------------------------
+    wtr = obs_trace.Tracer()
+    ship = SpanShip(cap=512)
+    wreg = Registry()
+    t0 = _time.perf_counter()
+    for k in range(iters):
+        sid = wtr.reserve_id()
+        m = _time.monotonic()
+        wtr.record_span("shard.reduce_blocked", m, m + 0.001,
+                        parent_id=sid,
+                        attrs={"rank": 0, "step": k, "stage": 0})
+        wtr.record_span("shard.compute", m, m + 0.002, span_id=sid,
+                        attrs={"rank": 0, "step": k,
+                               "compute_s": 0.001,
+                               "collective_s": 0.001,
+                               "xparent": 12345})
+        wreg.observe("shard_step_compute_seconds", 0.001)
+        wreg.observe("shard_step_collective_seconds", 0.001)
+        wreg.counter_inc("shard_steps_total")
+        ship.harvest(wtr)
+        wire = ship.flush()
+        _json.dumps({"op": "tokens", "step": k, "compute_s": 0.001,
+                     "collective_s": 0.001, "t_rx": m, "t_tx": m,
+                     "spans": wire, "spans_dropped": 0})
+    worker_us = (_time.perf_counter() - t0) / iters * 1e6
+
+    # -- numerator: coordinator side (ingest scales with world) ---------------
+    def rank_shipment(r):
+        # FRESH tuples+dicts per iteration, like the real path (each
+        # reply's spans parse off the wire into new objects): ingest
+        # takes ownership and mutates attrs in place, so reusing one
+        # shipment would measure the xparent branch exactly once and
+        # alias every ingested span onto one dict.
+        return [
+            ("shard.compute", 2 * r + 1, None, None, "span", 1.0,
+             1.002, {"rank": r, "step": 1, "compute_s": 0.001,
+                     "collective_s": 0.001, "xparent": 12345}),
+            ("shard.reduce_blocked", 2 * r + 2, 2 * r + 1, None,
+             "span", 1.0, 1.001, {"rank": r, "step": 1, "stage": 0}),
+        ]
+
+    ctr = obs_trace.Tracer()
+    syncs = [ClockSync() for _ in range(world)]
+    rids = [f"req-{i}" for i in range(slots)]
+    coord_acc = 0.0
+    for k in range(iters):
+        # Shipment construction sits OUTSIDE the timed region: on the
+        # real path those dicts come off the wire via recv_msg's json
+        # parse — protocol cost, not the tracing plane's.
+        ships = [rank_shipment(r) for r in range(world)]
+        t0 = _time.perf_counter()
+        sid = ctr.reserve_id()
+        m = _time.monotonic()
+        ctr.record_span("shard.step", m, m + 0.003, span_id=sid,
+                        attrs={"replica": "bench", "step": k,
+                               "world": world, "codec": "fp32",
+                               "request_ids": rids})
+        for r in range(world):
+            syncs[r].observe(m, m + 0.0005, m + 0.0025, m + 0.003)
+            off, unc = syncs[r].estimate
+            ctr.ingest(ships[r], offset=off,
+                       attrs={"clock_offset_s": round(off, 6),
+                              "clock_unc_s": round(unc, 6)})
+        coord_acc += _time.perf_counter() - t0
+        if k % 64 == 0:
+            # Realistic ring churn: a server's scrape path drains.
+            ctr.clear()
+    coord_us = coord_acc / iters * 1e6
+
+    # -- denominator + informational steps/s: the seam loop -------------------
+    def seam_run(ex, n_steps=200):
+        row = np.ones(d, np.float32)
+        t0 = _time.perf_counter()
+        prev = ex.submit([(0, row)], occupants=rids[:1])
+        for _ in range(n_steps - 1):
+            h = ex.submit([(0, row)], occupants=rids[:1])
+            # Bounded inside: FabricExecutor.collect gathers under
+            # its own step_timeout_s deadline (the GL010 contract
+            # lives one layer down).
+            ex.collect(prev)  # graftlint: disable=GL010
+            prev = h
+        ex.collect(prev)
+        return n_steps / (_time.perf_counter() - t0)
+
+    tr = obs_trace.get_tracer()
+    rates = {"on": [], "off": []}
+    ex = FabricExecutor(
+        SyntheticShardSet(world=world, slots=slots, d=d, seed=7,
+                          step_time_s=step_ms / 1000.0,
+                          collective_time_s=coll_ms / 1000.0),
+        mode="pipelined", name="trace-bench")
+    try:
+        ex.reset()
+        for arm in ("on", "off"):
+            tr.enabled = arm == "on"
+            seam_run(ex, n_steps=50)  # warm-up
+            for _ in range(3):
+                rates[arm].append(seam_run(ex))
+            tr.clear()
+    finally:
+        tr.enabled = True
+        ex.close()
+    step_wall_us = 1e6 / statistics.median(rates["off"])
+
+    frac = (worker_us + coord_us) / step_wall_us
+    out["serving_sharded_trace_cost_us"] = round(
+        worker_us + coord_us, 1)
+    out["serving_sharded_trace_worker_us"] = round(worker_us, 1)
+    out["serving_sharded_trace_coord_us"] = round(coord_us, 1)
+    out["serving_sharded_traced_steps_per_s"] = round(
+        statistics.median(rates["on"]), 1)
+    out["serving_sharded_untraced_steps_per_s"] = round(
+        statistics.median(rates["off"]), 1)
+    out["serving_sharded_trace_overhead_frac"] = round(frac, 4)
+    trace(f"sharded trace overhead: worker {worker_us:.1f}us + "
+          f"coord {coord_us:.1f}us per step over a "
+          f"{step_wall_us:.0f}us untraced step = {frac:.4f}")
     return out
 
 
@@ -895,6 +1082,15 @@ def main(argv: Optional[list] = None) -> int:
     except Exception as e:
         out["serving_sharded_error"] = str(e)[:200]
         trace(f"sharded-decode section failed: {e}")
+
+    # 10: cross-process tracing overhead (ISSUE 11) — the section-9
+    # sharded pipelined loop, traced vs untraced, paired interleaved;
+    # gated absolute (<= 0.02) in bench.py like section 7.
+    try:
+        out.update(sharded_trace_overhead(args.slots, trace))
+    except Exception as e:
+        out["serving_sharded_trace_error"] = str(e)[:200]
+        trace(f"sharded-trace-overhead section failed: {e}")
 
     # 4: the real jitted path — forward-only train_step model on a mesh.
     if not args.skip_local:

@@ -184,13 +184,16 @@ class Executor:
         self._resident = np.zeros((self.slots, self.d), np.float32)
 
     def submit(self, updates: Sequence[Update], step=None,
-               request_ids=None):
+               request_ids=None, occupants=None):
         """Apply slot updates, dispatch one decode step; returns an
         opaque handle for collect(). Base implementation runs the step
         eagerly on the caller's thread. `step`/`request_ids` are
         diagnostic context for overflow errors (see
-        DecodeStep.__call__); the eager path has no fixed-shape limit
-        and ignores them."""
+        DecodeStep.__call__); `occupants` is the full occupant
+        request-id list, trace-only context (the sharded coordinator
+        stamps it on its per-step shard.step span so worker spans
+        link into each occupant's tree); the eager path has no
+        fixed-shape limit and ignores them."""
         if self._resident is None:
             self.reset()
         for i, row in updates:
@@ -284,7 +287,7 @@ class LocalExecutor(Executor):
             super().reset()
 
     def submit(self, updates: Sequence[Update], step=None,
-               request_ids=None):
+               request_ids=None, occupants=None):
         if not self.pipelined:
             return super().submit(updates)
         # Async dispatch: both returned arrays are futures; the state
@@ -358,7 +361,7 @@ class SyntheticExecutor(Executor):
         self._worker.reset()
 
     def submit(self, updates: Sequence[Update], step=None,
-               request_ids=None):
+               request_ids=None, occupants=None):
         if not self.pipelined:
             return super().submit(updates)
         if self._resident is None:
@@ -655,6 +658,12 @@ class ReplicaPool:
             log.error("replica%d: breaker OPEN (%d failures in %.0fs) "
                       "— parked, pool degraded",
                       i, len(window), self.breaker_window_s)
+            # Publish BEFORE the flight snapshot: the snapshot is
+            # disk I/O that can take >100 ms on a loaded box, and a
+            # scraper reading serving_pool_replicas inside that
+            # window must not see the replica parked in states() but
+            # not in the gauge (observed as a full-suite flake).
+            self._publish_state()
             self._flight_snapshot("breaker_open", replica=i)
         else:
             delay = min(self.restart_backoff_cap_s,

@@ -311,7 +311,8 @@ class KVExecutorBase(Executor):
             self._backend_reset()
 
     def submit(self, updates: Sequence = (), step=None,
-               request_ids=None, gen: Optional[int] = None):
+               request_ids=None, gen: Optional[int] = None,
+               occupants=None):
         """Plan and dispatch one fused step. `updates` is unused (the
         KV plane assembles its own token window from slot state);
         `gen` (from kv_gen(), captured under the batcher's settle

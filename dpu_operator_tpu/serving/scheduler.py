@@ -561,8 +561,13 @@ class ContinuousBatcher:
                     # step must name the step and the admitting
                     # requests (the seize path can race admissions
                     # close to the slot limit).
+                    # occupants is trace-only context: a sharded
+                    # executor stamps it on its shard.step span so
+                    # the worker-side subtree links into every
+                    # occupant's /debug/traces tree (ISSUE 11).
                     handle = ex.submit(updates, step=self.steps + 1,
-                                       request_ids=admit_rids or None)
+                                       request_ids=admit_rids or None,
+                                       occupants=cur_rids)
                     self.blocked_since = None
                     self.steps += 1
                     if traced:

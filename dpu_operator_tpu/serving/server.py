@@ -216,13 +216,36 @@ class ServingServer:
                 if parsed.path == "/debug/traces":
                     # Span tree for one request: queue → admit →
                     # per-step → retire (+ any recovery chain), JSON.
-                    rid = (parse_qs(parsed.query)
-                           .get("request_id", [None])[0])
+                    # ?recent=N lists the most recently active
+                    # request ids instead — the discoverability mode
+                    # for an operator with no X-Request-Id in hand.
+                    qs = parse_qs(parsed.query)
+                    recent = qs.get("recent", [None])[0]
+                    if recent is not None:
+                        try:
+                            n = int(recent)
+                            if not 1 <= n <= 1000:
+                                raise ValueError(recent)
+                        except (TypeError, ValueError):
+                            return self._send(
+                                400, {"error": "recent must be an "
+                                               "int in [1, 1000]"})
+                        return self._send(
+                            200, {"recent":
+                                  server_ref.tracer
+                                  .recent_requests(n)})
+                    rid = qs.get("request_id", [None])[0]
                     if not rid:
                         return self._send(
-                            400, {"error": "need ?request_id="})
+                            400, {"error": "need ?request_id= "
+                                           "(or ?recent=N)"})
                     tree = server_ref.tracer.span_tree(rid)
                     if tree["span_count"] == 0:
+                        # Stable contract under concurrency: an
+                        # unknown (or fully evicted) id is ALWAYS
+                        # this 404 — span_tree works on one snapshot,
+                        # so a concurrently-draining tracer can never
+                        # surface a half-drained tree.
                         return self._send(
                             404, {"error": f"no spans for request "
                                            f"{rid!r} (evicted or "

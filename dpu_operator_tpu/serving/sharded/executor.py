@@ -40,16 +40,38 @@ exposes both on /metrics without extra wiring.
 
 from __future__ import annotations
 
-from typing import Sequence
+import math
+import time
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from ...obs import trace as obs_trace
+from ...obs.xproc import federate_labels
 from ..executor import Executor
 
 # Collective/skew distributions live at decode-step scale, same as the
 # scheduler's step histograms.
 _SHARD_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                   0.05, 0.1, 0.25, 1.0)
+
+
+class _TracedStep:
+    """One in-flight step's coordinator-side trace context: the
+    reserved shard.step span id the workers parent on, the submit
+    stamp, and the occupant request ids the recorded span will carry
+    (what links the whole shard subtree into each request's
+    /debug/traces tree)."""
+
+    __slots__ = ("sid", "t0", "rids", "step_no", "handle")
+
+    def __init__(self, sid: Optional[int], t0: float, rids,
+                 step_no: int):
+        self.sid = sid
+        self.t0 = t0
+        self.rids = list(rids) if rids else None
+        self.step_no = step_no
+        self.handle = None
 
 
 class FabricExecutor(Executor):
@@ -78,6 +100,10 @@ class FabricExecutor(Executor):
         self.name = name
         self._registry = registry
         self._step_no = 0
+        # Cross-process ingest bookkeeping (ISSUE 11): last published
+        # per-rank ship-loss total (the counter re-exports deltas so
+        # the series stays monotonic per coordinator).
+        self._ship_dropped_pub: Dict[int, int] = {}
 
     # -- wiring ---------------------------------------------------------------
 
@@ -91,17 +117,25 @@ class FabricExecutor(Executor):
 
     def reset(self) -> None:
         self._step_no = 0
+        # Reset may respawn the worker set (fresh processes, fresh
+        # cumulative counters): stale ship-loss cursors would misread
+        # the first post-respawn totals.
+        self._ship_dropped_pub.clear()
         self.shards.reset()
 
-    def submit(self, updates: Sequence, step=None, request_ids=None):
+    def submit(self, updates: Sequence, step=None, request_ids=None,
+               occupants=None):
         self._step_no += 1
-        handle = self.shards.submit(self._step_no, list(updates),
-                                    want_state=False)
+        tstep = self._begin_step(occupants or request_ids)
+        tstep.handle = self.shards.submit(self._step_no,
+                                          list(updates),
+                                          want_state=False,
+                                          trace_parent=tstep.sid)
         if self.pipelined:
-            return handle
+            return tstep
         # Sync-shape two-phase callers (the base adapter contract):
         # eager — the step completes before submit returns.
-        return self._gather(handle)
+        return self._gather(tstep)
 
     def collect(self, handle):
         if not self.pipelined:
@@ -113,11 +147,14 @@ class FabricExecutor(Executor):
         an update, the next state materializes from shard 0."""
         rows = np.asarray(x, np.float32)
         self._step_no += 1
-        handle = self.shards.submit(self._step_no,
-                                    list(enumerate(rows)),
-                                    want_state=True)
-        out = self.shards.collect(handle, timeout=self.step_timeout_s)
-        self._observe(out)
+        tstep = self._begin_step(None)
+        tstep.handle = self.shards.submit(self._step_no,
+                                          list(enumerate(rows)),
+                                          want_state=True,
+                                          trace_parent=tstep.sid)
+        out = self.shards.collect(tstep.handle,
+                                  timeout=self.step_timeout_s)
+        self._finish_step(tstep, out)
         if out.state is None:
             raise RuntimeError("shard plane returned no state for a "
                                "sync step")
@@ -128,10 +165,94 @@ class FabricExecutor(Executor):
 
     # -- internals ------------------------------------------------------------
 
-    def _gather(self, handle) -> np.ndarray:
-        out = self.shards.collect(handle, timeout=self.step_timeout_s)
-        self._observe(out)
+    def _begin_step(self, rids) -> "_TracedStep":
+        """Reserve the step's coordinator span id (ISSUE 11): workers
+        parent their shard.compute spans on it BEFORE it is recorded
+        — the span itself closes at collect, when its submit→gather
+        wall exists."""
+        tr = obs_trace.get_tracer()
+        sid = tr.reserve_id() if tr.enabled else None
+        return _TracedStep(sid, time.monotonic(), rids, self._step_no)
+
+    def _gather(self, tstep: "_TracedStep") -> np.ndarray:
+        try:
+            out = self.shards.collect(tstep.handle,
+                                      timeout=self.step_timeout_s)
+        except BaseException as e:
+            # The reserved id was already shipped: record the failed
+            # step against it so the workers' spans (and the chaos
+            # timeline) keep their parent instead of dangling.
+            tr = obs_trace.get_tracer()
+            if tstep.sid is not None and tr.enabled:
+                tr.record_span(
+                    "shard.step", tstep.t0, time.monotonic(),
+                    span_id=tstep.sid,
+                    attrs={"replica": self.name,
+                           "step": tstep.step_no,
+                           "world": int(self.shards.world),
+                           "codec": self.codec_name,
+                           "request_ids": tstep.rids,
+                           "error": type(e).__name__})
+            raise
+        self._finish_step(tstep, out)
         return out.tokens
+
+    def _finish_step(self, tstep: "_TracedStep", out) -> None:
+        tr = obs_trace.get_tracer()
+        if tstep.sid is not None and tr.enabled:
+            tr.record_span(
+                "shard.step", tstep.t0, time.monotonic(),
+                span_id=tstep.sid,
+                attrs={"replica": self.name, "step": tstep.step_no,
+                       "world": int(self.shards.world),
+                       "codec": self.codec_name,
+                       "request_ids": tstep.rids})
+        self._ingest(out, tr)
+        self._observe(out)
+
+    def _ingest(self, out, tr) -> None:
+        """Drain the shard plane's piggyback into the coordinator:
+        foreign spans onto the process tracer (clock-shifted, offset
+        and uncertainty stamped), federated metrics re-exported with
+        rank/codec labels, ship losses published as a counter."""
+        if out.spans_by_rank:
+            for rank, wires in out.spans_by_rank.items():
+                off, unc = (out.clock_by_rank or {}).get(
+                    rank, (0.0, float("inf")))
+                attrs = {"clock_offset_s": round(off, 6)}
+                if math.isfinite(unc):
+                    attrs["clock_unc_s"] = round(unc, 6)
+                else:
+                    # No round-trip estimate yet: spans land
+                    # unshifted and SAY SO — an unaligned foreign
+                    # span must not masquerade as an aligned one.
+                    off = 0.0
+                    attrs["clock_unaligned"] = True
+                tr.ingest(wires, offset=off, attrs=attrs)
+        reg = self._registry
+        if reg is None:
+            return
+        if out.span_dropped_by_rank:
+            for rank, total in out.span_dropped_by_rank.items():
+                last = self._ship_dropped_pub.get(rank, 0)
+                # A total BELOW the high-water mark means the worker
+                # respawned (fresh process, counter restarted from 0):
+                # everything it reports is new loss — resyncing the
+                # cursor without publishing would swallow it.
+                delta = total - last if total >= last else total
+                if delta > 0:
+                    reg.counter_inc(
+                        "serving_shard_trace_dropped_total",
+                        {"replica": self.name, "rank": str(rank)},
+                        by=float(delta),
+                        help="worker spans lost to the bounded "
+                             "piggyback ship buffer")
+                self._ship_dropped_pub[rank] = total
+        if out.metrics_by_rank:
+            for rank, snap in out.metrics_by_rank.items():
+                reg.apply_federated(
+                    snap, extra_labels=federate_labels(
+                        rank, self.codec_name, self.name))
 
     def _observe(self, out) -> None:
         reg = self._registry

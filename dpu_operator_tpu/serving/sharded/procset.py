@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...obs import trace as obs_trace
+from ...obs.xproc import ClockSync
 from ...parallel.topology import ring_order
 from .protocol import ProtocolError, recv_msg, send_msg
 from .shard_math import segment_bounds
@@ -94,12 +96,16 @@ class _ProcHandle:
     sockets, not here (unlike the synthetic set's per-rank reply
     board, which this deliberately is NOT)."""
 
-    __slots__ = ("gen", "step_no", "want_state")
+    __slots__ = ("gen", "step_no", "want_state", "tx")
 
     def __init__(self, gen: int, step_no: int, want_state: bool):
         self.gen = gen
         self.step_no = step_no
         self.want_state = want_state
+        # Per-rank monotonic send stamps (clock sync, ISSUE 11): the
+        # coordinator half of the NTP four-timestamp exchange the
+        # worker's reply completes.
+        self.tx: Dict[int, float] = {}
 
 
 class ShardProcessSet:
@@ -112,7 +118,8 @@ class ShardProcessSet:
                  jit: bool = True, spawn_timeout_s: float = 60.0,
                  python: str = sys.executable,
                  codec: str = "fp32", overlap: bool = False,
-                 overlap_blocks: int = 2):
+                 overlap_blocks: int = 2, span_buffer: int = 512,
+                 metrics_interval: int = 16):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         self.world = world
@@ -130,6 +137,11 @@ class ShardProcessSet:
         self.codec_name = str(codec or "fp32")
         self.overlap = bool(overlap)
         self.overlap_blocks = int(overlap_blocks)
+        # ISSUE 11 shipping knobs, handed to every worker: bounded
+        # span piggyback buffer (0 disables shipping) and the
+        # federated-metrics snapshot cadence.
+        self.span_buffer = int(span_buffer)
+        self.metrics_interval = max(1, int(metrics_interval))
         self.segments = segment_bounds(slots, world)
         self._procs: List[subprocess.Popen] = []
         self._socks: Dict[int, socket.socket] = {}
@@ -151,6 +163,11 @@ class ShardProcessSet:
         self._life = threading.RLock()
         self._outstanding: set = set()
         self.respawns = 0
+        # Per-rank monotonic clock offset estimators (ISSUE 11), fed
+        # by the send/receive stamps the step frames already carry.
+        # Reset on teardown: a respawned worker is a NEW process with
+        # a new clock.
+        self._clocks: Dict[int, ClockSync] = {}
 
     # -- rendezvous -----------------------------------------------------------
 
@@ -172,6 +189,13 @@ class ShardProcessSet:
         listener.listen(self.world + 2)
         listener.settimeout(self.spawn_timeout_s)
         cport = listener.getsockname()[1]
+        # Session root span (ISSUE 11): reserved now so the workers
+        # can parent their rendezvous spans (fabric.connect via the
+        # --trace-parent arg and the ring _HELLO) on it; recorded
+        # once the rendezvous completes.
+        tr = obs_trace.get_tracer()
+        spawn_sid = tr.reserve_id() if tr.enabled else None
+        t_spawn = time.monotonic()
         # The ring the shards reduce over: allocate one fabric address
         # per shard, then let topology.ring_order pick the canonical
         # order — rank r of the spawned set IS ring position r.
@@ -190,6 +214,10 @@ class ShardProcessSet:
                    "--peers", ",".join(ring),
                    "--seed", str(self.seed),
                    "--connect-timeout", str(self.spawn_timeout_s)]
+            if spawn_sid is not None:
+                cmd += ["--trace-parent", str(spawn_sid)]
+            cmd += ["--span-buffer", str(self.span_buffer),
+                    "--metrics-interval", str(self.metrics_interval)]
             if self._params_path:
                 cmd += ["--params-npz", self._params_path]
             if self.jit:
@@ -222,6 +250,12 @@ class ShardProcessSet:
         except (OSError, ProtocolError, ShardError):
             _reap(procs, socks, listener, kill=True)
             raise
+        if spawn_sid is not None:
+            tr.record_span(
+                "shard.spawn", t_spawn, time.monotonic(),
+                span_id=spawn_sid,
+                attrs={"world": self.world, "respawn": self.respawns,
+                       "codec": self.codec_name})
         with self._lock:
             self._listener = listener
             self._procs = procs
@@ -244,6 +278,10 @@ class ShardProcessSet:
             self._listener = None
             procs = self._procs
             self._procs = []
+            # A respawned worker is a new process with a new
+            # monotonic clock: stale offsets must not align the fresh
+            # incarnation's spans.
+            self._clocks = {}
             self._up = False
         _reap(procs, socks, listener, kill=kill)
 
@@ -273,27 +311,44 @@ class ShardProcessSet:
                 self._spawn()
                 return
             try:
-                for s in socks.values():
+                tx = {}
+                for rank, s in socks.items():
+                    tx[rank] = time.monotonic()
                     send_msg(s, {"op": "reset"})
                 for rank, s in socks.items():
                     msg, _ = recv_msg(s, timeout=self.spawn_timeout_s)
+                    t_now = time.monotonic()
                     if msg.get("op") != "ack":
                         raise ProtocolError(
                             f"shard {rank}: expected reset ack, got "
                             f"{msg.get('op')!r}")
+                    # The reset ack carries worker clock stamps too:
+                    # a first offset estimate exists before the first
+                    # step's spans need aligning.
+                    if "t_rx" in msg and "t_tx" in msg:
+                        self._clocks.setdefault(
+                            rank, ClockSync()).observe(
+                            tx[rank], float(msg["t_rx"]),
+                            float(msg["t_tx"]), t_now)
             except (OSError, ProtocolError, ShardError):
                 self._teardown(kill=True)
                 self.respawns += 1
                 self._spawn()
 
     def submit(self, step_no: int, updates: Sequence,
-               want_state: bool = False) -> _ProcHandle:
+               want_state: bool = False,
+               trace_parent=None) -> _ProcHandle:
         idx = [int(i) for i, _row in updates]
         rows = (np.stack([np.asarray(r, np.float32)
                           for _i, r in updates])
                 if updates else np.empty((0, self.d), np.float32))
         msg = {"op": "step", "step": step_no, "slots": idx,
                "want_state": bool(want_state)}
+        if trace_parent is not None:
+            # Context propagation (ISSUE 11): the coordinator's
+            # shard.step span id rides the frame; a worker that
+            # predates the field simply never reads it.
+            msg["trace_parent"] = int(trace_parent)
         payload = rows  # buffer-protocol part: sent without a copy
         with self._life:
             with self._lock:
@@ -309,7 +364,12 @@ class ShardProcessSet:
                 self._outstanding.add(handle)
                 socks = dict(self._socks)
             try:
-                for s in socks.values():
+                for rank, s in socks.items():
+                    # The clock-sync send stamp, per rank: taken
+                    # immediately before the write so queuing inside
+                    # this loop lands in the estimator's uncertainty,
+                    # not its bias.
+                    handle.tx[rank] = time.monotonic()
                     send_msg(s, msg, payload)
             except OSError as e:
                 raise ShardStepError(f"broadcast failed: {e!r}")
@@ -331,6 +391,10 @@ class ShardProcessSet:
         tokens = np.empty((self.slots,), np.int32)
         state = None
         compute, coll = [0.0] * self.world, [0.0] * self.world
+        spans_by_rank: Dict[int, list] = {}
+        clock_by_rank: Dict[int, tuple] = {}
+        metrics_by_rank: Dict[int, dict] = {}
+        span_dropped_by_rank: Dict[int, int] = {}
         try:
             for rank in range(self.world):
                 lo, hi = self.segments[rank]
@@ -362,15 +426,42 @@ class ShardProcessSet:
                         f"{msg.get('op')!r} (step "
                         f"{msg.get('step')} != {handle.step_no})",
                         rank=rank)
+                t_reply = time.monotonic()
                 seg = np.frombuffer(payload[:4 * (hi - lo)], np.int32)
                 tokens[lo:hi] = seg
                 compute[rank] = float(msg.get("compute_s", 0.0))
                 coll[rank] = float(msg.get("collective_s", 0.0))
+                # Clock sync (ISSUE 11): the reply completes the NTP
+                # four-timestamp exchange the submit stamps started.
+                # The worker's processing time sits BETWEEN its two
+                # stamps, so only genuine wire/queue time widens the
+                # uncertainty.
+                t_tx = handle.tx.get(rank)
+                if (t_tx is not None and "t_rx" in msg
+                        and "t_tx" in msg):
+                    sync = self._clocks.setdefault(rank, ClockSync())
+                    sync.observe(t_tx, float(msg["t_rx"]),
+                                 float(msg["t_tx"]), t_reply)
+                    clock_by_rank[rank] = sync.estimate
+                # Piggybacked spans + federated metrics: already paid
+                # for by the reply frame — never an extra round trip.
+                if msg.get("spans"):
+                    spans_by_rank[rank] = msg["spans"]
+                if "spans_dropped" in msg:
+                    span_dropped_by_rank[rank] = int(
+                        msg["spans_dropped"])
+                if msg.get("metrics"):
+                    metrics_by_rank[rank] = msg["metrics"]
                 if msg.get("state"):
                     state = np.frombuffer(
                         payload[4 * (hi - lo):],
                         np.float32).reshape(self.slots, self.d).copy()
-            return StepOutput(tokens, state, compute, coll)
+            return StepOutput(tokens, state, compute, coll,
+                              spans_by_rank=spans_by_rank or None,
+                              clock_by_rank=clock_by_rank or None,
+                              metrics_by_rank=metrics_by_rank or None,
+                              span_dropped_by_rank=(
+                                  span_dropped_by_rank or None))
         except ShardError:
             # A failed step leaves unread frames on the positional
             # control stream, so the only safe recovery is the

@@ -7,6 +7,23 @@ the collective's, and those ride parallel/fabric_collectives between
 the shards directly — the coordinator only ever moves scatter updates
 in and token ids out.
 
+Cross-process tracing (ISSUE 11) rides these SAME frames — the JSON
+object is free-form, so every field below is ignored by a worker (or
+coordinator) that predates it, and none adds a round trip:
+
+  * step msg → worker: ``trace_parent`` — the coordinator's reserved
+    ``shard.step`` span id; the worker's ``shard.compute`` parents on
+    it (as ``attrs["xparent"]`` — coordinator ids must never ride a
+    worker span's local ``parent_id``, the id spaces collide).
+  * every reply ← worker: ``t_rx``/``t_tx`` — the worker's monotonic
+    receive/reply stamps, completing the NTP four-timestamp exchange
+    the coordinator's ClockSync estimates clock offsets from.
+  * tokens reply ← worker: ``spans`` (obs.xproc wire lists from the
+    bounded SpanShip buffer), ``spans_dropped`` (its cumulative
+    overflow counter), and — every ``--metrics-interval`` steps —
+    ``metrics`` (a Registry.federated_snapshot() the coordinator
+    re-exports rank/codec-labelled).
+
 Every receive here takes a mandatory ``timeout`` and arms it on the
 socket before reading (the GL010 discipline: a dead or wedged peer
 surfaces as ``socket.timeout``/``ProtocolError`` in bounded time,

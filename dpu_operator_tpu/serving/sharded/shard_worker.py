@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import select
 import socket
 import sys
@@ -44,12 +45,24 @@ import time
 
 import numpy as np
 
+from ...obs import logging as obs_logging
+from ...obs import trace as obs_trace
+from ...obs.xproc import SpanShip
 from ...parallel.fabric_collectives import RingError, RingTransport
 from ...parallel.fabric_worker import protocol_stdout
+from ...utils.metrics import Registry
 from .protocol import ProtocolError, recv_msg, send_msg
 from .shard_math import (DoubleShardSlice, TpShardSlice,
                          segment_bounds)
 from .synthetic import GuardedReducer
+
+log = logging.getLogger("shard_worker")
+
+# Worker-local step-scale histogram bounds (the coordinator re-exports
+# these series verbatim, so they must match the serving plane's
+# decode-step resolution).
+_WORKER_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                   0.05, 0.1, 0.25, 1.0)
 
 
 def _ring_reducer(ring) -> GuardedReducer:
@@ -117,8 +130,7 @@ def _maybe_jit(sl, want_jit: bool, slots: int):
                 True)
     except Exception as e:  # fall back loudly, not silently
         sl.xp = np  # the numpy path must not trip over a half-swap
-        print(f"shard-worker: jit unavailable ({e!r}); numpy math",
-              file=sys.stderr, flush=True)
+        log.warning("jit unavailable (%r); numpy math", e)
         return None, None, False
 
 
@@ -160,6 +172,22 @@ def main(argv=None) -> int:
     ap.add_argument("--overlap-blocks", type=int, default=2,
                     help="row blocks per stage in overlap mode (2 = "
                          "double buffering)")
+    ap.add_argument("--trace-parent", type=int, default=0,
+                    help="coordinator span id this worker session "
+                         "parents its rendezvous spans on (ISSUE 11; "
+                         "0 = unparented). Rides the fabric _HELLO "
+                         "too, so ring peers agree on the session "
+                         "root.")
+    ap.add_argument("--span-buffer", type=int, default=512,
+                    help="bounded outbound span buffer (obs.xproc."
+                         "SpanShip): finished spans piggyback onto "
+                         "reply frames; overflow is dropped AND "
+                         "counted (shipped as spans_dropped). 0 "
+                         "disables shipping entirely.")
+    ap.add_argument("--metrics-interval", type=int, default=16,
+                    help="ship a federated metrics snapshot every N "
+                         "steps (piggybacked on the reply — never an "
+                         "extra round trip)")
     ap.add_argument("--connect-timeout", type=float, default=30.0)
     ap.add_argument("--idle-timeout", type=float, default=300.0,
                     help="control-socket wait interval: idle is NOT "
@@ -173,17 +201,42 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     proto_out = protocol_stdout()  # stdout carries ONLY the summary
+    # JSON-lines logging on stderr (satellite of ISSUE 11): the
+    # protocol_stdout guard above already repointed every stream
+    # handler, so setup() landing on stderr cannot touch the one-line
+    # stdout protocol. Rank binds once via context() — every record
+    # this process emits carries it.
+    obs_logging.setup("shard_worker", stream=sys.stderr)
+    with obs_logging.context(rank=args.rank):
+        return _serve(args, proto_out)
 
-    def trace(msg):
-        print(f"shard-worker[{args.rank}] {msg}", file=sys.stderr,
-              flush=True)
 
+def _serve(args, proto_out) -> int:
+    trace = log.info
     sl = _load_slice(args)
     partial_fn, finish_fn, jitted = _maybe_jit(sl, args.jit,
                                                args.slots)
     lo, hi = segment_bounds(args.slots, args.world)[args.rank]
     result = {"rank": args.rank, "world": args.world,
               "jitted": jitted, "steps": 0, "resets": 0, "ok": False}
+
+    # Cross-process tracing (ISSUE 11): this process's spans (the
+    # per-step shard.compute/reduce segments, the ring's
+    # fabric.connect, quantized shard.encode chunks) accumulate in the
+    # worker-global tracer and PIGGYBACK onto the reply frames the
+    # step loop already sends — zero extra round trips. The ship
+    # buffer is bounded and its losses counted (shipped too, so the
+    # coordinator re-exports them).
+    tracer = obs_trace.get_tracer()
+    ship = (SpanShip(cap=args.span_buffer)
+            if args.span_buffer > 0 else None)
+    # Worker-local metrics, federated to the coordinator every
+    # --metrics-interval steps as a snapshot on the same piggyback.
+    reg = Registry()
+    # Per-step span context the reduce closures read: the compute
+    # span's id is reserved at step start (reduce segments parent on
+    # it) and the span itself is recorded when the step closes.
+    cur = {"sid": None, "step": 0, "traced": False}
 
     peers = [p for p in args.peers.split(",") if p]
     ring = None
@@ -194,7 +247,9 @@ def main(argv=None) -> int:
             bind_port = int(peers[args.rank].rpartition(":")[2])
             ring = RingTransport(args.rank, args.world, args.bind_ip,
                                  peers, port=bind_port,
-                                 codec=args.codec)
+                                 codec=args.codec,
+                                 trace_parent=args.trace_parent
+                                 or None)
             trace(f"connecting ring ({args.world} ranks, "
                   f"codec={args.codec})")
             ring.connect(timeout=args.connect_timeout)
@@ -218,10 +273,30 @@ def main(argv=None) -> int:
 
         def reduce_fn(part, stage):
             t0 = time.monotonic()
-            if ring is None:
-                total = part
-            else:
-                total = ring.allreduce(part, out, scratch)
+            try:
+                if ring is None:
+                    total = part
+                else:
+                    total = ring.allreduce(part, out, scratch)
+            except BaseException as e:
+                # Peer-side evidence of a sick ring: how long this
+                # rank blocked before the failure surfaced — shipped
+                # like every other span, so the coordinator's flight
+                # snapshot shows the stall on the victim's peers.
+                if cur["traced"]:
+                    tracer.record_span(
+                        "shard.reduce_stall", t0, time.monotonic(),
+                        parent_id=cur["sid"],
+                        attrs={"rank": args.rank, "step": cur["step"],
+                               "stage": stage,
+                               "error": type(e).__name__})
+                raise
+            if cur["traced"]:
+                tracer.record_span(
+                    "shard.reduce_blocked", t0, time.monotonic(),
+                    parent_id=cur["sid"],
+                    attrs={"rank": args.rank, "step": cur["step"],
+                           "stage": stage})
             reduce_fn.collective_s += time.monotonic() - t0
             return total
 
@@ -253,12 +328,33 @@ def main(argv=None) -> int:
                 while not tkt.event.wait(60.0):
                     if not reducer.thread.is_alive():
                         coll_box[0] += time.monotonic() - t0
+                        if cur["traced"]:
+                            tracer.record_span(
+                                "shard.reduce_stall", t0,
+                                time.monotonic(),
+                                parent_id=cur["sid"],
+                                attrs={"rank": args.rank,
+                                       "step": cur["step"],
+                                       "error": "RingError"})
                         raise RingError(
                             "ring reducer thread died with the "
                             "reduce outstanding")
                 coll_box[0] += time.monotonic() - t0
                 if tkt.error is not None:
+                    if cur["traced"]:
+                        tracer.record_span(
+                            "shard.reduce_stall", t0,
+                            time.monotonic(), parent_id=cur["sid"],
+                            attrs={"rank": args.rank,
+                                   "step": cur["step"],
+                                   "error": type(tkt.error).__name__})
                     raise tkt.error
+                if cur["traced"]:
+                    tracer.record_span(
+                        "shard.reduce_blocked", t0, time.monotonic(),
+                        parent_id=cur["sid"],
+                        attrs={"rank": args.rank,
+                               "step": cur["step"]})
                 return tkt.value
 
         while True:
@@ -278,16 +374,27 @@ def main(argv=None) -> int:
             if not readable:
                 continue
             msg, payload = recv_msg(csock, timeout=args.idle_timeout)
+            # Clock-sync receive stamp (ISSUE 11): the coordinator
+            # pairs this with its own send/receive stamps to estimate
+            # this worker's monotonic offset (NTP midpoint) — the
+            # stamps ride frames that exist anyway.
+            t_rx = time.monotonic()
             op = msg["op"]
             if op == "close":
                 break
             if op == "reset":
                 x = np.zeros((args.slots, sl.d), np.float32)
                 result["resets"] += 1
-                send_msg(csock, {"op": "ack", "reset": True})
+                send_msg(csock, {"op": "ack", "reset": True,
+                                 "t_rx": round(t_rx, 6),
+                                 "t_tx": round(time.monotonic(), 6)})
                 continue
             if op != "step":
                 raise ProtocolError(f"unknown op {op!r}")
+            traced = tracer.enabled
+            sid = tracer.reserve_id() if traced else None
+            cur["sid"], cur["step"] = sid, msg["step"]
+            cur["traced"] = traced
             t0 = time.monotonic()
             idx = msg["slots"]
             rows = np.frombuffer(payload, np.float32).reshape(
@@ -308,9 +415,48 @@ def main(argv=None) -> int:
                                        finish_fn=finish_fn)
                 coll = reduce_fn.collective_s
             total = time.monotonic() - t0
+            if traced:
+                attrs = {"rank": args.rank, "step": msg["step"],
+                         "compute_s": round(max(0.0, total - coll),
+                                            6),
+                         "collective_s": round(coll, 6)}
+                tp = msg.get("trace_parent")
+                if tp:
+                    # A COORDINATOR-space parent id: it must not ride
+                    # parent_id (that space collides with this
+                    # process's ids) — the wire format carries it as
+                    # attrs["xparent"] and ingest resolves it.
+                    attrs["xparent"] = tp
+                tracer.record_span("shard.compute", t0,
+                                   time.monotonic(), span_id=sid,
+                                   attrs=attrs)
+            reg.observe("shard_step_compute_seconds",
+                        max(0.0, total - coll),
+                        buckets=_WORKER_BUCKETS,
+                        help="worker-local per-step compute time "
+                             "(federated to the coordinator)")
+            reg.observe("shard_step_collective_seconds", coll,
+                        buckets=_WORKER_BUCKETS,
+                        help="worker-local time blocked in the ring "
+                             "collective per step (federated)")
+            reg.counter_inc("shard_steps_total",
+                            help="steps served by this shard worker")
             reply = {"op": "tokens", "step": msg["step"],
                      "compute_s": round(max(0.0, total - coll), 6),
-                     "collective_s": round(coll, 6)}
+                     "collective_s": round(coll, 6),
+                     "t_rx": round(t_rx, 6)}
+            # Span shipping: everything the worker traced since the
+            # last reply piggybacks here — on a frame that exists
+            # anyway, never an extra round trip. Losses to the
+            # bounded buffer ship as a counter next to the spans.
+            if ship is not None:
+                ship.harvest(tracer)
+                wire = ship.flush()
+                if wire:
+                    reply["spans"] = wire
+                reply["spans_dropped"] = ship.dropped_total
+            if result["steps"] % args.metrics_interval == 0:
+                reply["metrics"] = reg.federated_snapshot()
             # Zero-copy reply: the token segment and the state ship as
             # buffer-protocol parts straight out of their arrays — no
             # tobytes() copies in the per-step loop (GL011).
@@ -318,12 +464,13 @@ def main(argv=None) -> int:
             if msg.get("want_state") and args.rank == 0:
                 reply["state"] = True
                 parts.append(np.ascontiguousarray(x, np.float32))
+            reply["t_tx"] = round(time.monotonic(), 6)
             send_msg(csock, reply, *parts)
             result["steps"] += 1
         result["ok"] = True
     except Exception as e:
         result["error"] = repr(e)[:300]
-        trace(f"failed: {e!r}")
+        log.error("failed: %r", e)
     finally:
         if reducer is not None:
             reducer.stop()

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ... import faults
+from ...obs import trace as obs_trace
 from ...parallel import quantize
 from .shard_math import (DoubleShardSlice, ShardSlice, TpShardSlice,
                          segment_bounds)
@@ -75,17 +76,37 @@ class ShardTimeout(ShardError):
 
 
 class StepOutput:
-    """What one replica step produced, assembled across shards."""
+    """What one replica step produced, assembled across shards.
 
-    __slots__ = ("tokens", "state", "compute_s", "collective_s")
+    The cross-process extras (ISSUE 11) are None on the in-process
+    backend — synthetic shard threads record straight into the
+    process tracer, so there is nothing to ship or clock-align:
+
+      * ``spans_by_rank`` — piggybacked wire spans per rank
+        (obs.xproc format), for ``Tracer.ingest``;
+      * ``clock_by_rank`` — per-rank (offset, uncertainty) monotonic
+        clock estimate at collect time;
+      * ``metrics_by_rank`` — federated Registry snapshots;
+      * ``span_dropped_by_rank`` — each worker's cumulative
+        bounded-ship-buffer loss counter."""
+
+    __slots__ = ("tokens", "state", "compute_s", "collective_s",
+                 "spans_by_rank", "clock_by_rank", "metrics_by_rank",
+                 "span_dropped_by_rank")
 
     def __init__(self, tokens: np.ndarray,
                  state: Optional[np.ndarray],
-                 compute_s: List[float], collective_s: List[float]):
+                 compute_s: List[float], collective_s: List[float],
+                 spans_by_rank=None, clock_by_rank=None,
+                 metrics_by_rank=None, span_dropped_by_rank=None):
         self.tokens = tokens
         self.state = state
         self.compute_s = compute_s
         self.collective_s = collective_s
+        self.spans_by_rank = spans_by_rank
+        self.clock_by_rank = clock_by_rank
+        self.metrics_by_rank = metrics_by_rank
+        self.span_dropped_by_rank = span_dropped_by_rank
 
 
 class _StepHandle:
@@ -95,13 +116,17 @@ class _StepHandle:
 
     __slots__ = ("gen", "step_no", "want_state", "events", "tokens",
                  "errors", "compute_s", "collective_s", "state",
-                 "_updates")
+                 "trace_parent", "_updates")
 
     def __init__(self, gen: int, step_no: int, world: int,
-                 want_state: bool):
+                 want_state: bool, trace_parent=None):
         self.gen = gen
         self.step_no = step_no
         self.want_state = want_state
+        # The coordinator's shard.step span id: shard threads parent
+        # their per-step spans on it (ISSUE 11 — the same hand-off the
+        # real protocol ships in the step frame's trace_parent field).
+        self.trace_parent = trace_parent
         self.events = [threading.Event() for _ in range(world)]
         self.tokens: List[Optional[np.ndarray]] = [None] * world
         self.errors: List[Optional[BaseException]] = [None] * world
@@ -172,7 +197,18 @@ class _ReduceBoard:
         # the collective failure domain is testable without sockets.
         faults.fire("fabric.send")
         if self.codec is not None:
+            # The codec roundtrip models the wire encode+decode; the
+            # per-block shard.encode span is the same segment the real
+            # transport records around its quantized chunk encodes.
+            tr = obs_trace.get_tracer()
+            te = time.monotonic() if tr.enabled else 0.0
             part = self.codec.roundtrip(np.asarray(part, np.float32))
+            if tr.enabled:
+                tr.record_span(
+                    "shard.encode", te, time.monotonic(),
+                    attrs={"rank": rank, "step": step_no,
+                           "stage": stage, "block": block,
+                           "codec": self.codec.name})
         # Cells key on the BLOCK too: the overlapped schedule runs one
         # collective per (stage, block) and every rank issues them in
         # the same order, so block-keyed cells are what keeps a rank's
@@ -325,16 +361,29 @@ class _Shard:
                 # A stale item from before a reset raced onto this
                 # queue: the handle was already aborted — ignore.
                 continue
+            # Per-step shard spans (ISSUE 11): the compute span's id
+            # is RESERVED up front so the reduce segments can parent
+            # on it before it is recorded (it closes at step end) —
+            # the same reserve-then-record pattern the coordinator
+            # uses for shard.step. Same taxonomy as the real shard
+            # worker, so synthetic-vs-subprocess traces compare.
+            tr = obs_trace.get_tracer()
+            traced = tr.enabled
+            sid = tr.reserve_id() if traced else None
+            # t0 binds BEFORE the try: the except handler records the
+            # failed step's span from it (the GL003 discipline).
+            t0 = time.monotonic()
             try:
-                t0 = time.monotonic()
                 if owner.fault_site is not None:
-                    faults.fire(f"{owner.fault_site}{rank}.step")
+                    faults.fire(f"{owner.fault_site}{rank}.step",
+                                attrs={"rank": rank,
+                                       "step": handle.step_no})
                 for i, row in handle._updates:  # type: ignore[attr-defined]
                     self.x[i] = row
                 coll = [0.0]
                 if owner.overlap:
-                    self.x, tokens = self._step_overlapped(handle,
-                                                           coll)
+                    self.x, tokens = self._step_overlapped(
+                        handle, coll, tr, sid)
                 else:
                     if owner.step_time_s[rank]:
                         time.sleep(owner.step_time_s[rank])
@@ -342,14 +391,44 @@ class _Shard:
                     def reduce_fn(part, stage,
                                   _h=handle, _c=coll):
                         t = time.monotonic()
-                        out = owner.board.reduce(self.gen, _h.step_no,
-                                                 stage, rank, part)
+                        try:
+                            out = owner.board.reduce(
+                                self.gen, _h.step_no, stage, rank,
+                                part)
+                        except BaseException as e:
+                            # The peer-side evidence of a sick ring
+                            # member: how long THIS rank sat in the
+                            # reduce before the poison/stall surfaced.
+                            if traced:
+                                tr.record_span(
+                                    "shard.reduce_stall", t,
+                                    time.monotonic(), parent_id=sid,
+                                    attrs={"rank": rank,
+                                           "step": _h.step_no,
+                                           "stage": stage,
+                                           "error": type(e).__name__})
+                            raise
+                        if traced:
+                            tr.record_span(
+                                "shard.reduce_blocked", t,
+                                time.monotonic(), parent_id=sid,
+                                attrs={"rank": rank,
+                                       "step": _h.step_no,
+                                       "stage": stage})
                         _c[0] += time.monotonic() - t
                         return out
 
                     self.x, tokens = self.slice.forward(self.x,
                                                         reduce_fn)
                 total = time.monotonic() - t0
+                if traced:
+                    tr.record_span(
+                        "shard.compute", t0, time.monotonic(),
+                        span_id=sid, parent_id=handle.trace_parent,
+                        attrs={"rank": rank, "step": handle.step_no,
+                               "compute_s": round(
+                                   max(0.0, total - coll[0]), 6),
+                               "collective_s": round(coll[0], 6)})
                 handle.deliver(
                     rank, tokens[lo:hi],
                     compute_s=max(0.0, total - coll[0]),
@@ -358,6 +437,12 @@ class _Shard:
                            if handle.want_state and rank == 0
                            else None))
             except BaseException as e:
+                if traced:
+                    tr.record_span(
+                        "shard.compute", t0, time.monotonic(),
+                        span_id=sid, parent_id=handle.trace_parent,
+                        attrs={"rank": rank, "step": handle.step_no,
+                               "error": type(e).__name__})
                 if isinstance(e, ShardError):
                     typed = e
                 else:
@@ -372,7 +457,7 @@ class _Shard:
                 owner.board.poison(self.gen, typed)
                 handle.deliver_error(rank, typed)
 
-    def _step_overlapped(self, handle: "_StepHandle", coll):
+    def _step_overlapped(self, handle: "_StepHandle", coll, tr, sid):
         """One step through forward_overlapped: block reduces queue to
         the reducer thread (submit returns immediately), the modelled
         compute cost rides INSIDE each block partial, and collective_s
@@ -391,15 +476,34 @@ class _Shard:
                 (_h.step_no, stage, block, part,
                  part.size / full if full else 1.0))
 
+        traced = tr.enabled
+
         def wait(t, _c=coll):
             t0 = time.monotonic()
             if not t.event.wait(wait_ceiling):
+                if traced:
+                    tr.record_span(
+                        "shard.reduce_stall", t0, time.monotonic(),
+                        parent_id=sid,
+                        attrs={"rank": rank, "step": handle.step_no,
+                               "error": "ShardCollectiveStall"})
                 raise ShardCollectiveStall(
                     f"rank {rank}: overlapped reduce never settled "
                     f"within {wait_ceiling}s", rank=rank)
             _c[0] += time.monotonic() - t0
             if t.error is not None:
+                if traced:
+                    tr.record_span(
+                        "shard.reduce_stall", t0, time.monotonic(),
+                        parent_id=sid,
+                        attrs={"rank": rank, "step": handle.step_no,
+                               "error": type(t.error).__name__})
                 raise t.error
+            if traced:
+                tr.record_span(
+                    "shard.reduce_blocked", t0, time.monotonic(),
+                    parent_id=sid,
+                    attrs={"rank": rank, "step": handle.step_no})
             return t.value
 
         # Compute cost as busy-time accounting too (same reasoning as
@@ -563,11 +667,13 @@ class SyntheticShardSet:
     # -- the step plane -------------------------------------------------------
 
     def submit(self, step_no: int, updates: Sequence,
-               want_state: bool = False) -> _StepHandle:
+               want_state: bool = False,
+               trace_parent=None) -> _StepHandle:
         with self._lock:
             self._ensure()
             handle = _StepHandle(self._gen, step_no, self.world,
-                                 want_state)
+                                 want_state,
+                                 trace_parent=trace_parent)
             # Rows are copied at apply time; the handle only carries
             # the references across the queue hop.
             handle._updates = [(int(i), np.asarray(row, np.float32))

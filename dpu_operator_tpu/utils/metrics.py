@@ -50,7 +50,7 @@ class Registry:
 
     def counter_inc(self, name: str, labels: Optional[dict] = None, by: float = 1.0,
                     help: str = "") -> None:
-        key = tuple(sorted((labels or {}).items()))
+        key = tuple(sorted(labels.items())) if labels else ()
         with self._lock:
             self._help.setdefault(name, help)
             self._counters.setdefault(name, {})
@@ -72,7 +72,9 @@ class Registry:
         use; later observations reuse them (per-metric, like
         promclient's histogram registration — a histogram cannot change
         buckets mid-flight without corrupting the cumulative counts)."""
-        key = tuple(sorted((labels or {}).items()))
+        # No-label fast path: the shard worker observes its two step
+        # histograms every decode step (section 10 prices this call).
+        key = tuple(sorted(labels.items())) if labels else ()
         if buckets:
             import math
 
@@ -161,6 +163,84 @@ class Registry:
                     return prev_bound + (b - prev_bound) * frac
                 prev_cum, prev_bound = cum, b
             return float(bs[-1])
+
+    def counter_set(self, name: str, value: float,
+                    labels: Optional[dict] = None,
+                    help: str = "") -> None:
+        """Metric federation (ISSUE 11): SET a counter series to an
+        authoritative total published by another process (a shard
+        worker's piggybacked snapshot). The SOURCE owns monotonicity;
+        a worker restart resets its totals exactly like a scraped
+        process restart resets a Prometheus counter — consumers handle
+        it with rate()/increase(), so the re-export must not paper
+        over it by clamping."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            self._help.setdefault(name, help)
+            self._counters.setdefault(name, {})[key] = float(value)
+
+    def histogram_set(self, name: str, labels: Optional[dict],
+                      bounds, bucket_counts, total: float,
+                      count: int, help: str = "") -> None:
+        """Metric federation: replace one histogram series' state with
+        an authoritative snapshot from another process (cumulative
+        per-bound counts + sum + count, exactly the internal state
+        observe() accumulates). Bounds register on first use and must
+        match thereafter — same contract as observe(buckets=)."""
+        key = tuple(sorted((labels or {}).items()))
+        bs_new = tuple(float(b) for b in bounds)
+        counts = [int(c) for c in bucket_counts]
+        if len(counts) != len(bs_new):
+            raise ValueError(
+                f"{name}: {len(counts)} bucket counts for "
+                f"{len(bs_new)} bounds")
+        with self._lock:
+            self._help.setdefault(name, help)
+            bs = self._hist_buckets.setdefault(name, bs_new)
+            if bs != bs_new:
+                raise ValueError(
+                    f"{name} already registered with buckets {bs}, "
+                    f"got conflicting {bs_new}")
+            self._hists.setdefault(name, {})[key] = {
+                "buckets": counts, "sum": float(total),
+                "count": int(count)}
+
+    def federated_snapshot(self) -> dict:
+        """JSON-able snapshot of every counter and histogram — what a
+        shard worker piggybacks onto its reply frames. Labels travel
+        as sorted [k, v] pairs; histogram entries carry their bounds
+        so the consumer can register them faithfully."""
+        with self._lock:
+            return {
+                "counters": [
+                    [name, [list(kv) for kv in key], val]
+                    for name, series in self._counters.items()
+                    for key, val in series.items()],
+                "hists": [
+                    [name, [list(kv) for kv in key],
+                     list(self._hist_buckets.get(name, _BUCKETS)),
+                     list(st["buckets"]), st["sum"], st["count"]]
+                    for name, series in self._hists.items()
+                    for key, st in series.items()],
+            }
+
+    def apply_federated(self, snap: dict,
+                        extra_labels: Optional[dict] = None) -> None:
+        """Re-export a federated_snapshot(), merging ``extra_labels``
+        into every series (the coordinator stamps rank/codec/replica
+        here — a label the source also set loses to the stamp: the
+        consumer's identity wins over self-description)."""
+        extra = dict(extra_labels or {})
+        for name, key, val in snap.get("counters", ()):
+            labels = dict(key)
+            labels.update(extra)
+            self.counter_set(name, val, labels)
+        for name, key, bounds, counts, total, count in snap.get(
+                "hists", ()):
+            labels = dict(key)
+            labels.update(extra)
+            self.histogram_set(name, labels, bounds, counts, total,
+                               count)
 
     def histogram_totals(self, name: str
                          ) -> Dict[tuple, Tuple[float, int]]:

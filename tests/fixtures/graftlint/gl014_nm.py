@@ -1,0 +1,22 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl014_nm.py
+"""GL014 near-misses that must stay silent: wall time recorded as a
+VALUE (the right clock for human-facing timestamps — a log field, a
+snapshot's wall_time, a plain return) with no arithmetic on it, and
+the monotonic clocks every duration in this tree is supposed to
+use."""
+import time
+
+
+def snapshot_header(reason):
+    # Wall time as a human-facing stamp: a value, never an operand.
+    return {"reason": reason, "wall_time": time.time()}
+
+
+def step_duration_monotonic(run_step):
+    t0 = time.monotonic()                 # the required clock
+    run_step()
+    return time.monotonic() - t0
+
+
+def wall_stamp():
+    return time.time()                    # returned, not arithmetic

@@ -1,0 +1,20 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl014_tp.py
+"""GL014 true positives: wall-clock time.time() feeding
+duration/deadline arithmetic in a serving module. Wall clocks slew
+and step under NTP — a span length, a deadline comparison, or a
+watchdog age computed from them is garbage exactly when nobody is
+watching. Both shapes fire: the direct operand, and the
+assign-then-subtract two lines later."""
+import time
+
+
+def step_duration(run_step):
+    t0 = time.time()                      # later subtracted: fires
+    run_step()
+    return time.time() - t0               # direct operand: fires
+
+
+def deadline_lapsed(deadline_mono):
+    # Wall stamp compared against a monotonic deadline — garbage
+    # always, not just during an NTP step.
+    return time.time() >= deadline_mono   # direct operand: fires

@@ -25,34 +25,50 @@ def _ring(world, fn, streams=1, chunk_bytes=64 << 10, timeout=20.0,
           codec=None, error_feedback=False):
     """Run fn(transport, rank) on every rank concurrently; returns the
     per-rank results, re-raising the first rank failure. ``codec`` may
-    be per-rank (a list) for the mismatch contract."""
-    base = next(PORTS)
-    peers = [f"127.0.0.1:{base + r}" for r in range(world)]
-    results, errors = [None] * world, []
+    be per-rank (a list) for the mismatch contract.
 
-    def rank(r):
-        t = RingTransport(r, world, "127.0.0.1", peers, streams=streams,
-                          chunk_bytes=chunk_bytes,
-                          codec=(codec[r] if isinstance(codec, list)
-                                 else codec),
-                          error_feedback=error_feedback)
-        try:
-            t.connect(timeout=timeout)
-            results[r] = fn(t, r)
-        except BaseException as e:
-            errors.append(e)
-        finally:
-            t.close()
+    Pre-agreed ring ports come from a fixed pool that this kernel's
+    ephemeral range (16000-65535) overlaps, so any server or client
+    socket elsewhere in the suite can transiently squat one — a bind
+    failure rolls the WHOLE ring forward to the next port base
+    (bounded retries; every other failure propagates untouched)."""
+    import errno
 
-    threads = [threading.Thread(target=rank, args=(r,), daemon=True)
-               for r in range(world)]
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join(timeout=60)
-    if errors:
-        raise errors[0]
-    return results
+    for _attempt in range(3):
+        base = next(PORTS)
+        peers = [f"127.0.0.1:{base + r}" for r in range(world)]
+        results, errors = [None] * world, []
+
+        def rank(r, peers=peers, results=results, errors=errors):
+            t = RingTransport(r, world, "127.0.0.1", peers,
+                              streams=streams,
+                              chunk_bytes=chunk_bytes,
+                              codec=(codec[r]
+                                     if isinstance(codec, list)
+                                     else codec),
+                              error_feedback=error_feedback)
+            try:
+                t.connect(timeout=timeout)
+                results[r] = fn(t, r)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                t.close()
+
+        threads = [threading.Thread(target=rank, args=(r,),
+                                    daemon=True)
+                   for r in range(world)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        if errors and isinstance(errors[0], OSError) \
+                and errors[0].errno == errno.EADDRINUSE:
+            continue
+        if errors:
+            raise errors[0]
+        return results
+    raise errors[0]
 
 
 @pytest.mark.parametrize("world,elems,streams", [

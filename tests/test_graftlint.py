@@ -58,7 +58,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 13
+    assert len(set(ids)) == len(ids) == 14
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -79,6 +79,7 @@ _EXPECT = {
     "GL011": 2,  # loop-send tobytes and loop-send np.copy
     "GL012": 2,  # bare list insert + bare counter RMW, second root locked
     "GL013": 3,  # two inversion edges + a send under a cross-root lock
+    "GL014": 3,  # direct subtract, assign-then-subtract, wall-vs-mono compare
 }
 
 

@@ -69,7 +69,11 @@ def test_span_nesting_and_explicit_parenting():
     mark = next(s for s in spans if s.span_id == ev_id)
     assert mark.kind == "event" and mark.t0 == mark.t1
     tree = tr.span_tree("r1")
-    assert tree["span_count"] == 2  # outer + mark; inner has no rid
+    # outer + mark own the rid; inner rides the DESCENDANT closure
+    # (ISSUE 11): a child of a request-owned span belongs to the
+    # request even when it carries no rid of its own — that is how
+    # shard-worker spans reach /debug/traces.
+    assert tree["span_count"] == 3
 
 
 def test_cross_thread_parenting_via_explicit_parent_id():
@@ -502,6 +506,237 @@ def test_trace_dropped_counter_on_metrics():
             assert "serving_trace_dropped_total 0.0" in text
         finally:
             srv.stop()
+
+
+# -- cross-process tracing plane (ISSUE 11) -----------------------------------
+
+
+def test_clock_sync_midpoint_bound_property():
+    """Property test of the NTP four-timestamp estimator: for random
+    true offsets and ASYMMETRIC wire delays, the estimate must land
+    within its own published uncertainty of the truth, and aligning a
+    causally-ordered cross-process pair (send happens-before receive)
+    must preserve order within that uncertainty."""
+    import random
+
+    from dpu_operator_tpu.obs.xproc import ClockSync
+
+    rng = random.Random(11)
+    for _case in range(200):
+        true_offset = rng.uniform(-500.0, 500.0)
+        sync = ClockSync(window=8)
+        for _s in range(5):
+            t_tx = rng.uniform(0, 1000.0)
+            d_fwd = rng.uniform(0.0001, 0.02)   # asymmetric on
+            d_bwd = rng.uniform(0.0001, 0.02)   # purpose
+            proc = rng.uniform(0.0, 0.05)       # remote step time
+            t_rx_remote = t_tx + d_fwd + true_offset
+            t_tx_remote = t_rx_remote + proc
+            t_rx_local = t_tx_remote - true_offset + d_bwd
+            sync.observe(t_tx, t_rx_remote, t_tx_remote, t_rx_local)
+        off, unc = sync.estimate
+        assert sync.ready
+        assert abs(off - true_offset) <= unc + 1e-9, (
+            f"estimate {off} missed true {true_offset} "
+            f"past its own uncertainty {unc}")
+        # Causal order: a local event at t, then a remote event whose
+        # true time is t + gap. Aligned via the estimate, order must
+        # hold whenever gap exceeds the uncertainty.
+        t_local_event = 100.0
+        gap = 2.01 * unc + 1e-6
+        t_remote_event = t_local_event + gap + true_offset
+        aligned = sync.to_local(t_remote_event)
+        assert aligned + unc >= t_local_event, (
+            "causally-later remote event aligned before the local "
+            "one past the stamped uncertainty")
+
+
+def test_clock_sync_rejects_causality_violating_samples():
+    from dpu_operator_tpu.obs.xproc import ClockSync
+
+    sync = ClockSync()
+    # Reply arrives "before" the request net of processing: garbage.
+    sync.observe(10.0, 500.0, 500.0, 9.0)
+    assert not sync.ready
+    assert sync.estimate == (0.0, float("inf"))
+
+
+def test_span_ship_bounds_and_counts_losses():
+    """The piggyback buffer contract: bounded, losses COUNTED (the
+    satellite's loss-counter-nonzero-under-pressure case), filter
+    keeps per-chunk fabric noise out."""
+    from dpu_operator_tpu.obs.xproc import SpanShip
+
+    tr = Tracer()
+    for i in range(6):
+        tr.record_span("shard.compute", float(i), float(i) + 0.5,
+                       attrs={"rank": 0, "step": i})
+    # Wire noise that must be filtered, not shipped:
+    tr.record_span("fabric.send", 0.0, 0.1, attrs={"rank": 0})
+    ship = SpanShip(cap=4)
+    shipped = ship.harvest(tr)
+    assert shipped == 4
+    assert ship.dropped_total == 2  # 6 shippable - cap
+    wire = ship.flush()
+    assert len(wire) == 4 and len(ship) == 0
+    assert all(w[0] == "shard.compute" for w in wire)
+    # harvest CONSUMED the tracer ring (exactly-once shipping).
+    assert tr.spans_snapshot() == []
+
+
+def test_ingest_remaps_ids_shifts_clock_and_stamps():
+    """Tracer.ingest: shipment-local ids remap to fresh local ids,
+    in-shipment parent links follow, a parent the shipment lost is
+    dropped (never aliased onto a local span), a coordinator-space
+    parent rides attrs['xparent'] verbatim, timestamps shift by
+    -offset, and the stamp attrs land on every span."""
+    tr = Tracer()
+    local_parent = tr.reserve_id()
+    # Worker-local ids 1 and 2 deliberately collide with the
+    # coordinator's own counter values.
+    wires = [
+        ["shard.compute", 1, None, None, "span", 100.0, 100.5,
+         {"rank": 3, "xparent": local_parent}],
+        ["shard.reduce_blocked", 2, 1, None, "span", 100.1, 100.2,
+         {"rank": 3}],
+        ["shard.encode", 3, 999, None, "span", 100.3, 100.4,
+         {"rank": 3}],  # parent 999 was lost to the worker's buffer
+    ]
+    n = tr.ingest(wires, offset=90.0,
+                  attrs={"clock_offset_s": 90.0, "clock_unc_s": 0.01})
+    assert n == 3
+    spans = {s.name: s for s in tr.spans_snapshot()}
+    comp = spans["shard.compute"]
+    red = spans["shard.reduce_blocked"]
+    enc = spans["shard.encode"]
+    assert comp.span_id not in (1, 2, 3)
+    assert comp.parent_id == local_parent      # xparent passthrough
+    assert red.parent_id == comp.span_id       # in-shipment remap
+    assert enc.parent_id is None               # lost parent dropped
+    assert abs(comp.t0 - 10.0) < 1e-9          # shifted onto our axis
+    for s in (comp, red, enc):
+        assert s.attrs["clock_offset_s"] == 90.0
+        assert s.attrs["clock_unc_s"] == 0.01
+        assert s.attrs["rank"] == 3
+
+
+def test_record_span_with_reserved_id_parents_children():
+    """The reserve-then-record pattern the coordinator's shard.step
+    (and every shard.compute) uses: children recorded BEFORE the
+    parent still nest under it in the tree."""
+    tr = Tracer()
+    sid = tr.reserve_id()
+    tr.record_span("child", 1.0, 2.0, parent_id=sid)
+    got = tr.record_span("parent", 0.5, 3.0, request_id="rq",
+                         span_id=sid)
+    assert got == sid
+    tree = tr.span_tree("rq")
+    assert tree["span_count"] == 2
+    (root,) = tree["tree"]
+    assert root["name"] == "parent"
+    assert [c["name"] for c in root["children"]] == ["child"]
+
+
+def test_debug_traces_recent_listing():
+    """?recent=N: the discoverability mode — the most recently active
+    request ids, newest first, without needing an X-Request-Id."""
+    with obs_trace.scoped():
+        srv = ServingServer(
+            [SyntheticExecutor(slots=2, d=8,
+                               step_time_s=0.001)]).start()
+        try:
+            rids = []
+            for i in range(2):
+                _r, body = _post(srv.url,
+                                 {"prompt": f"recent-{i}",
+                                  "max_tokens": 2,
+                                  "deadline_ms": 10000})
+                rids.append(body["id"])
+            code, data = _get_json(srv.url + "/debug/traces?recent=5")
+            assert code == 200
+            listed = [e["request_id"] for e in data["recent"]]
+            assert set(rids) <= set(listed)
+            for e in data["recent"]:
+                assert e["spans"] > 0 and e["t_last"] >= e["t0"]
+            # Newest-first ordering.
+            lasts = [e["t_last"] for e in data["recent"]]
+            assert lasts == sorted(lasts, reverse=True)
+            code, _ = _get_json(srv.url + "/debug/traces?recent=0")
+            assert code == 400
+            code, _ = _get_json(srv.url + "/debug/traces?recent=x")
+            assert code == 400
+        finally:
+            srv.stop()
+
+
+def test_debug_traces_unknown_id_stable_404_under_concurrent_drain():
+    """The satellite contract: an unknown-but-well-formed request id
+    answers a STABLE 404 while other threads drain/record
+    concurrently — never a 500, never a half-drained partial tree."""
+    with obs_trace.scoped() as tr:
+        srv = ServingServer(
+            [SyntheticExecutor(slots=2, d=8,
+                               step_time_s=0.0005)]).start()
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                tr.record_span("noise", float(i), float(i) + 0.1,
+                               request_id=f"other-{i % 7}")
+                if i % 5 == 0:
+                    tr.drain()
+                if i % 11 == 0:
+                    tr.spans_snapshot()
+                i += 1
+
+        threads = [threading.Thread(target=churn, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            _post(srv.url, {"prompt": "seed", "max_tokens": 2,
+                            "deadline_ms": 10000})
+            for _ in range(60):
+                code, body = _get_json(
+                    srv.url + "/debug/traces?request_id=req-unknown")
+                assert code == 404, (code, body)
+                assert "req-unknown" in body["error"]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            srv.stop()
+
+
+def test_flight_shards_section_groups_rank_tails(tmp_path):
+    """FlightRecorder snapshots grow a `shards` section: every
+    rank-attributed span grouped per rank, tail-bounded PER RANK and
+    taken before the main-tail truncation — the victim rank's last
+    moments survive a flooded coordinator ring."""
+    with obs_trace.scoped() as tr:
+        sid = tr.reserve_id()
+        tr.record_span("shard.step", 1.0, 2.0, span_id=sid,
+                       attrs={"replica": "r0", "step": 1})
+        for rank in (0, 1):
+            tr.record_span("shard.compute", 1.1, 1.9, parent_id=sid,
+                           attrs={"rank": rank, "step": 1})
+            tr.record_span("shard.reduce_blocked", 1.2, 1.5,
+                           attrs={"rank": rank, "step": 1})
+        # Flood the main tail with un-ranked coordinator spans.
+        rec = FlightRecorder(flight_dir=str(tmp_path), max_spans=4,
+                             shard_tail=8)
+        for i in range(50):
+            tr.record_span("step.host", 2.0 + i, 2.1 + i,
+                           attrs={"replica": "r0"})
+        snap = rec.snapshot("chaos", write=False)
+        assert set(snap["shards"]) == {"0", "1"}
+        for rank in ("0", "1"):
+            names = [s["name"] for s in snap["shards"][rank]]
+            assert names == ["shard.compute", "shard.reduce_blocked"]
+        # The main tail truncated away the shard spans — the shards
+        # section is exactly what preserved them.
+        assert all(s["name"] == "step.host" for s in snap["spans"])
 
 
 def test_obs_lane_wall_budget():

@@ -667,7 +667,11 @@ def test_overlap_lowers_blocked_collective_wait():
             samples = []
             for _ in range(7):
                 h = ex.submit([])
-                out = ex.shards.collect(h, timeout=10.0)
+                # Reach through the seam for the raw StepOutput: the
+                # executor's pipelined handle wraps the backend's
+                # (trace context rides along since ISSUE 11).
+                out = ex.shards.collect(h.handle, timeout=10.0)
+                ex._finish_step(h, out)
                 samples.append(max(out.collective_s))
             return sorted(samples)[len(samples) // 2]
         finally:
@@ -795,6 +799,141 @@ def test_real_shard_worker_rendezvous_token_equivalence():
 
 
 # -- lane budget --------------------------------------------------------------
+
+
+# -- cross-process tracing plane (ISSUE 11) -----------------------------------
+
+
+def _shard_taxonomy(tracer):
+    """(name, rank) multiset of the per-step shard spans — the
+    cross-backend comparison key (ids/timestamps differ by
+    construction; the TAXONOMY must not)."""
+    from collections import Counter
+
+    return Counter(
+        (s.name, s.attrs.get("rank"))
+        for s in tracer.spans_snapshot()
+        if s.name in ("shard.step", "shard.compute",
+                      "shard.reduce_blocked"))
+
+
+def _drive_steps(ex, n_steps):
+    from dpu_operator_tpu.obs import trace as obs_trace
+
+    with obs_trace.scoped() as tr:
+        ex.reset()
+        try:
+            for k in range(n_steps):
+                h = ex.submit([(0, np.full(ex.d, 1.0 + k,
+                                           np.float32))],
+                              occupants=[f"rq-{k}"])
+                ex.collect(h)
+            return _shard_taxonomy(tr), tr
+        finally:
+            ex.close()
+
+
+def test_cross_process_trace_taxonomy_equivalence():
+    """ISSUE 11 satellite: the SAME decode trace driven over synthetic
+    thread shards and over REAL shard_worker subprocesses must produce
+    the SAME span taxonomy — shard.step per step, shard.compute and
+    shard.reduce_blocked per rank per step — so everything tier-1
+    proves about shard traces transfers to the multi-process plane."""
+    from dpu_operator_tpu.serving import ShardProcessSet
+
+    n_steps, world = 3, 2
+    syn_tax, _ = _drive_steps(
+        FabricExecutor(SyntheticShardSet(world=world, slots=4, d=8,
+                                         seed=3),
+                       mode="pipelined"),
+        n_steps)
+    proc_tax, proc_tr = _drive_steps(
+        FabricExecutor(ShardProcessSet(world=world, slots=4, d=8,
+                                       seed=3, jit=False,
+                                       spawn_timeout_s=60.0),
+                       mode="pipelined"),
+        n_steps)
+    assert syn_tax == proc_tax, (syn_tax, proc_tax)
+    assert syn_tax[("shard.step", None)] == n_steps
+    for rank in range(world):
+        assert syn_tax[("shard.compute", rank)] == n_steps
+    # The subprocess run's foreign spans are clock-stamped: offset
+    # AND uncertainty on every one (the alignment error bar).
+    foreign = [s for s in proc_tr.spans_snapshot()
+               if s.name == "shard.compute"]
+    assert foreign
+    for s in foreign:
+        assert "clock_offset_s" in s.attrs
+        assert "clock_unc_s" in s.attrs or \
+            s.attrs.get("clock_unaligned")
+
+
+def test_procset_piggyback_federates_spans_and_metrics():
+    """One real-worker run proves the whole piggyback contract: spans
+    and metrics arrive ON the tokens reply (zero extra round trips —
+    StepOutput carries them, no other protocol op exists), worker
+    series re-export rank/codec-labelled, and the coordinator's
+    shard.step parents the workers' shard.compute spans."""
+    from dpu_operator_tpu.obs import trace as obs_trace
+    from dpu_operator_tpu.serving import ShardProcessSet
+
+    reg = Registry()
+    with obs_trace.scoped() as tr:
+        procs = ShardProcessSet(world=2, slots=4, d=8, jit=False,
+                                spawn_timeout_s=60.0,
+                                metrics_interval=1)
+        ex = FabricExecutor(procs, mode="pipelined", registry=reg,
+                            name="xp")
+        try:
+            ex.reset()
+            # Reach through the seam once to see the raw piggyback.
+            h = ex.submit([(0, np.ones(8, np.float32))])
+            out = procs.collect(h.handle, timeout=30.0)
+            assert out.spans_by_rank, "no spans rode the reply"
+            assert set(out.spans_by_rank) <= {0, 1}
+            assert out.metrics_by_rank, "no metrics rode the reply"
+            assert out.clock_by_rank
+            for off, unc in out.clock_by_rank.values():
+                assert unc >= 0 and abs(off) < 10.0
+            ex._finish_step(h, out)
+            ex.collect(ex.submit([]))
+        finally:
+            ex.close()
+        spans = tr.spans_snapshot()
+        steps = {s.span_id for s in spans if s.name == "shard.step"}
+        comp = [s for s in spans if s.name == "shard.compute"]
+        assert comp and all(c.parent_id in steps for c in comp)
+    text = reg.render()
+    assert ('shard_steps_total{codec="fp32",rank="0",replica="xp"}'
+            in text)
+    assert ('shard_steps_total{codec="fp32",rank="1",replica="xp"}'
+            in text)
+    assert ('shard_step_compute_seconds_bucket{codec="fp32",'
+            in text)
+
+
+def test_piggyback_loss_counter_nonzero_under_pressure():
+    """Satellite: a worker whose ship buffer is too small for its
+    span volume DROPS and COUNTS — the coordinator re-exports the
+    loss as serving_shard_trace_dropped_total, so piggyback loss is a
+    visible number, never silence."""
+    from dpu_operator_tpu.obs import trace as obs_trace
+    from dpu_operator_tpu.serving import ShardProcessSet
+
+    reg = Registry()
+    with obs_trace.scoped():
+        ex = FabricExecutor(
+            ShardProcessSet(world=2, slots=4, d=8, jit=False,
+                            spawn_timeout_s=60.0, span_buffer=1),
+            mode="pipelined", registry=reg, name="pressure")
+        try:
+            ex.reset()
+            for k in range(3):
+                ex.collect(ex.submit([]))
+        finally:
+            ex.close()
+    assert reg.counter_total(
+        "serving_shard_trace_dropped_total") > 0
 
 
 def test_sharded_lane_wall_budget():
