@@ -27,6 +27,8 @@ GL013  lock-order inversion across thread roots, or blocking while
        whole-held-set awareness)
 GL014  wall-clock time.time() in span/duration/deadline arithmetic
        where time.monotonic() is required (obs/serving/parallel)
+GL015  resident device-pool allocation at fp32 in serving/kvcache/
+       without an explicit kv-dtype-policy marker comment
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1332,6 +1334,116 @@ class WallClockDurationMath(Rule):
                             f"must be time.monotonic()")
 
 
+# --------------------------------------------------------------------------
+# GL015 — fp32 resident pool allocation without a dtype-policy marker
+
+
+class Fp32ResidentPoolWithoutPolicy(Rule):
+    """Origin: ISSUE 13's quantized KV residency. The resident paged
+    K/V pools moved to int8 codes + per-block scales — 4x resident
+    context per HBM byte, the direct lever on slots-per-replica and
+    the capacity math of ROADMAP item 2 — with fp32 kept as a
+    deliberate, marked reference layout. An UNMARKED fp32 pool
+    allocation in serving/kvcache/ is how the win silently erodes: a
+    refactor reintroduces an fp32 pool (or drops the dtype argument,
+    whose default IS fp32), tests stay green because correctness
+    doesn't change, and the replica quietly holds 4x the HBM per
+    slot. The rule makes the dtype decision explicit at every
+    resident-pool allocation site.
+
+    Fires on: an assignment in a serving/kvcache/ module whose target
+    name contains ``pool`` and whose value is a ``zeros``/``ones``/
+    ``empty``/``full`` call on a numpy/jax.numpy receiver with an
+    fp32 dtype (an explicit ``float32`` argument, OR no dtype at all
+    — the implicit default) and no ``# kv-dtype-policy:`` marker on
+    the line or the comment block directly above.
+
+    Near-misses that stay silent: int8/other-dtype pool allocations
+    (the resident default), fp32 allocations carrying the marker
+    (trailing or in the standalone comment run above), allocations
+    whose target is not pool-named (per-block scale vectors, staging
+    rows), and pool-named fp32 allocations OUTSIDE serving/kvcache/
+    (a bench or test building a reference is not residency)."""
+
+    rule_id = "GL015"
+    severity = SEVERITY_WARNING
+    title = "fp32 resident pool allocation without a dtype policy"
+    hint = ("resident KV pools default to int8 codes + per-block "
+            "scales (parallel/quantize.py block codec, 4x context "
+            "per HBM byte); an fp32 pool must carry a "
+            "'# kv-dtype-policy: <why>' marker on the allocation "
+            "line or the comment directly above it")
+
+    _ALLOC_NAMES = {"zeros", "ones", "empty", "full"}
+    _NP_MODULES = {"np", "numpy", "jnp"}
+    _MARKER = "kv-dtype-policy:"
+
+    def _is_fp32_alloc(self, call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in self._ALLOC_NAMES
+                and isinstance(f.value, ast.Name)
+                and f.value.id in self._NP_MODULES):
+            return False
+        dtype_args = [kw.value for kw in call.keywords
+                      if kw.arg == "dtype"]
+        # Positional dtype: zeros/ones/empty take it second, full
+        # third.
+        pos = 2 if f.attr == "full" else 1
+        if len(call.args) > pos:
+            dtype_args.append(call.args[pos])
+        if not dtype_args:
+            return True  # implicit default dtype IS fp32
+        for a in dtype_args:
+            name = _terminal_name(a)
+            if name == "float32" or (
+                    isinstance(a, ast.Constant)
+                    and a.value == "float32"):
+                return True
+        return False
+
+    def _marked(self, module: Module, line: int, end_line: int) -> bool:
+        # Trailing form: anywhere on the (possibly multi-line)
+        # statement — a marker on the call's continuation or closing
+        # line still states the policy.
+        for ln in range(line, min(end_line, len(module.lines)) + 1):
+            if self._MARKER in module.lines[ln - 1]:
+                return True
+        # Comment-block-above form.
+        ln = line - 1
+        while 1 <= ln <= len(module.lines):
+            text = module.lines[ln - 1].strip()
+            if not text.startswith("#"):
+                return False
+            if self._MARKER in text:
+                return True
+            ln -= 1
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("kvcache"):
+            return
+        for n in ast.walk(module.tree):
+            if not isinstance(n, ast.Assign) \
+                    or not isinstance(n.value, ast.Call):
+                continue
+            targets = [_terminal_name(t) for t in n.targets]
+            if not any("pool" in t.lower() for t in targets if t):
+                continue
+            if not self._is_fp32_alloc(n.value):
+                continue
+            if self._marked(module, n.lineno,
+                            getattr(n, "end_lineno", n.lineno)
+                            or n.lineno):
+                continue
+            yield self.finding(
+                module, n,
+                f"'{ast.unparse(n.targets[0])}' is a resident fp32 "
+                f"pool allocation in '{module.qualname_at(n)}' with "
+                f"no kv-dtype-policy marker — the int8 residency win "
+                f"erodes silently through exactly this site")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1342,4 +1454,5 @@ def default_rules() -> List[Rule]:
             UnboundedRetryLoop(), RequestLogWithoutContext(),
             KVAcquireWithoutRelease(), UnboundedTransportRecv(),
             CopyInTransportLoop(), InconsistentLockDiscipline(),
-            LockOrderInversion(), WallClockDurationMath()]
+            LockOrderInversion(), WallClockDurationMath(),
+            Fp32ResidentPoolWithoutPolicy()]

@@ -1,0 +1,296 @@
+"""Fused Pallas paged-attention decode kernel (ISSUE 13).
+
+One kernel launch per decode step replaces the memory-bound XLA
+composition in serving/kvcache/paged.py (full ``kpool[tables]``
+block-table gather -> separate fused append -> softmax over the whole
+padded ``[S, H, C, T]`` score tensor). Per grid program (= per slot)
+the kernel:
+
+  * QUANTIZES + APPENDS the step's new K/V rows straight into the
+    resident pools: each row is encoded with its block's scale (rows
+    arrive pre-scaled metadata in SMEM — the per-block scale update
+    itself is cheap ``[S, C]`` scatter math the caller runs in XLA,
+    see paged.py) and DMA'd to ``pool[table[pos // bs], pos % bs]``;
+  * GATHERS the slot's pages by block table directly from the pools
+    (``pltpu.ANY`` — HBM on a real TPU) into double-buffered VMEM
+    tiles, the pallas_guide.md double-buffering pattern: block b+1's
+    DMA is in flight while block b computes;
+  * computes causal attention with an ONLINE-SOFTMAX accumulator
+    (running max / normalizer / weighted sum per tile — the
+    FlashAttention recurrence), so the ``[S, H, C, T]`` score tensor
+    is never materialized: peak on-chip state is one ``[H, C, bs]``
+    tile;
+  * applies the explicit VALID-BLOCK GUARD: gathered K/V beyond the
+    slot's written context (``ctx + n_new``) is zeroed BEFORE use, so
+    unwritten pool contents (stale pages from a previous owner,
+    poisoned scratch, dequantized garbage) can never leak into the
+    output — not even through a ``0 * NaN`` on the value path, which
+    the additive score mask alone cannot stop.
+
+Resident pools are int8 codes with per-block scales (the
+parallel/quantize.py block-axis codec layout: ``[N, bs, H, dh]`` int8
++ ``[N]`` f32) or fp32 (``pool_dtype="fp32"``) — the kernel reads 4x
+fewer HBM bytes per gathered page in int8, which on a decode step
+whose arithmetic intensity is ~1 FLOP/byte is the whole speedup.
+
+The pools ride ``input_output_aliases`` (in-place append: untouched
+blocks keep their exact bytes — the prefix-cache and re-attach
+contracts depend on it) and the grid is over slots, whose block sets
+are disjoint by the allocator's ownership invariant.
+
+Off-TPU the same kernel runs under the Pallas interpreter
+(``interpret=True``), which is how tier-1 proves Pallas-vs-XLA
+equivalence on CPU (tests/test_paged_attn.py); on a TPU backend it
+compiles via Mosaic. AOT-lowering for a TPU target is exercised the
+same way the collective-matmul kernels do it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+
+def _is_tpu_backend() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # no backend at all: interpret
+        return False
+
+
+def make_paged_attn_step(slots: int, chunk: int, max_blocks: int,
+                         block_size: int, heads: int, d_head: int,
+                         num_blocks: int, pool_dtype: str = "int8",
+                         interpret: Optional[bool] = None):
+    """Build the fused step for one fixed shape set.
+
+    Returns ``step(tables, ctx, n_new, q, k_new, v_new, kscale_rows,
+    vscale_rows, kscale_tbl, vscale_tbl, kpool, vpool) -> (o, kpool',
+    vpool')`` where
+
+      * ``tables [S, B] int32`` — per-slot block tables (scalar-
+        prefetched: the DMA indices are known before the body runs);
+      * ``ctx / n_new [S] int32`` — written context and this step's
+        new-token count per slot;
+      * ``q, k_new, v_new [S, C, H, dh] f32`` — this step's projected
+        queries and the K/V rows to append;
+      * ``kscale_rows / vscale_rows [S, C] f32`` — the quant scale for
+        each NEW row (its destination block's scale, gathered by the
+        caller AFTER the XLA-side scale update);
+      * ``kscale_tbl / vscale_tbl [S, B] f32`` — the dequant scale for
+        each table entry (``scales[tables]``, same gather);
+      * ``kpool / vpool [N, bs, H, dh]`` int8 codes (or f32 when
+        ``pool_dtype="fp32"``, in which case every scale is 1.0 and
+        the multiply is exact).
+
+    The returned ``o [S, C, H, dh]`` is the attention output (the
+    caller applies the output projection / MLP / logits in XLA); the
+    pools are aliased in-place.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if pool_dtype not in ("int8", "fp32"):
+        raise ValueError(f"pool_dtype must be int8|fp32, got "
+                         f"{pool_dtype!r}")
+    if interpret is None:
+        interpret = not _is_tpu_backend()
+    S, C, B = int(slots), int(chunk), int(max_blocks)
+    bs, H, dh = int(block_size), int(heads), int(d_head)
+    N = int(num_blocks)
+    pdt = jnp.int8 if pool_dtype == "int8" else jnp.float32
+    inv_sqrt_dh = 1.0 / math.sqrt(dh)
+    NEG = -1e30  # python float: a jnp scalar here would be a captured
+    # constant, which pallas kernels must not close over
+
+    def kernel(tables_ref, ctx_ref, nnew_ref,            # scalar prefetch
+               q_ref, knew_ref, vnew_ref,                # [1,C,H,dh] VMEM
+               kscr_ref, vscr_ref, ksct_ref, vsct_ref,   # [S,C]/[S,B] SMEM
+               kpool_in, vpool_in,                       # ANY (unused alias)
+               o_ref, kpool_ref, vpool_ref,              # out: VMEM + ANY
+               krow, vrow, kbuf, vbuf,                   # VMEM scratch
+               arow_sem, agather_sem):
+        del kpool_in, vpool_in  # the aliased out refs are the pools
+        s = pl.program_id(0)
+        ctx = ctx_ref[s]
+        n_new = nnew_ref[s]
+        limit = ctx + n_new
+
+        # ---- append: quantize each new row, DMA it into its page ----
+        def quant(row, scale):
+            if pool_dtype == "fp32":
+                return row
+            # Exact division, same op the XLA twin uses: the two
+            # paths must produce bit-identical codes.
+            return jnp.clip(jnp.round(row / scale),
+                            -127, 127).astype(jnp.int8)
+
+        for c in range(C):  # static: C is the compiled chunk width
+            @pl.when(c < n_new)
+            def _append_row(c=c):
+                pos = ctx + c
+                blk = tables_ref[s, pos // bs]
+                off = pos % bs
+                krow[0] = quant(knew_ref[0, c], kscr_ref[s, c])
+                vrow[0] = quant(vnew_ref[0, c], vscr_ref[s, c])
+                kcp = pltpu.make_async_copy(
+                    krow.at[0], kpool_ref.at[blk, off], arow_sem.at[0])
+                vcp = pltpu.make_async_copy(
+                    vrow.at[0], vpool_ref.at[blk, off], arow_sem.at[1])
+                kcp.start()
+                vcp.start()
+                # Row DMAs complete before the next row reuses the
+                # staging buffers — and, transitively, before the
+                # gather below reads the same pages back.
+                kcp.wait()
+                vcp.wait()
+
+        # ---- gather + attend: double-buffered page DMA, online softmax
+        #
+        # The whole phase is 2-D per head (static head loop): Mosaic
+        # lowers 2-D transposes/matmuls only, and per-head [C, bs] /
+        # [bs, dh] tiles are what the MXU wants anyway. Online-softmax
+        # carries ride the fori_loop as per-head (m, l, acc) tuples.
+        # Query positions / mask geometry, 2D iota (TPU requires >=2D).
+        c_ids = jax.lax.broadcasted_iota(jnp.int32, (C, bs), 0)
+        t_off = jax.lax.broadcasted_iota(jnp.int32, (C, bs), 1)
+        pos_q = ctx + c_ids                        # [C, bs]
+
+        def gather(buf_slot, b):
+            kcp = pltpu.make_async_copy(
+                kpool_ref.at[tables_ref[s, b]], kbuf.at[buf_slot],
+                agather_sem.at[buf_slot, 0])
+            vcp = pltpu.make_async_copy(
+                vpool_ref.at[tables_ref[s, b]], vbuf.at[buf_slot],
+                agather_sem.at[buf_slot, 1])
+            return kcp, vcp
+
+        k0, v0 = gather(0, 0)
+        k0.start()
+        v0.start()
+
+        def body(b, carry):
+            slot = jax.lax.rem(b, 2)
+
+            @pl.when(b + 1 < B)
+            def _prefetch():
+                kn, vn = gather(jax.lax.rem(b + 1, 2), b + 1)
+                kn.start()
+                vn.start()
+
+            kw, vw = gather(slot, b)
+            kw.wait()
+            vw.wait()
+            t_ids = b * bs + t_off                 # [C, bs]
+            # The explicit valid-block guard: zero K/V beyond the
+            # written context BEFORE any arithmetic touches it.
+            t_valid = t_ids[:1].reshape(bs, 1) < limit    # [bs, 1]
+            causal = (t_ids <= pos_q) & (t_ids < limit)   # [C, bs]
+            ksc = ksct_ref[s, b]
+            vsc = vsct_ref[s, b]
+            out = []
+            for h in range(H):                     # static head loop
+                m, l, acc = carry[h]
+                kb = jnp.where(t_valid,
+                               kbuf[slot, :, h, :].astype(jnp.float32)
+                               * ksc, 0.0)         # [bs, dh]
+                vb = jnp.where(t_valid,
+                               vbuf[slot, :, h, :].astype(jnp.float32)
+                               * vsc, 0.0)
+                sb = jax.lax.dot_general(
+                    q_ref[0, :, h, :], kb,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * inv_sqrt_dh
+                sb = jnp.where(causal, sb, NEG)    # [C, bs]
+                m_new = jnp.maximum(
+                    m, jnp.max(sb, axis=1, keepdims=True))
+                alpha = jnp.exp(m - m_new)         # [C, 1]
+                p = jnp.exp(sb - m_new)            # [C, bs]
+                l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+                acc_new = acc * alpha + jax.lax.dot_general(
+                    p, vb, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                out.append((m_new, l_new, acc_new))
+            return tuple(out)
+
+        init = tuple(
+            (jnp.full((C, 1), NEG, jnp.float32),
+             jnp.zeros((C, 1), jnp.float32),
+             jnp.zeros((C, dh), jnp.float32))
+            for _ in range(H))
+        final = jax.lax.fori_loop(0, B, body, init)
+        # l > 0 always: masked tiles contribute exp(NEG - m) = exp(0)
+        # = 1 per row when everything is masked (m saturates at NEG),
+        # so an idle slot yields finite garbage the planner drops, not
+        # NaN.
+        for h in range(H):
+            _, l, acc = final[h]
+            o_ref[0, :, h, :] = acc / l
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, C, H, dh), lambda s, *_: (s, 0, 0, 0)),
+            pl.BlockSpec((1, C, H, dh), lambda s, *_: (s, 0, 0, 0)),
+            pl.BlockSpec((1, C, H, dh), lambda s, *_: (s, 0, 0, 0)),
+            # Whole-array SMEM refs indexed by program id: Mosaic
+            # requires SMEM blocks to match the array dims, and the
+            # scales are small scalar metadata anyway.
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, C, H, dh), lambda s, *_: (s, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, H, dh), pdt),       # krow staging
+            pltpu.VMEM((1, H, dh), pdt),       # vrow staging
+            pltpu.VMEM((2, bs, H, dh), pdt),   # kbuf double buffer
+            pltpu.VMEM((2, bs, H, dh), pdt),   # vbuf double buffer
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, C, H, dh), jnp.float32),
+            jax.ShapeDtypeStruct((N, bs, H, dh), pdt),
+            jax.ShapeDtypeStruct((N, bs, H, dh), pdt),
+        ),
+        # Inputs count scalar-prefetch operands first: kpool/vpool sit
+        # at flat positions 10/11; outputs 1/2 are the updated pools.
+        input_output_aliases={10: 1, 11: 2},
+        # No has_side_effects needed: the aliased pool outputs keep
+        # the append live through DCE.
+        cost_estimate=pl.CostEstimate(
+            flops=4 * S * C * B * bs * H * dh,
+            bytes_accessed=(2 * S * B * bs * H * dh
+                            * (1 if pool_dtype == "int8" else 4)
+                            + 3 * S * C * H * dh * 4),
+            transcendentals=S * B * H * C * bs,
+        ),
+        interpret=bool(interpret),
+    )
+
+    @functools.wraps(kernel)
+    def step(tables, ctx, n_new, q, k_new, v_new, kscale_rows,
+             vscale_rows, kscale_tbl, vscale_tbl, kpool, vpool):
+        return call(tables, ctx, n_new, q, k_new, v_new, kscale_rows,
+                    vscale_rows, kscale_tbl, vscale_tbl, kpool, vpool)
+
+    return step

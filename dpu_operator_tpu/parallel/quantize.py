@@ -29,7 +29,10 @@ Design rules the callers rely on:
     bf16 pair) take the array module as ``xp`` and use only traceable
     ufuncs, so the SAME math jits under jax for on-device encode
     (tested in tests/test_quantize.py); the ``Codec`` classes are the
-    numpy bindings of those twins.
+    numpy bindings of those twins. ``int8_block_encode_xp``/
+    ``int8_block_decode_xp`` are the block-axis variants (per-block
+    scales over a leading axis) shared by the resident paged-KV pools
+    and the fabric KV-transfer path.
   * **Frames are self-describing.** ``Codec.frame``/``parse_frame``
     carry (codec id, scale) ahead of the payload, so a peer running a
     different codec fails with the typed ``CodecError`` — never by
@@ -88,6 +91,36 @@ def int8_encode_xp(x, xp=np):
 
 def int8_decode_xp(q, scale, xp=np):
     return q.astype(xp.float32) * scale
+
+
+def int8_block_encode_xp(x, xp=np):
+    """Block-axis twin of ``int8_encode_xp``: symmetric per-BLOCK
+    quantization over a LEADING block axis. ``x`` is ``[N, ...]``;
+    returns ``(q int8 [N, ...], scales f32 [N])`` with
+    ``scales[b] = max|x[b]|/127`` (1.0 for an all-zero block, the
+    same exact-zero convention as the chunk codec). One codec shared
+    by the resident paged-KV pools (serving/kvcache/paged.py — pool
+    shape ``[num_blocks, block_size, heads, d_head]``) and the future
+    fabric KV-transfer path: a pool block quantized on one box must
+    decode bit-identically on another, so the math lives here, xp-
+    parameterized, jittable, and is tested np↔jit like the twins
+    above."""
+    flat = xp.reshape(x, (x.shape[0], -1))
+    amax = xp.max(xp.abs(flat), axis=1)
+    scales = xp.where(amax > 0, amax / 127.0, 1.0).astype(xp.float32)
+    tail = (-1,) + (1,) * (x.ndim - 1)
+    q = xp.clip(xp.round(x / xp.reshape(scales, tail)),
+                -127, 127).astype(xp.int8)
+    return q, scales
+
+
+def int8_block_decode_xp(q, scales, xp=np):
+    """Decode the block-axis codec: ``scales``' shape must be a
+    leading prefix of ``q``'s (``[N]`` against ``[N, ...]``, or the
+    gathered ``[S, B]`` against ``[S, B, bs, H, dh]`` — the paged-
+    attention table gather reuses the twin directly)."""
+    tail = scales.shape + (1,) * (q.ndim - scales.ndim)
+    return q.astype(xp.float32) * xp.reshape(scales, tail)
 
 
 def bf16_encode_xp(x, xp=np):

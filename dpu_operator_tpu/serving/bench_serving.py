@@ -86,6 +86,16 @@ Sections:
      workers) — see the section docstring. The piggyback adds zero
      protocol round trips by construction; this section prices its
      CPU side.
+ 11. fused paged attention + quantized KV residency (ISSUE 13): one
+     PagedDecodeStep step timed block_until_ready at steady full-slot
+     decode — serving_paged_attn_device_ms (deployed kernel: pallas
+     on TPU, compiled XLA on CPU; gated <= 1.35x rolling median) with
+     the xla/fp32/pallas decomposition alongside, a live
+     interpret-mode Pallas-vs-XLA equivalence check on CPU
+     (serving_paged_attn_equiv_ok — correctness instead of perf, per
+     the acceptance), and the residency accounting:
+     serving_kv_bytes_per_slot (int8) vs fp32 →
+     serving_kv_bytes_reduction, gated ABSOLUTE >= 3.5x.
 
 Protocol: exactly one JSON object on stdout; progress on stderr.
 """
@@ -955,6 +965,165 @@ def sharded_decode(slots: int, trace, world: int = 3, n_req: int = 48,
     return out
 
 
+def paged_attn_bench(trace, iters: int = 40, repeats: int = 3) -> dict:
+    """Section 11 (ISSUE 13): the fused-paged-attention decomposition.
+
+    Times ONE PagedDecodeStep step (embed → append → paged attention
+    → logits, ``block_until_ready`` — pure device wall, no scheduler)
+    at steady-state full-slot decode over three layouts on the same
+    shapes:
+
+      * ``serving_paged_attn_device_ms`` — the DEPLOYED kernel's
+        per-step device time (the fused Pallas kernel on a TPU
+        backend; the compiled XLA composition on CPU, where pallas
+        would run interpreted and time the interpreter, not the
+        kernel). Gated <= 1.35x its rolling median.
+      * ``serving_paged_attn_xla_ms`` / ``_pallas_ms`` — the
+        decomposition pair (``_pallas_ms`` only on TPU).
+      * ``serving_paged_attn_fp32_ms`` — the fp32-resident twin of
+        the deployed arm: the dtype half of the decomposition (int8
+        reads 4x fewer pool bytes per gather).
+
+    On CPU the acceptance criterion is correctness, not speed:
+    ``serving_paged_attn_equiv_ok`` records a live interpret-mode
+    Pallas-vs-XLA equivalence check at reduced shapes (bitwise pools,
+    identical argmax tokens — the tests/test_paged_attn.py contract,
+    re-proven in the bench artifact every round).
+
+    Residency accounting rides along: ``serving_kv_bytes_per_slot``
+    (int8 resident layout), its fp32 twin, and
+    ``serving_kv_bytes_reduction`` — gated ABSOLUTE >= 3.5x (the
+    acceptance floor; the layout either delivers its 4x-ish HBM win
+    or the round fails)."""
+    import time as _time
+
+    import numpy as np
+
+    from .kvcache.paged import PagedDecodeStep, kv_bytes_per_slot
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    dims = dict(slots=4, vocab=256, d=64, heads=4, block_size=16,
+                num_blocks=256, max_blocks_per_req=16, chunk=8, seed=3)
+    out: dict = {}
+
+    def steady_plan(step_obj):
+        """Full-occupancy decode plan: every slot mid-decode with a
+        half-full table — the shape the decode hot path actually
+        runs."""
+        S, C = step_obj.slots, step_obj.chunk
+        B, bs = step_obj.max_blocks_per_req, step_obj.block_size
+        rng = np.random.RandomState(7)
+        tables = np.arange(S * B, dtype=np.int32).reshape(S, B)
+        ctx = np.full((S,), (B // 2) * bs, np.int32)
+        n_new = np.ones((S,), np.int32)
+        host = rng.randint(0, step_obj.vocab,
+                           size=(S, C)).astype(np.int32)
+        use_host = np.ones((S,), bool)
+        return (step_obj.init_prev(), host, use_host, ctx, n_new,
+                tables)
+
+    def time_arm(kernel, pool_dtype):
+        st = PagedDecodeStep(kernel=kernel, pool_dtype=pool_dtype,
+                             **dims)
+        pools = st.init_pools()
+        prev, host, use_host, ctx, n_new, tables = steady_plan(st)
+        best = float("inf")
+        for _ in range(repeats):
+            # Warm one step, then time the loop; pools thread
+            # linearly (donation on accelerator backends).
+            p = st(*pools, prev, host, use_host, ctx, n_new, tables)
+            pools, tok = p[:4], p[4]
+            tok.block_until_ready()
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                p = st(*pools, prev, host, use_host, ctx, n_new,
+                       tables)
+                pools, tok = p[:4], p[4]
+            tok.block_until_ready()
+            best = min(best,
+                       (_time.perf_counter() - t0) / iters * 1000.0)
+        return best
+
+    xla_ms = time_arm("xla", "int8")
+    fp32_ms = time_arm("xla", "fp32")
+    out["serving_paged_attn_xla_ms"] = round(xla_ms, 3)
+    out["serving_paged_attn_fp32_ms"] = round(fp32_ms, 3)
+    if on_tpu:
+        pallas_ms = time_arm("pallas", "int8")
+        out["serving_paged_attn_pallas_ms"] = round(pallas_ms, 3)
+        # The headline tracks the DEPLOY-DEFAULT kernel — which on a
+        # TPU backend is unconditionally pallas (PagedDecodeStep's
+        # kernel=None auto-select), NOT min(pallas, xla): a
+        # Pallas-only regression must move the gated figure, and the
+        # acceptance comparison (pallas <= the XLA composition on the
+        # same shapes) is gated separately and absolutely in bench.py
+        # via the recorded pair.
+        out["serving_paged_attn_device_ms"] = round(pallas_ms, 3)
+        out["serving_paged_attn_kernel"] = "pallas"
+    else:
+        out["serving_paged_attn_device_ms"] = round(xla_ms, 3)
+        out["serving_paged_attn_kernel"] = "xla"
+        # Correctness instead of perf on CPU: a live interpret-mode
+        # equivalence spot check at reduced shapes.
+        small = dict(slots=2, vocab=32, d=16, heads=2, block_size=4,
+                     num_blocks=32, max_blocks_per_req=4, chunk=4,
+                     seed=5)
+        eq = True
+        toks = {}
+        pools = {}
+        for kern in ("xla", "pallas"):
+            st = PagedDecodeStep(kernel=kern, pool_dtype="int8",
+                                 interpret=True, **small)
+            p = st.init_pools()
+            prev = st.init_prev()
+            tables = np.arange(8, dtype=np.int32).reshape(2, 4)
+            ctx = np.zeros((2,), np.int32)
+            rng = np.random.RandomState(11)
+            emitted = []
+            for stepno in range(4):
+                host = rng.randint(0, 32, size=(2, 4)).astype(np.int32)
+                n_new = np.full((2,), 4 if stepno == 0 else 1,
+                                np.int32)
+                use_host = np.ones((2,), bool)
+                r = st(*p, prev, host, use_host, ctx, n_new, tables)
+                p, tok = r[:4], r[4]
+                ctx = ctx + n_new
+                prev = tok
+                emitted.append(np.asarray(tok).tolist())
+            toks[kern] = emitted
+            pools[kern] = [np.asarray(a) for a in p]
+        eq = toks["xla"] == toks["pallas"] and all(
+            np.array_equal(a, b) for a, b in zip(pools["xla"],
+                                                 pools["pallas"]))
+        out["serving_paged_attn_equiv_ok"] = bool(eq)
+
+    d = dims
+    dh = d["d"] // d["heads"]
+    int8_bytes = kv_bytes_per_slot(d["max_blocks_per_req"],
+                                   d["block_size"], d["heads"], dh,
+                                   "int8")
+    fp32_bytes = kv_bytes_per_slot(d["max_blocks_per_req"],
+                                   d["block_size"], d["heads"], dh,
+                                   "fp32")
+    out["serving_kv_bytes_per_slot"] = int8_bytes
+    out["serving_kv_bytes_per_slot_fp32"] = fp32_bytes
+    out["serving_kv_bytes_reduction"] = round(fp32_bytes / int8_bytes,
+                                              2)
+    trace(f"paged-attn: {out['serving_paged_attn_kernel']} "
+          f"{out['serving_paged_attn_device_ms']} ms/step (xla int8 "
+          f"{out['serving_paged_attn_xla_ms']}, fp32 "
+          f"{out['serving_paged_attn_fp32_ms']}, pallas "
+          f"{out.get('serving_paged_attn_pallas_ms', 'n/a — cpu')}); "
+          f"kv bytes/slot {int8_bytes} vs fp32 {fp32_bytes} = "
+          f"{out['serving_kv_bytes_reduction']}x"
+          + ("" if on_tpu else
+             f"; interpret equivalence "
+             f"{'ok' if out.get('serving_paged_attn_equiv_ok') else 'FAILED'}"))
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -1142,6 +1311,15 @@ def main(argv: Optional[list] = None) -> int:
         except Exception as e:
             out["serving_trace_error"] = str(e)[:200]
             trace(f"trace-overhead section failed: {e}")
+
+        # 11: fused paged attention + quantized KV residency
+        # (ISSUE 13) — Pallas-vs-XLA device decomposition, int8
+        # bytes/slot accounting, interpret-mode equivalence on CPU.
+        try:
+            out.update(paged_attn_bench(trace))
+        except Exception as e:
+            out["serving_paged_attn_error"] = str(e)[:200]
+            trace(f"paged-attn section failed: {e}")
 
     print(json.dumps(out), flush=True)
     return 0

@@ -8,9 +8,14 @@ Three layers (PagedAttention / Sarathi-Serve, sized to this repo):
     that ride the PR 5 seize→requeue path, and a chained-hash
     ``PrefixTree`` for block-granular prefix sharing;
   * device plane — paged.py: one AOT-compiled fused step (embed →
-    KV-append scatter → paged attention gather → logits → argmax)
-    over ``[num_blocks, block_size, heads, d_head]`` pools that never
-    leave the device;
+    KV-append → paged attention → logits → argmax) over
+    ``[num_blocks, block_size, heads, d_head]`` pools that never
+    leave the device. Since ISSUE 13 the resident format is int8
+    codes + per-block scales (4x context per HBM byte) and the
+    attention+append core is selectable: the fused Pallas kernel
+    (parallel/pallas_paged_attn.py — one launch per step, online
+    softmax, HBM→VMEM page DMA) or the XLA reference composition
+    (``kernel="pallas" | "xla"``);
   * executors — executor.py: ``PagedKVExecutor`` (real, jax) and
     ``SyntheticKVExecutor`` (jax-free, dialable step cost) behind the
     serving plane's two-phase submit/collect seam, with chunked
@@ -24,6 +29,7 @@ from .allocator import (CACHE_OWNER, KVBlockAllocator, KVCacheOOM,
                         KVLease, PrefixTree)
 from .executor import (NO_TOKEN, KVExecutorBase, PagedKVExecutor,
                        SyntheticKVExecutor)
+from .paged import kv_bytes_per_slot, paged_kv_error_bound
 
 __all__ = [
     "CACHE_OWNER",
@@ -35,4 +41,6 @@ __all__ = [
     "PagedKVExecutor",
     "PrefixTree",
     "SyntheticKVExecutor",
+    "kv_bytes_per_slot",
+    "paged_kv_error_bound",
 ]

@@ -496,7 +496,13 @@ class PagedKVExecutor(KVExecutorBase):
     dispatch returns while the step runs and the decode recurrence
     chains on device; ``mode="sync"`` drives the same executable
     through the scheduler's synchronous KV loop (the measured
-    baseline)."""
+    baseline). ``kernel=`` selects the fused Pallas paged-attention
+    kernel or the XLA reference composition (default: pallas on a TPU
+    backend, xla elsewhere) and ``pool_dtype=`` the resident KV
+    layout (int8 codes + per-block scales by default — 4x resident
+    context per HBM byte; "fp32" is the exact reference) — both pass
+    straight through to PagedDecodeStep, so the scheduler, chaos
+    matrix and sharded plane ride either path untouched."""
 
     def __init__(self, slots: int = 4, vocab: int = 64, d: int = 16,
                  heads: int = 2, block_size: int = 4,
@@ -505,7 +511,10 @@ class PagedKVExecutor(KVExecutorBase):
                  prefill_budget: Optional[int] = None,
                  prefix_cache: bool = True, seed: int = 0,
                  mode: str = "pipelined", warmup: bool = True,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 kernel: Optional[str] = None,
+                 pool_dtype: str = "int8",
+                 interpret: Optional[bool] = None):
         if mode not in ("pipelined", "sync"):
             raise ValueError(f"mode must be pipelined|sync, got {mode!r}")
         super().__init__(slots, vocab=vocab, block_size=block_size,
@@ -521,8 +530,10 @@ class PagedKVExecutor(KVExecutorBase):
             slots=slots, vocab=vocab, d=d, heads=heads,
             block_size=block_size, num_blocks=num_blocks,
             max_blocks_per_req=max_blocks_per_req, chunk=prefill_chunk,
-            seed=seed, donate=donate)
-        self._kpool, self._vpool = self._paged.init_pools()
+            seed=seed, donate=donate, kernel=kernel,
+            pool_dtype=pool_dtype, interpret=interpret)
+        (self._kpool, self._kscale,
+         self._vpool, self._vscale) = self._paged.init_pools()
         self._prev = self._paged.init_prev()
         if warmup:
             # One dispatched no-op step: first-execution lazy init is
@@ -531,15 +542,17 @@ class PagedKVExecutor(KVExecutorBase):
             self.reset()
 
     def _backend_reset(self) -> None:
-        # Pools are kept (re-attach depends on surviving pages); only
-        # the token recurrence restarts.
+        # Pools (codes AND scales) are kept — re-attach depends on
+        # surviving pages; only the token recurrence restarts.
         self._prev = self._paged.init_prev()
 
     def _dispatch(self, plan: _StepPlan):
         import jax.numpy as jnp
 
-        self._kpool, self._vpool, out = self._paged(
-            self._kpool, self._vpool, self._prev,
+        (self._kpool, self._kscale, self._vpool, self._vscale,
+         out) = self._paged(
+            self._kpool, self._kscale, self._vpool, self._vscale,
+            self._prev,
             jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
             jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
             jnp.asarray(plan.tables))

@@ -1,38 +1,68 @@
 """Device-resident paged-attention decode step (PagedAttention-style).
 
 The PR 3 ``DecodeStep`` keeps a ``[slots, d]`` hidden vector on device;
-this is its KV-bearing sibling: attention state lives in one flat
-``[num_blocks, block_size, heads, d_head]`` K pool and one V pool that
-NEVER leave the device, indexed through per-slot block tables the host
-allocator (kvcache/allocator.py) hands out. One compiled executable —
-one compile, ever — fuses, per step:
+this is its KV-bearing sibling: attention state lives in flat
+``[num_blocks, block_size, heads, d_head]`` K/V pools that NEVER leave
+the device, indexed through per-slot block tables the host allocator
+(kvcache/allocator.py) hands out. One compiled executable — one
+compile, ever — fuses, per step:
 
   * token embedding of a fixed ``[slots, chunk]`` token window
     (decode = 1 valid token, chunked prefill = up to ``chunk``);
-  * KV APPEND by scatter: each new token's K/V lands at
+  * KV APPEND: each new token's K/V lands at
     ``pool[table[pos // bs], pos % bs]``; padding rows use an
     out-of-range block id and drop (the PR 3 ``mode="drop"`` scatter
     discipline, extended from row indices to (block, offset) pairs);
   * paged attention: gather the slot's pages through its block table,
-    causal-mask to each query's own position, softmax, weighted sum;
-  * a small residual MLP and tied-embedding logits, argmax → the
+    causal-mask to each query's own position, softmax, weighted sum —
+    with an explicit VALID-BLOCK GUARD (gathered K/V beyond the
+    slot's written context is zeroed before use, so unwritten pool
+    contents can never leak into outputs, not even as ``0 * NaN`` on
+    the value path);
+  * a small residual MLP and untied-head logits, argmax → the
     ``[slots]`` int32 token ids — the only thing that crosses PCIe.
+
+ISSUE 13 made this a two-by-two of selectable layouts behind the SAME
+call signature:
+
+``kernel="pallas" | "xla"``
+    *pallas* (the deploy default on a TPU backend) runs the fused
+    parallel/pallas_paged_attn.py kernel: one launch per step gathers
+    pages by table straight from HBM into double-buffered VMEM tiles,
+    attends with an online-softmax accumulator (the ``[S, H, C, T]``
+    score tensor is never materialized) and appends the step's new
+    K/V in the same launch. *xla* is the reference composition (full
+    ``pool[tables]`` gather → masked softmax → einsum → scatter),
+    kept selectable and the tier-1 CPU default; off-TPU the pallas
+    path runs under the Pallas interpreter, which is how CPU tier-1
+    proves the two paths equivalent (tests/test_paged_attn.py).
+
+``pool_dtype="int8" | "fp32"``
+    *int8* is the RESIDENT format (the ISSUE 13 default): codes
+    ``[N, bs, H, dh]`` int8 plus per-block scales ``[N]`` f32 — the
+    parallel/quantize.py block-axis codec layout — 4x more resident
+    slots/context per HBM byte. A block's scale is set ONCE, by the
+    step that writes its row 0 (``scale = margin * amax(first rows)
+    / 127``; later rows quantize with the stored scale and clip),
+    which makes appends IDEMPOTENT: a re-attach replay re-quantizes
+    identical bytes, so kill/resume streams stay byte-identical to
+    unfailed ones — the property the whole-block requantize
+    alternative cannot give (re-rounding already-resident rows makes
+    replay path-dependent). The documented per-element error bound is
+    ``paged_kv_error_bound`` below. *fp32* keeps exact residency for
+    the byte-identical invariance lanes and as the quality reference.
 
 The fixed shapes are the whole contract: occupancy, prefill progress
 and prompt length vary, ``[slots, chunk]``/``[slots, max_blocks]``
 never do, so admissions and chunked prefill re-use the same executable
-as pure decode. The decode recurrence chains ON DEVICE: the previous
-step's (possibly still in-flight) token output feeds the next step's
-input through ``prev_tokens``, gated per slot by ``use_host`` — the
-pipelined scheduler can dispatch step k+1 before step k's tokens ever
-reach the host (the ISSUE 3 overlap, now with KV state).
-
-Donation follows DecodeStep's measured platform policy: the two pools
-are donated on accelerator backends (the decode session allocates its
-KV memory once); on CPU donation is off by default because the CPU
-runtime blocks dispatch on donated-input producers (~500us/step,
-measured in PR 3 — it serializes exactly the pipeline this exists
-for). ``donate=`` overrides.
+as pure decode. The decode recurrence chains ON DEVICE through
+``prev_tokens`` gated per slot by ``use_host`` — the pipelined
+scheduler can dispatch step k+1 before step k's tokens ever reach the
+host. Donation follows DecodeStep's measured platform policy: the
+pools (4 arrays now: codes + scales, twice) are donated on accelerator
+backends; on CPU donation is off by default because the CPU runtime
+blocks dispatch on donated-input producers (~500us/step, measured in
+PR 3). ``donate=`` overrides.
 """
 
 from __future__ import annotations
@@ -40,6 +70,35 @@ from __future__ import annotations
 from typing import Optional
 
 import numpy as np
+
+from ...parallel.quantize import int8_block_decode_xp
+
+
+def kv_bytes_per_slot(max_blocks_per_req: int, block_size: int,
+                      heads: int, d_head: int,
+                      pool_dtype: str = "int8") -> int:
+    """Resident KV bytes one slot's worst-case reservation pins:
+    ``max_blocks_per_req`` blocks of K and V rows plus their per-block
+    scale floats. Pure arithmetic on the layout (no device, no
+    compile) — the bench's ``serving_kv_bytes_per_slot`` and the
+    capacity math of ROADMAP item 2 both read it, and the >= 3.5x
+    int8-vs-fp32 reduction acceptance is checked against exactly this
+    accounting."""
+    elems = block_size * heads * d_head
+    itemsize = 1 if pool_dtype == "int8" else 4
+    return max_blocks_per_req * 2 * (elems * itemsize + 4)
+
+
+def paged_kv_error_bound(scale: float, amax: float) -> float:
+    """The documented per-element absolute error bound for one
+    resident int8 KV element against its fp32 truth (the PR 9
+    ``quantized_error_bound`` methodology applied to residency):
+    rounding contributes ``scale / 2``; a row whose magnitude exceeds
+    the block's first-write dynamic range clips at ``127 * scale`` and
+    contributes the excess. ``scale`` is the block's STORED scale,
+    ``amax`` the true fp32 max-abs over the block — both observable,
+    so tests and the bench verify the bound per block per step."""
+    return scale / 2.0 + max(0.0, amax - 127.0 * scale)
 
 
 class PagedDecodeStep:
@@ -52,12 +111,30 @@ class PagedDecodeStep:
                  block_size: int, num_blocks: int,
                  max_blocks_per_req: int, chunk: int,
                  hidden: Optional[int] = None, seed: int = 0,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 kernel: Optional[str] = None,
+                 pool_dtype: str = "int8",
+                 scale_margin: float = 1.5,
+                 interpret: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
 
         if d % heads:
             raise ValueError(f"d={d} must divide by heads={heads}")
+        if kernel is None:
+            # Deploy default: the fused kernel on a real TPU backend,
+            # the XLA composition on CPU tier-1 (where pallas would
+            # run interpreted — correct but orders slower per step).
+            from ...parallel.pallas_paged_attn import _is_tpu_backend
+            kernel = "pallas" if _is_tpu_backend() else "xla"
+        if kernel not in ("pallas", "xla"):
+            raise ValueError(f"kernel must be pallas|xla, got {kernel!r}")
+        if pool_dtype not in ("int8", "fp32"):
+            raise ValueError(f"pool_dtype must be int8|fp32, got "
+                             f"{pool_dtype!r}")
+        self.kernel = kernel
+        self.pool_dtype = pool_dtype
+        self.scale_margin = float(scale_margin)
         self.slots = int(slots)
         self.vocab = int(vocab)
         self.d = int(d)
@@ -96,9 +173,47 @@ class PagedDecodeStep:
         B, bs = self.max_blocks_per_req, self.block_size
         H, dh = self.heads, self.d_head
         N, T = self.num_blocks, B * bs
+        int8 = pool_dtype == "int8"
+        margin = self.scale_margin
 
-        def step(kpool, vpool, prev_tok, host_tok, use_host, ctx,
-                 n_new, tables):
+        fused = None
+        if kernel == "pallas":
+            from ...parallel.pallas_paged_attn import make_paged_attn_step
+
+            fused = make_paged_attn_step(
+                slots=S, chunk=C, max_blocks=B, block_size=bs,
+                heads=H, d_head=dh, num_blocks=N,
+                pool_dtype=pool_dtype, interpret=interpret)
+
+        def update_scales(scales, vals, blk, pos, valid, ctx):
+            """Per-block scale, set once by the step that writes the
+            block's row 0 (``bstart >= ctx`` — appends only ever
+            extend a block upward, so the block's first write this
+            session is exactly the step whose new rows include its
+            base position). Two drop-scatters: reset the touched
+            blocks, then scatter-max the group amax. Deterministic
+            under duplicate targets (set writes one value; max is
+            order-free) and IDEMPOTENT under re-attach replay (the
+            replay group equals the original first-write group —
+            replays restart at block-aligned cursors)."""
+            bstart = (pos // bs) * bs
+            reset = valid & (bstart >= ctx[:, None])
+            amax = jnp.max(jnp.abs(vals), axis=(2, 3))     # [S, C]
+            tgt = jnp.where(reset, blk, N)                 # N = drop
+            scales = scales.at[tgt].set(0.0, mode="drop")
+            scales = scales.at[tgt].max(
+                amax * np.float32(margin / 127.0), mode="drop")
+            # All-zero first group: the chunk codec's scale-1.0
+            # convention (decode stays exact zero, never 0/0).
+            return jnp.where(scales > 0, scales,
+                             jnp.float32(1.0)).astype(jnp.float32)
+
+        def quantize_rows(vals, row_scales):
+            q = jnp.round(vals / row_scales[:, :, None, None])
+            return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+        def step(kpool, kscale, vpool, vscale, prev_tok, host_tok,
+                 use_host, ctx, n_new, tables):
             # Slot 0 of the token window is the only position the
             # device recurrence can feed (decode is always one token);
             # prefill chunks come from the host wholesale.
@@ -113,26 +228,72 @@ class PagedDecodeStep:
             v = (x @ wv).reshape(S, C, H, dh)
             pos = ctx[:, None] + jnp.arange(C)[None, :]   # [S, C]
             valid = jnp.arange(C)[None, :] < n_new[:, None]
-            blk = jnp.take_along_axis(
+            blk_all = jnp.take_along_axis(
                 tables, jnp.clip(pos // bs, 0, B - 1), axis=1)
             # Invalid positions scatter to block id N — out of range,
             # dropped (never a masked-multiply: the pool must keep
             # exact prior contents at untouched positions).
-            blk = jnp.where(valid, blk, N)
+            blk = jnp.where(valid, blk_all, N)
             off = pos % bs
-            kpool = kpool.at[blk, off].set(k, mode="drop")
-            vpool = vpool.at[blk, off].set(v, mode="drop")
-            keys = kpool[tables].reshape(S, T, H, dh)
-            vals = vpool[tables].reshape(S, T, H, dh)
-            scores = jnp.einsum("schd,sthd->shct", q, keys) / np.sqrt(dh)
-            tpos = jnp.arange(T)
-            causal = ((tpos[None, None, :] <= pos[:, :, None])
-                      & valid[:, :, None])               # [S, C, T]
-            scores = jnp.where(causal[:, None, :, :], scores,
-                               jnp.float32(-1e30))
-            attn = jax.nn.softmax(scores, axis=-1)
-            o = jnp.einsum("shct,sthd->schd", attn, vals).reshape(
-                S, C, H * dh)
+            if int8:
+                # Scale update runs in XLA for BOTH kernels (cheap
+                # [S, C] scatter math), so the two paths quantize
+                # with bit-identical scales.
+                kscale = update_scales(kscale, k, blk, pos, valid, ctx)
+                vscale = update_scales(vscale, v, blk, pos, valid, ctx)
+                ksc_rows = kscale[blk_all]
+                vsc_rows = vscale[blk_all]
+            else:
+                ksc_rows = vsc_rows = jnp.ones((S, C), jnp.float32)
+            limit = ctx + n_new
+            if kernel == "pallas":
+                o, kpool, vpool = fused(
+                    tables, ctx, n_new, q, k, v, ksc_rows, vsc_rows,
+                    kscale[tables] if int8
+                    else jnp.ones((S, B), jnp.float32),
+                    vscale[tables] if int8
+                    else jnp.ones((S, B), jnp.float32),
+                    kpool, vpool)
+                o = o.reshape(S, C, H * dh)
+            else:
+                if int8:
+                    kpool = kpool.at[blk, off].set(
+                        quantize_rows(k, ksc_rows), mode="drop")
+                    vpool = vpool.at[blk, off].set(
+                        quantize_rows(v, vsc_rows), mode="drop")
+                    keys = int8_block_decode_xp(
+                        kpool[tables], kscale[tables],
+                        xp=jnp).reshape(S, T, H, dh)
+                    vals = int8_block_decode_xp(
+                        vpool[tables], vscale[tables],
+                        xp=jnp).reshape(S, T, H, dh)
+                else:
+                    kpool = kpool.at[blk, off].set(k, mode="drop")
+                    vpool = vpool.at[blk, off].set(v, mode="drop")
+                    keys = kpool[tables].reshape(S, T, H, dh)
+                    vals = vpool[tables].reshape(S, T, H, dh)
+                # The explicit valid-block guard (ISSUE 13 satellite):
+                # zero gathered K/V beyond the written context BEFORE
+                # any arithmetic. The additive score mask alone cannot
+                # stop garbage on the VALUE path — softmax weight 0
+                # times a poisoned NaN/Inf is NaN, and stale pages
+                # from a previous block owner are exactly that risk
+                # once pools hold dequantized int8 scratch.
+                tpos = jnp.arange(T)
+                t_ok = (tpos[None, :] < limit[:, None]
+                        )[:, :, None, None]
+                keys = jnp.where(t_ok, keys, 0.0)
+                vals = jnp.where(t_ok, vals, 0.0)
+                scores = jnp.einsum("schd,sthd->shct", q,
+                                    keys) / np.sqrt(dh)
+                causal = ((tpos[None, None, :] <= pos[:, :, None])
+                          & (tpos[None, None, :] < limit[:, None, None])
+                          & valid[:, :, None])           # [S, C, T]
+                scores = jnp.where(causal[:, None, :, :], scores,
+                                   jnp.float32(-1e30))
+                attn = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum("shct,sthd->schd", attn, vals).reshape(
+                    S, C, H * dh)
             y = x + o @ wo
             y = y + jax.nn.relu(y @ w1) @ w2
             last = jnp.clip(n_new - 1, 0, C - 1)
@@ -140,14 +301,17 @@ class PagedDecodeStep:
                 y, last[:, None, None], axis=1)[:, 0]    # [S, d]
             logits = yl @ wout
             out = jnp.argmax(logits, axis=1).astype(jnp.int32)
-            return kpool, vpool, out
+            return kpool, kscale, vpool, vscale, out
 
         if donate is None:
             donate = jax.devices()[0].platform != "cpu"
         self.donate = bool(donate)
-        dn = (0, 1) if self.donate else ()
-        kp = jnp.zeros((N, bs, H, dh), jnp.float32)
-        vp = jnp.zeros((N, bs, H, dh), jnp.float32)
+        dn = (0, 1, 2, 3) if self.donate else ()
+        pdt = jnp.int8 if int8 else jnp.float32
+        kp = jnp.zeros((N, bs, H, dh), pdt)
+        vp = jnp.zeros((N, bs, H, dh), pdt)
+        ksc = jnp.ones((N,), jnp.float32)
+        vsc = jnp.ones((N,), jnp.float32)
         pt = jnp.zeros((S,), jnp.int32)
         ht = jnp.zeros((S, C), jnp.int32)
         uh = jnp.zeros((S,), jnp.bool_)
@@ -157,16 +321,32 @@ class PagedDecodeStep:
         # since PR 2): admission latency never includes XLA, and the
         # supervisor's watchdog never reads a cold compile as a wedge.
         self._step = jax.jit(step, donate_argnums=dn).lower(
-            kp, vp, pt, ht, uh, i32, i32, tb).compile()
+            kp, ksc, vp, vsc, pt, ht, uh, i32, i32, tb).compile()
 
     def init_pools(self):
-        """Fresh zeroed (kpool, vpool) device arrays."""
+        """Fresh zeroed (kpool, kscale, vpool, vscale) device arrays —
+        int8 codes + per-block scales in the resident default, fp32
+        rows + all-ones scales in the exact reference layout."""
         import jax.numpy as jnp
 
         shape = (self.num_blocks, self.block_size, self.heads,
                  self.d_head)
-        return (jnp.zeros(shape, jnp.float32),
-                jnp.zeros(shape, jnp.float32))
+
+        def scales():
+            # DISTINCT arrays for K and V: the four pool args are all
+            # donated on accelerator backends, and donating one buffer
+            # twice is a runtime error.
+            return jnp.ones((self.num_blocks,), jnp.float32)
+
+        if self.pool_dtype == "int8":
+            return (jnp.zeros(shape, jnp.int8), scales(),
+                    jnp.zeros(shape, jnp.int8), scales())
+        # kv-dtype-policy: fp32 residency is the selectable EXACT
+        # reference layout (byte-identical invariance lanes + the
+        # int8 quality baseline); the resident default is int8.
+        kpool = jnp.zeros(shape, jnp.float32)
+        vpool = jnp.zeros(shape, jnp.float32)  # kv-dtype-policy: ditto
+        return (kpool, scales(), vpool, scales())
 
     def init_prev(self):
         """Zeroed [slots] int32 device array for the token recurrence."""
@@ -174,11 +354,31 @@ class PagedDecodeStep:
 
         return jnp.zeros((self.slots,), jnp.int32)
 
-    def __call__(self, kpool, vpool, prev_tok, host_tok, use_host,
-                 ctx, n_new, tables):
-        """(kpool', vpool', out_tokens) — all device arrays still in
-        flight (jax async dispatch); the scheduler's pipelined loop
-        overlaps host bookkeeping against them. The pools are consumed
-        when donation is on: thread them linearly."""
-        return self._step(kpool, vpool, prev_tok, host_tok, use_host,
-                          ctx, n_new, tables)
+    def kv_bytes_per_slot(self) -> int:
+        """Resident KV bytes one slot's worst-case reservation pins —
+        the module-level ``kv_bytes_per_slot`` on this step's layout."""
+        return kv_bytes_per_slot(self.max_blocks_per_req,
+                                 self.block_size, self.heads,
+                                 self.d_head, self.pool_dtype)
+
+    def dequantized_pools(self, kpool, kscale, vpool, vscale):
+        """Host-side fp32 view of resident pools (numpy): the
+        parallel/quantize.py block-axis decode twin — what the fabric
+        KV-transfer path would ship, and what the error-bound tests
+        compare against fp32-resident truth."""
+        if self.pool_dtype != "int8":
+            return np.asarray(kpool), np.asarray(vpool)
+        return (int8_block_decode_xp(np.asarray(kpool),
+                                     np.asarray(kscale)),
+                int8_block_decode_xp(np.asarray(vpool),
+                                     np.asarray(vscale)))
+
+    def __call__(self, kpool, kscale, vpool, vscale, prev_tok,
+                 host_tok, use_host, ctx, n_new, tables):
+        """(kpool', kscale', vpool', vscale', out_tokens) — all device
+        arrays still in flight (jax async dispatch); the scheduler's
+        pipelined loop overlaps host bookkeeping against them. The
+        pools are consumed when donation is on: thread them
+        linearly."""
+        return self._step(kpool, kscale, vpool, vscale, prev_tok,
+                          host_tok, use_host, ctx, n_new, tables)

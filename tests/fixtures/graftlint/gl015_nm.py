@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl015_nm.py
+"""GL015 near-misses that must stay silent: the int8 resident
+default, fp32 pools carrying the kv-dtype-policy marker (trailing and
+comment-block-above forms), and fp32 allocations that are not pools
+(per-block scale vectors, staging rows)."""
+
+import numpy as np
+
+
+class PoolPlane:
+    def init_pools(self, shape, n):
+        # The resident default: int8 codes — no marker needed.
+        self._kpool = np.zeros(shape, np.int8)
+        # Not a pool: the per-block scale vector rides fp32 always.
+        kscale = np.ones((n,), np.float32)
+        # kv-dtype-policy: fp32 reference layout for the exact
+        # byte-identical invariance lanes; resident default is int8.
+        ref_kpool = np.zeros(shape, np.float32)
+        vpool = np.zeros(shape, np.float32)  # kv-dtype-policy: ditto
+        return self._kpool, kscale, ref_kpool, vpool
+
+    def staging(self, rows, d):
+        # Not pool-named: a host staging buffer is not residency.
+        stage = np.empty((rows, d), np.float32)
+        return stage
+
+    def wrapped_pool(self, n, bs, h, dh):
+        # Multi-line allocation with the marker on the CLOSING line:
+        # still an explicit policy statement.
+        dbg_kpool = np.zeros(
+            (n, bs, h, dh),
+            np.float32)  # kv-dtype-policy: fp32 debug mirror
+        return dbg_kpool

@@ -1,0 +1,21 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl015_tp.py
+"""GL015 true positives: resident fp32 pools with no dtype policy.
+Two findings: an explicit float32 pool allocation, and the sneakier
+dtype-less form (the allocator default IS fp32 — the exact shape a
+refactor reintroduces without anyone typing 'float32')."""
+
+import numpy as np
+
+
+class PoolPlane:
+    def init_pools(self, shape):
+        # TP 1: explicit fp32, no marker — 4x the HBM per slot, green
+        # tests, silent capacity loss.
+        self._kpool = np.zeros(shape, np.float32)
+        return self._kpool
+
+    def scratch_pool(self, n, bs, h, dh):
+        # TP 2: implicit dtype — the default is fp32 whether or not
+        # anyone wrote it down.
+        vpool = np.zeros((n, bs, h, dh))
+        return vpool

@@ -672,7 +672,8 @@ def test_chaos_matrix_sharded(mode, fault, shard_opts, settle_counts,
 # -- paged-KV re-attach (ISSUE 7): retry without re-decode --------------------
 
 
-@pytest.mark.parametrize("backend", ["synthetic", "paged"])
+@pytest.mark.parametrize("backend", ["synthetic", "paged",
+                                     "paged-pallas"])
 def test_kv_kill_mid_decode_reattaches_pages_instead_of_redecoding(
         backend, settle_counts, tmp_path):
     """Chaos-matrix extension: a replica killed MID-DECODE of a
@@ -695,10 +696,20 @@ def test_kv_kill_mid_decode_reattaches_pages_instead_of_redecoding(
     else:
         from dpu_operator_tpu.serving import PagedKVExecutor
 
+        # "paged" = the tier-1 CPU default (XLA composition over the
+        # int8 resident pools); "paged-pallas" = the fused kernel
+        # under the interpreter — the ISSUE 13 acceptance runs the
+        # chaos matrix on BOTH kernel= paths.
         inner = PagedKVExecutor(slots=2, block_size=4, num_blocks=64,
                                 max_blocks_per_req=16,
                                 prefill_chunk=chunk, d=16, heads=2,
-                                vocab=32, mode="pipelined")
+                                vocab=32, mode="pipelined",
+                                kernel=("pallas"
+                                        if backend == "paged-pallas"
+                                        else None),
+                                interpret=(True
+                                           if backend == "paged-pallas"
+                                           else None))
 
     def run(inject, flight_dir=None):
         ex = FaultyExecutor(inner, site="kv0") if inject else inner

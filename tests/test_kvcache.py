@@ -242,14 +242,18 @@ def test_paged_stream_invariant_under_chunk_and_block_size():
     """Paging must be invisible: chunk=1 (token-at-a-time prefill) vs
     chunk=8, and block_size 2 vs 8 (same total context so the weights
     match), all decode the identical stream — the axes where append
-    offsets, causal masks and table gathers would break."""
+    offsets, causal masks and table gathers would break. Pinned to
+    fp32 pools: geometry invariance is EXACT there; on the int8
+    resident default the quantization groups change with block/chunk
+    size by design, and that divergence is bounded separately
+    (tests/test_paged_attn.py's error-bound lane)."""
     prompt = list(np.arange(13) % 7)
     golden = None
     for kw in (dict(prefill_chunk=8, block_size=4, max_blocks_per_req=8),
                dict(prefill_chunk=1, block_size=4, max_blocks_per_req=8),
                dict(prefill_chunk=8, block_size=2, max_blocks_per_req=16),
                dict(prefill_chunk=8, block_size=8, max_blocks_per_req=4)):
-        ex = _paged(mode="sync", **kw)
+        ex = _paged(mode="sync", pool_dtype="fp32", **kw)
         (stream,) = _drive(ex, [_req(prompt, max_tokens=6)])
         ex.allocator.assert_clean()
         if golden is None:

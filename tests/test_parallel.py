@@ -250,6 +250,30 @@ def test_bench_operator_gates_trip_on_regression():
         m[key] = bad
         gates = bench.evaluate_gates(m, history)
         assert not all(gates.values()), (key, gates)
+    # The ISSUE 13 residency gates are ABSOLUTE (no history needed):
+    # the >= 3.5x bytes/slot floor and the CPU interpret-equivalence.
+    m = dict(healthy)
+    m.update(serving_kv_bytes_reduction=3.99,
+             serving_paged_attn_equiv_ok=True)
+    gates = bench.evaluate_gates(m, history)
+    assert gates["serving_kv_bytes_reduction_ge_35"] is True
+    assert gates["serving_paged_attn_equiv_ok"] is True
+    m.update(serving_kv_bytes_reduction=2.0,
+             serving_paged_attn_equiv_ok=False)
+    gates = bench.evaluate_gates(m, history)
+    assert gates["serving_kv_bytes_reduction_ge_35"] is False
+    assert gates["serving_paged_attn_equiv_ok"] is False
+    # TPU rounds: the pallas-beats-xla acceptance comparison is its
+    # own absolute gate — a Pallas-only regression cannot hide behind
+    # the deploy headline's rolling median.
+    m = dict(healthy)
+    m.update(serving_paged_attn_pallas_ms=2.0,
+             serving_paged_attn_xla_ms=1.0)
+    assert bench.evaluate_gates(m, history)[
+        "serving_paged_attn_pallas_le_xla"] is False
+    m.update(serving_paged_attn_pallas_ms=0.8)
+    assert bench.evaluate_gates(m, history)[
+        "serving_paged_attn_pallas_le_xla"] is True
     # No history → no operator gates.
     assert bench.evaluate_gates(dict(healthy), {}) == {}
     # The real artifact files parse into usable history.

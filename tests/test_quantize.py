@@ -15,6 +15,8 @@ from dpu_operator_tpu.parallel.quantize import (Bf16Codec, CodecError,
                                                 bf16_decode_xp,
                                                 bf16_encode_xp,
                                                 get_codec,
+                                                int8_block_decode_xp,
+                                                int8_block_encode_xp,
                                                 int8_decode_xp,
                                                 int8_encode_xp)
 
@@ -95,6 +97,65 @@ def test_codec_twins_jit_under_jax_and_match_numpy():
     assert np.array_equal(w_np, np.asarray(w_j))
     b_j = jax.jit(lambda w: bf16_decode_xp(w, xp=jnp))(np.asarray(w_j))
     assert np.array_equal(bf16_decode_xp(w_np), np.asarray(b_j))
+
+
+# -- block-axis twins (ISSUE 13: the resident paged-KV codec) -----------------
+
+
+def test_int8_block_codec_per_block_scales_and_bound():
+    """Per-block symmetric int8 over a leading axis: each block gets
+    its OWN scale = max|x_b|/127 (a hot block cannot coarsen a quiet
+    one), per-element absolute error <= scale_b/2, and an all-zero
+    block decodes to exact zero via the scale-1.0 convention."""
+    rng = np.random.RandomState(3)
+    x = (rng.randn(6, 4, 2, 8) * rng.uniform(
+        0.01, 40, size=(6, 1, 1, 1))).astype(np.float32)
+    x[2] = 0.0
+    q, scales = int8_block_encode_xp(x)
+    assert q.dtype == np.int8 and q.shape == x.shape
+    assert scales.shape == (6,) and scales.dtype == np.float32
+    for b in range(6):
+        amax = np.max(np.abs(x[b]))
+        want = amax / 127.0 if amax > 0 else 1.0
+        assert scales[b] == pytest.approx(want)
+    back = int8_block_decode_xp(q, scales)
+    err = np.abs(back - x).reshape(6, -1).max(axis=1)
+    assert np.all(err <= scales / 2 + 1e-9)
+    assert np.all(back[2] == 0.0)
+
+
+def test_int8_block_codec_jit_matches_numpy():
+    """The block twins must jit under jax and reproduce numpy exactly
+    (codes are integer: equality is exact; scales to fp tolerance) —
+    the same contract as the chunk twins, because the resident pools
+    encode on device while the transfer path decodes host-side."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    x = (rng.randn(5, 4, 16) * 3).astype(np.float32)
+    q_np, s_np = int8_block_encode_xp(x)
+    q_j, s_j = jax.jit(lambda a: int8_block_encode_xp(a, xp=jnp))(x)
+    assert np.array_equal(q_np, np.asarray(q_j))
+    assert np.allclose(s_np, np.asarray(s_j), rtol=1e-6)
+    d_j = jax.jit(lambda q, s: int8_block_decode_xp(q, s, xp=jnp))(
+        np.asarray(q_j), np.asarray(s_j))
+    assert np.allclose(int8_block_decode_xp(q_np, s_np),
+                       np.asarray(d_j), rtol=1e-6, atol=1e-7)
+
+
+def test_int8_block_decode_broadcasts_gathered_scales():
+    """The paged-attention table gather hands the twin ``[S, B]``
+    scales against ``[S, B, bs, e]`` codes — the prefix-broadcast
+    contract the decode twin documents."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 3, 8).astype(np.float32)
+    q, scales = int8_block_encode_xp(x)
+    gq = q[None].repeat(2, axis=0)          # [2, 4, 3, 8]
+    gs = scales[None].repeat(2, axis=0)     # [2, 4]
+    back = int8_block_decode_xp(gq, gs)
+    assert back.shape == gq.shape
+    assert np.allclose(back[0], int8_block_decode_xp(q, scales))
 
 
 # -- error feedback -----------------------------------------------------------
