@@ -789,7 +789,13 @@ def bench_serving() -> dict:
             f"{out.get('serving_paged_attn_fp32_ms')}, pallas "
             f"{out.get('serving_paged_attn_pallas_ms')}), kv "
             f"{out.get('serving_kv_bytes_per_slot')} B/slot = "
-            f"{out.get('serving_kv_bytes_reduction')}x less than fp32",
+            f"{out.get('serving_kv_bytes_reduction')}x less than fp32; "
+            f"disagg decode p99 {out.get('serving_decode_p99_ms')} "
+            f"ms/tok under flood (colocated "
+            f"{out.get('serving_colocated_decode_p99_ms')}, isolation "
+            f"{out.get('serving_disagg_isolation_x')}x; transfer "
+            f"{out.get('serving_kv_transfer_gbps')} Gb/s, breakeven "
+            f"{out.get('serving_kv_transfer_breakeven_x')}x)",
             file=sys.stderr,
         )
         return out
@@ -945,6 +951,15 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # serving_paged_attn_pallas_le_xla gate above.
         ("serving_paged_attn_device_ms", 1.35,
          "serving_paged_attn_le_135_median"),
+        # Disaggregated prefill/decode (ISSUE 14): per-token decode
+        # p99 on the DEDICATED decode replica, measured WITH a
+        # concurrent prefill flood — the cross-replica isolation
+        # claim. Creep here means prefill work is leaking back into
+        # the decode replicas' step regime (a broken role split, a
+        # transfer plane stalling decode admissions, or the hand-off
+        # decoding more than its one token on the prefill side).
+        ("serving_decode_p99_ms", 1.35,
+         "serving_decode_p99_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -1042,6 +1057,12 @@ def main() -> int:
         "serving_kv_bytes_per_slot": "bytes",
         "serving_kv_bytes_per_slot_fp32": "bytes",
         "serving_kv_bytes_reduction": "x",
+        "serving_decode_p99_ms": "ms",
+        "serving_colocated_decode_p99_ms": "ms",
+        "serving_disagg_isolation_x": "x",
+        "serving_kv_transfer_gbps": "Gb/s",
+        "serving_kv_transfer_ms": "ms",
+        "serving_kv_transfer_breakeven_x": "x",
     }
     for key, unit in units.items():
         if key in metrics:

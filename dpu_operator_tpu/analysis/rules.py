@@ -29,6 +29,9 @@ GL014  wall-clock time.time() in span/duration/deadline arithmetic
        where time.monotonic() is required (obs/serving/parallel)
 GL015  resident device-pool allocation at fp32 in serving/kvcache/
        without an explicit kv-dtype-policy marker comment
+GL016  KV lease detached for a cross-replica hand-off with no paired
+       ack — no reattach/release and no hand-off to the transfer
+       plane in the same function (serving/)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1033,6 +1036,90 @@ class KVAcquireWithoutRelease(Rule):
 
 
 # --------------------------------------------------------------------------
+# GL016 — KV lease detached with no paired ack
+
+
+class KVDetachWithoutAck(Rule):
+    """Origin: ISSUE 14's disaggregated serving. GL009 polices the
+    allocator's acquire/release pairing; this is its OWNERSHIP-
+    TRANSFER sibling. A lease crossing a replica/process boundary is
+    detached first (``kv_detach_slot``/``lease.detach()``) — the
+    pages stay owned but no batcher slot, queue, or settle path will
+    ever see them again until someone acks the hand-off. A detach
+    with no visible way forward is therefore a WORSE leak than a
+    bare acquire: the leak ledger still names the owner, but every
+    recovery path (supervisor seize, queue requeue, settle choke
+    point) is structurally blind to the request, so the pages AND the
+    client's handler thread are both stranded.
+
+    The mechanical contract: a serving/ function that detaches —
+    calls ``kv_detach_slot(...)``, or ``.detach()`` on a lease-shaped
+    receiver — must, in the SAME function, either hand the detachment
+    to the transfer plane (a ``handoff``-named callable or the
+    stream's ``send_pages``) or settle it (``reattach`` — the failure
+    ack, ``release*``/``on_request_settled`` — the success/teardown
+    ack, or ``kv_import`` — the destination-side rebuild).
+
+    Scope: serving/, EXCLUDING kvcache/allocator.py (the lease owns
+    the primitive) and functions NAMED ``kv_detach_slot`` (the
+    executor seam that wraps it — the rule polices the seam's
+    clients, the same boundary GL009 draws). Near-misses that stay
+    silent: detach paired with a handoff or a failure-path reattach,
+    and ``.detach()`` on receivers with no lease pedigree (a torch
+    tensor, a thread)."""
+
+    rule_id = "GL016"
+    severity = SEVERITY_ERROR
+    title = "KV lease detached with no paired hand-off or ack"
+    hint = ("pair the detach: hand the result to the transfer plane "
+            "(handoff/send_pages) or settle it (reattach on failure, "
+            "release/kv_import on success) in the same function — a "
+            "detached lease is invisible to every supervisor/settle "
+            "recovery path, so an unpaired detach strands its pages "
+            "AND its client")
+
+    _DETACH_RECV_HINTS = ("lease",)
+    _ACK_NAMES = {"reattach", "send_pages", "kv_import",
+                  "on_request_settled"}
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving"):
+            return
+        if module.relpath.endswith("kvcache/allocator.py"):
+            return
+        for fn, qual in module.functions:
+            if qual.rsplit(".", 1)[-1] == "kv_detach_slot":
+                continue  # the seam definition, not a client
+            detaches: List[ast.Call] = []
+            acked = False
+            for n in _walk_through_lambdas(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                tname = _terminal_name(f)
+                if tname == "kv_detach_slot":
+                    detaches.append(n)
+                elif tname == "detach" and isinstance(f, ast.Attribute):
+                    recv = _terminal_name(f.value).lower()
+                    if any(h in recv for h in self._DETACH_RECV_HINTS):
+                        detaches.append(n)
+                elif tname in self._ACK_NAMES \
+                        or tname.startswith("release") \
+                        or "handoff" in tname.lower():
+                    acked = True
+            if not detaches or acked:
+                continue
+            for n in detaches:
+                yield self.finding(
+                    module, n,
+                    f"'{ast.unparse(n.func)}(...)' detaches a KV "
+                    f"lease in '{qual}' with no paired hand-off "
+                    f"(handoff/send_pages) or ack (reattach/release/"
+                    f"kv_import) — the pages and the request are "
+                    f"invisible to every recovery path")
+
+
+# --------------------------------------------------------------------------
 # GL010 — blocking fabric recv/collect with no deadline
 
 
@@ -1455,4 +1542,4 @@ def default_rules() -> List[Rule]:
             KVAcquireWithoutRelease(), UnboundedTransportRecv(),
             CopyInTransportLoop(), InconsistentLockDiscipline(),
             LockOrderInversion(), WallClockDurationMath(),
-            Fp32ResidentPoolWithoutPolicy()]
+            Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck()]

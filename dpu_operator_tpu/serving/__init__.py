@@ -18,12 +18,18 @@ executors over device-resident attention state with block-granular
 prefix reuse and chunked prefill, driven by the SAME queue/batcher/
 pool machinery (the batcher picks its KV loop off ``executor.kv``).
 
+The disaggregated plane (ISSUE 14) lives in disagg/: role-typed
+prefill/decode ReplicaPools with KV pages streamed between their
+pools over the fabric (``DisaggPool``; hand off via ``pool_factory=``
+on the ServingServer) — see docs/serving.md.
+
 Importing this package stays jax-free; jax loads only when a
 LocalExecutor or PagedKVExecutor is constructed.
 """
 
 from .api import (Draining, GenerateRequest, QueueFull, ServingError,
                   encode_prompt, encode_prompt_tokens)
+from .disagg import DisaggPool, KVSpec, KVSpecMismatch
 from .executor import (Executor, LocalExecutor, ReplicaPool,
                        SyntheticExecutor)
 from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
@@ -37,6 +43,7 @@ from .sharded import (FabricExecutor, ShardProcessSet,
 __all__ = [
     "AdmissionQueue",
     "ContinuousBatcher",
+    "DisaggPool",
     "Draining",
     "Executor",
     "FabricExecutor",
@@ -44,6 +51,8 @@ __all__ = [
     "KVBlockAllocator",
     "KVCacheOOM",
     "KVLease",
+    "KVSpec",
+    "KVSpecMismatch",
     "LocalExecutor",
     "PagedKVExecutor",
     "PrefixTree",

@@ -1,0 +1,34 @@
+"""Disaggregated prefill/decode serving (ROADMAP item 1).
+
+Dedicated prefill replicas build paged KV and stream the pages over
+the fabric to decode replicas that only run the per-token step:
+
+  * spec.py   — ``KVSpec``: the pool layout declared ONCE; wire
+    bytes, segmentation and the receiver's parse all derive from it
+    (hello-checked with typed CodecMismatch/KVSpecMismatch).
+  * stream.py — ``KVPageStream``/``KVPageStreamServer``: pages
+    point-to-point over the sharded plane's framed transport with
+    the PR 9 int8 block codec (verbatim for int8-resident pools).
+  * pool.py   — ``DisaggPool``: two role-typed ReplicaPools plus the
+    transfer plane; lease migration rides the PR 7 detach →
+    stream → import → ``_reattach`` path, failure disposition
+    mirrors the supervisor's requeue contract.
+
+See docs/serving.md ("Disaggregated prefill/decode").
+"""
+
+from .pool import DisaggPool
+from .spec import CodecMismatch, KVSpec, KVSpecMismatch
+from .stream import (KVPageStream, KVPageStreamServer, KVStreamError,
+                     KVStreamNack)
+
+__all__ = [
+    "CodecMismatch",
+    "DisaggPool",
+    "KVPageStream",
+    "KVPageStreamServer",
+    "KVSpec",
+    "KVSpecMismatch",
+    "KVStreamError",
+    "KVStreamNack",
+]

@@ -1,0 +1,164 @@
+"""KVSpec — the paged-KV pool layout, declared exactly once.
+
+The disaggregated plane moves KV pages between pools that were built
+by different executors in different processes. Everything both ends
+must agree on to do that safely — block geometry, head layout, the
+resident dtype (int8 codes + per-block scales vs fp32 rows), the
+model identity that makes the bytes meaningful at all — lives in ONE
+frozen ``KVSpec``, and every derived quantity (wire bytes per block,
+the segment slicing of a transfer, the payload split a receiver
+parses) is computed FROM it. This is the SpecLayout/pjit pattern from
+the exemplars: declare the partitioning once, derive all slice math
+from the declaration, so the sender's segmentation and the receiver's
+parse can never drift apart — they are the same function.
+
+The hello handshake (stream.py) exchanges ``fingerprint()`` dicts
+plus the wire codec id before any payload moves, the PR 9 discipline:
+a codec disagreement raises the SAME typed ``CodecMismatch`` the
+quantized ring uses, a layout disagreement raises ``KVSpecMismatch``
+naming the differing fields — never int8 bytes decoded as floats,
+never pages appended into the wrong geometry.
+
+Wire codecs (parallel/quantize.py's block-axis twins):
+
+  * ``int8`` — codes + per-block scales. For an int8-resident pool
+    this is a VERBATIM passthrough (the pool layout IS the wire
+    layout; byte-identical on both ends by construction). For an fp32
+    pool it quantizes per block on the way out (KV tolerates int8 far
+    better than gradients — the PR 9 lesson applied to residency).
+  * ``fp32`` — raw rows, lossless; the exact-reference wire for fp32
+    pools. An int8 pool REQUIRES the int8 wire: dequantizing resident
+    codes to ship fp32 would quadruple the bytes and re-rounding on
+    arrival would break the byte-identical stream contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Tuple
+
+from ...parallel.fabric_collectives import CodecMismatch
+
+__all__ = ["KVSpec", "KVSpecMismatch", "CodecMismatch", "WIRE_CODECS"]
+
+#: Wire codecs the page stream understands (fp32 = raw rows, int8 =
+#: parallel/quantize.py block-axis codes + per-block scales).
+WIRE_CODECS = ("fp32", "int8")
+
+
+class KVSpecMismatch(RuntimeError):
+    """The two ends of a page stream disagree on the pool layout or
+    model identity. Raised at hello time, before any page moves —
+    the layout sibling of the codec's ``CodecMismatch``."""
+
+
+@dataclass(frozen=True)
+class KVSpec:
+    """One paged-KV pool layout + the model identity its pages encode.
+
+    ``num_blocks`` is deliberately NOT part of the spec: pool capacity
+    is a per-replica sizing decision (a decode replica may hold far
+    more resident context than a prefill replica) and block ids are
+    remapped at import anyway. Everything that determines what a
+    block's BYTES mean is here."""
+
+    model: str            # executor family ("paged", "synthetic-kv")
+    block_size: int       # tokens per block
+    heads: int
+    d_head: int
+    vocab: int
+    max_blocks_per_req: int
+    pool_dtype: str       # "int8" (codes+scales) | "fp32"
+    planes: int = 2       # K and V (synthetic ships 1 content plane)
+    seed: int = 0         # weight identity: pages from a different
+    #                       model are bytes, not KV
+
+    def __post_init__(self):
+        if self.pool_dtype not in ("int8", "fp32"):
+            raise ValueError(f"pool_dtype must be int8|fp32, got "
+                             f"{self.pool_dtype!r}")
+        if self.block_size < 1 or self.heads < 1 or self.d_head < 1 \
+                or self.planes < 1:
+            raise ValueError("block_size/heads/d_head/planes must be "
+                             ">= 1")
+
+    # -- derived geometry (every slice below comes from here) ----------------
+
+    @property
+    def block_shape(self) -> Tuple[int, int, int]:
+        return (self.block_size, self.heads, self.d_head)
+
+    @property
+    def elems_per_block(self) -> int:
+        return self.block_size * self.heads * self.d_head
+
+    @property
+    def max_context(self) -> int:
+        return self.max_blocks_per_req * self.block_size
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def default_codec(self) -> str:
+        """The natural wire for this pool: its own resident layout."""
+        return "int8" if self.pool_dtype == "int8" else "fp32"
+
+    def validate_codec(self, codec: str) -> str:
+        if codec not in WIRE_CODECS:
+            raise ValueError(f"wire codec must be one of {WIRE_CODECS},"
+                             f" got {codec!r}")
+        if self.pool_dtype == "int8" and codec != "int8":
+            raise ValueError(
+                "int8-resident pools require the int8 wire: the codes "
+                "+ scales ARE the transfer format (fp32 would 4x the "
+                "bytes and re-round on arrival)")
+        return codec
+
+    def plane_part_nbytes(self, codec: str,
+                          n_blocks: int) -> Tuple[int, int]:
+        """(payload_bytes, scale_bytes) for ONE plane of ``n_blocks``
+        blocks under ``codec`` — the receiver's parse and the sender's
+        frame are both this function."""
+        if codec == "int8":
+            return n_blocks * self.elems_per_block, n_blocks * 4
+        return n_blocks * self.elems_per_block * 4, 0
+
+    def wire_block_nbytes(self, codec: str) -> int:
+        """Total wire bytes one block costs across all planes."""
+        pay, sc = self.plane_part_nbytes(codec, 1)
+        return self.planes * (pay + sc)
+
+    def segments(self, n_blocks: int, codec: str,
+                 max_seg_bytes: int = 1 << 18
+                 ) -> List[Tuple[int, int]]:
+        """Transfer segmentation: ``[(start_block, count), ...]``
+        covering ``n_blocks`` with each segment's wire payload at most
+        ``max_seg_bytes`` (always >= 1 block/segment). Derived from
+        the spec so a layout change re-derives both ends at once."""
+        if n_blocks <= 0:
+            return []
+        per = max(1, max_seg_bytes // self.wire_block_nbytes(codec))
+        return [(s, min(per, n_blocks - s))
+                for s in range(0, n_blocks, per)]
+
+    # -- the hello contract ---------------------------------------------------
+
+    def fingerprint(self) -> Dict:
+        return asdict(self)
+
+    def check_hello(self, remote: Dict, local_codec: str,
+                    remote_codec: str) -> None:
+        """Validate a peer's hello against this spec + codec. Codec
+        disagreement is the PR 9 ``CodecMismatch``; layout/model
+        disagreement is ``KVSpecMismatch`` naming every differing
+        field — both raised BEFORE any payload byte is parsed."""
+        if remote_codec != local_codec:
+            raise CodecMismatch(
+                f"kv page stream codec mismatch: local {local_codec!r}"
+                f" vs peer {remote_codec!r}")
+        mine = self.fingerprint()
+        diffs = [f"{k}: {mine[k]!r} != {remote.get(k)!r}"
+                 for k in mine if remote.get(k) != mine[k]]
+        if diffs:
+            raise KVSpecMismatch(
+                "kv pool layout mismatch: " + "; ".join(sorted(diffs)))

@@ -433,11 +433,24 @@ class ReplicaPool:
                  breaker_threshold: int = 5,
                  quorum: Optional[int] = None,
                  poll_s: float = 0.02, seed: int = 0,
-                 tracer=None, flight_recorder=None):
+                 tracer=None, flight_recorder=None,
+                 role: str = "unified",
+                 name_prefix: str = "replica",
+                 batcher_kwargs: Optional[dict] = None):
         from .scheduler import ContinuousBatcher
 
         if not executors:
             raise ValueError("a pool needs at least one executor")
+        # Role-typed pools (serving/disagg): `role` is the
+        # serving_pool_replicas label (prefill|decode|unified) and
+        # `name_prefix` namespaces replica names so a prefill pool's
+        # replica0 and a decode pool's replica0 never collide in
+        # per-replica series. `batcher_kwargs` rides every batcher
+        # construction INCLUDING supervisor restarts — a restarted
+        # prefill replica must keep its handoff hook.
+        self.role = str(role)
+        self.name_prefix = str(name_prefix)
+        self.batcher_kwargs = dict(batcher_kwargs or {})
         self.queue = queue
         self.registry = registry
         if registry is not None:
@@ -489,11 +502,15 @@ class ReplicaPool:
         self._sup_stop = threading.Event()
         self._sup_thread: Optional[threading.Thread] = None
 
+    def _rname(self, i: int) -> str:
+        return f"{self.name_prefix}{i}"
+
     def _make_batcher(self, i: int, ex: Executor):
         return self._Batcher(ex, self.queue, registry=self.registry,
-                             replica=f"replica{i}",
+                             replica=self._rname(i),
                              crash_only=self.supervised,
-                             tracer=self.tracer)
+                             tracer=self.tracer,
+                             **self.batcher_kwargs)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -534,7 +551,8 @@ class ReplicaPool:
 
     def states(self) -> Dict[str, str]:
         with self._plock:
-            return {f"replica{i}": s for i, s in enumerate(self._state)}
+            return {self._rname(i): s
+                    for i, s in enumerate(self._state)}
 
     def all_parked(self) -> bool:
         """True when every replica's breaker is open — no restart will
@@ -557,9 +575,9 @@ class ReplicaPool:
         for (st, sh), n in counts.items():
             self.registry.gauge_set(
                 "serving_pool_replicas", float(n),
-                {"state": st, "sharded": sh},
-                help="replicas by supervision state and whether the "
-                     "replica is fabric-sharded")
+                {"state": st, "sharded": sh, "role": self.role},
+                help="replicas by supervision state, fabric-sharding, "
+                     "and serving role (prefill|decode|unified)")
 
     def _count(self, name: str, labels: dict, help: str = "") -> None:
         if self.registry is not None:
@@ -593,15 +611,15 @@ class ReplicaPool:
                             and now >= restart_at:
                         self._restart(i)
                 except Exception:
-                    log.exception("supervisor: replica%d cycle failed",
-                                  i)
+                    log.exception("supervisor: %s cycle failed",
+                                  self._rname(i))
             self._sup_stop.wait(self.poll_s)
 
     def _replica_down(self, i: int, batcher, why: str) -> None:
         err = batcher.failure
         self.tracer.event(
             "supervisor.detect",
-            attrs={"replica": f"replica{i}", "why": why,
+            attrs={"replica": self._rname(i), "why": why,
                    "error": str(err)[:200] if err else None})
         # _seizing flips BEFORE seize(): at no instant is a seized
         # request in none of {batcher slots, this hand-off, the queue}
@@ -615,12 +633,13 @@ class ReplicaPool:
             rids = [r.request_id for r in seized]
             self.tracer.record_span(
                 "supervisor.seize", t0, time.monotonic(),
-                attrs={"replica": f"replica{i}", "why": why,
+                attrs={"replica": self._rname(i), "why": why,
                        "request_ids": rids})
-            self.tracer.decision("seize", replica=f"replica{i}",
+            self.tracer.decision("seize", replica=self._rname(i),
                                  why=why, request_ids=rids)
-            log.warning("replica%d %s (%s); requeueing %d in-flight "
-                        "request(s): %s", i, why, err, len(seized),
+            log.warning("%s %s (%s); requeueing %d in-flight "
+                        "request(s): %s", self._rname(i), why, err,
+                        len(seized),
                         rids)
             self._requeue(i, seized)
         finally:
@@ -645,19 +664,19 @@ class ReplicaPool:
             if self.registry is not None:
                 self.registry.gauge_set(
                     "serving_breaker_state", 1.0,
-                    {"replica": f"replica{i}"},
+                    {"replica": self._rname(i)},
                     help="1 when the replica's restart breaker is "
                          "open (replica parked)")
             self.tracer.event(
                 "supervisor.breaker_open",
-                attrs={"replica": f"replica{i}",
+                attrs={"replica": self._rname(i),
                        "failures_in_window": len(window),
                        "window_s": self.breaker_window_s})
             self.tracer.decision("breaker_open",
-                                 replica=f"replica{i}")
-            log.error("replica%d: breaker OPEN (%d failures in %.0fs) "
-                      "— parked, pool degraded",
-                      i, len(window), self.breaker_window_s)
+                                 replica=self._rname(i))
+            log.error("%s: breaker OPEN (%d failures in %.0fs) "
+                      "— parked, pool degraded", self._rname(i),
+                      len(window), self.breaker_window_s)
             # Publish BEFORE the flight snapshot: the snapshot is
             # disk I/O that can take >100 ms on a loaded box, and a
             # scraper reading serving_pool_replicas inside that
@@ -677,7 +696,7 @@ class ReplicaPool:
 
     def _requeue(self, i: int, reqs: List[GenerateRequest]) -> None:
         now = time.monotonic()
-        replica = f"replica{i}"
+        replica = self._rname(i)
         for req in reqs:
             if req.done:
                 # Settled before (or while) the replica fell over —
@@ -752,7 +771,8 @@ class ReplicaPool:
             # rebuilt. (Executor-level failures surface later, in the
             # new batcher thread's reset/step, and come back through
             # the normal death path.)
-            log.exception("replica%d: restart construction failed", i)
+            log.exception("%s: restart construction failed",
+                          self._rname(i))
             self._record_failure(i)
             return
         with self._plock:
@@ -765,15 +785,15 @@ class ReplicaPool:
             self._restart_at[i] = None
         b.start()
         self._count("serving_replica_restarts_total",
-                    {"replica": f"replica{i}"},
+                    {"replica": self._rname(i)},
                     help="supervisor-initiated replica restarts")
         self.tracer.record_span(
             "supervisor.restart", t0, time.monotonic(),
-            attrs={"replica": f"replica{i}",
+            attrs={"replica": self._rname(i),
                    "restarts": self.restarts[i]})
-        self.tracer.decision("restart", replica=f"replica{i}")
+        self.tracer.decision("restart", replica=self._rname(i))
         self._publish_state()
-        log.info("replica%d: restarted (attempt %d)", i,
+        log.info("%s: restarted (attempt %d)", self._rname(i),
                  self.restarts[i])
         # The recovery snapshot: by restart time the ring holds the
         # WHOLE chain (fault → detect → seize → requeue → restart) —
@@ -786,7 +806,7 @@ class ReplicaPool:
             return
         try:
             rec.snapshot(reason,
-                         extra={"replica": f"replica{replica}",
+                         extra={"replica": self._rname(replica),
                                 "states": self.states()})
         except Exception:
             # The recorder is evidence, not a dependency: a snapshot

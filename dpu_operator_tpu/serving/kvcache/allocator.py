@@ -198,7 +198,7 @@ class KVLease:
     never leave the lease ahead of (or behind) what the client saw."""
 
     __slots__ = ("allocator", "exec_id", "owner", "blocks", "prompt",
-                 "cached_tokens", "_released", "_lock")
+                 "cached_tokens", "_released", "_in_transit", "_lock")
 
     def __init__(self, allocator: KVBlockAllocator, exec_id: str,
                  owner: str, blocks: List[int],
@@ -210,6 +210,7 @@ class KVLease:
         self.prompt = tuple(int(t) for t in prompt)
         self.cached_tokens = int(cached_tokens)
         self._released = False
+        self._in_transit = False
         self._lock = threading.Lock()
 
     @property
@@ -217,10 +218,48 @@ class KVLease:
         return self._released
 
     @property
+    def in_transit(self) -> bool:
+        return self._in_transit
+
+    @property
     def resumable(self) -> bool:
         """True while the pages are still owned — the supervisor's
         requeue keeps decoded tokens (retry resumes) iff this holds."""
         return not self._released
+
+    # -- cross-replica hand-off (serving/disagg) ------------------------------
+
+    def detach(self) -> bool:
+        """Mark the lease as crossing a replica boundary (pages being
+        exported/streamed). The pages stay owned — a failed transfer
+        must be able to ``reattach()`` and resume on the source side —
+        but a detached lease refuses a second concurrent hand-off and
+        refuses ``kv_attach`` until the transfer plane settles it one
+        way or the other (the detach/ack pairing GL016 polices).
+
+        Returns False when the lease is ALREADY RELEASED: the settle
+        choke point can fire from the HTTP handler's thread at any
+        time (the same race every release path tolerates by
+        idempotency), so detach-of-released is a benign lost race —
+        the caller must treat the request as settled, never hand it
+        off. A DOUBLE detach still raises: two concurrent hand-offs
+        means two owners, an ownership bug no disposition fixes."""
+        with self._lock:
+            if self._released:
+                return False
+            if self._in_transit:
+                raise ValueError(
+                    f"double detach of lease (owner {self.owner!r})")
+            self._in_transit = True
+            return True
+
+    def reattach(self) -> None:
+        """Ack the hand-off's FAILURE path: the transfer did not go
+        through, ownership returns to the source pool (the request can
+        requeue and resume there). Idempotent; the success path's ack
+        is ``release()`` after the destination lease is attached."""
+        with self._lock:
+            self._in_transit = False
 
     def release(self, cache_hook=None) -> bool:
         """Idempotent: returns the pages exactly once, False on the

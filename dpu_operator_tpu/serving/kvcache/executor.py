@@ -183,6 +183,13 @@ class KVExecutorBase(Executor):
             if self._states[slot] is not None:
                 raise ValueError(f"slot {slot} already bound")
             lease = getattr(req, "kv_lease", None)
+            if lease is not None and lease.in_transit:
+                # The transfer plane owns a detached lease until it
+                # acks (attach) or reattaches (failure) — a request
+                # reaching admission mid-transfer means two owners.
+                raise ValueError(
+                    f"request {req.request_id}: lease is mid-transfer "
+                    f"(detached, not yet acked)")
             if lease is not None and not lease.released:
                 # The released check races the settle choke point
                 # (finish() can release from the HTTP handler's thread
@@ -218,16 +225,7 @@ class KVExecutorBase(Executor):
             need_total = -(-(plen + req.max_tokens) // self.block_size)
             need = need_total - len(cached_blocks)
             try:
-                try:
-                    fresh = self.allocator.acquire(need, owner)
-                except KVCacheOOM:
-                    # Evict LRU prefix-cache leaves to make room; a
-                    # second OOM is the real admission shed.
-                    if self.prefix is None:
-                        raise
-                    self.prefix.evict(
-                        need - self.allocator.free_count())
-                    fresh = self.allocator.acquire(need, owner)
+                fresh = self._acquire_with_evict(need, owner)
             except KVCacheOOM:
                 if cached_blocks:
                     self.allocator.release(cached_blocks, owner)
@@ -265,6 +263,25 @@ class KVExecutorBase(Executor):
         self.resumed_total += 1
         return 0
 
+    def _acquire_with_evict(self, n: int, owner: str):
+        """Page reservation with the admission eviction policy: on
+        OOM, evict LRU prefix-cache leaves to make room; a second OOM
+        is the real shed. ONE copy shared by kv_attach and kv_import
+        so admission and transfer-import can never diverge on shed
+        behavior. Callers own the blocks' way back (lease
+        registration or the cached-blocks unwind) — the GL009 pairing
+        lives at the call sites, which is why the acquires below are
+        individually waived."""
+        try:
+            # graftlint: disable=GL009
+            return self.allocator.acquire(n, owner)
+        except KVCacheOOM:
+            if self.prefix is None:
+                raise
+            self.prefix.evict(n - self.allocator.free_count())
+            # graftlint: disable=GL009
+            return self.allocator.acquire(n, owner)
+
     def kv_release_slot(self, slot: int, cache: bool = True) -> None:
         """Unbind `slot` and release its lease exactly once; when
         `cache`, the request's full prompt blocks are inserted into
@@ -276,23 +293,131 @@ class KVExecutorBase(Executor):
             self._states[slot] = None
         if st is None:
             return
-        # confirmed, NOT ctx: a mid-prefill truncation retires the
-        # slot while its latest chunk is dispatched but uncollected.
-        # If that step then fails (pools and prefix cache survive the
-        # reset), ctx-derived caching would publish blocks whose KV
-        # for those positions was never written — and match_and_fork
-        # would serve them as truth to every later same-prefix
-        # request.
-        written = min(len(st.lease.prompt), st.confirmed)
-        full = (written // self.block_size) * self.block_size
-        hook = None
-        if cache and self.prefix is not None and full > 0:
-            prefix_tree, bs = self.prefix, self.block_size
+        st.lease.release(
+            cache_hook=self.prefix_cache_hook(st.confirmed)
+            if cache else None)
 
-            def hook(lease):
+    def prefix_cache_hook(self, confirmed: int):
+        """The release-time prefix-cache insert covering only
+        COLLECT-CONFIRMED prompt positions (confirmed, NOT ctx: a
+        mid-prefill truncation retires the slot while its latest
+        chunk is dispatched but uncollected — if that step then fails,
+        ctx-derived caching would publish blocks whose KV was never
+        written, and match_and_fork would serve them as truth to
+        every later same-prefix request). Shared by the retire path
+        above and the disagg transfer plane's post-ack release."""
+        if self.prefix is None:
+            return None
+        prefix_tree, bs = self.prefix, self.block_size
+        confirmed = int(confirmed)
+
+        def hook(lease):
+            written = min(len(lease.prompt), confirmed)
+            full = (written // bs) * bs
+            if full > 0:
                 prefix_tree.insert(lease.prompt[:full],
                                    lease.blocks[:full // bs])
-        st.lease.release(cache_hook=hook)
+        return hook
+
+    # -- cross-replica page hand-off (serving/disagg) --------------------------
+
+    @property
+    def kv_spec(self):
+        """The pool layout + model identity as a KVSpec — declared
+        once here, and everything the transfer path does (wire bytes,
+        segmentation, the receiver's parse, the hello check) derives
+        from it. Lazy import: kvcache must stay importable without
+        the disagg package (which imports kvcache back)."""
+        from ..disagg.spec import KVSpec
+
+        return KVSpec(**self._spec_fields())
+
+    def _spec_fields(self) -> dict:
+        raise NotImplementedError
+
+    def kv_detach_slot(self, slot: int) -> Optional[dict]:
+        """Unbind `slot` and DETACH its lease for a cross-replica
+        hand-off: the pages stay owned (a failed transfer reattaches
+        and resumes here), the slot frees for new admissions, and the
+        returned descriptor carries everything the transfer plane
+        needs — the lease, the collect-CONFIRMED written extent
+        (export must never ship positions a failed step left
+        unwritten), and this executor (the export source). The
+        detach/ack pairing is the GL016 contract: every caller must
+        visibly hand the result to the transfer plane or settle it.
+
+        Returns None when the request settled concurrently (the
+        handler-thread finish() released the lease between the
+        caller's done-check and here — the race every settle path
+        tolerates): the slot is unbound, the pages already returned
+        through the choke point, and there is nothing to hand off."""
+        with self._slock:
+            st = self._states[slot]
+            self._states[slot] = None
+        if st is None:
+            raise ValueError(f"slot {slot}: nothing bound to detach")
+        if not st.lease.detach():
+            return None
+        return {"lease": st.lease, "confirmed": int(st.confirmed),
+                "req_id": st.req_id, "executor": self}
+
+    def kv_export(self, req, detach: dict) -> Tuple[dict, list]:
+        """Read the detached lease's WRITTEN pages out of this pool:
+        ``(meta, planes)`` where meta is the wire-ready transfer
+        header (self-contained: the importer rebuilds the lease from
+        it alone, no shared objects across the boundary) and planes
+        the pool-layout arrays ``[(payload, scales), ...]`` for the
+        stream's codec stage."""
+        lease = detach["lease"]
+        n_tokens = int(detach["confirmed"])
+        n_blocks = -(-n_tokens // self.block_size)
+        blocks = lease.blocks[:n_blocks]
+        planes = self._export_pages(blocks, req, n_tokens)
+        meta = {"req": req.request_id, "tokens": n_tokens,
+                "n_blocks": n_blocks, "cached": lease.cached_tokens,
+                "prompt_tokens": list(lease.prompt),
+                "settled": [int(t) for t in req.tokens],
+                "max_tokens": int(req.max_tokens)}
+        return meta, planes
+
+    def kv_import(self, meta: dict, planes: list):
+        """Build a LOCAL lease for a transferred request: reserve its
+        worst-case pages from THIS pool (OOM here is the importer's
+        nack — capacity pressure, the transfer plane's retry/requeue
+        decision), write the shipped pages into the first blocks, and
+        return the new KVLease (exec_id = this executor, so the
+        decode-side kv_attach takes the _reattach resume path). The
+        caller owns attaching it to the request — and releasing it if
+        the hand-off dies between ack and attach."""
+        prompt = [int(t) for t in meta["prompt_tokens"]]
+        plen = len(prompt)
+        if plen + int(meta["max_tokens"]) > self.max_context:
+            raise ValueError(
+                f"transferred request {meta.get('req')} needs "
+                f"{plen} + {meta['max_tokens']} context; this pool "
+                f"caps at {self.max_context}")
+        owner = str(meta["req"])
+        need = -(-(plen + int(meta["max_tokens"])) // self.block_size)
+        n_blocks = int(meta["n_blocks"])
+        if n_blocks > need:
+            raise ValueError(
+                f"transfer ships {n_blocks} block(s) but the lease "
+                f"geometry derives {need}")
+        fresh = self._acquire_with_evict(need, owner)
+        try:
+            self._import_pages(fresh[:n_blocks], planes, meta)
+        except BaseException:
+            self.allocator.release(fresh, owner)
+            raise
+        return KVLease(self.allocator, self._exec_id, owner, fresh,
+                       tuple(prompt),
+                       cached_tokens=int(meta.get("cached", 0)))
+
+    def _export_pages(self, blocks, req, n_tokens: int) -> list:
+        raise NotImplementedError
+
+    def _import_pages(self, blocks, planes: list, meta: dict) -> None:
+        raise NotImplementedError
 
     # -- the two-phase decode contract ----------------------------------------
 
@@ -526,6 +651,7 @@ class PagedKVExecutor(KVExecutorBase):
                          pipelined=mode == "pipelined")
         from .paged import PagedDecodeStep
 
+        self._seed = int(seed)  # weight identity, stamped on kv_spec
         self._paged = PagedDecodeStep(
             slots=slots, vocab=vocab, d=d, heads=heads,
             block_size=block_size, num_blocks=num_blocks,
@@ -545,6 +671,53 @@ class PagedKVExecutor(KVExecutorBase):
         # Pools (codes AND scales) are kept — re-attach depends on
         # surviving pages; only the token recurrence restarts.
         self._prev = self._paged.init_prev()
+
+    def _spec_fields(self) -> dict:
+        p = self._paged
+        return dict(model="paged", block_size=p.block_size,
+                    heads=p.heads, d_head=p.d_head, vocab=p.vocab,
+                    max_blocks_per_req=p.max_blocks_per_req,
+                    pool_dtype=p.pool_dtype, planes=2,
+                    seed=self._seed)
+
+    def _export_pages(self, blocks, req, n_tokens: int) -> list:
+        """Gather the written blocks device->host. Under _slock: the
+        pool references must not be donated into a concurrently
+        dispatched step mid-gather (the same plan+dispatch atomicity
+        submit() documents). np.asarray blocks on any in-flight step,
+        which is correct — the last step covering these positions was
+        already collected, so the values are final; a later in-flight
+        step only appends BEYOND the export extent (whole-block
+        gathers may include such an append, which is exactly the
+        value the decode side's own first step would write — the
+        byte-identity argument in docs/serving.md)."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        with self._slock:
+            k = np.asarray(self._kpool[idx])
+            ksc = np.asarray(self._kscale[idx])
+            v = np.asarray(self._vpool[idx])
+            vsc = np.asarray(self._vscale[idx])
+        return [(k, ksc), (v, vsc)]
+
+    def _import_pages(self, blocks, planes: list, meta: dict) -> None:
+        """Scatter transferred pages into this pool at the freshly
+        acquired block ids. Under _slock, between steps: .at[].set
+        builds NEW arrays, so an in-flight step keeps its own
+        (donated or not) buffers and the next dispatch picks up the
+        imported pools — no step ever sees a half-written import."""
+        import jax.numpy as jnp
+
+        (k, ksc), (v, vsc) = planes
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        with self._slock:
+            self._kpool = self._kpool.at[idx].set(
+                jnp.asarray(k, self._kpool.dtype))
+            self._kscale = self._kscale.at[idx].set(jnp.asarray(ksc))
+            self._vpool = self._vpool.at[idx].set(
+                jnp.asarray(v, self._vpool.dtype))
+            self._vscale = self._vscale.at[idx].set(jnp.asarray(vsc))
 
     def _dispatch(self, plan: _StepPlan):
         import jax.numpy as jnp
@@ -577,6 +750,7 @@ class SyntheticKVExecutor(KVExecutorBase):
                  max_blocks_per_req: int = 16, prefill_chunk: int = 8,
                  prefill_budget: Optional[int] = None,
                  prefix_cache: bool = True, step_time_s: float = 0.0,
+                 token_time_s: float = 0.0,
                  seed: int = 0, pipelined: bool = True,
                  fault_site: Optional[str] = None):
         super().__init__(slots, vocab=vocab, block_size=block_size,
@@ -586,6 +760,14 @@ class SyntheticKVExecutor(KVExecutorBase):
                          prefill_budget=prefill_budget,
                          prefix_cache=prefix_cache, pipelined=pipelined)
         self.step_time_s = float(step_time_s)
+        # Per-PLANNED-TOKEN cost on top of the fixed floor: the knob
+        # that makes prefill REAL in the cost model — a step co-running
+        # an 8-token prefill chunk costs base + 8*token_time_s, and
+        # every decode token in that batch pays it. Zero (the default)
+        # keeps the PR 7 fixed-cost behavior; the disagg bench turns it
+        # on to measure the cross-replica isolation claim (a prefill
+        # flood CANNOT inflate a dedicated decode replica's steps).
+        self.token_time_s = float(token_time_s)
         self.seed = int(seed)
         self.fault_site = fault_site
         self._dev_prev = np.zeros((self.slots,), np.int32)
@@ -601,8 +783,11 @@ class SyntheticKVExecutor(KVExecutorBase):
     def _device_step(self, plan: _StepPlan) -> np.ndarray:
         if self.fault_site is not None:
             faults.fire(f"{self.fault_site}.step")
-        if self.step_time_s:
-            time.sleep(self.step_time_s)
+        cost = self.step_time_s
+        if self.token_time_s:
+            cost += self.token_time_s * int(np.sum(plan.n_new))
+        if cost:
+            time.sleep(cost)
         out = np.zeros((self.slots,), np.int32)
         for s in range(self.slots):
             n = int(plan.n_new[s])
@@ -640,6 +825,54 @@ class SyntheticKVExecutor(KVExecutorBase):
         if raw.error is not None:
             raise raw.error
         return raw.tokens
+
+    # -- cross-replica hand-off (the jax-free double) --------------------------
+
+    def _spec_fields(self) -> dict:
+        return dict(model="synthetic-kv", block_size=self.block_size,
+                    heads=1, d_head=1, vocab=self.vocab,
+                    max_blocks_per_req=self.max_blocks_per_req,
+                    pool_dtype="fp32", planes=1, seed=self.seed)
+
+    def _page_content(self, prompt, settled, n_tokens: int
+                      ) -> np.ndarray:
+        """The synthetic plane's KV truth for positions
+        [0, n_tokens): position p's "KV" is the token the step that
+        wrote it CONSUMED — prompt[p] through prefill, then the
+        settled stream shifted by one (position plen+j holds
+        settled[j], the previous emit fed back as input). Computable
+        host-side from the request alone on BOTH ends, which turns
+        the synthetic import into a true end-to-end transport
+        integrity check: the importer recomputes and compares."""
+        plen = len(prompt)
+        vals = [float(prompt[p]) if p < plen
+                else float(settled[p - plen])
+                for p in range(int(n_tokens))]
+        n_blocks = -(-int(n_tokens) // self.block_size)
+        arr = np.zeros((n_blocks, self.block_size, 1, 1), np.float32)
+        if vals:
+            arr.reshape(-1)[:len(vals)] = vals
+        return arr
+
+    def _export_pages(self, blocks, req, n_tokens: int) -> list:
+        content = self._page_content(req.prompt_tokens, req.tokens,
+                                     n_tokens)
+        return [(content, np.ones((content.shape[0],), np.float32))]
+
+    def _import_pages(self, blocks, planes: list, meta: dict) -> None:
+        """Verify, don't store: the synthetic recurrence is position-
+        only, so the pool content is the TRANSPORT'S correctness
+        proof, not decode state. Exact even through the int8 wire:
+        token values are small ints (< vocab <= 127/scale margin), so
+        scale/2 rounding error < 0.5 and rint recovers them."""
+        (payload, _scales), = planes
+        expect = self._page_content(meta["prompt_tokens"],
+                                    meta["settled"], meta["tokens"])
+        got = np.rint(np.asarray(payload, np.float32))
+        if not np.array_equal(got, np.rint(expect)):
+            raise ValueError(
+                f"transferred page content diverges for request "
+                f"{meta.get('req')} (transport corruption)")
 
     def close(self) -> None:
         self._worker.close()

@@ -79,7 +79,8 @@ class ContinuousBatcher:
     def __init__(self, executor, queue, registry=None,
                  replica: str = "replica0", idle_wait_s: float = 0.05,
                  pipelined: Optional[bool] = None,
-                 crash_only: bool = False, tracer=None):
+                 crash_only: bool = False, tracer=None,
+                 handoff=None):
         self.executor = executor
         self.queue = queue
         self.registry = registry
@@ -93,6 +94,16 @@ class ContinuousBatcher:
         # [slots, d] rows: admission binds a block-table lease and the
         # loop is _run_kv (chunked prefill + NO_TOKEN-aware retire).
         self.kv_mode = bool(getattr(executor, "kv", False))
+        # Role hand-off (serving/disagg): when set, this batcher is a
+        # PREFILL replica — a request that emits a token and is not
+        # finished leaves its slot through kv_detach_slot and
+        # handoff(req, detach) instead of decoding here. Called UNDER
+        # the settle lock, so it must only enqueue (the transfer
+        # plane's worker does the export/stream off-thread). KV-only:
+        # the row plane has no transferable state.
+        if handoff is not None and not self.kv_mode:
+            raise ValueError("handoff requires a paged-KV executor")
+        self.handoff = handoff
         # crash_only (Candea & Fox): an executor failure EXITS the loop
         # with the occupants left in their slots and the error on
         # self.failure — the supervisor (ReplicaPool) seizes, requeues
@@ -674,6 +685,43 @@ class ContinuousBatcher:
                 # still prefilling (possibly zero tokens).
                 req.truncated = True
                 finished = True
+            if not finished and emitted and self.handoff is not None:
+                # Prefill replica: the emit means prefill completed
+                # (the step that processes the last prompt token emits
+                # the first decode token), so the request's KV is
+                # built and its decode regime belongs elsewhere.
+                # Detach the lease (pages stay owned — a failed
+                # transfer resumes here) and hand ownership to the
+                # transfer plane. A retry that re-attached here first
+                # re-decodes exactly one token and hands off again —
+                # the stream stays byte-identical either way.
+                detach = ex.kv_detach_slot(i)
+                if detach is None:
+                    # Settled concurrently by the handler thread (the
+                    # finish choke point released the lease between
+                    # the done-check above and the detach): pages
+                    # already returned, nothing to hand off — just
+                    # free the slot, like the req.done branch.
+                    self._slots[i] = None
+                    continue
+                self.tracer.event(
+                    "disagg.handoff", request_id=req.request_id,
+                    parent_id=req.trace_parent,
+                    attrs={"replica": self.replica,
+                           "tokens": len(req.tokens),
+                           "confirmed": detach["confirmed"]})
+                self.tracer.decision("handoff",
+                                     request_id=req.request_id,
+                                     replica=self.replica)
+                # Hand off BEFORE emptying the slot: the transfer
+                # plane's _transferring counter must cover the request
+                # before active() stops counting it, or a quiesce poll
+                # landing in the gap reads the pool as drained around
+                # a live hand-off (the supervisor's _seizing
+                # discipline: flip the accounting flag first).
+                self.handoff(req, detach)
+                self._slots[i] = None
+                continue
             if finished:
                 ex.kv_release_slot(i, cache=True)
                 self._count("serving_tokens_total",

@@ -78,6 +78,7 @@ class ServingServer:
                  registry: Optional[Registry] = None,
                  drainer=None, node_name: Optional[str] = None,
                  pool_opts: Optional[dict] = None,
+                 pool_factory=None,
                  tracer=None, flight_dir: Optional[str] = None):
         # Per-server registry by default: tests and benches run several
         # servers in one process; sharing default_registry would blend
@@ -100,11 +101,25 @@ class ServingServer:
         # pool_opts passes supervision knobs through (supervise,
         # watchdog_s, max_attempts, quorum, backoff/breaker tuning) —
         # the pool's defaults are the production contract.
+        # pool_factory swaps the scheduler layer wholesale (the
+        # disagg plane's role-typed DisaggPool): called with
+        # (executors, queue, registry, tracer=, flight_recorder=), it
+        # must return a ReplicaPool-shaped object — start/stop/
+        # quiesce/live_count/states/all_parked/quorum/supervised/
+        # executors — and `executors` passed to THIS constructor must
+        # be the factory pool's full executor list (the front door
+        # validates vocab/max_context/d across all of them).
         opts = dict(pool_opts or {})
         opts.setdefault("tracer", self.tracer)
         opts.setdefault("flight_recorder", self.flight)
-        self.pool = ReplicaPool(executors, self.queue,
-                                registry=self.registry, **opts)
+        if pool_factory is not None:
+            self.pool = pool_factory(executors, self.queue,
+                                     self.registry,
+                                     tracer=self.tracer,
+                                     flight_recorder=self.flight)
+        else:
+            self.pool = ReplicaPool(executors, self.queue,
+                                    registry=self.registry, **opts)
         # serving_trace_dropped_total is published as a DELTA against
         # the tracer's monotonic drop count at scrape time; init the
         # series so a zero-drop run still proves the bound exists.

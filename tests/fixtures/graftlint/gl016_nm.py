@@ -1,0 +1,33 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl016_nm.py
+"""GL016 near-misses that must stay silent: detach handed to the
+transfer plane (the handoff hook / the stream's send_pages), detach
+paired with the failure-path reattach, detach settled through a
+release, and .detach() on receivers with no lease pedigree (a torch
+tensor, a thread)."""
+
+
+class Router:
+    def hand_off(self, slot, req):
+        # Handed to the transfer plane: the handoff callable owns it.
+        detach = self.executor.kv_detach_slot(slot)
+        self.handoff(req, detach)
+
+    def ship(self, req, detach):
+        # Streamed with a failure-path ack: reattach on any raise.
+        lease = detach["lease"]
+        lease.detach()
+        try:
+            return self.stream.send_pages(self.meta(req), self.planes)
+        except Exception:
+            lease.reattach()
+            raise
+
+    def teardown(self, detach):
+        # Settled: release IS the success/teardown ack.
+        detach["lease"].release()
+
+    def unrelated(self, grad, worker):
+        # No lease pedigree: autograd detach and a thread detach.
+        flat = grad.detach()
+        worker.detach()
+        return flat

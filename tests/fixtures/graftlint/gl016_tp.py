@@ -1,0 +1,18 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl016_tp.py
+"""GL016 true positives: a KV lease detached for a hand-off with no
+paired ack anywhere in the function. Two findings: a kv_detach_slot
+whose result is stashed on an ad hoc dict (no handoff, no reattach —
+the request is now invisible to every supervisor/settle recovery
+path), and a bare lease.detach() dropped on the floor."""
+
+
+class Router:
+    def pull(self, slot, req):
+        # TP 1: detached and stashed; nobody will ever ack this.
+        detach = self.executor.kv_detach_slot(slot)
+        self.parked[req.request_id] = detach
+
+    def mark(self, req):
+        # TP 2: detach with no hand-off and no failure-path reattach.
+        req.kv_lease.detach()
+        return req.request_id

@@ -856,7 +856,8 @@ def test_breaker_parks_flapping_replica_healthz_red_at_zero_live():
             assert sum(srv.pool.restarts) == restarts_at_park
             assert reg.gauge_value(
                 "serving_pool_replicas",
-                {"state": "parked", "sharded": "false"}) == 1.0
+                {"state": "parked", "sharded": "false",
+                 "role": "unified"}) == 1.0
         finally:
             srv.stop()
 

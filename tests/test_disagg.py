@@ -1,0 +1,640 @@
+"""Disaggregated prefill/decode serving (ISSUE 14).
+
+Correctness strategy, carried over from the PR 7 invariance suite:
+the SAME fixed trace must decode the SAME byte-identical token
+streams whether a request lives its whole life in one colocated
+replica or is prefilled on one replica, its KV pages streamed over
+the fabric, and decoded on another — across Synthetic and real
+jitted paged executors, sync and pipelined decode loops, int8 and
+fp32 resident pools, prefix-cache-hit prefills, and a transfer cut
+mid-stream by an injected fault. Every test asserts ZERO leaked
+blocks on BOTH pools at teardown, and the chaos cases assert
+exactly-once settle through the monkeypatched finish() counter.
+"""
+
+import json
+import time
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu import faults
+from dpu_operator_tpu.faults import FaultyExecutor
+from dpu_operator_tpu.obs import FlightRecorder
+from dpu_operator_tpu.obs import trace as obs_trace
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      DisaggPool, GenerateRequest,
+                                      KVSpecMismatch, ServingServer,
+                                      SyntheticKVExecutor)
+from dpu_operator_tpu.serving.disagg import (KVPageStream,
+                                             KVPageStreamServer,
+                                             KVSpec, KVStreamNack)
+from dpu_operator_tpu.serving.disagg.spec import CodecMismatch
+from dpu_operator_tpu.utils.metrics import Registry
+
+# The PR 7 invariance trace: the 26-token prompt fills the whole
+# block table; the 25-token one chunk-prefills mid-run.
+PROMPTS = [list(np.arange(25) % 13), [3, 1, 4, 1, 5], [9] * 12,
+           list(np.arange(26) % 13)]
+MAX_TOKENS = 6
+
+POOL_OPTS = dict(watchdog_s=0.5, restart_backoff_s=0.01, poll_s=0.005)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    leaked = faults.active_plan()
+    faults.uninstall()
+    assert leaked is None, "test leaked an installed FaultPlan"
+
+
+@pytest.fixture()
+def settle_counts(monkeypatch):
+    counts = Counter()
+    orig = GenerateRequest.finish
+
+    def counting(self):
+        counts[self.request_id] += 1
+        orig(self)
+
+    monkeypatch.setattr(GenerateRequest, "finish", counting)
+    return counts
+
+
+def _req(prompt, max_tokens=MAX_TOKENS, deadline_s=60.0):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + deadline_s,
+                           prompt_tokens=list(prompt))
+
+
+def _drive_colocated(ex, prompts, **req_kw):
+    q = AdmissionQueue(max_depth=len(prompts) + 4)
+    b = ContinuousBatcher(ex, q)
+    reqs = [_req(p, **req_kw) for p in prompts]
+    for r in reqs:
+        q.submit(r)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(30), "request lost"
+    finally:
+        b.stop()
+    for r in reqs:
+        assert r.error is None, r.error
+    ex.allocator.assert_clean()
+    return [list(r.tokens) for r in reqs]
+
+
+def _drive_disagg(pool, queue, prompts, timeout=30.0, **req_kw):
+    reqs = [_req(p, **req_kw) for p in prompts]
+    for r in reqs:
+        queue.submit(r)
+    for r in reqs:
+        assert r.wait(timeout), "request lost"
+    for r in reqs:
+        assert r.error is None, r.error
+    return [list(r.tokens) for r in reqs], reqs
+
+
+def _synth(**kw):
+    args = dict(slots=2, block_size=4, num_blocks=64,
+                max_blocks_per_req=16, prefill_chunk=8, pipelined=True)
+    args.update(kw)
+    return SyntheticKVExecutor(**args)
+
+
+# -- KVSpec: layout declared once, slice math derived -------------------------
+
+
+def test_spec_derives_wire_bytes_and_segments():
+    spec = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                  vocab=32, max_blocks_per_req=8, pool_dtype="int8")
+    # 4*2*8 = 64 int8 code bytes + 4 scale bytes, twice (K and V).
+    assert spec.wire_block_nbytes("int8") == 2 * (64 + 4)
+    # Segments cover exactly, in order, each under the byte budget.
+    segs = spec.segments(7, "int8", max_seg_bytes=3 * 136)
+    assert segs == [(0, 3), (3, 3), (6, 1)]
+    assert spec.segments(0, "int8") == []
+    # The receiver's parse is the same function as the sender's frame.
+    pay, sc = spec.plane_part_nbytes("int8", 3)
+    assert (pay, sc) == (3 * 64, 12)
+    assert spec.blocks_for_tokens(9) == 3
+
+
+def test_spec_hello_rejects_codec_and_layout_mismatch():
+    spec = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                  vocab=32, max_blocks_per_req=8, pool_dtype="fp32")
+    with pytest.raises(CodecMismatch):
+        spec.check_hello(spec.fingerprint(), "fp32", "int8")
+    other = KVSpec(model="paged", block_size=8, heads=2, d_head=8,
+                   vocab=32, max_blocks_per_req=8, pool_dtype="fp32")
+    with pytest.raises(KVSpecMismatch, match="block_size"):
+        spec.check_hello(other.fingerprint(), "fp32", "fp32")
+    # A different SEED is a different model: its pages are not KV here.
+    reseeded = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                      vocab=32, max_blocks_per_req=8,
+                      pool_dtype="fp32", seed=7)
+    with pytest.raises(KVSpecMismatch, match="seed"):
+        spec.check_hello(reseeded.fingerprint(), "fp32", "fp32")
+
+
+def test_spec_int8_pool_requires_int8_wire():
+    spec = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                  vocab=32, max_blocks_per_req=8, pool_dtype="int8")
+    assert spec.default_codec() == "int8"
+    with pytest.raises(ValueError, match="int8"):
+        spec.validate_codec("fp32")
+
+
+# -- the page stream: framed transport + hello + segmentation ----------------
+
+
+def test_stream_roundtrip_and_mismatch_rejection():
+    """Pages round-trip the real socket path byte-exactly (fp32 wire),
+    the segmentation really splits (tiny seg budget), and a client
+    with a different layout or codec is refused at hello with the
+    typed error — before any payload byte moves."""
+    spec = KVSpec(model="paged", block_size=2, heads=2, d_head=4,
+                  vocab=32, max_blocks_per_req=8, pool_dtype="fp32")
+    got = {}
+
+    def import_fn(meta, planes):
+        got["meta"] = meta
+        got["planes"] = planes
+        return {"ok_extra": 1}
+
+    srv = KVPageStreamServer(spec, import_fn, codec="fp32")
+    try:
+        rng = np.random.RandomState(0)
+        k = rng.randn(5, 2, 2, 4).astype(np.float32)
+        v = rng.randn(5, 2, 2, 4).astype(np.float32)
+        ones = np.ones((5,), np.float32)
+        st = KVPageStream(spec, srv.addr, codec="fp32", seg_bytes=80)
+        assert len(spec.segments(5, "fp32", 80)) > 1
+        ack = st.send_pages(
+            {"req": "r1", "n_blocks": 5, "tokens": 10,
+             "prompt_tokens": [1], "settled": [], "max_tokens": 1,
+             "cached": 0}, [(k, ones), (v, ones)])
+        assert ack["ok"] and ack["ok_extra"] == 1
+        np.testing.assert_array_equal(got["planes"][0][0], k)
+        np.testing.assert_array_equal(got["planes"][1][0], v)
+
+        # Layout mismatch: refused at hello, typed.
+        other = KVSpec(model="paged", block_size=4, heads=2, d_head=4,
+                       vocab=32, max_blocks_per_req=8,
+                       pool_dtype="fp32")
+        bad = KVPageStream(other, srv.addr, codec="fp32")
+        with pytest.raises(KVStreamNack, match="block_size"):
+            bad.connect()
+        mixed = KVPageStream(spec, srv.addr, codec="int8")
+        with pytest.raises(KVStreamNack, match="codec"):
+            mixed.connect()
+        st.close()
+    finally:
+        srv.close()
+
+
+def test_stream_import_failure_nacks_with_oom_flag():
+    spec = KVSpec(model="paged", block_size=2, heads=1, d_head=2,
+                  vocab=32, max_blocks_per_req=4, pool_dtype="fp32",
+                  planes=1)
+
+    def import_fn(meta, planes):
+        raise RuntimeError("kv cache exhausted: need 4, 0 free")
+
+    srv = KVPageStreamServer(spec, import_fn, codec="fp32")
+    try:
+        st = KVPageStream(spec, srv.addr, codec="fp32")
+        blocks = np.zeros((1, 2, 1, 2), np.float32)
+        with pytest.raises(KVStreamNack) as ei:
+            st.send_pages({"req": "r", "n_blocks": 1, "tokens": 2,
+                           "prompt_tokens": [1], "settled": [],
+                           "max_tokens": 1, "cached": 0},
+                          [(blocks, np.ones((1,), np.float32))])
+        assert ei.value.oom
+        st.close()
+    finally:
+        srv.close()
+
+
+# -- lease detach/ack ---------------------------------------------------------
+
+
+def test_lease_detach_reattach_contract():
+    from dpu_operator_tpu.serving.kvcache import (KVBlockAllocator,
+                                                  KVLease)
+
+    a = KVBlockAllocator(num_blocks=4, block_size=2)
+    lease = KVLease(a, "ex", "r1", a.acquire(2, "r1"), (1, 2), 0)
+    assert lease.detach() is True
+    assert lease.in_transit and lease.resumable
+    with pytest.raises(ValueError, match="double detach"):
+        lease.detach()
+    lease.reattach()
+    assert not lease.in_transit
+    assert lease.detach() is True
+    # release is the success-path ack: terminal, pages return.
+    assert lease.release() is True
+    a.assert_clean()
+    # Detach-of-released is the BENIGN settle race (the handler's
+    # finish() can release from its own thread at any time): False,
+    # never a raise that would crash the retiring batcher.
+    assert lease.detach() is False
+
+
+def test_detach_slot_of_settled_request_is_none_not_crash():
+    """Review finding: a handler-thread finish() landing between the
+    retire loop's done-check and kv_detach_slot releases the lease
+    first; the detach must report 'already settled' (None) — raising
+    through the crash-only batcher would convert a benign settle race
+    into a full replica restart."""
+    ex = _synth(pipelined=False)
+    r = _req(PROMPTS[1])
+    ex.kv_attach(0, r)
+    r.fail("handler abandoned")  # settle choke point releases lease
+    assert ex.kv_detach_slot(0) is None
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_late_import_after_sender_gave_up_releases_pages():
+    """Review finding: an import completing AFTER the sender's ack
+    deadline (sender popped _pending and moved on) must release its
+    decode-side pages instead of registering them in _imported
+    forever — orphaned worst-case reservations would silently drain
+    the decode pool."""
+    pre, dec = _synth(), _synth()
+    q = AdmissionQueue(max_depth=4)
+    pool = DisaggPool([pre], [dec], q, pool_opts=dict(POOL_OPTS))
+    try:
+        import_fn = pool._import_fn(0)
+        meta = {"req": "ghost", "xfer": "dead-xfer", "n_blocks": 1,
+                "tokens": 4, "cached": 0, "max_tokens": 1,
+                "prompt_tokens": [1, 2, 3, 4], "settled": [5]}
+        planes = [(np.asarray([[1.0], [2.0], [3.0], [4.0]],
+                              np.float32).reshape(1, 4, 1, 1),
+                   np.ones((1,), np.float32))]
+        # No _pending entry for this xfer: the sender is gone.
+        with pytest.raises(RuntimeError, match="abandoned"):
+            import_fn(meta, planes)
+        assert pool._imported == {}
+        dec.allocator.assert_clean()
+    finally:
+        pool.stop()
+    pre.close()
+    dec.close()
+
+
+def test_kv_attach_refuses_mid_transfer_lease():
+    ex = _synth(pipelined=False)
+    r = _req(PROMPTS[1])
+    ex.kv_attach(0, r)
+    detach = ex.kv_detach_slot(0)
+    with pytest.raises(ValueError, match="mid-transfer"):
+        ex.kv_attach(1, r)
+    detach["lease"].reattach()
+    assert ex.kv_attach(1, r) == 0  # resumes through _reattach
+    ex.kv_release_slot(1, cache=False)
+    r.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- equivalence: disagg streams == colocated streams -------------------------
+
+
+@pytest.mark.parametrize("decode_pipelined", [True, False])
+def test_disagg_streams_match_colocated_synthetic(decode_pipelined):
+    """The acceptance invariance on the jax-free plane, both decode
+    loop shapes: prefill-replica + page transfer + decode-replica
+    produces the colocated executor's exact streams — and the roles
+    really split (the prefill executor decodes exactly the one
+    hand-off token per request, the decode executor everything
+    else)."""
+    colo = _synth()
+    baseline = _drive_colocated(colo, PROMPTS)
+    colo.close()
+
+    pre, dec = _synth(), _synth()
+    q = AdmissionQueue(max_depth=16)
+    pool = DisaggPool(
+        [pre], [dec], q, pool_opts=dict(POOL_OPTS),
+        decode_pool_opts=dict(
+            POOL_OPTS,
+            batcher_kwargs={"pipelined": decode_pipelined}))
+    pool.start()
+    try:
+        streams, _ = _drive_disagg(pool, q, PROMPTS)
+    finally:
+        pool.stop()
+    assert streams == baseline
+    assert any(len(set(s)) > 1 for s in baseline), \
+        "degenerate streams would make this equality vacuous"
+    # Role split: prefill emitted ONE token per request (the
+    # prefill-finish emit), decode everything else, via _reattach.
+    assert pre.decode_tokens == len(PROMPTS)
+    assert dec.decode_tokens == len(PROMPTS) * (MAX_TOKENS - 1)
+    assert dec.resumed_total == len(PROMPTS)
+    pre.allocator.assert_clean()
+    dec.allocator.assert_clean()
+    pre.close()
+    dec.close()
+
+
+@pytest.mark.parametrize("pool_dtype", ["int8", "fp32"])
+def test_disagg_streams_match_colocated_paged(pool_dtype):
+    """The real jitted path: int8-resident pools ship their codes +
+    scales VERBATIM (the acceptance's int8-pool transfer case), fp32
+    pools ship lossless rows — both byte-identical to colocated
+    decode, including a second wave whose prefill hits the PREFILL
+    replica's prefix cache (cached_tokens rides the transfer, so the
+    client-visible proof survives the migration)."""
+    from dpu_operator_tpu.serving import PagedKVExecutor
+
+    args = dict(slots=2, block_size=4, num_blocks=64,
+                max_blocks_per_req=8, prefill_chunk=8, seed=0,
+                vocab=32, d=16, heads=2, mode="pipelined",
+                pool_dtype=pool_dtype)
+    colo = PagedKVExecutor(**args)
+    # Two waves of the same trace: the second wave's prefill is a
+    # prefix-cache hit (colocated inserts at retire; so does the
+    # prefill replica's post-ack release).
+    baseline = _drive_colocated(colo, PROMPTS)
+    baseline2 = _drive_colocated(colo, PROMPTS)
+    assert baseline2 == baseline  # PR 7 invariance, still true
+
+    pre = PagedKVExecutor(**args)
+    dec = PagedKVExecutor(**args)
+    q = AdmissionQueue(max_depth=16)
+    pool = DisaggPool([pre], [dec], q, pool_opts=dict(POOL_OPTS))
+    assert pool.codec == ("int8" if pool_dtype == "int8" else "fp32")
+    pool.start()
+    try:
+        streams, _ = _drive_disagg(pool, q, PROMPTS)
+        streams2, reqs2 = _drive_disagg(pool, q, PROMPTS)
+    finally:
+        pool.stop()
+    assert streams == baseline
+    assert streams2 == baseline
+    # The prefix-cache-hit prefill: wave 2 saw cached tokens, and the
+    # count survived the lease migration into the response surface.
+    cached = [r.kv_lease.cached_tokens for r in reqs2]
+    assert any(c > 0 for c in cached), cached
+    assert dec.resumed_total == 2 * len(PROMPTS)
+    pre.allocator.assert_clean()
+    dec.allocator.assert_clean()
+
+    # Third wave through a SYNC decode batcher over the same
+    # executors (fresh pool, sessions reset at start): the ISSUE 3
+    # sync<->pipelined equivalence, carried to the disagg path on the
+    # real jitted model.
+    q2 = AdmissionQueue(max_depth=16)
+    pool_sync = DisaggPool(
+        [pre], [dec], q2, pool_opts=dict(POOL_OPTS),
+        decode_pool_opts=dict(
+            POOL_OPTS, batcher_kwargs={"pipelined": False}))
+    pool_sync.start()
+    try:
+        streams3, _ = _drive_disagg(pool_sync, q2, PROMPTS)
+    finally:
+        pool_sync.stop()
+    assert streams3 == baseline
+    pre.allocator.assert_clean()
+    dec.allocator.assert_clean()
+
+
+# -- chaos: kill the transfer mid-stream --------------------------------------
+
+
+def test_kill_transfer_mid_stream_recovers_exactly_once(
+        settle_counts, tmp_path):
+    """The ISSUE 14 chaos headline: the page stream is CUT between
+    segments (twice, on different requests) — the decode side's
+    partial accumulation dies with the connection (zero allocated
+    blocks), the prefill-side lease reattaches, the request requeues
+    to the prefill front, re-attaches its surviving pages, re-decodes
+    exactly one token and hands off again. Must hold: byte-identical
+    streams vs the uninjected run, exactly-once settle, both leak
+    ledgers clean, and ONE flight-recorder file showing the
+    injection -> detection -> migration timeline across both
+    replicas."""
+    t0 = time.perf_counter()
+
+    def run(inject, flight_dir=None):
+        pre, dec = _synth(), _synth()
+        reg = Registry()
+        q = AdmissionQueue(max_depth=16)
+        rec = (FlightRecorder(flight_dir=str(flight_dir))
+               if flight_dir is not None else None)
+        # seg_bytes=16 -> every transfer is multi-segment, so the
+        # at_calls=[2] fault lands genuinely MID-transfer.
+        pool = DisaggPool([pre], [dec], q, registry=reg, seg_bytes=16,
+                          flight_recorder=rec,
+                          pool_opts=dict(POOL_OPTS))
+        pool.start()
+        try:
+            streams, reqs = _drive_disagg(pool, q, PROMPTS)
+        finally:
+            pool.stop()
+        pre.allocator.assert_clean()
+        dec.allocator.assert_clean()
+        pre.close()
+        dec.close()
+        return streams, reqs, reg, dec
+
+    baseline, _, _, _ = run(inject=False)
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            plan.inject("kvstream.send",
+                        exc=RuntimeError("cut mid-transfer"),
+                        at_calls=[2, 6])
+            injected, reqs, reg, dec = run(inject=True,
+                                           flight_dir=tmp_path)
+        spans = tr.spans_snapshot()
+    assert injected == baseline, (injected, baseline)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    # The decode side attached each request exactly once — after the
+    # failed transfer the request went BACK to prefill, never to a
+    # half-imported decode state.
+    assert dec.resumed_total == len(PROMPTS)
+    assert reg.counter_value("serving_kv_transfers_total",
+                             {"outcome": "requeued_prefill"}) >= 1
+    assert reg.counter_value("serving_kv_transfers_total",
+                             {"outcome": "ok"}) == len(PROMPTS)
+
+    # The migration is visible in the TRACE, not just the counters:
+    # for some victim, handoff -> failed transfer -> queue.requeue ->
+    # second handoff -> import on the decode replica, in order.
+    failed = [s for s in spans if s.name == "disagg.transfer"
+              and s.attrs.get("error")]
+    assert failed, "no failed transfer span recorded"
+    victim = failed[0].request_id
+    vspans = [s for s in spans if s.request_id == victim]
+    names = [s.name for s in vspans]
+    assert names.count("disagg.handoff") >= 2, names
+    assert "queue.requeue" in names
+    ok_import = [s for s in vspans if s.name == "disagg.import"]
+    assert len(ok_import) == 1, "decode side must import exactly once"
+    assert ok_import[-1].t0 >= failed[0].t1, \
+        "import must follow the failed transfer"
+
+    # One flight file, written at the failure, carrying the whole
+    # chain: the injected fault, the erroring transfer leg, and the
+    # requeue-to-prefill migration decision — across both replicas'
+    # span streams (prefill's handoff event + the transfer plane).
+    files = sorted(tmp_path.glob("flight-kv_transfer_failed-*.json"))
+    assert files, sorted(p.name for p in tmp_path.iterdir())
+    doc = json.loads(files[0].read_text())
+    fspans = doc["spans"]
+    fault = next(s for s in fspans if s["name"] == "fault.fired"
+                 and s["attrs"].get("site") == "kvstream.send")
+    xfer = next(s for s in fspans if s["name"] == "disagg.transfer"
+                and s["attrs"].get("error"))
+    hand = next(s for s in fspans if s["name"] == "disagg.handoff"
+                and s["request_id"] == xfer["request_id"])
+    rq = next(s for s in fspans if s["name"] == "queue.requeue"
+              and s["request_id"] == xfer["request_id"])
+    assert (hand["t0"] <= xfer["t0"] <= fault["t0"] <= rq["t0"]), \
+        "injection -> detection -> migration out of order"
+    assert doc["extra"]["outcome"] == "requeued_prefill"
+    assert time.perf_counter() - t0 < 24.0
+
+
+def test_kill_prefill_replica_mid_run_recovers(settle_counts):
+    """The replica-level kill composed with disagg: the PREFILL
+    batcher dies mid-run (executor fault), its supervisor seizes and
+    requeues the occupants to the shared front queue, the restarted
+    prefill replica re-attaches (or re-prefills) them, and hand-offs
+    resume — streams byte-identical, settle exactly once, ledgers
+    clean on both pools."""
+    def run(inject):
+        inner = _synth(fault_site="pf0" if inject else None)
+        ex = FaultyExecutor(inner, site="pf0") if inject else inner
+        dec = _synth()
+        q = AdmissionQueue(max_depth=16)
+        pool = DisaggPool([ex], [dec], q, pool_opts=dict(POOL_OPTS))
+        pool.start()
+        try:
+            streams, _ = _drive_disagg(pool, q, PROMPTS)
+        finally:
+            pool.stop()
+        inner.allocator.assert_clean()
+        dec.allocator.assert_clean()
+        inner.close()
+        dec.close()
+        return streams, pool
+
+    baseline, _ = run(inject=False)
+    with faults.injected() as plan:
+        plan.inject("pf0.submit", exc=RuntimeError("injected kill"),
+                    at_calls=[3])
+        injected, pool = run(inject=True)
+    assert injected == baseline
+    assert set(settle_counts.values()) == {1}, settle_counts
+    assert sum(pool.prefill_pool.restarts) >= 1
+
+
+def test_decode_oom_nack_requeues_to_prefill(settle_counts):
+    """A decode pool too small for the request's worst case nacks the
+    import (oom) — the transfer fails typed, the request burns an
+    attempt and retries via prefill until the budget exhausts: a 500
+    retries_exhausted, never a hang, never a leak."""
+    pre = _synth()
+    dec = _synth(num_blocks=2)  # cannot hold any request's worst case
+    q = AdmissionQueue(max_depth=8)
+    reg = Registry()
+    pool = DisaggPool([pre], [dec], q, registry=reg, max_attempts=2,
+                      pool_opts=dict(POOL_OPTS))
+    r = _req(PROMPTS[1])
+    pool.start()
+    try:
+        q.submit(r)
+        assert r.wait(20), "request lost"
+    finally:
+        pool.stop()
+    assert r.error == "retries_exhausted"
+    assert settle_counts[r.request_id] == 1
+    assert reg.counter_value("serving_kv_transfers_total",
+                             {"outcome": "retries_exhausted"}) == 1
+    pre.allocator.assert_clean()
+    dec.allocator.assert_clean()
+    pre.close()
+    dec.close()
+
+
+# -- HTTP integration + metrics exposition ------------------------------------
+
+
+def _post(url, body):
+    data = json.dumps(body).encode()
+    try:
+        r = urllib.request.urlopen(
+            urllib.request.Request(url + "/v1/generate", data=data),
+            timeout=20)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_disagg_server_http_roundtrip_and_metrics(tmp_path):
+    """The whole front door over a DisaggPool: generate round-trips
+    (with the transferred lease's cached_tokens in the response),
+    /metrics exposes the transfer series and the role-labelled pool
+    gauge, and a drain completes in-flight work through the transfer
+    plane."""
+    pre, dec = _synth(), _synth()
+    reg = Registry()
+
+    def factory(execs, queue, registry, tracer, flight_recorder):
+        return DisaggPool([pre], [dec], queue, registry=registry,
+                          tracer=tracer,
+                          flight_recorder=flight_recorder,
+                          pool_opts=dict(POOL_OPTS))
+
+    srv = ServingServer([pre, dec], registry=reg,
+                        pool_factory=factory).start()
+    try:
+        toks = [int(t) for t in PROMPTS[0]]
+        code, body = _post(srv.url, {"prompt_tokens": toks,
+                                     "max_tokens": 4,
+                                     "deadline_ms": 20000})
+        assert code == 200 and len(body["tokens"]) == 4
+        # Same prompt again: the prefill replica's prefix cache hits,
+        # and the cached count survives the migration to the decode
+        # lease the response reads.
+        code2, body2 = _post(srv.url, {"prompt_tokens": toks,
+                                       "max_tokens": 4,
+                                       "deadline_ms": 20000})
+        assert code2 == 200 and body2["tokens"] == body["tokens"]
+        assert body2["kv"]["cached_tokens"] > 0
+
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=10).read().decode()
+        assert 'serving_kv_transfer_bytes_total{codec="fp32"}' in text
+        assert "serving_kv_transfer_seconds_bucket" in text
+        assert ('serving_pool_replicas{role="prefill",'
+                'sharded="false",state="live"} 1' in text)
+        assert ('serving_pool_replicas{role="decode",'
+                'sharded="false",state="live"} 1' in text)
+        # Transfers really moved the derived bytes: n_blocks * wire.
+        assert reg.counter_value("serving_kv_transfer_bytes_total",
+                                 {"codec": "fp32"}) > 0
+        assert srv.begin_drain(timeout=10.0)
+    finally:
+        srv.stop()
+    pre.allocator.assert_clean()
+    dec.allocator.assert_clean()
+    pre.close()
+    dec.close()
+
+
+def test_disagg_pool_rejects_mismatched_executors():
+    pre = _synth()
+    dec = _synth(block_size=8, num_blocks=32)
+    with pytest.raises(KVSpecMismatch):
+        DisaggPool([pre], [dec], AdmissionQueue(max_depth=4))
+    pre.close()
+    dec.close()

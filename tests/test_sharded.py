@@ -423,8 +423,10 @@ def test_shard_metrics_codec_label_tracks_transport():
 
 
 def test_pool_publishes_sharded_replica_dimension():
-    """serving_pool_replicas gains the `sharded` label: a mixed pool
-    reports its fabric-sharded and single-host capacity separately."""
+    """serving_pool_replicas carries the `sharded` and `role` labels:
+    a mixed pool reports its fabric-sharded and single-host capacity
+    separately, under its serving role (unified here; prefill|decode
+    in the disagg plane — tests/test_disagg.py covers those)."""
     reg = Registry()
     q = AdmissionQueue(max_depth=4)
     ex_sh = FabricExecutor(SyntheticShardSet(world=2, slots=2, d=8))
@@ -434,10 +436,12 @@ def test_pool_publishes_sharded_replica_dimension():
     try:
         assert reg.gauge_value(
             "serving_pool_replicas",
-            {"state": "live", "sharded": "true"}) == 1.0
+            {"state": "live", "sharded": "true",
+             "role": "unified"}) == 1.0
         assert reg.gauge_value(
             "serving_pool_replicas",
-            {"state": "live", "sharded": "false"}) == 1.0
+            {"state": "live", "sharded": "false",
+             "role": "unified"}) == 1.0
         assert ex_sh._registry is reg  # bind_registry hook ran
     finally:
         pool.stop()
