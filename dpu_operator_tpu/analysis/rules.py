@@ -32,6 +32,9 @@ GL015  resident device-pool allocation at fp32 in serving/kvcache/
 GL016  KV lease detached for a cross-replica hand-off with no paired
        ack — no reattach/release and no hand-off to the transfer
        plane in the same function (serving/)
+GL017  plan-time write to collect-owned decode state
+       (decode_tokens/last_token/confirmed watermark) outside the
+       collect owner-guard region (serving/kvcache/ + serving/spec.py)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1531,6 +1534,88 @@ class Fp32ResidentPoolWithoutPolicy(Rule):
                 f"erodes silently through exactly this site")
 
 
+# --------------------------------------------------------------------------
+# GL017 — plan-time mutation of collect-owned decode state
+
+
+class PlanTimeCollectStateWrite(Rule):
+    """Origin: ISSUE 15's speculative collect path, generalizing the
+    phantom-step throughput-inflation class PR 7's review fixed by
+    hand: ``decode_tokens`` was counted at PLAN time, so the
+    pipelined loop's phantom post-retire step inflated the bench's
+    headline tokens/s by ~1/max_tokens AND could stamp a retired
+    request's emit into a freshly re-admitted slot state's
+    ``last_token``. The fix moved every such write under collect()'s
+    owner-guard region (generation check + per-slot plan-owner
+    attribution) — and speculative decoding raises the stakes: the
+    ctx ROLLBACK and the confirmed-watermark advance live on the same
+    guard, so a plan-time write to any of these is now a correctness
+    bug (phantom tokens, poisoned resume cursors, prefix-cache
+    publication of unwritten KV), not just a skewed metric.
+
+    The mechanical contract: in serving/kvcache/ and serving/spec.py,
+    the attributes ``decode_tokens`` / ``last_token`` / ``confirmed``
+    (the watermark) are COLLECT-OWNED — assignments and augmented
+    assignments to them may appear only in ``collect``-named
+    functions (``collect``, ``_collect_spec``, ...), in ``__init__``
+    (state construction), or in ``_reattach`` (cursors rebuilt from
+    SETTLED tokens — durable truth, not in-flight state).
+
+    Near-misses that stay silent: the same writes inside a collect
+    path or constructor, plan-time writes to PLAN-owned cursors
+    (``ctx``, ``prefill_pos``, ``pending_emit``, ``chain_device``),
+    local variables that merely share the names, and writes in
+    modules outside the scope (the scheduler settles requests, not
+    slot state)."""
+
+    rule_id = "GL017"
+    severity = SEVERITY_ERROR
+    title = "plan-time write to collect-owned decode state"
+    hint = ("decode_tokens/last_token/confirmed are written only "
+            "under collect()'s owner guard (generation + plan-owner "
+            "attribution), in __init__, or in _reattach's "
+            "settled-token rebuild — a plan/submit-time write "
+            "counts phantom steps, stamps retired requests' emits "
+            "into re-admitted slots, or publishes unwritten KV "
+            "through the watermark")
+
+    _OWNED = {"decode_tokens", "last_token", "confirmed"}
+
+    @staticmethod
+    def _allowed(qual: str) -> bool:
+        leaf = qual.rsplit(".", 1)[-1]
+        return (leaf == "__init__" or leaf == "_reattach"
+                or "collect" in leaf)
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not (module.in_dir("kvcache")
+                or module.relpath.endswith("serving/spec.py")):
+            return
+        for fn, qual in module.functions:
+            if self._allowed(qual):
+                continue
+            for n in _walk_through_lambdas(fn):
+                targets = []
+                if isinstance(n, ast.Assign):
+                    targets = n.targets
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [n.target]
+                for t in targets:
+                    # Attribute stores only: a local that shares the
+                    # name is someone's temporary, not slot state.
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    if t.attr not in self._OWNED:
+                        continue
+                    yield self.finding(
+                        module, n,
+                        f"'{ast.unparse(t)}' written in '{qual}' — "
+                        f"'{t.attr}' is collect-owned state (owner-"
+                        f"guarded in collect, or __init__/_reattach "
+                        f"construction); a plan-time write is the "
+                        f"phantom-step class PR 7 fixed by hand")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1542,4 +1627,5 @@ def default_rules() -> List[Rule]:
             KVAcquireWithoutRelease(), UnboundedTransportRecv(),
             CopyInTransportLoop(), InconsistentLockDiscipline(),
             LockOrderInversion(), WallClockDurationMath(),
-            Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck()]
+            Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
+            PlanTimeCollectStateWrite()]

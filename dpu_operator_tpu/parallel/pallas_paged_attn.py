@@ -38,6 +38,18 @@ blocks keep their exact bytes — the prefix-cache and re-attach
 contracts depend on it) and the grid is over slots, whose block sets
 are disjoint by the allocator's ownership invariant.
 
+PER-ROW OUTPUTS (the ISSUE 15 verify contract): the kernel's ``o`` is
+``[S, C, H, dh]`` — one attention output per APPENDED row, not only
+row ``n_new - 1``. Each query row ``j`` attends under its own causal
+mask (positions ``<= ctx + j``), so for a speculative verify window
+(``n_new = k + 1`` host-fed tokens: the last accepted token plus k
+drafts) row ``j``'s output depends only on the window prefix through
+``j`` — exactly the per-position target predictions greedy
+verification compares against the drafts. paged.py's ``per_pos=True``
+projects ALL C rows to logits/argmax after the kernel; this kernel
+needed no change for speculation beyond honoring that contract, and
+rows past ``n_new`` are garbage the collect path never reads.
+
 Off-TPU the same kernel runs under the Pallas interpreter
 (``interpret=True``), which is how tier-1 proves Pallas-vs-XLA
 equivalence on CPU (tests/test_paged_attn.py); on a TPU backend it

@@ -23,6 +23,13 @@ prefill/decode ReplicaPools with KV pages streamed between their
 pools over the fabric (``DisaggPool``; hand off via ``pool_factory=``
 on the ServingServer) — see docs/serving.md.
 
+Speculative decoding (ISSUE 15) lives in spec.py: the draft-model
+contract, greedy-verify acceptance math and bookkeeping behind the KV
+executors' third mode (``PagedKVExecutor(mode="speculative")`` /
+``SyntheticKVExecutor(spec=SpecConfig(...))``) — k drafted tokens
+verified per slot in one batched step, rejection truncated at the
+collect-confirmed watermark.
+
 Importing this package stays jax-free; jax loads only when a
 LocalExecutor or PagedKVExecutor is constructed.
 """
@@ -37,6 +44,7 @@ from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
 from .queue import AdmissionQueue
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
+from .spec import NO_TOKEN, OracleDraft, SpecConfig, TruncatedDraft
 from .sharded import (FabricExecutor, ShardProcessSet,
                       SyntheticShardSet)
 
@@ -54,6 +62,8 @@ __all__ = [
     "KVSpec",
     "KVSpecMismatch",
     "LocalExecutor",
+    "NO_TOKEN",
+    "OracleDraft",
     "PagedKVExecutor",
     "PrefixTree",
     "QueueFull",
@@ -61,9 +71,11 @@ __all__ = [
     "ServingError",
     "ServingServer",
     "ShardProcessSet",
+    "SpecConfig",
     "SyntheticExecutor",
     "SyntheticKVExecutor",
     "SyntheticShardSet",
+    "TruncatedDraft",
     "encode_prompt",
     "encode_prompt_tokens",
 ]

@@ -1061,6 +1061,120 @@ def disagg_serving(trace, slots: int = 4, step_ms: float = 2.0,
     return out
 
 
+def speculative_decode(trace, slots: int = 4, n_req: int = 24,
+                       toks: int = 16, step_ms: float = 2.0,
+                       tok_ms: float = 0.05, k: int = 4,
+                       accept: float = 0.75, repeats: int = 3) -> dict:
+    """Section 13 (ISSUE 15): speculative draft/verify decode vs the
+    one-token baseline — ACCEPTED tokens/s/slot through the real
+    ContinuousBatcher (queue preloaded, no HTTP), interleaved
+    best-of-3. Cost model: SyntheticKVExecutor with a fixed per-step
+    floor plus a per-planned-token cost, so a verify step really
+    costs more than a one-token step (its window is k+1 wide) and
+    the speedup is the honest ratio of that physics — fixed floor
+    amortized over ~E[accepted+1] tokens — not a free lunch. The
+    draft is the OracleDraft at a CONTROLLED per-position acceptance
+    rate (`accept`), the dial the ISSUE 15 acceptance criterion
+    (>= 1.5x at the controlled rate) is stated against.
+
+      * serving_spec_tokens_per_s — accepted tokens/s/slot, spec arm
+        (gated >= 0.85x rolling median in bench.py);
+      * serving_spec_baseline_tokens_per_s — the PR 7 one-token
+        pipelined arm on the same cost model;
+      * serving_spec_speedup — the paired ratio (gated ABSOLUTE
+        >= 1.5 in bench.py: the acceptance criterion itself);
+      * serving_spec_accept_rate / serving_spec_tokens_per_step —
+        the acceptance decomposition (realized rate: positions after
+        a run's first miss count as rejected);
+      * serving_spec_step_ms / serving_spec_baseline_step_ms — the
+        per-step-cost decomposition (a verify step IS dearer; the
+        win is tokens per step, and these two lines prove both
+        halves)."""
+    import time as _time
+
+    from .api import GenerateRequest
+    from .kvcache import SyntheticKVExecutor
+    from .queue import AdmissionQueue
+    from .scheduler import ContinuousBatcher
+    from .spec import OracleDraft, SpecConfig
+
+    out: dict = {}
+    step_s, tok_s = step_ms / 1000.0, tok_ms / 1000.0
+    prompt_len, vocab = 8, 64
+    tok_total = n_req * toks
+
+    def one_run(kind):
+        spec = None
+        if kind == "spec":
+            spec = SpecConfig(OracleDraft(k=k, accept_rate=accept,
+                                          vocab=vocab, target_seed=0),
+                              k)
+        ex = SyntheticKVExecutor(
+            slots=slots, vocab=vocab, block_size=4, num_blocks=2048,
+            max_blocks_per_req=16, prefill_chunk=8,
+            step_time_s=step_s, token_time_s=tok_s,
+            pipelined=kind == "baseline", spec=spec,
+            prefix_cache=False)
+        q = AdmissionQueue(max_depth=n_req + 1)
+        b = ContinuousBatcher(ex, q)
+        reqs = [GenerateRequest(
+            prompt_vec=None, max_tokens=toks,
+            deadline=_time.monotonic() + 600.0,
+            prompt_tokens=[(3 * i + j) % vocab
+                           for j in range(prompt_len)])
+            for i in range(n_req)]
+        for r in reqs:
+            q.submit(r)
+        t0 = _time.perf_counter()
+        b.start()
+        ok = all(r.wait(timeout=600) for r in reqs)
+        wall = _time.perf_counter() - t0
+        b.stop()
+        if not ok or any(r.error for r in reqs):
+            raise RuntimeError(next(
+                (r.error for r in reqs if r.error), "request lost"))
+        delivered = sum(len(r.tokens) for r in reqs)
+        assert delivered == tok_total, (delivered, tok_total)
+        stats = ex.kv_stats()
+        steps = ex._step_no
+        ex.allocator.assert_clean()
+        ex.close()
+        return (tok_total / slots) / wall, wall, steps, stats
+
+    # Interleaved best-of-3: both arms share each rep's box weather,
+    # the section-5/9 shared-box defense.
+    best: dict = {}
+    for rep in range(repeats):
+        for kind in ("spec", "baseline"):
+            rate, wall, steps, stats = one_run(kind)
+            trace(f"spec-decode {kind} rep{rep}: {rate:.0f} accepted "
+                  f"tok/s/slot over {steps} steps")
+            if kind not in best or rate > best[kind][0]:
+                best[kind] = (rate, wall, steps, stats)
+
+    sp_rate, sp_wall, sp_steps, sp_stats = best["spec"]
+    bl_rate, bl_wall, bl_steps, _ = best["baseline"]
+    out["serving_spec_tokens_per_s"] = round(sp_rate, 1)
+    out["serving_spec_baseline_tokens_per_s"] = round(bl_rate, 1)
+    if bl_rate > 0:
+        out["serving_spec_speedup"] = round(sp_rate / bl_rate, 2)
+    out["serving_spec_accept_rate"] = sp_stats["spec_accept_rate"]
+    out["serving_spec_tokens_per_step"] = sp_stats[
+        "spec_tokens_per_step"]
+    out["serving_spec_step_ms"] = round(sp_wall / sp_steps * 1000, 3)
+    out["serving_spec_baseline_step_ms"] = round(
+        bl_wall / bl_steps * 1000, 3)
+    trace(f"speculative decode: {out['serving_spec_tokens_per_s']} "
+          f"vs baseline {out['serving_spec_baseline_tokens_per_s']} "
+          f"accepted tok/s/slot = "
+          f"{out.get('serving_spec_speedup')}x at realized accept "
+          f"rate {out['serving_spec_accept_rate']} "
+          f"({out['serving_spec_tokens_per_step']} tok/verify-step; "
+          f"step cost {out['serving_spec_step_ms']} vs "
+          f"{out['serving_spec_baseline_step_ms']} ms)")
+    return out
+
+
 def sharded_decode(slots: int, trace, world: int = 3, n_req: int = 48,
                    toks: int = 16, step_ms: float = 2.0,
                    coll_ms: float = 1.0, repeats: int = 3) -> dict:
@@ -1506,6 +1620,17 @@ def main(argv: Optional[list] = None) -> int:
     except Exception as e:
         out["serving_disagg_error"] = str(e)[:200]
         trace(f"disagg section failed: {e}")
+
+    # 13: speculative draft/verify decode vs the one-token baseline
+    # (ISSUE 15) — accepted tokens/s/slot at a controlled acceptance
+    # rate on the synthetic cost model; gated >= 0.85x rolling median
+    # (serving_spec_tokens_per_s) + the ABSOLUTE >= 1.5x speedup
+    # acceptance gate in bench.py.
+    try:
+        out.update(speculative_decode(trace))
+    except Exception as e:
+        out["serving_spec_error"] = str(e)[:200]
+        trace(f"speculative-decode section failed: {e}")
 
     # 4: the real jitted path — forward-only train_step model on a mesh.
     if not args.skip_local:

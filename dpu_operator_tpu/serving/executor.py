@@ -167,6 +167,14 @@ class Executor:
     #: runs its token-level KV loop (attach leases, chunked prefill,
     #: NO_TOKEN-aware retire) instead of the [slots, d] row plane.
     kv: bool = False
+    #: True when the executor runs the draft/verify speculative mode
+    #: (ISSUE 15, KV plane only): collect() returns [slots, chunk]
+    #: accepted-token RUNS instead of [slots] single tokens, and the
+    #: executor presents pipelined=False — the next plan drafts from
+    #: the previous step's accepted tokens, so the collect-before-
+    #: plan (sync) loop shape is structural. The batcher needs no
+    #: branch on this: retire normalizes both collect shapes.
+    speculative: bool = False
     #: True when this replica's step spans multiple fabric shard
     #: workers (serving/sharded FabricExecutor): the pool publishes it
     #: as the `sharded` dimension on serving_pool_replicas so a

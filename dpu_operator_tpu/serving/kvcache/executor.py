@@ -54,21 +54,24 @@ import numpy as np
 
 from ... import faults
 from ..executor import Executor, _GuardedWorker
+# NO_TOKEN re-exported here for back-compat: the sentinel and the
+# emit-masking idiom live in serving/spec.py (ISSUE 15 cleanup) so the
+# one-token and speculative collect paths share one definition.
+from ..spec import (NO_TOKEN, SpecConfig, accept_length, clamp_spec_k,
+                    synthetic_next_token)
 from .allocator import KVBlockAllocator, KVCacheOOM, KVLease, PrefixTree
 
 log = logging.getLogger(__name__)
 
-#: collect() sentinel for "no token emitted for this slot this step"
-#: (mid-prefill chunk, inactive slot, or stale-generation handle).
-NO_TOKEN = -1
-
 
 class _SlotState:
     __slots__ = ("req_id", "lease", "ctx", "prefill_pos", "last_token",
-                 "chain_device", "pending_emit", "confirmed")
+                 "chain_device", "pending_emit", "confirmed",
+                 "max_total")
 
     def __init__(self, req_id: str, lease: KVLease, ctx: int,
-                 prefill_pos: int, last_token: Optional[int]):
+                 prefill_pos: int, last_token: Optional[int],
+                 max_total: int = 0):
         self.req_id = req_id
         self.lease = lease
         self.ctx = int(ctx)
@@ -78,20 +81,27 @@ class _SlotState:
         self.pending_emit = False
         # Positions whose KV writes a COLLECTED step has confirmed on
         # device. ctx advances at plan time — one step ahead in the
-        # pipelined loop — so anything derived from ctx alone (the
+        # pipelined loop, and a full speculative window ahead in
+        # verify steps — so anything derived from ctx alone (the
         # prefix-cache insert) would cover in-flight writes that a
-        # failing step never lands. Attach-time positions are genuinely
+        # failing step never lands, or rejected draft positions a
+        # collect rolls back. Attach-time positions are genuinely
         # written: prefix-cache hits by the cache contract, re-attach
         # cursors by the settled tokens that imply their steps ran.
         self.confirmed = int(ctx)
+        # prompt + max_tokens: the request's total position budget,
+        # needed at plan time to clamp speculative proposals inside
+        # the worst-case pages reserved at admission (spec.clamp_spec_k).
+        self.max_total = int(max_total)
 
 
 class _StepPlan:
     __slots__ = ("gen", "step_no", "host_tok", "use_host", "ctx",
-                 "n_new", "tables", "emit", "owners", "stale")
+                 "n_new", "tables", "emit", "owners", "spec_k",
+                 "stale")
 
     def __init__(self, gen, step_no, host_tok, use_host, ctx, n_new,
-                 tables, emit, owners=None, stale=False):
+                 tables, emit, owners=None, spec_k=None, stale=False):
         self.gen = gen
         self.step_no = step_no
         self.host_tok = host_tok
@@ -104,6 +114,11 @@ class _StepPlan:
         # an emit to the state that planned it — a retire + fresh
         # admit can rebind the slot between submit and collect.
         self.owners = owners
+        # Speculative plans only: per-slot drafted-token count (>= 0
+        # marks a verify slot; the drafts themselves are
+        # host_tok[s, 1:1+spec_k[s]], so collect can re-derive the
+        # acceptance comparison from the plan alone).
+        self.spec_k = spec_k
         self.stale = stale
 
 
@@ -124,7 +139,8 @@ class KVExecutorBase(Executor):
                  num_blocks: int = 128, max_blocks_per_req: int = 16,
                  prefill_chunk: int = 8,
                  prefill_budget: Optional[int] = None,
-                 prefix_cache: bool = True, pipelined: bool = True):
+                 prefix_cache: bool = True, pipelined: bool = True,
+                 spec: Optional[SpecConfig] = None):
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
@@ -158,6 +174,32 @@ class KVExecutorBase(Executor):
         self.steps_decode = 0
         self.steps_mixed = 0
         self.resumed_total = 0
+        self.spec: Optional[SpecConfig] = None
+        if spec is not None:
+            self._install_spec(spec)
+
+    def _install_spec(self, spec: SpecConfig) -> None:
+        """Arm speculative decoding (the third executor mode). Must
+        run before the first submit. Structural constraints, checked
+        here once: the verify window rides the compiled chunk width
+        (``k + 1 <= prefill_chunk``), and the executor must present
+        the SYNC loop shape to the batcher — the next plan needs the
+        previous step's ACCEPTED length (ctx rolls back at collect),
+        so a pipelined plan-ahead would plan against provisional
+        cursors. The two-phase submit/collect seam itself is
+        unchanged; only ``pipelined=False`` routing selects the
+        collect-before-plan shape."""
+        if spec.k + 1 > self.prefill_chunk:
+            raise ValueError(
+                f"spec k={spec.k} needs a verify window of k+1 <= "
+                f"prefill_chunk={self.prefill_chunk}")
+        if self.pipelined:
+            raise ValueError(
+                "speculative decoding requires the sync loop shape "
+                "(pipelined=False): the next plan depends on the "
+                "previous step's accepted length")
+        self.spec = spec
+        self.speculative = True
 
     # -- attach / detach (called by the batcher under its settle lock) --------
 
@@ -236,7 +278,7 @@ class KVExecutorBase(Executor):
             req.kv_lease = lease
             self._states[slot] = _SlotState(
                 owner, lease, ctx=cached, prefill_pos=cached,
-                last_token=None)
+                last_token=None, max_total=plen + req.max_tokens)
             return cached
 
     def _reattach(self, slot: int, req, lease: KVLease) -> int:
@@ -250,7 +292,8 @@ class KVExecutorBase(Executor):
         if k > 0:
             st = _SlotState(req.request_id, lease,
                             ctx=plen + k - 1, prefill_pos=plen,
-                            last_token=int(req.tokens[-1]))
+                            last_token=int(req.tokens[-1]),
+                            max_total=plen + req.max_tokens)
         else:
             # Killed mid-prefill: replay the prefill from the cached
             # prefix (pages already reserved — replay re-appends
@@ -258,7 +301,8 @@ class KVExecutorBase(Executor):
             st = _SlotState(req.request_id, lease,
                             ctx=lease.cached_tokens,
                             prefill_pos=lease.cached_tokens,
-                            last_token=None)
+                            last_token=None,
+                            max_total=plen + req.max_tokens)
         self._states[slot] = st
         self.resumed_total += 1
         return 0
@@ -475,6 +519,10 @@ class KVExecutorBase(Executor):
         tables = np.zeros((S, B), np.int32)
         emit = np.zeros((S,), bool)
         owners: List = [None] * S
+        spec = self.spec
+        spec_k = np.full((S,), -1, np.int32) if spec is not None \
+            else None
+        spec_slots: List[int] = []
         budget = self.prefill_budget
         step_prefill = 0
         step_decode = 0
@@ -506,8 +554,17 @@ class KVExecutorBase(Executor):
                 emit[s] = finishes
                 st.ctx += take
                 st.prefill_pos += take
-                st.chain_device = bool(finishes)
+                # Speculative mode never chains on device: the next
+                # plan drafts FROM the last accepted token, which must
+                # be host-side (stamped at collect — the sync loop
+                # shape guarantees collect precedes the next plan).
+                st.chain_device = bool(finishes) and spec is None
                 st.pending_emit = bool(finishes)
+            elif spec is not None:
+                # Speculative decode: defer to the batched draft call
+                # below (one propose per step — a jitted draft wants
+                # one fixed-shape dispatch, not a per-slot loop).
+                spec_slots.append(s)
             else:
                 # Decode: one token, NEVER budget-rationed (the
                 # bounded-prefill contract protecting decode p99).
@@ -527,6 +584,45 @@ class KVExecutorBase(Executor):
                 st.ctx += 1
                 st.chain_device = True
                 st.pending_emit = True
+        if spec_slots:
+            # One fixed-shape propose over ALL slots (idle/prefill
+            # rows carry zeros and are ignored): the draft's AOT
+            # executable compiles once, like every other step shape.
+            last = np.zeros((S,), np.int32)
+            base = np.zeros((S,), np.int32)
+            for s in spec_slots:
+                st = self._states[s]
+                if st.last_token is None:
+                    raise RuntimeError(
+                        f"slot {s}: speculative decode with no prior "
+                        f"token (request {st.req_id})")
+                last[s] = st.last_token
+                base[s] = st.ctx
+            drafts = np.asarray(spec.draft.propose(last, base),
+                                np.int32)
+            for s in spec_slots:
+                st = self._states[s]
+                # Clamp inside the admission-time page reservation:
+                # the max position a verify step writes equals the
+                # one-token loop's max, so speculation never needs
+                # slack pages (see spec.clamp_spec_k).
+                ks = clamp_spec_k(spec.k, st.ctx, st.max_total, C)
+                host_tok[s, 0] = st.last_token
+                if ks:
+                    host_tok[s, 1:1 + ks] = drafts[s, :ks]
+                use_host[s] = True
+                n_new[s] = ks + 1
+                spec_k[s] = ks
+                emit[s] = True
+                step_decode += 1
+                # Provisional FULL-ACCEPTANCE advance: collect rolls
+                # ctx back to the accepted extent. The confirmed
+                # watermark never moves here — that is exactly what
+                # makes rejection a pure truncation.
+                st.ctx += ks + 1
+                st.chain_device = False
+                st.pending_emit = True
+                spec.stats.proposed += ks
         self._step_no += 1
         self.prefill_tokens += step_prefill
         if step_decode:
@@ -534,14 +630,20 @@ class KVExecutorBase(Executor):
             if step_prefill:
                 self.steps_mixed += 1
         return _StepPlan(self._gen, self._step_no, host_tok, use_host,
-                         ctx, n_new, tables, emit, owners)
+                         ctx, n_new, tables, emit, owners,
+                         spec_k=spec_k)
 
     def collect(self, handle: _KVHandle) -> np.ndarray:
         """[slots] int32: the emitted token per slot, NO_TOKEN (-1)
         where this step emitted nothing (mid-prefill chunk, idle slot,
-        stale handle). Pure — no state mutation, so an abandoned
-        batcher thread waking from a wedge cannot corrupt the
-        restarted session by collecting."""
+        stale handle). Speculative executors return [slots, chunk]
+        instead — each row the step's ACCEPTED token run, NO_TOKEN-
+        padded (see _collect_spec); the scheduler's retire normalizes
+        both shapes through spec.token_run. Pure — no state mutation,
+        so an abandoned batcher thread waking from a wedge cannot
+        corrupt the restarted session by collecting."""
+        if self.spec is not None:
+            return self._collect_spec(handle)
         out = np.full((self.slots,), NO_TOKEN, np.int32)
         if handle.plan.stale:
             return out
@@ -581,6 +683,77 @@ class KVExecutorBase(Executor):
                         self.decode_tokens += 1
         return out
 
+    def _collect_spec(self, handle: _KVHandle) -> np.ndarray:
+        """The speculative collect path: [slots, chunk] int32, row s
+        holding the step's accepted token run left-aligned (NO_TOKEN
+        padding). Greedy-verify acceptance per decode slot: the
+        target's per-position argmax ``t_0..t_ks`` against the plan's
+        drafts — ``a`` leading matches accept ``t_0..t_a`` (a+1
+        tokens, at least the bonus).
+
+        REJECTION IS ROLLBACK, done entirely here under the same
+        owner guard the one-token path uses: ``st.ctx`` (advanced by
+        ks+1 at plan time) rolls back to ``plan_ctx + a + 1`` and the
+        confirmed watermark advances ONLY to that accepted extent.
+        No device-side unwind exists or is needed — KV at rejected
+        positions sits beyond the watermark, so the prefix cache can
+        never publish it (the PR 7 confirmed contract), a re-attach
+        rebuilds cursors from settled tokens below it, and the next
+        verify step's append simply overwrites the dead rows (a
+        position's K/V depends only on its own input embedding, so
+        the overwrite equals what an unspeculated run writes).
+
+        Mid-prefill chunks confirm their full n_new exactly like the
+        one-token path; a prefill-finishing step emits its single
+        token as a length-1 run. The owner guard + the ``n_new == 0``
+        check keep the zero-work-slot no-op contract (a budget-
+        starved slot raced by retire+re-admit between submit and
+        collect must neither advance a watermark nor stamp a
+        last_token) — the guard speculative rollback leans on."""
+        C = self.prefill_chunk
+        out = np.full((self.slots, C), NO_TOKEN, np.int32)
+        if handle.plan.stale:
+            return out
+        raw = np.asarray(self._materialize(handle.raw), np.int32)
+        plan = handle.plan
+        spec = self.spec
+        with self._slock:
+            if plan.gen != self._gen:
+                return out
+            for s in range(self.slots):
+                st = self._states[s]
+                if st is None or st.req_id != plan.owners[s]:
+                    continue
+                n = int(plan.n_new[s])
+                if n == 0:
+                    continue
+                base = int(plan.ctx[s])
+                ks = int(plan.spec_k[s])
+                if ks < 0:
+                    # Prefill chunk: every planned position's KV is
+                    # now real (chunks write without emitting); the
+                    # finishing chunk emits one token.
+                    st.confirmed = max(st.confirmed, base + n)
+                    if plan.emit[s] and st.pending_emit:
+                        t = int(raw[s, n - 1])
+                        out[s, 0] = t
+                        st.last_token = t
+                        self.decode_tokens += 1
+                    continue
+                if not st.pending_emit:
+                    continue
+                target = raw[s, :ks + 1]
+                a = accept_length(plan.host_tok[s, 1:1 + ks], target)
+                run = target[:a + 1]
+                out[s, :a + 1] = run
+                st.ctx = base + a + 1          # the rollback
+                st.confirmed = max(st.confirmed, base + a + 1)
+                st.last_token = int(run[-1])
+                self.decode_tokens += a + 1
+                spec.stats.accepted += a
+                spec.stats.runs += 1
+        return out
+
     def kv_stats(self) -> dict:
         """Scrape-time snapshot for /metrics and the bench."""
         stats = self.allocator.stats()
@@ -596,6 +769,14 @@ class KVExecutorBase(Executor):
         if self.prefix is not None:
             out["prefix_hit_tokens"] = self.prefix.hit_tokens
             out["prefix_lookup_tokens"] = self.prefix.lookup_tokens
+        if self.spec is not None:
+            st = self.spec.stats
+            out["spec_proposed_tokens"] = st.proposed
+            out["spec_accepted_tokens"] = st.accepted
+            out["spec_verify_steps"] = st.runs
+            out["spec_accept_rate"] = round(st.accept_rate(), 6)
+            out["spec_tokens_per_step"] = round(st.tokens_per_step(),
+                                                6)
         return out
 
     # -- backend hooks --------------------------------------------------------
@@ -621,13 +802,19 @@ class PagedKVExecutor(KVExecutorBase):
     dispatch returns while the step runs and the decode recurrence
     chains on device; ``mode="sync"`` drives the same executable
     through the scheduler's synchronous KV loop (the measured
-    baseline). ``kernel=`` selects the fused Pallas paged-attention
-    kernel or the XLA reference composition (default: pallas on a TPU
-    backend, xla elsewhere) and ``pool_dtype=`` the resident KV
-    layout (int8 codes + per-block scales by default — 4x resident
-    context per HBM byte; "fp32" is the exact reference) — both pass
-    straight through to PagedDecodeStep, so the scheduler, chaos
-    matrix and sharded plane ride either path untouched."""
+    baseline); ``mode="speculative"`` (ISSUE 15) is the draft/verify
+    third mode — the step compiles with PER-POSITION argmax outputs
+    (``per_pos=True`` in PagedDecodeStep, both kernels) and the
+    executor plans k-token verify windows against ``draft`` (default:
+    a spec.TruncatedDraft built from this step's own embed/positional/
+    output weights), behind the unchanged submit/collect seam in the
+    sync loop shape. ``kernel=`` selects the fused Pallas
+    paged-attention kernel or the XLA reference composition (default:
+    pallas on a TPU backend, xla elsewhere) and ``pool_dtype=`` the
+    resident KV layout (int8 codes + per-block scales by default — 4x
+    resident context per HBM byte; "fp32" is the exact reference) —
+    both pass straight through to PagedDecodeStep, so the scheduler,
+    chaos matrix and sharded plane ride any mode untouched."""
 
     def __init__(self, slots: int = 4, vocab: int = 64, d: int = 16,
                  heads: int = 2, block_size: int = 4,
@@ -639,9 +826,12 @@ class PagedKVExecutor(KVExecutorBase):
                  donate: Optional[bool] = None,
                  kernel: Optional[str] = None,
                  pool_dtype: str = "int8",
-                 interpret: Optional[bool] = None):
-        if mode not in ("pipelined", "sync"):
-            raise ValueError(f"mode must be pipelined|sync, got {mode!r}")
+                 interpret: Optional[bool] = None,
+                 spec_k: int = 4, draft=None):
+        if mode not in ("pipelined", "sync", "speculative"):
+            raise ValueError(f"mode must be pipelined|sync|speculative"
+                             f", got {mode!r}")
+        speculative = mode == "speculative"
         super().__init__(slots, vocab=vocab, block_size=block_size,
                          num_blocks=num_blocks,
                          max_blocks_per_req=max_blocks_per_req,
@@ -649,6 +839,7 @@ class PagedKVExecutor(KVExecutorBase):
                          prefill_budget=prefill_budget,
                          prefix_cache=prefix_cache,
                          pipelined=mode == "pipelined")
+        from ..spec import TruncatedDraft
         from .paged import PagedDecodeStep
 
         self._seed = int(seed)  # weight identity, stamped on kv_spec
@@ -657,7 +848,12 @@ class PagedKVExecutor(KVExecutorBase):
             block_size=block_size, num_blocks=num_blocks,
             max_blocks_per_req=max_blocks_per_req, chunk=prefill_chunk,
             seed=seed, donate=donate, kernel=kernel,
-            pool_dtype=pool_dtype, interpret=interpret)
+            pool_dtype=pool_dtype, interpret=interpret,
+            per_pos=speculative)
+        if speculative:
+            if draft is None:
+                draft = TruncatedDraft.from_paged(self._paged, spec_k)
+            self._install_spec(SpecConfig(draft, spec_k))
         (self._kpool, self._kscale,
          self._vpool, self._vscale) = self._paged.init_pools()
         self._prev = self._paged.init_prev()
@@ -729,7 +925,13 @@ class PagedKVExecutor(KVExecutorBase):
             jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
             jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
             jnp.asarray(plan.tables))
-        self._prev = out
+        if self.spec is None:
+            # out is the [slots] token recurrence the next pipelined
+            # step may chain on device. The speculative step's out is
+            # [slots, chunk] per-position argmax and NEVER chains —
+            # every verify window is host-fed from the last ACCEPTED
+            # token, so _prev stays the zeroed init.
+            self._prev = out
         return out
 
     def _materialize(self, raw) -> np.ndarray:
@@ -739,11 +941,15 @@ class PagedKVExecutor(KVExecutorBase):
 class SyntheticKVExecutor(KVExecutorBase):
     """Jax-free KV replica: same allocator/lease/plan machinery, but
     the "device" is ``next = (31 * last_token + 7 * position + seed)
-    % vocab`` — deterministic AND position-dependent, so a resume that
-    rewinds cursors wrong produces a visibly different stream. With
-    ``pipelined=True`` steps run FIFO on a worker thread with a
-    dialable ``step_time_s`` (the SyntheticExecutor overlap idiom);
-    ``fault_site`` names the in-device chaos seam."""
+    % vocab`` (spec.synthetic_next_token) — deterministic AND
+    position-dependent, so a resume that rewinds cursors wrong
+    produces a visibly different stream. With ``pipelined=True``
+    steps run FIFO on a worker thread with a dialable ``step_time_s``
+    (the SyntheticExecutor overlap idiom); ``fault_site`` names the
+    in-device chaos seam. ``spec=`` (requires ``pipelined=False``)
+    arms the draft/verify third mode — the SpecConfig's draft is
+    typically spec.OracleDraft, whose dialed acceptance rate is what
+    the bench's controlled-speedup measurement turns."""
 
     def __init__(self, slots: int = 4, vocab: int = 64,
                  block_size: int = 4, num_blocks: int = 128,
@@ -752,13 +958,15 @@ class SyntheticKVExecutor(KVExecutorBase):
                  prefix_cache: bool = True, step_time_s: float = 0.0,
                  token_time_s: float = 0.0,
                  seed: int = 0, pipelined: bool = True,
-                 fault_site: Optional[str] = None):
+                 fault_site: Optional[str] = None,
+                 spec: Optional[SpecConfig] = None):
         super().__init__(slots, vocab=vocab, block_size=block_size,
                          num_blocks=num_blocks,
                          max_blocks_per_req=max_blocks_per_req,
                          prefill_chunk=prefill_chunk,
                          prefill_budget=prefill_budget,
-                         prefix_cache=prefix_cache, pipelined=pipelined)
+                         prefix_cache=prefix_cache, pipelined=pipelined,
+                         spec=spec)
         self.step_time_s = float(step_time_s)
         # Per-PLANNED-TOKEN cost on top of the fixed floor: the knob
         # that makes prefill REAL in the cost model — a step co-running
@@ -785,9 +993,31 @@ class SyntheticKVExecutor(KVExecutorBase):
             faults.fire(f"{self.fault_site}.step")
         cost = self.step_time_s
         if self.token_time_s:
+            # Per-PLANNED-token cost covers draft positions too: a
+            # verify step really is wider than a one-token step, and
+            # the spec bench's per-step-cost decomposition leans on
+            # exactly this physics.
             cost += self.token_time_s * int(np.sum(plan.n_new))
         if cost:
             time.sleep(cost)
+        if self.spec is not None:
+            # Per-position outputs, the verify contract: out[s, j] is
+            # the target's next token after consuming input j at
+            # position ctx+j. The synthetic recurrence is Markov on
+            # (input, position), so the per-position form IS the
+            # one-token recurrence applied at each fed position.
+            C = self.prefill_chunk
+            out = np.full((self.slots, C), NO_TOKEN, np.int32)
+            for s in range(self.slots):
+                n = int(plan.n_new[s])
+                for j in range(n):
+                    tok_in = (int(plan.host_tok[s, j])
+                              if plan.use_host[s]
+                              else int(self._dev_prev[s]))
+                    out[s, j] = synthetic_next_token(
+                        tok_in, int(plan.ctx[s]) + j, self.seed,
+                        self.vocab)
+            return out
         out = np.zeros((self.slots,), np.int32)
         for s in range(self.slots):
             n = int(plan.n_new[s])
@@ -799,8 +1029,8 @@ class SyntheticKVExecutor(KVExecutorBase):
             else:
                 last_in = int(self._dev_prev[s])
             last_pos = int(plan.ctx[s]) + n - 1
-            out[s] = (31 * last_in + 7 * last_pos + self.seed) \
-                % self.vocab
+            out[s] = synthetic_next_token(last_in, last_pos,
+                                          self.seed, self.vocab)
         self._dev_prev = out
         return out
 

@@ -52,10 +52,19 @@ call signature:
     ``paged_kv_error_bound`` below. *fp32* keeps exact residency for
     the byte-identical invariance lanes and as the quality reference.
 
+``per_pos=True`` (ISSUE 15)
+    compiles the step with argmax tokens for EVERY chunk position
+    (``[slots, chunk]`` int32) instead of last-position-only — the
+    speculative verify contract: a k-token draft window needs the
+    target's prediction after each fed position, and both kernels
+    already compute per-row attention outputs, so the widening is the
+    post-kernel logits projection alone.
+
 The fixed shapes are the whole contract: occupancy, prefill progress
 and prompt length vary, ``[slots, chunk]``/``[slots, max_blocks]``
 never do, so admissions and chunked prefill re-use the same executable
-as pure decode. The decode recurrence chains ON DEVICE through
+as pure decode — and the speculative verify window (``n_new = k+1``
+host-fed tokens) is just a chunk plan whose rows happen to be drafts. The decode recurrence chains ON DEVICE through
 ``prev_tokens`` gated per slot by ``use_host`` — the pipelined
 scheduler can dispatch step k+1 before step k's tokens ever reach the
 host. Donation follows DecodeStep's measured platform policy: the
@@ -115,7 +124,8 @@ class PagedDecodeStep:
                  kernel: Optional[str] = None,
                  pool_dtype: str = "int8",
                  scale_margin: float = 1.5,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 per_pos: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -134,6 +144,16 @@ class PagedDecodeStep:
                              f"{pool_dtype!r}")
         self.kernel = kernel
         self.pool_dtype = pool_dtype
+        # per_pos (ISSUE 15): the step emits argmax tokens for EVERY
+        # chunk position ([S, C] int32) instead of only the last
+        # written one ([S]) — the output shape speculative verify
+        # needs (target tokens t_0..t_k against the drafted window).
+        # Both kernels share it for free: the Pallas kernel already
+        # returns per-row attention outputs for all C appended rows
+        # (o is [S, C, H, dh]); only the logits projection after the
+        # kernel narrows to one row, so widening it is an XLA-side
+        # change common to both paths.
+        self.per_pos = bool(per_pos)
         self.scale_margin = float(scale_margin)
         self.slots = int(slots)
         self.vocab = int(vocab)
@@ -168,6 +188,10 @@ class PagedDecodeStep:
         # fixed point (token t forever) — which would make every
         # stream-equality test in the suite vacuously green.
         wout = w(d, vocab)
+        # The truncated-stage draft (spec.TruncatedDraft) reuses
+        # exactly these three — draft and target share one token
+        # space by construction.
+        self.draft_params = (embed, wpos, wout)
 
         S, C = self.slots, self.chunk
         B, bs = self.max_blocks_per_req, self.block_size
@@ -296,11 +320,19 @@ class PagedDecodeStep:
                     S, C, H * dh)
             y = x + o @ wo
             y = y + jax.nn.relu(y @ w1) @ w2
-            last = jnp.clip(n_new - 1, 0, C - 1)
-            yl = jnp.take_along_axis(
-                y, last[:, None, None], axis=1)[:, 0]    # [S, d]
-            logits = yl @ wout
-            out = jnp.argmax(logits, axis=1).astype(jnp.int32)
+            if per_pos:
+                # Speculative verify: logits for EVERY chunk position
+                # — out[s, j] is the target's argmax after consuming
+                # input j (padding rows yield garbage the collect
+                # path never reads: n_new bounds the comparison).
+                logits = y @ wout                        # [S, C, V]
+                out = jnp.argmax(logits, axis=2).astype(jnp.int32)
+            else:
+                last = jnp.clip(n_new - 1, 0, C - 1)
+                yl = jnp.take_along_axis(
+                    y, last[:, None, None], axis=1)[:, 0]    # [S, d]
+                logits = yl @ wout
+                out = jnp.argmax(logits, axis=1).astype(jnp.int32)
             return kpool, kscale, vpool, vscale, out
 
         if donate is None:

@@ -65,6 +65,7 @@ from ..obs import logging as obs_logging
 from ..obs import trace as obs_trace
 from .api import KV_OOM_ERROR, GenerateRequest
 from .kvcache.allocator import KVCacheOOM
+from .spec import token_run
 
 log = logging.getLogger(__name__)
 
@@ -94,6 +95,14 @@ class ContinuousBatcher:
         # [slots, d] rows: admission binds a block-table lease and the
         # loop is _run_kv (chunked prefill + NO_TOKEN-aware retire).
         self.kv_mode = bool(getattr(executor, "kv", False))
+        if getattr(executor, "speculative", False) and self.pipelined:
+            # The executor enforces its OWN pipelined=False; this
+            # guards the batcher's override knob — the plan-ahead
+            # loop would plan verify windows against provisional
+            # (un-rolled-back) cursors.
+            raise ValueError(
+                "speculative executors require the sync loop shape; "
+                "pipelined=True override is invalid")
         # Role hand-off (serving/disagg): when set, this batcher is a
         # PREFILL replica — a request that emits a token and is not
         # finished leaves its slot through kv_detach_slot and
@@ -656,11 +665,18 @@ class ContinuousBatcher:
         """KV-aware retire against the submit-time snapshot. NO_TOKEN
         (-1) marks a slot whose step emitted nothing — a mid-prefill
         chunk (the request stays, its prompt still filling under the
-        chunk budget) or a stale post-seize handle. Emitted tokens
-        settle like the row plane, except the lease is
-        released-AND-cached before finish() so the settle hook no-ops
-        and the prompt's full blocks enter the prefix tree while the
-        owner refs still hold them."""
+        chunk budget) or a stale post-seize handle. A speculative
+        executor's collect returns [slots, chunk] ACCEPTED RUNS
+        instead of [slots] single tokens (ISSUE 15); both shapes
+        normalize through spec.token_run and the per-request checks
+        move to PER-ACCEPTED-TOKEN — a slot may finish mid-run
+        (max_tokens reached, or the deadline lapsed after an earlier
+        token of the same run), and tokens past that point are
+        dropped exactly as an unspeculated run would never have
+        decoded them. Emitted tokens settle like the row plane,
+        except the lease is released-AND-cached before finish() so
+        the settle hook no-ops and the prompt's full blocks enter the
+        prefix tree while the owner refs still hold them."""
         ex = self.executor
         now = time.monotonic()
         for i, req in enumerate(snapshot):
@@ -673,11 +689,22 @@ class ContinuousBatcher:
                 ex.kv_release_slot(i, cache=False)
                 self._slots[i] = None
                 continue
-            t = int(tokens[i])
-            emitted = t >= 0
-            if emitted:
+            # ONE extraction for both collect shapes (a 1-D entry is
+            # a run of length <= 1) — the hoisted idiom, literally.
+            run = token_run(tokens[i])
+            emitted = bool(run)
+            finished = False
+            for t in run:
                 req.tokens.append(t)
-            finished = emitted and len(req.tokens) >= req.max_tokens
+                if len(req.tokens) >= req.max_tokens:
+                    finished = True
+                    break
+                if now >= req.deadline:
+                    # Deadline mid-run: keep what settled, drop the
+                    # accepted tail.
+                    req.truncated = True
+                    finished = True
+                    break
             if not finished and now >= req.deadline:
                 # Deadline mid-decode OR mid-prefill: return whatever
                 # exists, marked truncated, at the step boundary —
@@ -777,7 +804,11 @@ class ContinuousBatcher:
         NO_TOKEN. `pipelined` picks the shape: True settles step k-1
         while step k runs on the device (the decode recurrence chains
         on device, so dispatch needs no host token); False collects
-        every step before the next dispatch — the measured baseline.
+        every step before the next dispatch — the measured baseline,
+        and the shape speculative executors REQUIRE (their next plan
+        drafts from the previous step's accepted tokens, so they
+        construct with pipelined=False and this loop needs no
+        speculative branch at all: collect just returns runs).
         Token STREAMS are identical either way: rows decode
         independently and the plan depends only on committed cursors
         (the ISSUE 3 equivalence argument, carried to tokens).

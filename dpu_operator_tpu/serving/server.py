@@ -152,6 +152,9 @@ class ServingServer:
             # Scrape-time delta state for the kv token counters
             # (published like serving_trace_dropped_total).
             self._kv_pub: dict = {}
+            # Same discipline for the speculative-decoding counters
+            # (present only on executors running mode="speculative").
+            self._spec_pub: dict = {}
         dims = {ex.d for ex in executors}
         if len(dims) != 1:
             # prompt_vec width is validated once at the front door; a
@@ -432,6 +435,9 @@ class ServingServer:
             agg = {"used": 0, "free": 0, "shared": 0,
                    "hit": 0, "lookup": 0}
             deltas = {"prefill": 0, "decode": 0}
+            spec_agg = {"proposed": 0, "accepted": 0, "runs": 0}
+            spec_deltas = {"proposed": 0, "accepted": 0}
+            spec_seen = False
             with self._trace_pub_lock:
                 for idx, ex in enumerate(self.pool.executors):
                     st = ex.kv_stats()
@@ -445,6 +451,24 @@ class ServingServer:
                     deltas["decode"] += st["decode_tokens"] - last[1]
                     self._kv_pub[idx] = (st["prefill_tokens"],
                                          st["decode_tokens"])
+                    if "spec_proposed_tokens" in st:
+                        # Speculative replica (ISSUE 15): acceptance
+                        # counters as deltas, rates as scrape-time
+                        # gauges over the cumulative totals.
+                        spec_seen = True
+                        spec_agg["proposed"] += st[
+                            "spec_proposed_tokens"]
+                        spec_agg["accepted"] += st[
+                            "spec_accepted_tokens"]
+                        spec_agg["runs"] += st["spec_verify_steps"]
+                        slast = self._spec_pub.get(idx, (0, 0))
+                        spec_deltas["proposed"] += (
+                            st["spec_proposed_tokens"] - slast[0])
+                        spec_deltas["accepted"] += (
+                            st["spec_accepted_tokens"] - slast[1])
+                        self._spec_pub[idx] = (
+                            st["spec_proposed_tokens"],
+                            st["spec_accepted_tokens"])
             for state in ("used", "free", "shared"):
                 self.registry.gauge_set(
                     "serving_kv_blocks", float(agg[state]),
@@ -465,6 +489,30 @@ class ServingServer:
                 "serving_decode_tokens_total", by=float(
                     max(0, deltas["decode"])),
                 help="decode tokens emitted by paged-KV steps")
+            if spec_seen:
+                self.registry.counter_inc(
+                    "serving_spec_proposed_tokens_total", by=float(
+                        max(0, spec_deltas["proposed"])),
+                    help="draft tokens fed to speculative verify "
+                         "steps")
+                self.registry.counter_inc(
+                    "serving_spec_accepted_tokens_total", by=float(
+                        max(0, spec_deltas["accepted"])),
+                    help="draft tokens the target model accepted")
+                self.registry.gauge_set(
+                    "serving_spec_accept_rate",
+                    round(spec_agg["accepted"] / spec_agg["proposed"],
+                          6) if spec_agg["proposed"] else 0.0,
+                    help="accepted fraction of proposed draft tokens "
+                         "(cumulative)")
+                self.registry.gauge_set(
+                    "serving_spec_tokens_per_step",
+                    round((spec_agg["accepted"] + spec_agg["runs"])
+                          / spec_agg["runs"], 6)
+                    if spec_agg["runs"] else 0.0,
+                    help="emitted tokens per verify step (accepted "
+                         "drafts + the bonus; 1.0 = the one-token "
+                         "baseline)")
         # Per-replica host-gap share of the decode loop: the overlap
         # number an operator watches — near 0 means host scheduling
         # hides behind device steps; climbing toward 1 means the device

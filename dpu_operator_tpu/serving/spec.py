@@ -1,0 +1,277 @@
+"""Speculative decoding: the draft-model contract and acceptance math.
+
+ISSUE 15 makes tokens-per-step the throughput lever: a cheap DRAFT
+model proposes ``k`` tokens per decode slot, the target model verifies
+all ``k + 1`` positions in ONE batched step (the chunked-prefill plan
+machinery re-used: ``host_tok[s, :k+1]``, ``n_new[s] = k+1``), and
+greedy argmax verification accepts the longest prefix on which the
+draft matched the target — plus the target's one bonus token, so every
+verify step emits at least the token the one-token baseline would
+have.
+
+The verify recurrence, 0-indexed over one slot's step window:
+
+  * inputs fed:   ``[last, d_1, .., d_k]`` at positions
+    ``ctx .. ctx+k`` (``last`` = the slot's last settled token);
+  * target out:   ``t_j`` = the target's argmax after consuming input
+    ``j`` (per-position logits — the ISSUE 15 kernel change);
+  * acceptance:   ``t_0`` always (it equals exactly the non-spec
+    step's emit); ``t_j`` for ``j >= 1`` iff ``d_j == t_{j-1}`` and
+    every earlier draft matched — i.e. ``a = accept_length(draft,
+    target)`` leading matches accept ``t_0 .. t_a``: ``a + 1`` tokens.
+
+Rejection is a WATERMARK TRUNCATION, not a device unwind: the plan
+advanced ``st.ctx`` by ``k + 1`` assuming full acceptance, and collect
+rolls it back to ``plan_ctx + a + 1`` while the collect-confirmed
+watermark (built in PR 7 precisely so uncollected positions can never
+poison the prefix cache) advances only to the accepted extent. KV
+written at rejected positions is dead bytes the next append
+overwrites — K/V at a position depends only on that position's input
+embedding, so the re-append after a rollback writes exactly what an
+unspeculated run would have.
+
+This module is the jax-free plane of the contract (numpy only — the
+scheduler imports it): the sentinel + emit-masking idiom shared by
+both collect paths, the acceptance math, the bookkeeping, and the two
+shipped drafts. ``TruncatedDraft`` lazy-imports jax in its
+constructor only.
+
+Draft contract
+--------------
+
+``draft.propose(last[S] int32, ctx[S] int32) -> [S, k] int32`` —
+called ONCE per planned step with fixed-shape full-slot arrays (rows
+for slots not in decode regime carry zeros and are ignored), so a
+jitted draft AOT-compiles one executable. ``k`` is fixed at draft
+construction and must satisfy ``k + 1 <= prefill_chunk`` (the verify
+window rides the prefill chunk's compiled width). Draft proposals
+chain on the draft's OWN tokens (after a mispredict the tail is dead
+anyway — it can never be accepted past the first mismatch).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+#: collect() sentinel for "no token emitted at this position" — ONE
+#: definition shared by the one-token collect path
+#: (kvcache/executor.py), the speculative collect path, and the
+#: scheduler's retire, so the two collect paths cannot drift.
+NO_TOKEN = -1
+
+
+def token_run(row) -> List[int]:
+    """The per-slot emit-masking idiom, hoisted (ISSUE 15 cleanup):
+    the emitted-token run of one collect row — the leading prefix of
+    valid (``>= 0``) tokens, stopped at the first NO_TOKEN pad. Both
+    collect shapes normalize through it: a scalar/0-d entry is a run
+    of length <= 1, a speculative row is the accepted run."""
+    arr = np.atleast_1d(np.asarray(row))
+    out: List[int] = []
+    for t in arr:
+        if int(t) < 0:
+            break
+        out.append(int(t))
+    return out
+
+
+def accept_length(draft, target) -> int:
+    """Greedy-verify acceptance: the number ``a`` of leading draft
+    positions where ``draft[j] == target[j]`` — the target tokens
+    ``target[:a + 1]`` (matches plus the bonus) are the step's
+    accepted run. Deterministic: greedy argmax on both sides means no
+    sampling correction is needed (the Leviathan/Chen rejection-
+    sampling machinery degenerates to exact prefix match)."""
+    draft = np.asarray(draft).reshape(-1)
+    target = np.asarray(target).reshape(-1)
+    a = 0
+    while a < len(draft) and a < len(target) \
+            and int(draft[a]) == int(target[a]):
+        a += 1
+    return a
+
+
+def synthetic_next_token(tok: int, pos: int, seed: int,
+                         vocab: int) -> int:
+    """The synthetic token plane's target recurrence — ONE definition
+    shared by SyntheticKVExecutor's device and the OracleDraft that
+    predicts it, so the oracle can never drift from the model it
+    drafts for."""
+    return (31 * int(tok) + 7 * int(pos) + int(seed)) % int(vocab)
+
+
+class SpecStats:
+    """Acceptance bookkeeping, mutated ONLY under the executor's
+    collect owner-guard (proposed at plan time is the one exception —
+    a proposal exists whether or not its step survives, and a stale
+    step's proposals correctly depress the measured rate)."""
+
+    __slots__ = ("proposed", "accepted", "runs")
+
+    def __init__(self):
+        self.proposed = 0   # draft tokens fed to verify steps
+        self.accepted = 0   # draft tokens the target confirmed
+        self.runs = 0       # verify steps collected
+
+    def accept_rate(self) -> float:
+        """Accepted fraction of proposed draft tokens (positions after
+        a run's first mismatch count as rejected — this is the
+        REALIZED rate, which is what the speedup math depends on, not
+        the per-position oracle rate)."""
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    def tokens_per_step(self) -> float:
+        """Emitted tokens per verify step: accepted drafts + the bonus
+        token every step carries. 1.0 = the one-token baseline."""
+        return ((self.accepted + self.runs) / self.runs
+                if self.runs else 0.0)
+
+
+class SpecConfig:
+    """One executor's speculative-decoding configuration: the draft,
+    the per-slot proposal depth ``k``, and the acceptance stats. The
+    executor validates ``k + 1 <= prefill_chunk`` (the verify window
+    is the compiled chunk width) and that it runs the sync loop shape
+    — the next plan needs the previous step's ACCEPTED length, so
+    collect-before-plan is structural, not a tuning choice."""
+
+    def __init__(self, draft, k: int):
+        if k < 1:
+            raise ValueError(f"spec k must be >= 1, got {k}")
+        draft_k = getattr(draft, "k", None)
+        if draft_k is not None and int(draft_k) != int(k):
+            raise ValueError(
+                f"draft proposes k={draft_k} tokens but the config "
+                f"asks for k={k}")
+        self.draft = draft
+        self.k = int(k)
+        self.stats = SpecStats()
+
+
+class OracleDraft:
+    """Controlled-acceptance draft for the synthetic token plane: it
+    KNOWS the target recurrence (synthetic_next_token) and corrupts
+    each proposal with a deterministic hash of (token, position) so
+    the per-position hit rate is ``accept_rate`` — the dial the bench
+    and the equivalence tests turn. Pure function of (last, ctx):
+    byte-identical streams across runs, loop shapes, and resumes."""
+
+    def __init__(self, k: int, accept_rate: float = 0.7,
+                 vocab: int = 64, target_seed: int = 0,
+                 seed: int = 0):
+        if not 0.0 <= accept_rate <= 1.0:
+            raise ValueError(f"accept_rate must be in [0, 1], got "
+                             f"{accept_rate}")
+        self.k = int(k)
+        self.accept_rate = float(accept_rate)
+        self.vocab = int(vocab)
+        self.target_seed = int(target_seed)
+        self.seed = int(seed)
+
+    def _hit(self, tok: int, pos: int) -> bool:
+        # LCG-style mix: deterministic, position- and token-sensitive,
+        # cheap. The 23-bit hash compares against a threshold in the
+        # SAME domain (no modulo fold — a `% 1e6` over 2^23 residues
+        # would bias mid rates by ~1.4 points), so the per-position
+        # rate is accept_rate to within 2^-23 and 0.0/1.0 are exact.
+        h = (1103515245 * (tok * 131 + pos * 7919 + self.seed)
+             + 12345) & 0x7FFFFFFF
+        return (h >> 8) < int(round(self.accept_rate * (1 << 23)))
+
+    def propose(self, last, ctx) -> np.ndarray:
+        last = np.asarray(last, np.int64)
+        ctx = np.asarray(ctx, np.int64)
+        out = np.zeros((len(last), self.k), np.int32)
+        for s in range(len(last)):
+            t = int(last[s])
+            for j in range(self.k):
+                pos = int(ctx[s]) + j
+                nxt = synthetic_next_token(t, pos, self.target_seed,
+                                           self.vocab)
+                if not self._hit(t, pos):
+                    nxt = (nxt + 1) % self.vocab  # deliberate miss
+                out[s, j] = nxt
+                t = nxt  # chain on own proposal (dead past a miss)
+        return out
+
+
+class TruncatedDraft:
+    """The jitted plane's cheap draft: a TRUNCATED-STAGE variant of
+    the target PagedDecodeStep — the SAME embed/positional/output
+    weights with the attention and MLP stages cut, so the draft is
+    attention-free (no KV, no block tables, no gather) and one AOT
+    executable proposes all k tokens for every slot in one dispatch:
+
+        x_j = embed[t_j] + wpos[pos_j];  t_{j+1} = argmax(x_j @ wout)
+
+    Acceptance against the full target is whatever the truncation
+    earns — correctness never depends on it (a 0%-accept draft still
+    yields byte-identical streams at one bonus token per step); the
+    CONTROLLED-rate speedup measurements use OracleDraft on the
+    synthetic plane instead."""
+
+    def __init__(self, embed, wpos, wout, k: int, slots: int):
+        import jax
+        import jax.numpy as jnp
+
+        self.k = int(k)
+        T = int(wpos.shape[0])
+
+        def propose(last, ctx):
+            t = last
+            cols = []
+            for j in range(self.k):
+                pos = jnp.clip(ctx + j, 0, T - 1)
+                x = embed[t] + wpos[pos]
+                t = jnp.argmax(x @ wout, axis=-1).astype(jnp.int32)
+                cols.append(t)
+            return jnp.stack(cols, axis=1)
+
+        z = jnp.zeros((int(slots),), jnp.int32)
+        self._fn = jax.jit(propose).lower(z, z).compile()
+
+    @classmethod
+    def from_paged(cls, paged_step, k: int) -> "TruncatedDraft":
+        """Build from a kvcache/paged.PagedDecodeStep — the weights
+        are the ones its executable already closed over, so draft and
+        target can never disagree on the token space."""
+        embed, wpos, wout = paged_step.draft_params
+        return cls(embed, wpos, wout, k, paged_step.slots)
+
+    def propose(self, last, ctx) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self._fn(jnp.asarray(last, jnp.int32),
+                                   jnp.asarray(ctx, jnp.int32)),
+                          np.int32)
+
+
+def clamp_spec_k(k: int, ctx: int, max_total: int, chunk: int) -> int:
+    """Per-slot draft depth under the page-reservation bound. With
+    ``r = max_total - ctx - 1`` tokens still owed (``max_total =
+    plen + max_tokens``), drafting beyond ``r - 1`` can only propose
+    tokens past the request's budget — and, critically, would append
+    KV past the worst-case pages reserved at admission (the plan's
+    clipped table gather would silently scatter into table entry
+    B-1's block — another slot era's data). Clamped, the maximum
+    position a verify step writes equals the one-token loop's
+    maximum, so ADMISSION MATH IS UNCHANGED: no extra slack pages,
+    no new OOM class. Also bounded by the compiled chunk width
+    (``k + 1 <= chunk``)."""
+    owed = int(max_total) - int(ctx) - 1
+    return max(0, min(int(k), owed - 1, int(chunk) - 1))
+
+
+__all__ = [
+    "NO_TOKEN",
+    "OracleDraft",
+    "SpecConfig",
+    "SpecStats",
+    "TruncatedDraft",
+    "accept_length",
+    "clamp_spec_k",
+    "synthetic_next_token",
+    "token_run",
+]

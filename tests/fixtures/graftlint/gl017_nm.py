@@ -1,0 +1,56 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl017_nm.py
+"""GL017 near-misses that must stay silent: the same attribute writes
+inside the collect owner-guard region, constructor initialization,
+the _reattach settled-token rebuild, plan-time writes to PLAN-owned
+cursors, and locals that merely share the names."""
+
+
+class SlotState:
+    def __init__(self, ctx):
+        # Construction is not mutation of live collect state.
+        self.last_token = None
+        self.confirmed = int(ctx)
+
+
+class Executor:
+    def __init__(self):
+        self.decode_tokens = 0
+
+    def collect(self, handle):
+        raw = self._materialize(handle.raw)
+        with self._slock:
+            if handle.plan.gen == self._gen:
+                for s, st in enumerate(self._states):
+                    if st is None or st.req_id != handle.plan.owners[s]:
+                        continue
+                    # The owner-guard region: exactly where these
+                    # writes belong.
+                    st.confirmed = max(st.confirmed, int(raw[s]))
+                    st.last_token = int(raw[s])
+                    self.decode_tokens += 1
+        return raw
+
+    def _collect_spec(self, handle):
+        with self._slock:
+            for st in self._states:
+                if st is not None:
+                    st.last_token = 0
+                    self.decode_tokens += 1
+
+    def _reattach(self, slot, req):
+        # Cursors rebuilt from SETTLED tokens — durable truth.
+        st = self._states[slot]
+        st.last_token = int(req.tokens[-1])
+        st.confirmed = len(req.tokens)
+
+    def _plan_step(self):
+        # Plan-owned cursors: plan time is exactly where these move.
+        last_token = None
+        for st in self._states:
+            if st is None:
+                continue
+            st.ctx += 1
+            st.prefill_pos += 1
+            st.pending_emit = True
+            last_token = st.ctx  # local, not slot state
+        return last_token

@@ -1,0 +1,25 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl017_tp.py
+"""GL017 true positives: collect-owned decode state written at PLAN
+time. Two findings: the phantom-step counter inflation (decode_tokens
+bumped while planning — the exact class PR 7's review fixed by hand),
+and a submit-path last_token stamp (a retired request's emit can land
+in a freshly re-admitted slot state)."""
+
+
+class Executor:
+    def _plan_step(self):
+        plan = self._build_plan()
+        # TP 1: counted at plan time — the pipelined loop's phantom
+        # post-retire step inflates throughput by ~1/max_tokens.
+        self.decode_tokens += int(plan.n_new.sum())
+        return plan
+
+    def submit(self, updates=()):
+        plan = self._plan_step()
+        raw = self._dispatch(plan)
+        for s, st in enumerate(self._states):
+            if st is not None and plan.emit[s]:
+                # TP 2: stamped before collect attributes the emit to
+                # the state that planned it.
+                st.last_token = int(plan.host_tok[s, 0])
+        return raw

@@ -772,6 +772,95 @@ def test_kv_kill_mid_decode_reattaches_pages_instead_of_redecoding(
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 
+# -- speculative decode (ISSUE 15): kill mid-verify ---------------------------
+
+
+@pytest.mark.parametrize("backend", ["synthetic", "paged"])
+def test_kv_kill_mid_verify_resumes_from_confirmed_watermark(
+        backend, settle_counts, tmp_path):
+    """Chaos-matrix extension (ISSUE 15): a replica killed MID-VERIFY
+    of a speculative request must resume from the COLLECT-CONFIRMED
+    watermark — never from accepted-but-uncollected draft positions
+    (the killed step's provisional ctx advance dies with the
+    incarnation; _reattach rebuilds cursors from settled tokens).
+    Byte-identical streams vs the uninjected speculative run prove it
+    (the recurrences are position-dependent, so a resume that trusted
+    an uncollected verify window diverges visibly), settle exactly
+    once, leak ledger clean."""
+    from dpu_operator_tpu.serving.spec import OracleDraft, SpecConfig
+
+    t0 = time.perf_counter()
+    plen, chunk, max_toks, k = 32, 8, 8, 4
+    prompt = [int(x) for x in range(plen)]
+    if backend == "synthetic":
+        from dpu_operator_tpu.serving import SyntheticKVExecutor
+
+        inner = SyntheticKVExecutor(
+            slots=2, block_size=4, num_blocks=64,
+            max_blocks_per_req=16, prefill_chunk=chunk,
+            pipelined=False,
+            spec=SpecConfig(OracleDraft(k=k, accept_rate=0.6,
+                                        vocab=64, target_seed=0), k))
+    else:
+        from dpu_operator_tpu.serving import PagedKVExecutor
+
+        # The int8 resident default on the XLA composition: resume
+        # replays re-plan the SAME verify windows (drafts are pure
+        # functions of (last, ctx)), so even quantization groups
+        # reproduce and streams stay byte-identical vs uninjected.
+        inner = PagedKVExecutor(slots=2, block_size=4, num_blocks=64,
+                                max_blocks_per_req=16,
+                                prefill_chunk=chunk, d=16, heads=2,
+                                vocab=32, mode="speculative", spec_k=k)
+
+    def run(inject, flight_dir=None):
+        ex = FaultyExecutor(inner, site="kvs0") if inject else inner
+        reqs = [GenerateRequest(prompt_vec=None, max_tokens=max_toks,
+                                deadline=time.monotonic() + 60.0,
+                                prompt_tokens=list(prompt))]
+        pool, _q = _run_pool([ex], reqs, timeout=20.0,
+                             flight_dir=flight_dir)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        inner.allocator.assert_clean()
+        return [(r.error, list(r.tokens)) for r in reqs], reqs
+
+    baseline, _ = run(inject=False)
+    runs_before = inner.spec.stats.runs
+    assert runs_before > 0, "the baseline never speculated"
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            # The baseline primed the prefix cache: prefill is one
+            # chunk step, so submit 3 is the SECOND verify step —
+            # tokens settled, a verify window in flight.
+            plan.inject("kvs0.submit",
+                        exc=RuntimeError("injected mid-verify kill"),
+                        at_calls=[3])
+            injected, reqs = run(inject=True, flight_dir=tmp_path)
+        spans = tr.spans_snapshot()
+    assert injected == baseline, (injected, baseline)
+    assert all(e is None for e, _ in injected)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    assert inner.resumed_total >= 1
+    assert inner.spec.stats.runs > runs_before
+    victim = reqs[0].request_id
+    requeues = [s for s in spans if s.name == "supervisor.requeue"
+                and s.attrs.get("outcome") == "requeued_kv"]
+    assert [s.request_id for s in requeues] == [victim]
+    flight = _flight_spans(tmp_path, "restart")
+    assert any(s["name"] == "supervisor.requeue"
+               and s["attrs"].get("outcome") == "requeued_kv"
+               for s in flight)
+    if hasattr(inner, "close"):
+        inner.close()
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
 # -- health contract over HTTP ------------------------------------------------
 
 

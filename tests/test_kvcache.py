@@ -373,6 +373,59 @@ def test_stale_generation_submit_is_noop():
     ex.close()
 
 
+def test_zero_work_slot_raced_by_retire_readmit_noops_at_collect():
+    """Regression (ISSUE 15 satellite): the collect owner guard
+    (kvcache/executor.py) is load-bearing for speculative rollback,
+    and its ZERO-TOKEN case was untested — a budget-starved slot
+    (n_new == 0: the plan recorded the owner but planned no work)
+    raced by retire + re-admit between submit and collect must be a
+    PURE no-op at collect: no watermark advance, no last_token stamp
+    on the slot's new occupant. Both guards are exercised: the
+    owner mismatch (rebound slot) and the n_new == 0 check (same
+    owner, zero work)."""
+    ex = SyntheticKVExecutor(slots=2, prefill_chunk=4, prefill_budget=4,
+                             pipelined=False, num_blocks=64,
+                             prefix_cache=False)
+    long_req = _req(list(np.arange(16) % 5), max_tokens=2)
+    starved = _req(list(np.arange(8) % 3), max_tokens=2)
+    ex.kv_attach(0, long_req)
+    ex.kv_attach(1, starved)
+    # First plan (rotating start at slot 0): slot 0 takes the whole
+    # 4-token budget, slot 1 gets n_new == 0.
+    h = ex.submit((), gen=ex.kv_gen())
+    assert int(h.plan.n_new[1]) == 0 and h.plan.owners[1] is not None
+    # Case 1 — same owner, zero work: nothing may move at collect.
+    st = ex._states[1]
+    confirmed0, last0 = st.confirmed, st.last_token
+    ex.collect(h)
+    assert st.confirmed == confirmed0 and st.last_token is last0
+
+    # Step 2's rotating start favors slot 1; collect it so step 3
+    # starts at slot 0 again and slot 1 is starved once more.
+    ex.collect(ex.submit((), gen=ex.kv_gen()))
+
+    # Case 2 — budget-starved slot retired + re-admitted between
+    # submit and collect: the rebound slot's fresh state must be
+    # untouched by the old zero-work handle.
+    h2 = ex.submit((), gen=ex.kv_gen())
+    assert int(h2.plan.n_new[1]) == 0
+    assert h2.plan.owners[1] == starved.request_id
+    ex.kv_release_slot(1, cache=False)       # retire
+    starved.fail("seized elsewhere")
+    fresh = _req([7, 7, 7], max_tokens=2)
+    ex.kv_attach(1, fresh)                   # re-admit
+    st2 = ex._states[1]
+    confirmed0, last0 = st2.confirmed, st2.last_token
+    ex.collect(h2)
+    assert st2.confirmed == confirmed0 and st2.last_token is last0
+    ex.kv_release_slot(0, cache=False)
+    ex.kv_release_slot(1, cache=False)
+    long_req.finish()
+    fresh.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
 @pytest.mark.parametrize("pipelined", [False, True])
 def test_decode_token_counter_matches_delivered(pipelined):
     """Regression: decode_tokens was counted at PLAN time, so the

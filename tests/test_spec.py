@@ -1,0 +1,363 @@
+"""Speculative decoding (ISSUE 15): acceptance math, the draft
+contract, and the stream-equivalence lane.
+
+Correctness strategy carries PR 7's: INVARIANCE. Greedy argmax
+verification is deterministic, so a speculative stream must be
+BYTE-IDENTICAL to the one-token stream on the same trace — at every
+acceptance rate (0%: every step still emits its bonus token; 100%:
+full windows accept), across the synthetic and the real jitted
+planes, and on both paged-attention kernels. Rejection rollback is
+exercised hardest at LOW acceptance (every step rolls ctx back), full
+windows hardest at rate 1.0.
+
+Real-model lanes pin ``pool_dtype="fp32"`` for exact byte-identity,
+the PR 13 precedent: int8 per-block scales are set once by the step
+that writes a block's row 0 over ALL that step's rows — a verify
+window groups rejected rows into the amax, so speculative int8
+quantization GROUPS differ from one-token runs by design and the
+divergence is bounded by the documented paged_kv_error_bound, not
+zero. Speculative int8 runs are still deterministic against
+themselves, asserted below.
+
+Every allocator-touching test asserts a clean leak ledger."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      GenerateRequest,
+                                      SyntheticKVExecutor)
+from dpu_operator_tpu.serving.spec import (NO_TOKEN, OracleDraft,
+                                           SpecConfig, accept_length,
+                                           clamp_spec_k,
+                                           synthetic_next_token,
+                                           token_run)
+
+MODEL = dict(vocab=32, d=16, heads=2)
+VOCAB = 64  # the synthetic executors' default
+
+
+def _req(prompt, max_tokens=6, deadline_s=60.0):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + deadline_s,
+                           prompt_tokens=list(prompt))
+
+
+def _drive(ex, reqs, timeout=60.0):
+    q = AdmissionQueue(max_depth=len(reqs) + 1)
+    b = ContinuousBatcher(ex, q)
+    for r in reqs:
+        q.submit(r)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=timeout), "request lost"
+    finally:
+        b.stop()
+    for r in reqs:
+        assert r.error is None, r.error
+    return [list(r.tokens) for r in reqs]
+
+
+def _oracle_spec(k=4, accept_rate=0.7, seed=0):
+    return SpecConfig(OracleDraft(k=k, accept_rate=accept_rate,
+                                  vocab=VOCAB, target_seed=seed), k)
+
+
+def _synth(spec=None, **kw):
+    args = dict(slots=2, num_blocks=64, pipelined=spec is None)
+    args.update(kw)
+    return SyntheticKVExecutor(spec=spec, **args)
+
+
+# The PR 7 invariance trace (test_kvcache.PROMPTS): a long prompt
+# chunk-prefilled mid-run, a short one, a constant one, and the
+# full-table 26-token edge.
+PROMPTS = [list(np.arange(25) % 13), [3, 1, 4, 1, 5], [9] * 12,
+           list(np.arange(26) % 13)]
+
+
+# -- acceptance math + contracts ---------------------------------------------
+
+
+def test_accept_length_is_longest_prefix_match():
+    assert accept_length([1, 2, 3], [1, 2, 3, 9]) == 3
+    assert accept_length([1, 2, 3], [1, 7, 3, 9]) == 1
+    assert accept_length([5], [4, 4]) == 0
+    assert accept_length([], [4]) == 0
+
+
+def test_token_run_stops_at_first_pad():
+    assert token_run([5, 0, 7, NO_TOKEN, 9]) == [5, 0, 7]
+    assert token_run([NO_TOKEN, 3]) == []
+    assert token_run(np.int32(4)) == [4]
+    assert token_run(np.int32(NO_TOKEN)) == []
+
+
+def test_clamp_spec_k_never_exceeds_reserved_pages():
+    # owed = max_total - ctx - 1 tokens; drafting past owed-1 would
+    # append KV beyond the admission-time worst case.
+    assert clamp_spec_k(4, ctx=10, max_total=20, chunk=8) == 4
+    assert clamp_spec_k(4, ctx=16, max_total=20, chunk=8) == 2
+    assert clamp_spec_k(4, ctx=18, max_total=20, chunk=8) == 0
+    assert clamp_spec_k(9, ctx=0, max_total=99, chunk=8) == 7  # window
+
+
+def test_oracle_draft_is_deterministic_and_rate_controlled():
+    d = OracleDraft(k=4, accept_rate=0.7, vocab=VOCAB, target_seed=0)
+    last = np.arange(8, dtype=np.int32)
+    ctx = np.arange(8, dtype=np.int32) * 3
+    a, b = d.propose(last, ctx), d.propose(last, ctx)
+    assert np.array_equal(a, b)
+    # rate 1.0 is the exact oracle; rate 0.0 always misses its FIRST
+    # proposal (later ones chain on the corrupted token — dead past
+    # the first mismatch anyway, so acceptance is structurally 0).
+    exact = OracleDraft(k=4, accept_rate=1.0, vocab=VOCAB,
+                        target_seed=0).propose(last, ctx)
+    never = OracleDraft(k=4, accept_rate=0.0, vocab=VOCAB,
+                        target_seed=0).propose(last, ctx)
+    for s in range(8):
+        t = int(last[s])
+        for j in range(4):
+            want = synthetic_next_token(t, int(ctx[s]) + j, 0, VOCAB)
+            assert int(exact[s, j]) == want
+            if j == 0:
+                assert int(never[s, j]) != want
+            t = want
+
+
+def test_spec_config_validates_k_and_loop_shape():
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        SpecConfig(OracleDraft(k=1), 0)
+    with pytest.raises(ValueError, match="draft proposes k=2"):
+        SpecConfig(OracleDraft(k=2), 4)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        SyntheticKVExecutor(prefill_chunk=4, pipelined=False,
+                            spec=_oracle_spec(k=4))
+    with pytest.raises(ValueError, match="sync loop shape"):
+        SyntheticKVExecutor(pipelined=True, spec=_oracle_spec(k=4))
+    # The batcher's own override knob is guarded too (the executor
+    # flag is what it keys on): forcing the plan-ahead loop over a
+    # speculative executor would plan against provisional cursors.
+    ex = SyntheticKVExecutor(pipelined=False, spec=_oracle_spec(k=4))
+    with pytest.raises(ValueError, match="sync loop shape"):
+        ContinuousBatcher(ex, AdmissionQueue(max_depth=2),
+                          pipelined=True)
+    ex.close()
+
+
+# -- synthetic plane: byte-identical streams at every acceptance rate --------
+
+
+@pytest.mark.parametrize("accept_rate", [0.0, 0.6, 1.0])
+def test_synthetic_spec_streams_byte_identical_to_both_loop_shapes(
+        accept_rate):
+    """ISSUE 15 acceptance: speculative streams == non-speculative
+    streams on the PR 7 invariance trace, against BOTH the sync and
+    the pipelined one-token loops (the extended sync↔pipelined
+    equivalence lane). Rate 0 exercises rollback on every verify
+    step; rate 1 full-window acceptance; 0.6 the mixed regime."""
+    golden = {}
+    for pipelined in (False, True):
+        ex = _synth(pipelined=pipelined)
+        golden[pipelined] = _drive(
+            ex, [_req(p, max_tokens=6) for p in PROMPTS])
+        ex.allocator.assert_clean()
+        ex.close()
+    assert golden[False] == golden[True]
+
+    ex = _synth(spec=_oracle_spec(accept_rate=accept_rate))
+    streams = _drive(ex, [_req(p, max_tokens=6) for p in PROMPTS])
+    st = ex.kv_stats()
+    ex.allocator.assert_clean()
+    ex.close()
+    # The PR 7 counter contract carries to spec mode: accepted runs
+    # are clamped to the request budget, so absent deadline
+    # truncation the counter equals exactly what clients received.
+    assert st["decode_tokens"] == sum(len(s) for s in streams)
+    assert streams == golden[False], (streams, golden[False])
+    assert any(len(set(s)) > 1 for s in streams), \
+        "degenerate streams would make the equality vacuous"
+    assert st["spec_verify_steps"] > 0
+    if accept_rate == 0.0:
+        assert st["spec_accepted_tokens"] == 0
+        assert st["spec_tokens_per_step"] == 1.0
+    if accept_rate == 1.0:
+        assert st["spec_accepted_tokens"] == st["spec_proposed_tokens"]
+        assert st["spec_tokens_per_step"] > 2.0
+
+
+def test_spec_uses_strictly_fewer_steps_at_full_acceptance():
+    """The throughput lever itself: same trace, same streams, fewer
+    target-model steps — tokens-per-step > 1 is the whole point."""
+    base = _synth(pipelined=False)
+    _drive(base, [_req(p, max_tokens=8) for p in PROMPTS[:2]])
+    base_steps = base._step_no
+    base.allocator.assert_clean()
+    base.close()
+
+    ex = _synth(spec=_oracle_spec(accept_rate=1.0))
+    _drive(ex, [_req(p, max_tokens=8) for p in PROMPTS[:2]])
+    spec_steps = ex._step_no
+    ex.allocator.assert_clean()
+    ex.close()
+    assert spec_steps < base_steps, (spec_steps, base_steps)
+
+
+def test_spec_resume_reattaches_from_confirmed_watermark():
+    """Kill-between-steps at the executor seam: a speculative
+    executor reset mid-run re-attaches from SETTLED tokens (the
+    confirmed watermark's durable shadow) and the resumed stream is
+    byte-identical — accepted-but-uncollected draft positions never
+    leak into the resume cursors."""
+    prompt = list(np.arange(16) % 9)
+    ref = _synth(spec=_oracle_spec(accept_rate=0.6), slots=1)
+    (golden,) = _drive(ref, [_req(prompt, max_tokens=8)])
+    ref.allocator.assert_clean()
+    ref.close()
+
+    ex = _synth(spec=_oracle_spec(accept_rate=0.6), slots=1)
+    req = _req(prompt, max_tokens=8)
+    ex.kv_attach(0, req)
+    while len(req.tokens) < 3:            # part-way, then "die"
+        runs = ex.collect(ex.submit((), gen=ex.kv_gen()))
+        req.tokens.extend(token_run(runs[0]))
+    ex.reset()
+    assert req.kv_lease.resumable
+    ex.kv_attach(0, req)
+    assert ex.resumed_total == 1
+    while len(req.tokens) < 8:
+        runs = ex.collect(ex.submit((), gen=ex.kv_gen()))
+        for t in token_run(runs[0]):
+            if len(req.tokens) < 8:
+                req.tokens.append(t)
+    assert list(req.tokens) == golden
+    ex.kv_release_slot(0)
+    req.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_spec_prefix_cache_hit_reproduces_uncached_stream():
+    """The confirmed watermark bounds the cache insert in spec mode
+    too: a second same-prefix request must hit the cache AND decode
+    the identical stream."""
+    prompt = list(np.arange(21) % 11)
+    ex = _synth(spec=_oracle_spec(accept_rate=0.6))
+    (first,) = _drive(ex, [_req(prompt, max_tokens=5)])
+    hits0 = ex.prefix.hit_tokens
+    req = _req(prompt, max_tokens=5)
+    (second,) = _drive(ex, [req])
+    assert second == first
+    assert req.kv_lease.cached_tokens > 0
+    assert ex.prefix.hit_tokens > hits0
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- the real jitted plane: both kernels, fp32-exact ------------------------
+
+
+def _paged(**kw):
+    from dpu_operator_tpu.serving import PagedKVExecutor
+
+    args = dict(slots=2, block_size=4, num_blocks=64,
+                max_blocks_per_req=8, prefill_chunk=8, seed=0,
+                pool_dtype="fp32", **MODEL)
+    args.update(kw)
+    return PagedKVExecutor(**args)
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_paged_spec_streams_byte_identical_both_kernels(kernel):
+    """The real target model: speculative (truncated-stage draft —
+    whatever acceptance the truncation earns, correctness must not
+    depend on it) equals the sync one-token loop, byte-identical, on
+    the XLA composition and the fused Pallas kernel (interpreter on
+    CPU). fp32 pools: the exact lane (see module docstring)."""
+    interp = True if kernel == "pallas" else None
+    prompts = PROMPTS[:3] if kernel == "xla" else PROMPTS[:2]
+    toks = 6 if kernel == "xla" else 4
+    sync = _paged(mode="sync", kernel=kernel, interpret=interp)
+    golden = _drive(sync, [_req(p, max_tokens=toks) for p in prompts])
+    sync.allocator.assert_clean()
+
+    spec = _paged(mode="speculative", spec_k=3, kernel=kernel,
+                  interpret=interp)
+    streams = _drive(spec, [_req(p, max_tokens=toks)
+                            for p in prompts])
+    st = spec.kv_stats()
+    spec.allocator.assert_clean()
+    assert streams == golden, (streams, golden)
+    assert any(len(set(s)) > 1 for s in golden)
+    assert st["spec_verify_steps"] > 0
+
+
+def test_paged_spec_int8_is_deterministic_against_itself():
+    """int8 residency under speculation: quantization groups differ
+    from the one-token run by design (scale-once over a verify
+    window's rows, rejected included), so the contract is
+    DETERMINISM — two identical spec runs produce identical streams
+    — not cross-mode byte-identity (the documented PR 13 carve-out)."""
+    runs = []
+    for _ in range(2):
+        ex = _paged(mode="speculative", spec_k=3, pool_dtype="int8")
+        runs.append(_drive(ex, [_req(p, max_tokens=5)
+                                for p in PROMPTS[:2]]))
+        ex.allocator.assert_clean()
+    assert runs[0] == runs[1]
+
+
+def test_truncated_draft_shares_target_token_space():
+    from dpu_operator_tpu.serving.spec import TruncatedDraft
+
+    ex = _paged(mode="speculative", spec_k=3)
+    draft = ex.spec.draft
+    assert isinstance(draft, TruncatedDraft)
+    out = draft.propose(np.zeros(2, np.int32), np.zeros(2, np.int32))
+    assert out.shape == (2, 3)
+    assert (0 <= out).all() and (out < MODEL["vocab"]).all()
+
+
+# -- /metrics exposition -----------------------------------------------------
+
+
+def test_metrics_exposition_of_spec_series():
+    """Satellite: the speculative series appear in a real /metrics
+    scrape — proposed/accepted counters with real values plus the
+    scrape-time acceptance and tokens-per-step gauges."""
+    import json
+    import urllib.request
+
+    from dpu_operator_tpu.serving import ServingServer
+
+    ex = SyntheticKVExecutor(slots=2, num_blocks=64, pipelined=False,
+                             spec=_oracle_spec(accept_rate=1.0))
+    srv = ServingServer([ex]).start()
+    try:
+        body = json.dumps({"prompt_tokens": list(range(1, 10)),
+                           "max_tokens": 6,
+                           "deadline_ms": 10000}).encode()
+        for _ in range(2):
+            urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/v1/generate",
+                                       data=body), timeout=10).read()
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=5).read().decode()
+    finally:
+        srv.stop()
+    for series in ("serving_spec_proposed_tokens_total",
+                   "serving_spec_accepted_tokens_total",
+                   "serving_spec_accept_rate",
+                   "serving_spec_tokens_per_step"):
+        assert series in text, series
+    acc = [l for l in text.splitlines()
+           if l.startswith("serving_spec_accepted_tokens_total")]
+    rate = [l for l in text.splitlines()
+            if l.startswith("serving_spec_accept_rate")]
+    assert float(acc[0].split()[-1]) > 0        # oracle at rate 1.0
+    assert float(rate[0].split()[-1]) == 1.0
+    ex.allocator.assert_clean()
+    ex.close()
