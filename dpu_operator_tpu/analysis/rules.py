@@ -1616,6 +1616,87 @@ class PlanTimeCollectStateWrite(Rule):
                         f"phantom-step class PR 7 fixed by hand")
 
 
+# --------------------------------------------------------------------------
+# GL018 — inline per-rank KV geometry outside the KVSpec shard axis
+
+
+class InlineShardKVGeometry(Rule):
+    """Origin: ISSUE 16's context-parallel paged KV. Every per-rank
+    pool shape, block range and wire size derives from ONE declaration
+    — ``KVSpec.shard_axis``/``world`` and its ``rank_heads`` /
+    ``rank_blocks`` / ``rank_view`` / ``rank_wire_block_nbytes``
+    family (disagg/spec.py, the GL-discipline sibling of the layout
+    fingerprint). The failure class this guards: a transfer or worker
+    module re-derives a rank's slice inline (``num_blocks // world``,
+    ``rank * heads // world``), the formula drifts from the spec's
+    (uneven tail blocks, a changed axis), and two sides of one socket
+    now disagree about which pages rank 1 owns — pages land in the
+    wrong rank's pool with every byte checksum-clean.
+
+    Fires on: a binary ``//``, ``%`` or ``*`` expression in a
+    serving/sharded/ or serving/disagg/ module (EXCEPT disagg/spec.py,
+    the derivation home) whose operand names mix KV-pool geometry
+    (``heads``, ``d_head``, ``num_blocks``, ``n_blocks``,
+    ``block_size``, ``max_blocks_per_req``, ``elems_per_block``,
+    ``pool_heads``, ``pool_blocks``) with shard topology (``world``,
+    ``rank``, ``n_shards``). Only the outermost qualifying expression
+    fires (``rank * num_blocks // world`` is one finding, not two).
+
+    Near-misses that stay silent: geometry-only arithmetic (``tokens
+    // block_size``), shard arithmetic over non-KV state (the fabric
+    plane's row split ``d // world`` — different subsystem, its own
+    discipline), calls into the spec's rank_* family, and the same
+    formulas inside disagg/spec.py itself."""
+
+    rule_id = "GL018"
+    severity = SEVERITY_WARNING
+    title = "per-rank KV geometry computed inline instead of from KVSpec"
+    hint = ("derive every per-rank KV shape from the KVSpec shard "
+            "axis (rank_heads/rank_blocks/rank_view/"
+            "rank_wire_block_nbytes in serving/disagg/spec.py) — an "
+            "inline re-derivation drifts from the spec's partition "
+            "and ships pages into the wrong rank's pool")
+
+    _GEOM = {"heads", "d_head", "num_blocks", "n_blocks",
+             "block_size", "max_blocks_per_req", "elems_per_block",
+             "pool_heads", "pool_blocks"}
+    _SHARD = {"world", "rank", "n_shards"}
+    _OPS = (ast.FloorDiv, ast.Mod, ast.Mult)
+
+    def _names(self, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            name = _terminal_name(n)
+            if name:
+                out.add(name)
+        return out
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not (module.in_dir("sharded") or module.in_dir("disagg")):
+            return
+        if module.relpath.endswith("disagg/spec.py"):
+            return
+        # Outermost-match walk: a fired expression's sub-expressions
+        # are the same finding, not new ones.
+        stack = list(ast.iter_child_nodes(module.tree))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.BinOp) and isinstance(n.op, self._OPS):
+                names = self._names(n)
+                if names & self._GEOM and names & self._SHARD:
+                    yield self.finding(
+                        module, n,
+                        f"'{ast.unparse(n)}' in "
+                        f"'{module.qualname_at(n)}' mixes KV-pool "
+                        f"geometry with shard topology inline — "
+                        f"per-rank geometry derives from the KVSpec "
+                        f"shard axis (rank_heads/rank_blocks/"
+                        f"rank_view), or the two sides of a transfer "
+                        f"disagree about page ownership")
+                    continue
+            stack.extend(ast.iter_child_nodes(n))
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1628,4 +1709,4 @@ def default_rules() -> List[Rule]:
             CopyInTransportLoop(), InconsistentLockDiscipline(),
             LockOrderInversion(), WallClockDurationMath(),
             Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
-            PlanTimeCollectStateWrite()]
+            PlanTimeCollectStateWrite(), InlineShardKVGeometry()]

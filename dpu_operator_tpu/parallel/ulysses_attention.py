@@ -152,3 +152,25 @@ def dense_attention_reference(q, k, v, causal: bool = False):
         jnp.transpose(q, (1, 0, 2)), jnp.transpose(k, (1, 0, 2)),
         jnp.transpose(v, (1, 0, 2)), causal)
     return jnp.transpose(out, (1, 0, 2)).astype(q.dtype)
+
+
+# -- serving fusion (ISSUE 16) ------------------------------------------------
+
+
+def concat_head_partials(parts):
+    """Merge per-shard head-sharded attention outputs back into the
+    full-head layout: each part is one shard's ``o_r [..., Hr, dh]``
+    for its CONTIGUOUS head slice (KVSpec.rank_heads order), the
+    result is ``[..., H, dh]`` — the return all-to-all of
+    `_ulysses_body` collapsed to a host-side concat, which is what it
+    degenerates to when q/k/v projection is replicated and each
+    shard's heads never leave it. The serving plane's head-sharded
+    paged-KV replicas (serving/kvcache/sharded.py) merge their
+    decode/verify-window partials here; per-head attention is
+    independent, so the concat IS the exact full attention output."""
+    import numpy as np
+
+    if not parts:
+        raise ValueError("concat_head_partials needs >= 1 partial")
+    return np.concatenate([np.asarray(p, np.float32) for p in parts],
+                          axis=-2)

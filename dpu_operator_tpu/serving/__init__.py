@@ -40,7 +40,8 @@ from .disagg import DisaggPool, KVSpec, KVSpecMismatch
 from .executor import (Executor, LocalExecutor, ReplicaPool,
                        SyntheticExecutor)
 from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
-                      PagedKVExecutor, PrefixTree, SyntheticKVExecutor)
+                      PagedKVExecutor, PrefixTree,
+                      ShardedPagedKVExecutor, SyntheticKVExecutor)
 from .queue import AdmissionQueue
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
@@ -71,6 +72,7 @@ __all__ = [
     "ServingError",
     "ServingServer",
     "ShardProcessSet",
+    "ShardedPagedKVExecutor",
     "SpecConfig",
     "SyntheticExecutor",
     "SyntheticKVExecutor",

@@ -1474,6 +1474,222 @@ def paged_attn_bench(trace, iters: int = 40, repeats: int = 3) -> dict:
     return out
 
 
+def sharded_kv_scaling(trace, slots: int = 2, n_req: int = 6,
+                       toks: int = 8, repeats: int = 2) -> dict:
+    """Section 14 (ISSUE 16): context-parallel paged KV — what
+    sharding the K/V pools across shard workers buys, in three
+    measurements.
+
+    1. Resident context per replica vs world (1, 2, 4): PURE KVSpec
+       arithmetic from the blessed derivation site
+       (``rank_resident_nbytes`` on the realistic ISSUE-13 layout —
+       16-token blocks, 8 heads x 128 d_head, int8 codes + scales).
+       Fix the per-worker HBM budget at what a single worker pins for
+       a 4096-block pool, then size the largest replica pool whose
+       WORST rank still fits that budget.
+       serving_ctx_per_replica_scaling is the world-2/world-1 token
+       ratio, taken as the MIN over both shard axes (page keeps full
+       heads per block; head pays the unsharded per-block scale), and
+       is gated ABSOLUTE >= 1.7 in bench.py — the acceptance
+       criterion itself. Arithmetic, not a timing: a layout
+       regression is never box weather. The _w4 twin is the
+       linearity artifact.
+
+    2. Measured decode: tokens/s and per-token p99 through the REAL
+       ContinuousBatcher over ShardedPagedKVExecutor (page axis — the
+       ring/long-context path) at world 1/2/4 thread shards, paired
+       interleaved with the single-worker PagedKVExecutor twin on the
+       same dims, best-of-N. serving_shard_kv_tokens_per_s (world 2)
+       holds 0.85x its rolling median and serving_shard_kv_p99_ms
+       (world 2) gets the 1.35x latency band against its own rolling
+       median — the bounded-p99 half of the ISSUE 16 acceptance as
+       this harness can state it. NOTE both twins run the same tiny
+       CPU payload, where real attention compute is microseconds: the
+       sharded figure IS the coordinator hand-off + partial merge
+       cost, so the absolute vs-single comparison is structurally
+       >1x here and rides the artifact informationally
+       (serving_shard_kv_p99_vs_single) for real-chip rounds, where
+       attention dominates and the ratio is the meaningful one; the
+       gated rolling medians are what catch creep either way.
+
+    3. Per-rank transfer decomposition: a sharded lease ships as
+       ``world`` point-to-point sub-streams, each framed by its
+       ``rank_view``. Loopback microbench with both rank streams
+       CONCURRENT (the bandwidth-multiplication claim is parallelism
+       of independent links): aggregate Gb/s across both links plus
+       the per-rank figures."""
+    import numpy as np
+
+    from .api import GenerateRequest
+    from .disagg import KVPageStream, KVPageStreamServer
+    from .disagg.spec import KVSpec
+    from .kvcache import PagedKVExecutor
+    from .kvcache.sharded import ShardedPagedKVExecutor
+    from .queue import AdmissionQueue
+    from .scheduler import ContinuousBatcher
+
+    out: dict = {}
+
+    # -- 1: resident context per replica (KVSpec arithmetic) -------------
+    layout = dict(model="paged", block_size=16, heads=8, d_head=128,
+                  vocab=64, max_blocks_per_req=64, pool_dtype="int8")
+    base_blocks = 4096
+    budget = KVSpec(**layout).rank_resident_nbytes(0, base_blocks)
+
+    def ctx_tokens(axis, world):
+        if world == 1:
+            return base_blocks * layout["block_size"]
+        spec = KVSpec(**layout, shard_axis=axis, world=world)
+
+        def fits(m):
+            return all(spec.rank_resident_nbytes(r, m) <= budget
+                       for r in range(world))
+
+        lo, hi = world, 2 * world * base_blocks
+        while lo < hi:  # largest pool whose worst rank fits the budget
+            mid = (lo + hi + 1) // 2
+            lo, hi = (mid, hi) if fits(mid) else (lo, mid - 1)
+        return lo * spec.block_size
+
+    w1_tokens = ctx_tokens("none", 1)
+    scal = {(axis, w): ctx_tokens(axis, w) / w1_tokens
+            for axis in ("page", "head") for w in (2, 4)}
+    out["serving_ctx_per_replica_scaling"] = round(
+        min(scal[("page", 2)], scal[("head", 2)]), 3)
+    out["serving_ctx_per_replica_scaling_w4"] = round(
+        min(scal[("page", 4)], scal[("head", 4)]), 3)
+    trace(f"sharded-kv context scaling: {w1_tokens} tokens/replica at "
+          f"world 1 -> x{out['serving_ctx_per_replica_scaling']} at "
+          f"world 2 (page {scal[('page', 2)]:.3f} / head "
+          f"{scal[('head', 2)]:.3f}), "
+          f"x{out['serving_ctx_per_replica_scaling_w4']} at world 4")
+
+    # -- 2: measured decode vs world (real batcher, real paged JAX) ------
+    dims = dict(slots=slots, vocab=32, d=16, heads=2, block_size=4,
+                num_blocks=64, max_blocks_per_req=8, prefill_chunk=8,
+                seed=0)
+    prompt_len = 12
+
+    def one_run(world):
+        # world 0 = the single-worker PagedKVExecutor twin; otherwise
+        # the page-axis thread-shard set (per-rank pool shapes differ
+        # per world, so rep 0 pays each world's jit compile once and
+        # best-of-N discards it).
+        if world == 0:
+            ex = PagedKVExecutor(mode="pipelined", **dims)
+        else:
+            ex = ShardedPagedKVExecutor(world=world, shard_axis="page",
+                                        mode="pipelined", **dims)
+        q = AdmissionQueue(max_depth=n_req + 1)
+        b = ContinuousBatcher(ex, q)
+        reqs = [GenerateRequest(
+            prompt_vec=None, max_tokens=toks,
+            deadline=time.monotonic() + 600.0,
+            prompt_tokens=[(5 * i + j) % dims["vocab"]
+                           for j in range(prompt_len)])
+            for i in range(n_req)]
+        for r in reqs:
+            q.submit(r)
+        t0 = time.perf_counter()
+        b.start()
+        ok = all(r.wait(timeout=600) for r in reqs)
+        wall = time.perf_counter() - t0
+        b.stop()
+        if not ok or any(r.error for r in reqs):
+            raise RuntimeError(next(
+                (r.error for r in reqs if r.error), "request lost"))
+        ex.allocator.assert_clean()
+        if world:
+            assert ex.shards.outstanding() == 0
+        ex.close()
+        per_tok = sorted(
+            (r.finished_at - r.admitted_at) * 1000.0 / len(r.tokens)
+            for r in reqs)
+        return n_req * toks / wall, nearest_rank(per_tok, 0.99)
+
+    best: dict = {}
+    for rep in range(repeats):
+        for world in (0, 1, 2, 4):
+            name = "single" if world == 0 else f"w{world}"
+            rate, p99 = one_run(world)
+            trace(f"sharded-kv decode {name} rep{rep}: {rate:.0f} "
+                  f"tok/s, p99 {p99:.2f} ms/tok")
+            if name not in best or rate > best[name][0]:
+                best[name] = (rate, p99)
+
+    out["serving_shard_kv_tokens_per_s"] = round(best["w2"][0], 1)
+    out["serving_shard_kv_p99_ms"] = round(best["w2"][1], 3)
+    out["serving_shard_kv_single_tokens_per_s"] = round(
+        best["single"][0], 1)
+    out["serving_shard_kv_single_p99_ms"] = round(best["single"][1], 3)
+    out["serving_shard_kv_tokens_per_s_w1"] = round(best["w1"][0], 1)
+    out["serving_shard_kv_tokens_per_s_w4"] = round(best["w4"][0], 1)
+    if best["single"][1] > 0:
+        out["serving_shard_kv_p99_vs_single"] = round(
+            best["w2"][1] / best["single"][1], 2)
+    trace(f"sharded-kv decode: world 2 "
+          f"{out['serving_shard_kv_tokens_per_s']} tok/s at p99 "
+          f"{out['serving_shard_kv_p99_ms']} ms/tok "
+          f"({out.get('serving_shard_kv_p99_vs_single')}x the "
+          f"single-worker twin)")
+
+    # -- 3: per-rank transfer decomposition (concurrent loopback) --------
+    spec = KVSpec(**layout, shard_axis="head", world=2)
+    n_blocks, iters = 64, 3
+    barrier = threading.Barrier(spec.world + 1)
+    rank_res: dict = {}
+
+    def pump(rank):
+        rv = spec.rank_view(rank)
+        srv = KVPageStreamServer(rv, lambda meta, planes: {})
+        try:
+            st = KVPageStream(rv, srv.addr)
+            rng = np.random.RandomState(rank)
+            codes = rng.randint(-127, 127, size=(
+                n_blocks, rv.block_size, rv.heads,
+                rv.d_head)).astype("int8")
+            scales = rng.rand(n_blocks).astype("float32")
+            meta = {"req": f"bench-r{rank}", "n_blocks": n_blocks,
+                    "tokens": n_blocks * rv.block_size}
+            planes = [(codes, scales), (codes, scales)]
+            st.send_pages(meta, planes)  # warm (connect + first frame)
+            barrier.wait()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st.send_pages(meta, planes)
+            rank_res[rank] = (t0, time.perf_counter())
+            st.close()
+        finally:
+            srv.close()
+
+    threads = [threading.Thread(target=pump, args=(r,), daemon=True)
+               for r in range(spec.world)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for t in threads:
+        t.join(timeout=60)
+    rank_bytes = {r: spec.rank_wire_block_nbytes(r, "int8") * n_blocks
+                  for r in range(spec.world)}
+    if len(rank_res) == spec.world:
+        # Aggregate over the union of the timed windows (close/shutdown
+        # costs after a rank's last send don't count against the wire).
+        agg_wall = (max(t1 for _, t1 in rank_res.values())
+                    - min(t0 for t0, _ in rank_res.values()))
+        out["serving_shard_kv_transfer_gbps"] = round(
+            sum(rank_bytes.values()) * iters * 8 / 1e9 / agg_wall, 3)
+        for r in range(spec.world):
+            t0, t1 = rank_res[r]
+            out[f"serving_shard_kv_transfer_rank{r}_gbps"] = round(
+                rank_bytes[r] * iters * 8 / 1e9 / (t1 - t0), 3)
+        trace(f"sharded-kv transfer: "
+              f"{out['serving_shard_kv_transfer_gbps']} Gb/s aggregate "
+              f"over {spec.world} concurrent rank streams (rank0 "
+              f"{out['serving_shard_kv_transfer_rank0_gbps']}, rank1 "
+              f"{out['serving_shard_kv_transfer_rank1_gbps']})")
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -1691,6 +1907,16 @@ def main(argv: Optional[list] = None) -> int:
         except Exception as e:
             out["serving_paged_attn_error"] = str(e)[:200]
             trace(f"paged-attn section failed: {e}")
+
+        # 14: context-parallel paged KV (ISSUE 16) — resident context
+        # per replica vs world (KVSpec arithmetic, ABSOLUTE >= 1.7x
+        # gate at world 2), measured sharded decode tokens/s + p99 vs
+        # the single-worker twin, per-rank transfer decomposition.
+        try:
+            out.update(sharded_kv_scaling(trace))
+        except Exception as e:
+            out["serving_shard_kv_error"] = str(e)[:200]
+            trace(f"sharded-kv section failed: {e}")
 
     print(json.dumps(out), flush=True)
     return 0

@@ -398,8 +398,21 @@ class DisaggPool:
             self._transfer_failed(req, lease, target, e, t0)
             return
         t1 = time.monotonic()
-        wire_bytes = (self.spec.wire_block_nbytes(self.codec)
-                      * int(meta["n_blocks"]))
+        # Sharded pools ship world per-rank sub-streams; the honest
+        # byte count (and the per-rank decomposition) derives from
+        # each rank's rank_view geometry — head-sharded sub-streams
+        # duplicate the tiny per-block scale vector, which this
+        # accounting keeps visible instead of papering over.
+        rank_counts = meta.get("rank_blocks")
+        if rank_counts is not None:
+            rank_bytes = [
+                self.spec.rank_wire_block_nbytes(r, self.codec)
+                * int(n) for r, n in enumerate(rank_counts)]
+            wire_bytes = sum(rank_bytes)
+        else:
+            rank_bytes = None
+            wire_bytes = (self.spec.wire_block_nbytes(self.codec)
+                          * int(meta["n_blocks"]))
         # The ack IS the hand-off's success acknowledgment: attach the
         # decode-side lease, then release the prefill pages with the
         # prefix-cache insert riding inside (owner refs still held, so
@@ -414,6 +427,13 @@ class DisaggPool:
                 {"codec": self.codec}, by=float(wire_bytes),
                 help="KV page payload bytes shipped prefill->decode, "
                      "by wire codec")
+            if rank_bytes is not None:
+                for r, nbytes in enumerate(rank_bytes):
+                    self.registry.counter_inc(
+                        "serving_shard_kv_transfer_bytes_total",
+                        {"rank": str(r)}, by=float(nbytes),
+                        help="per-rank KV page bytes shipped over the "
+                             "sharded point-to-point sub-streams")
             self.registry.observe(
                 "serving_kv_transfer_seconds", t1 - t0,
                 help="one request's KV transfer wall "

@@ -34,12 +34,28 @@ Wire codecs (parallel/quantize.py's block-axis twins):
 
 from __future__ import annotations
 
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, replace
 from typing import Dict, List, Tuple
 
 from ...parallel.fabric_collectives import CodecMismatch
 
-__all__ = ["KVSpec", "KVSpecMismatch", "CodecMismatch", "WIRE_CODECS"]
+# Per-rank geometry rides the SAME even-contiguous split the fabric
+# ring and the row-plane shard ownership use (shard_math.segment_bounds
+# is this exact symbol, re-exported) — one partition function, so a
+# rank's pool slice, its transfer segmentation and the collective's
+# wire segments can never disagree about where a rank's bytes start.
+from ...parallel.fabric_collectives import (
+    _segment_bounds as segment_bounds)
+
+__all__ = ["KVSpec", "KVSpecMismatch", "CodecMismatch", "WIRE_CODECS",
+           "SHARD_AXES"]
+
+#: KV shard axes: "none" (single worker), "head" (Ulysses — every rank
+#: holds ALL blocks, a contiguous head slice of each; decode's k+1
+#: verify windows attend all-local), "page" (ring — every rank holds
+#: ALL heads of a contiguous block-id range; long prefill chunks fold
+#: cross-rank partials with the flash online-softmax recurrence).
+SHARD_AXES = ("none", "head", "page")
 
 #: Wire codecs the page stream understands (fp32 = raw rows, int8 =
 #: parallel/quantize.py block-axis codes + per-block scales).
@@ -72,6 +88,15 @@ class KVSpec:
     planes: int = 2       # K and V (synthetic ships 1 content plane)
     seed: int = 0         # weight identity: pages from a different
     #                       model are bytes, not KV
+    #: Context-parallel KV (ISSUE 16): how the pools split across the
+    #: shard workers of one replica. "head" gives every rank ALL block
+    #: ids and a contiguous head slice of each block (Ulysses); "page"
+    #: gives every rank ALL heads of a contiguous block-id range
+    #: (ring). Per-rank pool shapes, slice bounds and per-rank wire
+    #: framing all derive from these two fields — never recomputed
+    #: inline at a use site (the GL018 contract).
+    shard_axis: str = "none"
+    world: int = 1
 
     def __post_init__(self):
         if self.pool_dtype not in ("int8", "fp32"):
@@ -81,6 +106,20 @@ class KVSpec:
                 or self.planes < 1:
             raise ValueError("block_size/heads/d_head/planes must be "
                              ">= 1")
+        if self.shard_axis not in SHARD_AXES:
+            raise ValueError(f"shard_axis must be one of {SHARD_AXES},"
+                             f" got {self.shard_axis!r}")
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {self.world}")
+        if self.shard_axis == "none" and self.world != 1:
+            raise ValueError(
+                f"shard_axis='none' is the single-worker layout; "
+                f"world={self.world} needs a shard axis")
+        if self.shard_axis == "head" and self.heads % self.world:
+            raise ValueError(
+                f"head-sharded pools need heads % world == 0 (the "
+                f"Ulysses all-to-all constraint): heads={self.heads}, "
+                f"world={self.world}")
 
     # -- derived geometry (every slice below comes from here) ----------------
 
@@ -140,6 +179,88 @@ class KVSpec:
         per = max(1, max_seg_bytes // self.wire_block_nbytes(codec))
         return [(s, min(per, n_blocks - s))
                 for s in range(0, n_blocks, per)]
+
+    # -- per-rank geometry (context-parallel KV, ISSUE 16) --------------------
+    #
+    # Everything a rank knows about its own slice of the pools comes
+    # from the four methods below plus ``rank_view`` — pool shapes,
+    # page counts, slice bounds, per-rank wire framing. Computing any
+    # of these inline at a use site is the layout-drift class GL018
+    # flags: this dataclass is the single blessed derivation site.
+
+    @property
+    def sharded(self) -> bool:
+        return self.world > 1
+
+    def _check_rank(self, rank: int) -> int:
+        rank = int(rank)
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return rank
+
+    def rank_heads(self, rank: int) -> Tuple[int, int]:
+        """[lo, hi) of the contiguous head slice rank holds — the
+        full head range unless the axis is "head"."""
+        rank = self._check_rank(rank)
+        if self.shard_axis == "head":
+            return segment_bounds(self.heads, self.world)[rank]
+        return (0, self.heads)
+
+    def rank_head_count(self, rank: int) -> int:
+        lo, hi = self.rank_heads(rank)
+        return hi - lo
+
+    def rank_blocks(self, rank: int, num_blocks: int
+                    ) -> Tuple[int, int]:
+        """[lo, hi) of the GLOBAL block-id range rank's pool holds.
+        ``num_blocks`` is the replica's pool capacity (a sizing
+        decision, deliberately outside the spec — see the class
+        docstring); the partition of it is pure spec."""
+        rank = self._check_rank(rank)
+        if self.shard_axis == "page":
+            if num_blocks < self.world:
+                raise ValueError(
+                    f"page-sharded pool needs num_blocks >= world: "
+                    f"{num_blocks} < {self.world}")
+            return segment_bounds(int(num_blocks), self.world)[rank]
+        return (0, int(num_blocks))
+
+    def rank_block_shape(self, rank: int) -> Tuple[int, int, int]:
+        """One resident block's shape in rank's pool:
+        ``(block_size, rank_heads, d_head)``."""
+        return (self.block_size, self.rank_head_count(rank),
+                self.d_head)
+
+    def rank_view(self, rank: int) -> "KVSpec":
+        """Rank's slice of the layout AS a single-worker KVSpec — the
+        per-rank wire format. A sharded transfer is ``world``
+        point-to-point streams, each framed/segmented/parsed by its
+        rank_view exactly like an unsharded stream; deriving the view
+        here (instead of re-declaring it rank-side) is what keeps the
+        per-rank sender and receiver the same function."""
+        rank = self._check_rank(rank)
+        return replace(self, heads=self.rank_head_count(rank),
+                       shard_axis="none", world=1)
+
+    def rank_plane_part_nbytes(self, rank: int, codec: str,
+                               n_blocks: int) -> Tuple[int, int]:
+        """(payload_bytes, scale_bytes) for ONE plane of ``n_blocks``
+        of rank's blocks — ``plane_part_nbytes`` through rank_view."""
+        return self.rank_view(rank).plane_part_nbytes(codec, n_blocks)
+
+    def rank_wire_block_nbytes(self, rank: int, codec: str) -> int:
+        return self.rank_view(rank).wire_block_nbytes(codec)
+
+    def rank_resident_nbytes(self, rank: int, num_blocks: int) -> int:
+        """Resident pool bytes rank pins for a ``num_blocks`` replica
+        pool (all planes, codes + scales for int8) — what the bench's
+        resident-context-per-replica arithmetic divides by."""
+        lo, hi = self.rank_blocks(rank, num_blocks)
+        elem = 1 if self.pool_dtype == "int8" else 4
+        per_block = (self.block_size * self.rank_head_count(rank)
+                     * self.d_head * elem
+                     + (4 if self.pool_dtype == "int8" else 0))
+        return self.planes * (hi - lo) * per_block
 
     # -- the hello contract ---------------------------------------------------
 

@@ -191,34 +191,65 @@ class KVPageStream:
         return the importer's ack. Any failure closes the stream (the
         positional protocol is desynced past repair) and raises
         KVStreamError/KVStreamNack — the caller owns disposition."""
-        if len(planes) != self.spec.planes:
+        if self.spec.sharded:
+            # Context-parallel pools (ISSUE 16): ``planes`` is the
+            # rank-major plane-set list kv_export produced, and the
+            # transfer is ``world`` per-rank sub-streams multiplexed
+            # on this socket — each framed/segmented by that rank's
+            # ``rank_view`` (a single-worker KVSpec), so the per-rank
+            # sender and the receiver's parse stay the same function.
+            if len(planes) != self.spec.world:
+                raise ValueError(
+                    f"sharded spec (world {self.spec.world}) wants "
+                    f"rank-major plane sets, caller passed "
+                    f"{len(planes)}")
+        elif len(planes) != self.spec.planes:
             raise ValueError(
                 f"spec declares {self.spec.planes} plane(s), caller "
                 f"passed {len(planes)}")
         self.connect()
         sock = self._sock
         n_blocks = int(meta["n_blocks"])
-        wire = _wire_planes(self.spec, self.codec, planes)
-        segs = self.spec.segments(n_blocks, self.codec, self.seg_bytes)
         xfer = meta.get("xfer") or uuid.uuid4().hex[:12]
+        if self.spec.sharded:
+            counts = [int(c) for c in meta["rank_blocks"]]
+            rank_segs = [
+                self.spec.rank_view(r).segments(counts[r], self.codec,
+                                                self.seg_bytes)
+                for r in range(self.spec.world)]
+            n_segs = sum(len(s) for s in rank_segs)
+        else:
+            counts, rank_segs = [n_blocks], [self.spec.segments(
+                n_blocks, self.codec, self.seg_bytes)]
+            n_segs = len(rank_segs[0])
         try:
             send_msg(sock, dict(meta, kind="pages", xfer=xfer,
-                                codec=self.codec, segments=len(segs)))
-            for si, (start, count) in enumerate(segs):
-                # The chaos seam: a mid-transfer kill lands BETWEEN
-                # segments, after real bytes moved.
-                faults.fire("kvstream.send",
-                            attrs={"xfer": xfer, "seg": si})
-                parts = []
-                for payload, scales in wire:
-                    parts.append(payload[start:start + count])
-                    if self.codec == "int8":
-                        parts.append(np.ascontiguousarray(
-                            scales[start:start + count], np.float32))
-                send_msg(sock, {"kind": "seg", "xfer": xfer,
-                                "seq": si, "start": start,
-                                "count": count,
-                                "last": si == len(segs) - 1}, *parts)
+                                codec=self.codec, segments=n_segs))
+            si = 0
+            for r, segs in enumerate(rank_segs):
+                rv = (self.spec.rank_view(r) if self.spec.sharded
+                      else self.spec)
+                wire = _wire_planes(rv, self.codec,
+                                    planes[r] if self.spec.sharded
+                                    else planes)
+                for start, count in segs:
+                    # The chaos seam: a mid-transfer kill lands
+                    # BETWEEN segments, after real bytes moved.
+                    faults.fire("kvstream.send",
+                                attrs={"xfer": xfer, "seg": si,
+                                       "rank": r})
+                    parts = []
+                    for payload, scales in wire:
+                        parts.append(payload[start:start + count])
+                        if self.codec == "int8":
+                            parts.append(np.ascontiguousarray(
+                                scales[start:start + count],
+                                np.float32))
+                    send_msg(sock, {"kind": "seg", "xfer": xfer,
+                                    "seq": si, "rank": r,
+                                    "start": start, "count": count,
+                                    "last": si == n_segs - 1}, *parts)
+                    si += 1
             ack, _ = recv_msg(sock, timeout=self.timeout_s)
         except (OSError, ProtocolError) as e:
             self.close()
@@ -365,30 +396,48 @@ class KVPageStreamServer:
             raise ProtocolError(
                 f"pages frame stamped codec {codec!r} on a "
                 f"{self.codec!r}-negotiated stream")
-        acc: List[List[bytes]] = [[] for _ in range(2 * self.spec.planes)]
-        covered = 0
+        # Sharded pools: ``world`` per-rank sub-streams multiplexed on
+        # this socket, each parsed by its rank_view (the same derived
+        # geometry the sender framed with); the flat path is the
+        # world-1 degenerate case of the same loop.
+        if self.spec.sharded:
+            counts = [int(c) for c in meta["rank_blocks"]]
+            views = [self.spec.rank_view(r)
+                     for r in range(self.spec.world)]
+        else:
+            counts, views = [n_blocks], [self.spec]
+        acc: List[List[List[bytes]]] = [
+            [[] for _ in range(2 * self.spec.planes)] for _ in views]
+        covered = [0] * len(views)
         for si in range(n_segs):
             msg, payload = recv_msg(conn, timeout=self.timeout_s)
+            r = int(msg.get("rank", 0))
             if (msg.get("kind") != "seg"
                     or msg.get("xfer") != meta.get("xfer")
                     or int(msg.get("seq", -1)) != si
-                    or int(msg.get("start", -1)) != covered):
+                    or not 0 <= r < len(views)
+                    or int(msg.get("start", -1)) != covered[r]):
                 raise ProtocolError(
                     f"segment stream desync at seq {si}: {msg}")
             count = int(msg["count"])
             for p, (raw, sc) in enumerate(_split_segment(
-                    self.spec, codec, count, payload)):
-                acc[2 * p].append(raw)
-                acc[2 * p + 1].append(sc)
-            covered += count
-        if covered != n_blocks:
+                    views[r], codec, count, payload)):
+                acc[r][2 * p].append(raw)
+                acc[r][2 * p + 1].append(sc)
+            covered[r] += count
+        if covered != counts:
             raise ProtocolError(
                 f"segments cover {covered} block(s), header declared "
-                f"{n_blocks}")
-        planes = _pool_planes(
-            self.spec, codec, n_blocks,
-            [(b"".join(acc[2 * p]), b"".join(acc[2 * p + 1]))
-             for p in range(self.spec.planes)])
+                f"{counts}")
+        rank_planes = [
+            _pool_planes(
+                views[r], codec, counts[r],
+                [(b"".join(acc[r][2 * p]),
+                  b"".join(acc[r][2 * p + 1]))
+                 for p in range(self.spec.planes)])
+            for r in range(len(views))]
+        planes = (rank_planes if self.spec.sharded
+                  else rank_planes[0])
         try:
             faults.fire("kvstream.import",
                         attrs={"xfer": meta.get("xfer")})

@@ -30,6 +30,8 @@ from .allocator import (CACHE_OWNER, KVBlockAllocator, KVCacheOOM,
 from .executor import (NO_TOKEN, KVExecutorBase, PagedKVExecutor,
                        SyntheticKVExecutor)
 from .paged import kv_bytes_per_slot, paged_kv_error_bound
+from .sharded import (KVShardProcessSet, ShardedPagedKVExecutor,
+                      SyntheticKVShardSet, resolve_shard_axis)
 
 __all__ = [
     "CACHE_OWNER",
@@ -37,10 +39,14 @@ __all__ = [
     "KVCacheOOM",
     "KVExecutorBase",
     "KVLease",
+    "KVShardProcessSet",
     "NO_TOKEN",
     "PagedKVExecutor",
     "PrefixTree",
+    "ShardedPagedKVExecutor",
     "SyntheticKVExecutor",
+    "SyntheticKVShardSet",
     "kv_bytes_per_slot",
     "paged_kv_error_bound",
+    "resolve_shard_axis",
 ]

@@ -76,11 +76,49 @@ PR 3). ``donate=`` overrides.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
 from ...parallel.quantize import int8_block_decode_xp
+
+#: One weight set per (seed, vocab, d, max_context, hidden) identity,
+#: shared by every step object built from it — the single-worker step,
+#: every rank's partial step and the coordinator finish step MUST
+#: close over literally the same arrays, or the byte-identical
+#: sharded-vs-single stream contract (ISSUE 16) rests on rng-order
+#: luck instead of object identity.
+_PARAM_CACHE: dict = {}
+
+
+def build_paged_params(seed: int, vocab: int, d: int,
+                       max_context: int,
+                       hidden: Optional[int] = None) -> dict:
+    """The paged model's weights, in the ONE blessed rng draw order
+    (embed, wpos, wq, wk, wv, wo, w1, w2, wout — the PR 13 order;
+    every consumer that re-derived this order independently would be
+    a silent stream-divergence bug). Returns device (jnp) arrays,
+    cached per identity."""
+    import jax.numpy as jnp
+
+    hidden = int(hidden or 2 * d)
+    key = (int(seed), int(vocab), int(d), int(max_context), hidden)
+    got = _PARAM_CACHE.get(key)
+    if got is not None:
+        return got
+    rng = np.random.RandomState(seed)
+
+    def w(*shape):
+        return jnp.asarray(
+            rng.randn(*shape).astype(np.float32)
+            / np.sqrt(shape[0]))
+
+    params = dict(
+        embed=w(vocab, d), wpos=w(max_context, d),
+        wq=w(d, d), wk=w(d, d), wv=w(d, d), wo=w(d, d),
+        w1=w(d, hidden), w2=w(hidden, d), wout=w(d, vocab))
+    _PARAM_CACHE[key] = params
+    return params
 
 
 def kv_bytes_per_slot(max_blocks_per_req: int, block_size: int,
@@ -166,28 +204,22 @@ class PagedDecodeStep:
         self.chunk = int(chunk)
         hidden = int(hidden or 2 * d)
 
-        rng = np.random.RandomState(seed)
-
-        def w(*shape):
-            return jnp.asarray(
-                rng.randn(*shape).astype(np.float32)
-                / np.sqrt(shape[0]))
-
-        embed = w(vocab, d)
-        # Absolute positional embedding: decode output must depend on
-        # WHERE in the sequence a token sits, or the argmax recurrence
-        # collapses to a fixed point and every resume/prefix test is
-        # vacuously green. Positions are absolute, so cached prefix KV
-        # (computed at the same positions) stays bit-identical on
-        # reuse.
-        wpos = w(max_blocks_per_req * block_size, d)
-        wq, wk, wv, wo = w(d, d), w(d, d), w(d, d), w(d, d)
-        w1, w2 = w(d, hidden), w(hidden, d)
-        # UNTIED output head: with logits = y @ embed.T the residual
-        # stream's own embedding dominates and argmax collapses to a
-        # fixed point (token t forever) — which would make every
-        # stream-equality test in the suite vacuously green.
-        wout = w(d, vocab)
+        # Shared weight identity (build_paged_params): absolute
+        # positional embedding (or the argmax recurrence collapses to
+        # a fixed point and every resume/prefix test is vacuously
+        # green; absolute positions also keep cached prefix KV
+        # bit-identical on reuse) and an UNTIED output head (logits =
+        # y @ embed.T would let the residual stream's own embedding
+        # dominate into the same fixed-point collapse). Rank partial
+        # steps and the coordinator finish step (ISSUE 16) close over
+        # the SAME cached arrays.
+        params = build_paged_params(seed, vocab, d,
+                                    max_blocks_per_req * block_size,
+                                    hidden)
+        embed, wpos = params["embed"], params["wpos"]
+        wq, wk, wv, wo = (params["wq"], params["wk"], params["wv"],
+                          params["wo"])
+        w1, w2, wout = params["w1"], params["w2"], params["wout"]
         # The truncated-stage draft (spec.TruncatedDraft) reuses
         # exactly these three — draft and target share one token
         # space by construction.
@@ -414,3 +446,336 @@ class PagedDecodeStep:
         linearly."""
         return self._step(kpool, kscale, vpool, vscale, prev_tok,
                           host_tok, use_host, ctx, n_new, tables)
+
+
+class PagedRankStep:
+    """ONE shard worker's half of the fused paged step (ISSUE 16):
+    append into this rank's pool slice, attend over this rank's
+    residency, return un-finished attention partials. The projection
+    compute (embed → q/k/v) is REPLICATED — O(chunk * d) per step, the
+    cheap part — while the pools, the append scatter and the attention
+    gather (the O(context) parts) are sharded, which is exactly what
+    makes resident context per replica scale with world size.
+
+    Two axes, the slice bounds handed IN from the replica's KVSpec
+    (``KVSpec.rank_heads`` / ``KVSpec.rank_blocks`` — never derived
+    here, the GL018 contract):
+
+    ``shard_axis="head"`` (Ulysses)
+        pool ``[num_blocks, bs, rank_heads, dh]``: all block ids, a
+        contiguous head slice of each. Attention for the rank's heads
+        is COMPLETE locally (per-head attention is independent), so
+        the partial is the exact per-head output ``o_r`` — the
+        degenerate all-to-all of ulysses_attention._ulysses_body with
+        the q/k/v re-shard replaced by replicated projection: heads
+        stay where they live, nothing crosses the fabric but the
+        ``[S, C, Hr*dh]`` outputs. Decode/verify windows (C = k+1)
+        ride this: the per-step wire cost is independent of context.
+        On a TPU backend the rank step runs the SAME fused Pallas
+        paged-attention kernel as the single-worker step, built at
+        the rank's head count.
+
+    ``shard_axis="page"`` (ring)
+        pool ``[rank_blocks, bs, heads, dh]``: all heads of a
+        contiguous global block-id range. The rank attends its OWN
+        pages only and returns un-normalized flash partials
+        ``(m, l, o)`` per (slot, head, chunk-row); the coordinator
+        folds rank partials in rank order with ring_attention's
+        online-softmax recurrence (``merge_partial_softmax``) — the
+        ring fold with the per-hop RDMA replaced by the collect
+        gather. Long prefill chunks ride this: every rank scans only
+        its share of the pages.
+
+    int8 residency: every rank computes the FULL k/v projection, so
+    the per-block scale (margin * amax over ALL heads of the block's
+    first-write group) is bit-identical on every rank and to the
+    single-worker pool — a head slice quantized under that scale IS
+    the corresponding slice of the single-worker codes. Scale
+    set-once/idempotence carries over unchanged."""
+
+    def __init__(self, slots: int, vocab: int, d: int, heads: int,
+                 block_size: int, num_blocks: int,
+                 max_blocks_per_req: int, chunk: int, *,
+                 shard_axis: str, head_bounds: Tuple[int, int],
+                 block_bounds: Tuple[int, int],
+                 hidden: Optional[int] = None, seed: int = 0,
+                 donate: Optional[bool] = None,
+                 kernel: Optional[str] = None,
+                 pool_dtype: str = "int8",
+                 scale_margin: float = 1.5,
+                 interpret: Optional[bool] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if shard_axis not in ("head", "page"):
+            raise ValueError(f"shard_axis must be head|page, got "
+                             f"{shard_axis!r}")
+        if pool_dtype not in ("int8", "fp32"):
+            raise ValueError(f"pool_dtype must be int8|fp32, got "
+                             f"{pool_dtype!r}")
+        if kernel is None:
+            from ...parallel.pallas_paged_attn import _is_tpu_backend
+            kernel = ("pallas" if _is_tpu_backend()
+                      and shard_axis == "head" else "xla")
+        if kernel == "pallas" and shard_axis == "page":
+            raise ValueError(
+                "the fused pallas kernel normalizes its softmax; "
+                "page-sharded ranks return flash partials (kernel="
+                "'xla')")
+        self.kernel = kernel
+        self.shard_axis = shard_axis
+        self.pool_dtype = pool_dtype
+        self.slots, self.chunk = int(slots), int(chunk)
+        self.heads, self.d_head = int(heads), d // heads
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_req = int(max_blocks_per_req)
+        h_lo, h_hi = (int(head_bounds[0]), int(head_bounds[1]))
+        b_lo, b_hi = (int(block_bounds[0]), int(block_bounds[1]))
+        self.head_bounds = (h_lo, h_hi)
+        self.block_bounds = (b_lo, b_hi)
+        #: Local pool geometry — all of it from the bounds the KVSpec
+        #: derived, none recomputed here.
+        self.pool_heads = h_hi - h_lo if shard_axis == "head" \
+            else self.heads
+        self.pool_blocks = b_hi - b_lo if shard_axis == "page" \
+            else self.num_blocks
+
+        params = build_paged_params(
+            seed, vocab, d, max_blocks_per_req * block_size, hidden)
+        embed, wpos = params["embed"], params["wpos"]
+        wq, wk, wv = params["wq"], params["wk"], params["wv"]
+
+        S, C = self.slots, self.chunk
+        B, bs = self.max_blocks_per_req, self.block_size
+        H, dh = self.heads, self.d_head
+        Hr, Nr = self.pool_heads, self.pool_blocks
+        T = B * bs
+        int8 = pool_dtype == "int8"
+        margin = float(scale_margin)
+        head = shard_axis == "head"
+
+        fused = None
+        if kernel == "pallas":
+            from ...parallel.pallas_paged_attn import \
+                make_paged_attn_step
+
+            fused = make_paged_attn_step(
+                slots=S, chunk=C, max_blocks=B, block_size=bs,
+                heads=Hr, d_head=dh, num_blocks=Nr,
+                pool_dtype=pool_dtype, interpret=interpret)
+
+        def update_scales(scales, vals, tgt, ctx, pos, valid):
+            """The single-worker set-once scale rule against the
+            LOCAL drop bound Nr: ``tgt`` already maps un-owned and
+            invalid rows out of range. ``vals`` is the FULL-head k/v,
+            so the stored scale equals the single-worker pool's."""
+            bstart = (pos // bs) * bs
+            reset = valid & (bstart >= ctx[:, None])
+            amax = jnp.max(jnp.abs(vals), axis=(2, 3))     # [S, C]
+            t = jnp.where(reset, tgt, Nr)
+            scales = scales.at[t].set(0.0, mode="drop")
+            scales = scales.at[t].max(
+                amax * np.float32(margin / 127.0), mode="drop")
+            return jnp.where(scales > 0, scales,
+                             jnp.float32(1.0)).astype(jnp.float32)
+
+        def quantize_rows(vals, row_scales):
+            q = jnp.round(vals / row_scales[:, :, None, None])
+            return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+        def step(kpool, kscale, vpool, vscale, prev_tok, host_tok,
+                 use_host, ctx, n_new, tables):
+            tok0 = jnp.where(use_host, host_tok[:, 0], prev_tok)
+            toks = jnp.concatenate([tok0[:, None], host_tok[:, 1:]],
+                                   axis=1)
+            pos_ids = jnp.clip(
+                ctx[:, None] + jnp.arange(C)[None, :], 0, T - 1)
+            x = embed[toks] + wpos[pos_ids]              # [S, C, d]
+            # FULL-head projections, replicated on every rank: the
+            # scale rule needs the whole row's amax, and decode's one
+            # token makes this O(d) — never the O(context) part.
+            q = (x @ wq).reshape(S, C, H, dh)
+            k = (x @ wk).reshape(S, C, H, dh)
+            v = (x @ wv).reshape(S, C, H, dh)
+            pos = ctx[:, None] + jnp.arange(C)[None, :]   # [S, C]
+            valid = jnp.arange(C)[None, :] < n_new[:, None]
+            blk_all = jnp.take_along_axis(
+                tables, jnp.clip(pos // bs, 0, B - 1), axis=1)
+            if head:
+                # All block ids local; local id == global id.
+                lblk_all = blk_all
+                ltab = tables
+                owned_tab = jnp.ones((S, B), jnp.bool_)
+            else:
+                owned = (blk_all >= b_lo) & (blk_all < b_hi)
+                lblk_all = jnp.where(owned, blk_all - b_lo, Nr)
+                owned_tab = (tables >= b_lo) & (tables < b_hi)
+                ltab = jnp.where(owned_tab, tables - b_lo, 0)
+            lblk = jnp.where(valid, lblk_all, Nr)
+            off = pos % bs
+            kw = k[:, :, h_lo:h_hi] if head else k
+            vw = v[:, :, h_lo:h_hi] if head else v
+            qw = q[:, :, h_lo:h_hi] if head else q
+            if int8:
+                kscale = update_scales(kscale, k, lblk, ctx, pos,
+                                       valid)
+                vscale = update_scales(vscale, v, lblk, ctx, pos,
+                                       valid)
+                ksc_rows = kscale[jnp.clip(lblk_all, 0, Nr - 1)]
+                vsc_rows = vscale[jnp.clip(lblk_all, 0, Nr - 1)]
+            else:
+                ksc_rows = vsc_rows = jnp.ones((S, C), jnp.float32)
+            limit = ctx + n_new
+            if kernel == "pallas":
+                o, kpool, vpool = fused(
+                    ltab, ctx, n_new, qw, kw, vw, ksc_rows, vsc_rows,
+                    kscale[ltab] if int8
+                    else jnp.ones((S, B), jnp.float32),
+                    vscale[ltab] if int8
+                    else jnp.ones((S, B), jnp.float32),
+                    kpool, vpool)
+                return (kpool, kscale, vpool, vscale,
+                        o.reshape(S, C, Hr, dh))
+            if int8:
+                kpool = kpool.at[lblk, off].set(
+                    quantize_rows(kw, ksc_rows), mode="drop")
+                vpool = vpool.at[lblk, off].set(
+                    quantize_rows(vw, vsc_rows), mode="drop")
+                keys = int8_block_decode_xp(
+                    kpool[ltab], kscale[ltab],
+                    xp=jnp).reshape(S, T, Hr, dh)
+                vals = int8_block_decode_xp(
+                    vpool[ltab], vscale[ltab],
+                    xp=jnp).reshape(S, T, Hr, dh)
+            else:
+                kpool = kpool.at[lblk, off].set(kw, mode="drop")
+                vpool = vpool.at[lblk, off].set(vw, mode="drop")
+                keys = kpool[ltab].reshape(S, T, Hr, dh)
+                vals = vpool[ltab].reshape(S, T, Hr, dh)
+            # The single-worker valid-block guard, with block
+            # OWNERSHIP folded in: positions outside this rank's page
+            # range must contribute nothing on either the score or
+            # the value path.
+            tpos = jnp.arange(T)
+            owned_pos = jnp.repeat(owned_tab, bs, axis=1)  # [S, T]
+            t_ok = ((tpos[None, :] < limit[:, None]) & owned_pos
+                    )[:, :, None, None]
+            keys = jnp.where(t_ok, keys, 0.0)
+            vals = jnp.where(t_ok, vals, 0.0)
+            scores = jnp.einsum("schd,sthd->shct", qw,
+                                keys) / np.sqrt(dh)
+            causal = ((tpos[None, None, :] <= pos[:, :, None])
+                      & (tpos[None, None, :] < limit[:, None, None])
+                      & valid[:, :, None]
+                      & owned_pos[:, None, :])             # [S, C, T]
+            scores = jnp.where(causal[:, None, :, :], scores,
+                               jnp.float32(-1e30))
+            if head:
+                # Per-head attention is complete locally: normalize
+                # here, exactly the single-worker softmax.
+                attn = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum("shct,sthd->schd", attn, vals)
+                return kpool, kscale, vpool, vscale, o
+            # Page axis: flash partials over the rank's pages only —
+            # the masked-out guard keeps a rank that owns NOTHING for
+            # a row at (m=-1e30, l=0, o=0), which the coordinator
+            # fold treats as the identity.
+            m = jnp.max(scores, axis=-1)                   # [S, H, C]
+            p = jnp.where(scores > jnp.float32(-1e29),
+                          jnp.exp(scores - m[..., None]), 0.0)
+            l = jnp.sum(p, axis=-1)                        # [S, H, C]
+            o = jnp.einsum("shct,sthd->shcd", p, vals)
+            return kpool, kscale, vpool, vscale, m, l, o
+
+        if donate is None:
+            donate = jax.devices()[0].platform != "cpu"
+        dn = (0, 1, 2, 3) if donate else ()
+        pdt = jnp.int8 if int8 else jnp.float32
+        kp = jnp.zeros((Nr, bs, Hr, dh), pdt)
+        vp = jnp.zeros((Nr, bs, Hr, dh), pdt)
+        ksc = jnp.ones((Nr,), jnp.float32)
+        vsc = jnp.ones((Nr,), jnp.float32)
+        pt = jnp.zeros((S,), jnp.int32)
+        ht = jnp.zeros((S, C), jnp.int32)
+        uh = jnp.zeros((S,), jnp.bool_)
+        i32 = jnp.zeros((S,), jnp.int32)
+        tb = jnp.zeros((S, B), jnp.int32)
+        self._step = jax.jit(step, donate_argnums=dn).lower(
+            kp, ksc, vp, vsc, pt, ht, uh, i32, i32, tb).compile()
+
+    def init_pools(self):
+        """Fresh zeroed per-rank (kpool, kscale, vpool, vscale)."""
+        import jax.numpy as jnp
+
+        shape = (self.pool_blocks, self.block_size, self.pool_heads,
+                 self.d_head)
+        pdt = jnp.int8 if self.pool_dtype == "int8" else jnp.float32
+        return (jnp.zeros(shape, pdt),
+                jnp.ones((self.pool_blocks,), jnp.float32),
+                jnp.zeros(shape, pdt),
+                jnp.ones((self.pool_blocks,), jnp.float32))
+
+    def __call__(self, kpool, kscale, vpool, vscale, prev_tok,
+                 host_tok, use_host, ctx, n_new, tables):
+        """head axis: ``(pools..., o_r [S, C, Hr, dh])``; page axis:
+        ``(pools..., m [S, H, C], l [S, H, C], o [S, H, C, dh])``."""
+        return self._step(kpool, kscale, vpool, vscale, prev_tok,
+                          host_tok, use_host, ctx, n_new, tables)
+
+
+class PagedFinishStep:
+    """The coordinator's tail of the sharded paged step: residual +
+    MLP + untied-head logits + argmax over the MERGED attention
+    output — operation-for-operation the tail of PagedDecodeStep's
+    fused step (same cached weights, same clip/take_along_axis
+    shapes), so a bit-identical merged ``o`` yields a bit-identical
+    token stream. ``per_pos`` widens the logits projection exactly as
+    the single-worker step does for speculative verify windows."""
+
+    def __init__(self, slots: int, vocab: int, d: int,
+                 block_size: int, max_blocks_per_req: int, chunk: int,
+                 hidden: Optional[int] = None, seed: int = 0,
+                 per_pos: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self.slots, self.chunk = int(slots), int(chunk)
+        self.per_pos = bool(per_pos)
+        T = int(max_blocks_per_req) * int(block_size)
+        params = build_paged_params(seed, vocab, d, T, hidden)
+        embed, wpos, wo = params["embed"], params["wpos"], params["wo"]
+        w1, w2, wout = params["w1"], params["w2"], params["wout"]
+        self.draft_params = (embed, wpos, wout)
+        S, C = self.slots, self.chunk
+        per_pos = self.per_pos
+
+        def finish(prev_tok, host_tok, use_host, ctx, n_new, o):
+            tok0 = jnp.where(use_host, host_tok[:, 0], prev_tok)
+            toks = jnp.concatenate([tok0[:, None], host_tok[:, 1:]],
+                                   axis=1)
+            pos_ids = jnp.clip(
+                ctx[:, None] + jnp.arange(C)[None, :], 0, T - 1)
+            x = embed[toks] + wpos[pos_ids]              # [S, C, d]
+            y = x + o @ wo
+            y = y + jax.nn.relu(y @ w1) @ w2
+            if per_pos:
+                logits = y @ wout                        # [S, C, V]
+                return jnp.argmax(logits, axis=2).astype(jnp.int32)
+            last = jnp.clip(n_new - 1, 0, C - 1)
+            yl = jnp.take_along_axis(
+                y, last[:, None, None], axis=1)[:, 0]    # [S, d]
+            logits = yl @ wout
+            return jnp.argmax(logits, axis=1).astype(jnp.int32)
+
+        pt = jnp.zeros((S,), jnp.int32)
+        ht = jnp.zeros((S, C), jnp.int32)
+        uh = jnp.zeros((S,), jnp.bool_)
+        i32 = jnp.zeros((S,), jnp.int32)
+        of = jnp.zeros((S, C, int(d)), jnp.float32)
+        self._finish = jax.jit(finish).lower(
+            pt, ht, uh, i32, i32, of).compile()
+
+    def __call__(self, prev_tok, host_tok, use_host, ctx, n_new, o):
+        return self._finish(prev_tok, host_tok, use_host, ctx, n_new,
+                            o)

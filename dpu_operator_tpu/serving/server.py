@@ -438,12 +438,24 @@ class ServingServer:
             spec_agg = {"proposed": 0, "accepted": 0, "runs": 0}
             spec_deltas = {"proposed": 0, "accepted": 0}
             spec_seen = False
+            rank_agg: dict = {}
             with self._trace_pub_lock:
                 for idx, ex in enumerate(self.pool.executors):
                     st = ex.kv_stats()
                     agg["used"] += st["blocks_used"]
                     agg["free"] += st["blocks_free"]
                     agg["shared"] += st["blocks_shared"]
+                    if hasattr(ex, "kv_rank_stats"):
+                        # Context-parallel pools (ISSUE 16): the same
+                        # gauge, decomposed per shard rank — one extra
+                        # label on sharded-KV executors only, the
+                        # aggregate series above stays as-is.
+                        for r, rst in ex.kv_rank_stats().items():
+                            for state in ("used", "free"):
+                                key = (r, state)
+                                rank_agg[key] = (
+                                    rank_agg.get(key, 0)
+                                    + rst[f"blocks_{state}"])
                     agg["hit"] += st["prefix_hit_tokens"]
                     agg["lookup"] += st["prefix_lookup_tokens"]
                     last = self._kv_pub.get(idx, (0, 0))
@@ -473,6 +485,12 @@ class ServingServer:
                 self.registry.gauge_set(
                     "serving_kv_blocks", float(agg[state]),
                     {"state": state},
+                    help="paged KV blocks by allocator state "
+                         "(shared = refcount > 1)")
+            for (r, state), n in sorted(rank_agg.items()):
+                self.registry.gauge_set(
+                    "serving_kv_blocks", float(n),
+                    {"state": state, "rank": str(r)},
                     help="paged KV blocks by allocator state "
                          "(shared = refcount > 1)")
             self.registry.gauge_set(

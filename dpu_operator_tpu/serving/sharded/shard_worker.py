@@ -134,7 +134,60 @@ def _maybe_jit(sl, want_jit: bool, slots: int):
         return None, None, False
 
 
+def _kv_main(argv) -> int:
+    """``--kv`` mode (ISSUE 16): this process serves ONE rank's slice
+    of a context-parallel paged KV pool instead of a row-state shard —
+    it dials the coordinator's per-rank listener, rebuilds the shared
+    ``KVSpec`` from ``--kv-spec`` and derives its OWN head/block slice
+    bounds from it (the GL018 discipline holds across the process
+    boundary), then serves framed step/reset messages until the
+    coordinator closes the stream. Same one-JSON-line stdout protocol
+    as the row worker."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kv", action="store_true")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--connect", required=True,
+                    help="ip:port of the KVShardProcessSet's per-rank "
+                         "listener")
+    ap.add_argument("--slots", type=int, required=True)
+    ap.add_argument("--num-blocks", type=int, required=True)
+    ap.add_argument("--chunk", type=int, required=True)
+    ap.add_argument("--kv-spec", required=True,
+                    help="k=v CSV of KVSpec.fingerprint() — the ONE "
+                         "layout declaration both ends derive from")
+    args = ap.parse_args(argv)
+    proto_out = protocol_stdout()
+    obs_logging.setup("shard_worker", stream=sys.stderr)
+    with obs_logging.context(rank=args.rank):
+        from ..kvcache.sharded import serve_kv_rank, spec_from_argv
+
+        spec = spec_from_argv(args.kv_spec)
+        host, port = args.connect.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=30.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        rc, err = 0, None
+        try:
+            serve_kv_rank(sock, args.rank, spec, slots=args.slots,
+                          num_blocks=args.num_blocks,
+                          chunk=args.chunk)
+        except (OSError, ProtocolError) as e:
+            # A dead coordinator closes the socket: bounded, loud.
+            rc, err = 1, str(e)
+            log.warning("kv rank %d: coordinator stream died: %s",
+                        args.rank, e)
+        finally:
+            sock.close()
+        print(json.dumps({"ok": rc == 0, "mode": "kv",
+                          "rank": args.rank, "error": err}),
+              file=proto_out, flush=True)
+    return rc
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--kv" in argv:
+        return _kv_main(argv)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rank", type=int, required=True,
                     help="ring rank (the coordinator applies "

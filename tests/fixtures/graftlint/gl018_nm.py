@@ -1,0 +1,24 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/disagg/fx_gl018_nm.py
+"""GL018 near-misses that must stay silent: geometry-only arithmetic,
+the fabric plane's shard split over non-KV state, and per-rank
+geometry taken from the KVSpec rank_* family (the discipline the rule
+enforces)."""
+
+
+class Streamer:
+    def blocks_for(self, tokens):
+        # Geometry-only: tokens to block count, no shard topology.
+        return (tokens + self.block_size - 1) // self.block_size
+
+    def row_split(self, world):
+        # Shard arithmetic over NON-KV state: the fabric plane's row
+        # shard of the activation width — its own subsystem.
+        return self.d // world
+
+    def owned(self, spec, rank, blocks):
+        # The derived way: the spec's partition is the single truth.
+        lo, hi = spec.rank_blocks(rank, self.num_blocks)
+        return [b for b in blocks if lo <= b < hi]
+
+    def wire_bytes(self, spec, rank, codec, count):
+        return spec.rank_wire_block_nbytes(rank, codec) * count

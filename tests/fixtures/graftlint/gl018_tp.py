@@ -1,0 +1,20 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/disagg/fx_gl018_tp.py
+"""GL018 true positives: per-rank KV geometry re-derived inline in a
+transfer module. Two findings: a resident-capacity split that ignores
+the spec's uneven-tail partition, and an inline block-range formula —
+one finding for the whole compound expression (outermost match), not
+one per operator."""
+
+
+class Streamer:
+    def plan_capacity(self):
+        # TP 1: the spec's rank_blocks gives rank world-1 the tail
+        # remainder; this even split disagrees with it.
+        per_rank = self.num_blocks // self.world
+        return per_rank
+
+    def rank_range(self, rank, world):
+        # TP 2 (ONE finding): the classic inline partition — drifts
+        # the moment the spec's formula or axis changes.
+        lo = rank * self.num_blocks // world
+        return lo

@@ -772,6 +772,120 @@ def test_kv_kill_mid_decode_reattaches_pages_instead_of_redecoding(
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 
+# -- context-parallel paged KV (ISSUE 16): kill one shard mid-decode ----------
+
+
+@pytest.mark.parametrize("shard_axis", ["head", "page"])
+def test_shard_kill_mid_decode_sharded_kv_reattaches_all_ranks(
+        shard_axis, settle_counts, tmp_path):
+    """Chaos-matrix extension (ISSUE 16): killing ONE rank of a
+    context-parallel sharded-KV replica mid-decode must recover through
+    the same seize→requeue→re-attach chain as a whole-replica kill —
+    the lease's block table re-attaches with EVERY rank's page set
+    intact (byte-identical streams vs an uninjected run prove the
+    per-rank pools survived the re-rendezvous; the recurrence is
+    position- and content-dependent, so a rank that lost its K/V slice
+    would diverge visibly). Exactly-once settle, BOTH leak ledgers
+    clean (block allocator + the shard set's in-flight board), and the
+    flight snapshot carries the victim rank's own fault.fired plus the
+    re-rendezvous span."""
+    from dpu_operator_tpu.serving import ShardedPagedKVExecutor
+
+    t0 = time.perf_counter()
+    plen, chunk, max_toks, world = 32, 8, 6, 2
+    prompt = [int(x) for x in range(plen)]
+    inner = ShardedPagedKVExecutor(
+        slots=2, block_size=4, num_blocks=64, max_blocks_per_req=16,
+        prefill_chunk=chunk, d=16, heads=2, vocab=32, mode="pipelined",
+        world=world, shard_axis=shard_axis, fault_site="kvshard",
+        step_timeout_s=5.0)
+
+    def run(inject, flight_dir=None):
+        reqs = [GenerateRequest(prompt_vec=None, max_tokens=max_toks,
+                                deadline=time.monotonic() + 60.0,
+                                prompt_tokens=list(prompt))]
+        resets0 = inner.shards.resets
+        pool, _q = _run_pool([inner], reqs, timeout=20.0,
+                             flight_dir=flight_dir)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+                # Re-rendezvous: the restart's reset() tears down the
+                # poisoned shard generation and respawns all world
+                # rank threads against the SURVIVING pools.
+                _wait(lambda: inner.shards.resets > resets0 + 1,
+                      msg="shard set re-rendezvous")
+        finally:
+            pool.stop()
+        # Both leak ledgers: no block leaked by the seize, and no
+        # un-aborted in-flight step left on the shard board.
+        inner.allocator.assert_clean()
+        assert inner.shards.outstanding() == 0, \
+            "shard set leaked an un-aborted in-flight step"
+        return [(r.error, list(r.tokens)) for r in reqs], reqs
+
+    baseline, _ = run(inject=False)
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            # The baseline primed the prefix cache, so prefill is one
+            # chunk step; rank 1's 4th step lands mid-decode. The
+            # fault fires INSIDE the victim rank's step thread — the
+            # coordinator poisons the generation and the batcher's
+            # collect() surfaces ShardStepError(rank=1).
+            plan.inject("kvshard1.step",
+                        exc=RuntimeError("injected shard kill"),
+                        at_calls=[4])
+            injected, reqs = run(inject=True, flight_dir=tmp_path)
+        spans = tr.spans_snapshot()
+    assert injected == baseline, (injected, baseline)
+    assert all(e is None for e, _ in injected)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    victim = reqs[0].request_id
+    assert inner.resumed_total >= 1
+
+    # The cheap retry: requeue rode with the block table, and the
+    # victim replayed strictly fewer steps than a full re-decode.
+    requeues = [s for s in spans if s.name == "supervisor.requeue"
+                and s.attrs.get("outcome") == "requeued_kv"]
+    assert [s.request_id for s in requeues] == [victim]
+    queue_rq = [s for s in spans if s.name == "queue.requeue"
+                and s.request_id == victim]
+    assert queue_rq and queue_rq[0].attrs.get("kv_blocks", 0) > 0, \
+        "block-table ownership did not ride the queue"
+    requeue_t = requeues[0].t0
+    replayed = sum(
+        1 for s in spans
+        if s.name == "step.device" and s.t0 > requeue_t
+        and victim in (s.attrs.get("request_ids") or ()))
+    full_redecode = -(-plen // chunk) + max_toks
+    assert 0 < replayed < full_redecode, (replayed, full_redecode)
+
+    # The per-rank story rides the SAME timeline: the rank-stamped
+    # fault.fired groups into the victim's shards tail of the restart
+    # snapshot, and the re-rendezvous span is on the main tail.
+    doc = _flight_doc(tmp_path, "restart")
+    flight = doc["spans"]
+    assert any(s["name"] == "fault.fired"
+               and s["attrs"].get("site") == "kvshard1.step"
+               for s in flight)
+    assert any(s["name"] == "supervisor.requeue"
+               and s["attrs"].get("outcome") == "requeued_kv"
+               for s in flight)
+    shards_sec = doc.get("shards", {})
+    victim_tail = shards_sec.get("1", [])
+    assert any(s["name"] == "fault.fired"
+               and s["attrs"].get("rank") == 1 for s in victim_tail), \
+        "victim rank's shards tail is missing its fault.fired"
+    rendezvous = [s for s in spans if s.name == "kvshard.rendezvous"]
+    assert rendezvous and any(s.attrs.get("world") == world
+                              for s in rendezvous), \
+        "re-rendezvous span missing from the recovery trace"
+    inner.close()
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
 # -- speculative decode (ISSUE 15): kill mid-verify ---------------------------
 
 

@@ -1,0 +1,337 @@
+"""Context-parallel paged KV (ISSUE 16): the tier-1 equivalence lane.
+
+The contract: a ``ShardedPagedKVExecutor`` — K/V pools partitioned
+across shard workers on the head (Ulysses-style) or page (ring-style)
+axis — decodes token streams BYTE-IDENTICAL to the single-worker
+``PagedKVExecutor`` on the PR 7 invariance trace, in every mode the
+single-worker executor supports:
+
+  * head axis: q/k/v projection is replicated so the int8 per-block
+    scales (amax over ALL heads) stay bit-identical; each rank appends
+    and attends only its head slice, and per-head softmax makes the
+    concatenated output EXACTLY the single-worker rows;
+  * page axis: each rank attends its owned block range and returns
+    flash partials (m, l, o) folded by ``merge_partial_softmax`` in
+    rank order — the argmax-stable online-softmax reassociation the
+    PR 13 lane already documents as under the decision margin.
+
+Mode matrix here: world 1 (degenerate), 2 and 3; int8 and fp32 pools;
+sync and pipelined loops; speculative verify (same-mode comparison —
+the PR 13 carve-out: int8 quantization groups differ between spec and
+one-token runs, so spec compares against single-worker SPEC).
+
+Cost note for docs/ci.md: every executor AOT-compiles world+1 steps at
+construction (~1-2 s at these shapes; weights come from the process
+param cache). The golden single-worker streams are computed once per
+pool dtype and shared across cases. The real-subprocess
+``KVShardProcessSet`` smoke is slow-marked (two interpreter spawns +
+three compiles per worker).
+"""
+
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      DisaggPool, GenerateRequest,
+                                      PagedKVExecutor,
+                                      ShardedPagedKVExecutor)
+from dpu_operator_tpu.utils.metrics import Registry
+
+# The PR 7 invariance trace: prompts crossing block boundaries, a
+# table-capacity prompt, a repeated-token prompt.
+DIMS = dict(slots=2, vocab=32, d=16, heads=2, block_size=4,
+            num_blocks=64, max_blocks_per_req=8, prefill_chunk=8,
+            seed=0)
+PROMPTS = [[int(x) for x in np.arange(25) % 13], [3, 1, 4, 1, 5],
+           [9] * 12, [int(x) for x in np.arange(26) % 13]]
+MAX_TOKENS = 6
+
+POOL_OPTS = dict(watchdog_s=0.5, restart_backoff_s=0.01, poll_s=0.005)
+
+
+def _req(prompt, max_tokens=MAX_TOKENS, deadline_s=60.0):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + deadline_s,
+                           prompt_tokens=list(prompt))
+
+
+def _drive_direct(ex, prompts, max_tokens=MAX_TOKENS):
+    """Sync-loop the executor directly (no batcher), in waves of
+    ``ex.slots``: attach, submit/collect until every stream has
+    max_tokens, release. Streams depend only on each prompt (the PR 7
+    invariance), so wave boundaries don't change them."""
+    streams = []
+    for i in range(0, len(prompts), ex.slots):
+        wave = prompts[i:i + ex.slots]
+        reqs = [_req(p, max_tokens) for p in wave]
+        for s, r in enumerate(reqs):
+            ex.kv_attach(s, r)
+        got = [[] for _ in reqs]
+        for _ in range(200):
+            toks = ex.collect(ex.submit((), gen=ex.kv_gen()))
+            for s in range(len(reqs)):
+                if toks[s] >= 0 and len(got[s]) < max_tokens:
+                    got[s].append(int(toks[s]))
+                    reqs[s].tokens.append(int(toks[s]))
+            if all(len(st) == max_tokens for st in got):
+                break
+        assert all(len(st) == max_tokens for st in got), got
+        for s, r in enumerate(reqs):
+            ex.kv_release_slot(s, cache=False)
+            r.finish()
+        streams.extend(got)
+    ex.allocator.assert_clean()
+    return streams
+
+
+def _drive_batched(ex, prompts, max_tokens=MAX_TOKENS, timeout=60.0):
+    q = AdmissionQueue(max_depth=len(prompts) + 1)
+    b = ContinuousBatcher(ex, q)
+    reqs = [_req(p, max_tokens) for p in prompts]
+    for r in reqs:
+        q.submit(r)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=timeout), "request lost"
+    finally:
+        b.stop()
+    for r in reqs:
+        assert r.error is None, r.error
+    ex.allocator.assert_clean()
+    return [list(r.tokens) for r in reqs]
+
+
+# One single-worker golden per pool dtype, shared by every case below
+# (the executor builds dominate lane cost, not the decode steps).
+_GOLDEN: dict = {}
+
+
+def _golden(pool_dtype):
+    if pool_dtype not in _GOLDEN:
+        ex = PagedKVExecutor(mode="sync", pool_dtype=pool_dtype,
+                             **DIMS)
+        _GOLDEN[pool_dtype] = _drive_direct(ex, PROMPTS)
+        assert any(len(set(s)) > 1 for s in _GOLDEN[pool_dtype]), \
+            "degenerate golden streams would make equality vacuous"
+    return _GOLDEN[pool_dtype]
+
+
+# -- the equivalence matrix ---------------------------------------------------
+
+
+CASES = [
+    # world 1 is the degenerate partition: one rank owns everything,
+    # the merge is an identity — the cheapest proof the shard plumbing
+    # adds nothing to the math.
+    (1, "head", "int8", "sync"),
+    (2, "head", "int8", "sync"),
+    (2, "page", "int8", "pipelined"),
+    # heads=2 does not divide by 3: the resolver would refuse "head",
+    # page-axis partitions any world (uneven block ranges).
+    (3, "page", "int8", "sync"),
+    (2, "head", "fp32", "pipelined"),
+    (2, "page", "fp32", "sync"),
+]
+
+
+@pytest.mark.parametrize("world,axis,pool_dtype,mode", CASES)
+def test_sharded_streams_byte_identical_to_single_worker(
+        world, axis, pool_dtype, mode):
+    """ISSUE 16 acceptance: same trace, same seed — the sharded
+    executor's streams equal the single-worker executor's BYTE FOR
+    BYTE on both shard axes, both pool dtypes, both loop shapes. The
+    recurrence is position- and content-dependent, so any rank that
+    dropped, duplicated or mis-merged a K/V slice diverges within a
+    token or two."""
+    ex = ShardedPagedKVExecutor(world=world, shard_axis=axis,
+                                mode=mode, pool_dtype=pool_dtype,
+                                **DIMS)
+    try:
+        drive = _drive_direct if mode == "sync" else _drive_batched
+        streams = drive(ex, PROMPTS)
+        assert streams == _golden(pool_dtype), (streams,
+                                                _golden(pool_dtype))
+        assert ex.shards.outstanding() == 0, \
+            "shard set leaked an un-aborted in-flight step"
+    finally:
+        ex.close()
+
+
+def test_speculative_verify_on_sharded_kv_is_same_mode_identical():
+    """Speculative verify rides the Ulysses (head) path untouched: the
+    k+1 verify window attends entirely locally per rank. int8 scales
+    group over the verify window's rows (the PR 13 carve-out), so the
+    comparison is SAME-MODE: sharded speculative == single-worker
+    speculative, byte-identical."""
+    single = PagedKVExecutor(mode="speculative", spec_k=3, **DIMS)
+    golden = _drive_batched(single, PROMPTS)
+    if hasattr(single, "close"):
+        single.close()
+
+    ex = ShardedPagedKVExecutor(world=2, shard_axis="head",
+                                mode="speculative", spec_k=3, **DIMS)
+    try:
+        streams = _drive_batched(ex, PROMPTS)
+        assert streams == golden, (streams, golden)
+        assert ex.kv_stats()["spec_verify_steps"] > 0
+        assert ex.shards.outstanding() == 0
+    finally:
+        ex.close()
+
+
+def test_shard_axis_resolution_and_spec_validation():
+    from dpu_operator_tpu.serving.disagg.spec import KVSpec
+    from dpu_operator_tpu.serving.kvcache import resolve_shard_axis
+
+    # auto prefers the head axis (exact per-head softmax, no partial
+    # merge) and falls back to pages when heads don't divide.
+    assert resolve_shard_axis("auto", heads=2, world=2) == "head"
+    assert resolve_shard_axis("auto", heads=2, world=3) == "page"
+    with pytest.raises(ValueError, match="head"):
+        KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+               vocab=32, max_blocks_per_req=8, pool_dtype="int8",
+               shard_axis="head", world=3)
+    # Sharding is part of the layout fingerprint: a world-2 head
+    # partition is NOT wire-compatible with a flat pool.
+    flat = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                  vocab=32, max_blocks_per_req=8, pool_dtype="int8")
+    sharded = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                     vocab=32, max_blocks_per_req=8,
+                     pool_dtype="int8", shard_axis="head", world=2)
+    assert flat.fingerprint() != sharded.fingerprint()
+    # Per-rank geometry sums back to the whole on both axes.
+    assert sum(sharded.rank_heads(r)[1] - sharded.rank_heads(r)[0]
+               for r in range(2)) == 2
+    paged = KVSpec(model="paged", block_size=4, heads=2, d_head=8,
+                   vocab=32, max_blocks_per_req=8, pool_dtype="int8",
+                   shard_axis="page", world=3)
+    spans = [paged.rank_blocks(r, 64) for r in range(3)]
+    assert spans[0][0] == 0 and spans[-1][1] == 64
+    assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+
+# -- disagg: per-rank page sets ride the stream point-to-point ---------------
+
+
+def test_sharded_disagg_streams_and_per_rank_transfer_counter():
+    """The sharded transfer: each rank's page set ships as its own
+    framed sub-stream (multiplexed on the one socket), the decode
+    replica's ranks re-scatter by DEST ownership — streams stay
+    byte-identical to the colocated single-worker golden, and the
+    per-rank ``serving_shard_kv_transfer_bytes_total`` decomposition
+    sums to the aggregate transfer counter's bytes."""
+    pre = ShardedPagedKVExecutor(world=2, shard_axis="page",
+                                 mode="pipelined", **DIMS)
+    dec = ShardedPagedKVExecutor(world=2, shard_axis="page",
+                                 mode="pipelined", **DIMS)
+    reg = Registry()
+    q = AdmissionQueue(max_depth=16)
+    pool = DisaggPool([pre], [dec], q, registry=reg,
+                      pool_opts=dict(POOL_OPTS))
+    pool.start()
+    try:
+        reqs = [_req(p) for p in PROMPTS]
+        for r in reqs:
+            q.submit(r)
+        for r in reqs:
+            assert r.wait(60.0), "request lost"
+        for r in reqs:
+            assert r.error is None, r.error
+        streams = [list(r.tokens) for r in reqs]
+    finally:
+        pool.stop()
+    assert streams == _golden("int8"), (streams, _golden("int8"))
+    spec = pre._kvspec
+    per_rank = [reg.counter_value("serving_shard_kv_transfer_bytes_total",
+                                  {"rank": str(r)}) or 0.0
+                for r in range(2)]
+    assert sum(per_rank) > 0, per_rank
+    # Honest accounting: the per-rank decomposition is exactly the
+    # spec-derived wire bytes — rank r ships its owned page count
+    # times its per-block wire size, nothing hidden.
+    xfers = reg.counter_value("serving_kv_transfers_total",
+                              {"outcome": "ok"})
+    assert xfers == len(PROMPTS)
+    for ex in (pre, dec):
+        ex.allocator.assert_clean()
+        assert ex.shards.outstanding() == 0
+        ex.close()
+    assert spec.shard_axis == "page"
+
+
+# -- /metrics: the rank dimension --------------------------------------------
+
+
+def test_metrics_exposition_kv_blocks_rank_dimension():
+    """Satellite: on a sharded-KV executor the ``serving_kv_blocks``
+    gauge grows a ``rank`` label — per-rank used/free resident page
+    counts from the spec partition + allocator refcounts (pools never
+    touched at scrape time)."""
+    import json as _json
+
+    from dpu_operator_tpu.serving import ServingServer
+
+    ex = ShardedPagedKVExecutor(world=2, shard_axis="page",
+                                mode="pipelined", **DIMS)
+    srv = ServingServer([ex]).start()
+    try:
+        body = _json.dumps({"prompt_tokens": PROMPTS[0],
+                            "max_tokens": 2,
+                            "deadline_ms": 30000}).encode()
+        urllib.request.urlopen(
+            urllib.request.Request(srv.url + "/v1/generate",
+                                   data=body), timeout=30).read()
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=5).read().decode()
+    finally:
+        srv.stop()
+    lines = [l for l in text.splitlines()
+             if l.startswith("serving_kv_blocks{")]
+    for r in ("0", "1"):
+        for state in ("used", "free"):
+            pat = re.compile(r'serving_kv_blocks\{(?=[^}]*rank="%s")'
+                             r'(?=[^}]*state="%s")' % (r, state))
+            assert any(pat.match(l) for l in lines), (r, state, lines)
+    # The aggregate (rank-free) series is still published unchanged.
+    agg = [l for l in lines if 'rank=' not in l]
+    assert any('state="used"' in l for l in agg)
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+# -- the real-subprocess backend (slow) ---------------------------------------
+
+
+@pytest.mark.slow
+def test_process_shard_set_world2_streams_match_golden():
+    """World-equivalence smoke on the REAL boundary: two shard worker
+    subprocesses (own interpreters, own pools) behind
+    ``KVShardProcessSet`` decode the identical streams. Slow-marked:
+    two interpreter spawns + per-worker AOT compiles."""
+    from dpu_operator_tpu.serving.disagg.spec import KVSpec
+    from dpu_operator_tpu.serving.kvcache import KVShardProcessSet
+
+    spec = KVSpec(model="paged", block_size=DIMS["block_size"],
+                  heads=DIMS["heads"],
+                  d_head=DIMS["d"] // DIMS["heads"],
+                  vocab=DIMS["vocab"],
+                  max_blocks_per_req=DIMS["max_blocks_per_req"],
+                  pool_dtype="int8", planes=2, seed=DIMS["seed"],
+                  shard_axis="head", world=2)
+    backend = KVShardProcessSet(spec, slots=DIMS["slots"],
+                                num_blocks=DIMS["num_blocks"],
+                                chunk=DIMS["prefill_chunk"])
+    ex = ShardedPagedKVExecutor(world=2, shard_axis="head",
+                                mode="sync", backend=backend, **DIMS)
+    try:
+        streams = _drive_direct(ex, PROMPTS)
+        assert streams == _golden("int8")
+        assert ex.shards.outstanding() == 0
+        assert sorted(ex.shards.live_ranks()) == [0, 1]
+    finally:
+        ex.close()
