@@ -800,7 +800,16 @@ def bench_serving() -> dict:
             f"{out.get('serving_spec_baseline_tokens_per_s')} accepted "
             f"tok/s/slot = {out.get('serving_spec_speedup')}x (accept "
             f"rate {out.get('serving_spec_accept_rate')}, "
-            f"{out.get('serving_spec_tokens_per_step')} tok/step)",
+            f"{out.get('serving_spec_tokens_per_step')} tok/step); "
+            f"cluster-prefix hit {out.get('serving_prefix_hit_frac')} "
+            f"vs rr {out.get('serving_prefix_hit_frac_rr')} = "
+            f"{out.get('serving_prefix_route_uplift_x')}x uplift, ttft "
+            f"p99 {out.get('serving_ttft_p99_ms')} vs "
+            f"{out.get('serving_ttft_p99_rr_ms')} ms = "
+            f"{out.get('serving_ttft_vs_rr_x')}x (tier spill "
+            f"{out.get('serving_tier_spill_gbps')} Gb/s, restore "
+            f"{out.get('serving_tier_restore_gbps')} Gb/s, pulled "
+            f"{out.get('serving_router_pulled_blocks')} blocks)",
             file=sys.stderr,
         )
         return out
@@ -909,6 +918,24 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
     ctx = metrics.get("serving_ctx_per_replica_scaling")
     if ctx is not None:
         gates["serving_ctx_scaling_ge_17"] = bool(ctx >= 1.7)
+    # Cluster prefix cache (ISSUE 17), ABSOLUTE: the acceptance pair
+    # itself, on a deterministic two-arm A/B (identical replicas and
+    # request order, sleep-based synthetic step costs). Prefix-aware
+    # routing + tiering must lift cluster hit-token fraction >= 1.5x
+    # over prefix-blind round-robin AND hold steady-state TTFT p99 at
+    # <= 0.7x the round-robin arm's; the routed arm's own hit frac
+    # keeps an absolute floor so both arms rotting together (a tier
+    # that stopped restoring, gossip gone stale) cannot pass the
+    # ratio gates with garbage numerators.
+    upx = metrics.get("serving_prefix_route_uplift_x")
+    if upx is not None:
+        gates["serving_prefix_uplift_ge_15"] = bool(upx >= 1.5)
+    phf = metrics.get("serving_prefix_hit_frac")
+    if phf is not None:
+        gates["serving_prefix_hit_frac_ge_04"] = bool(phf >= 0.4)
+    ttx = metrics.get("serving_ttft_vs_rr_x")
+    if ttx is not None:
+        gates["serving_ttft_vs_rr_le_07"] = bool(ttx <= 0.7)
 
     for key, band, label in (
         ("fabric_tcp_gbps", 0.85, "fabric_tcp_ge_085_median"),
@@ -1008,6 +1035,12 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # where attention dominates and that comparison is meaningful.
         ("serving_shard_kv_p99_ms", 1.35,
          "serving_shard_kv_p99_le_135_median"),
+        # Cluster prefix cache (ISSUE 17): the routed arm's absolute
+        # steady-state TTFT p99 gets the latency band against its own
+        # rolling median — the vs-rr ratio gate above can stay green
+        # while BOTH arms drift slower (queue or restore-path creep),
+        # and this band is what catches that drift.
+        ("serving_ttft_p99_ms", 1.35, "serving_ttft_p99_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -1130,6 +1163,15 @@ def main() -> int:
         "serving_shard_kv_transfer_gbps": "Gb/s",
         "serving_shard_kv_transfer_rank0_gbps": "Gb/s",
         "serving_shard_kv_transfer_rank1_gbps": "Gb/s",
+        "serving_prefix_hit_frac": "frac",
+        "serving_prefix_hit_frac_rr": "frac",
+        "serving_prefix_route_uplift_x": "x",
+        "serving_ttft_p99_ms": "ms",
+        "serving_ttft_p99_rr_ms": "ms",
+        "serving_ttft_vs_rr_x": "x",
+        "serving_tier_spill_gbps": "Gb/s",
+        "serving_tier_restore_gbps": "Gb/s",
+        "serving_router_pull_gbps": "Gb/s",
     }
     for key, unit in units.items():
         if key in metrics:

@@ -35,6 +35,11 @@ GL016  KV lease detached for a cross-replica hand-off with no paired
 GL017  plan-time write to collect-owned decode state
        (decode_tokens/last_token/confirmed watermark) outside the
        collect owner-guard region (serving/kvcache/ + serving/spec.py)
+GL018  per-rank KV geometry computed inline instead of derived from
+       the KVSpec shard axis (serving/sharded/ + serving/disagg/)
+GL019  prefix-tree publish from a tier restore or remote pull with no
+       chained-hash re-verification in the same function
+       (serving/kvcache/ + serving/router/)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1697,6 +1702,88 @@ class InlineShardKVGeometry(Rule):
             stack.extend(ast.iter_child_nodes(n))
 
 
+# --------------------------------------------------------------------------
+# GL019 — prefix-tree publish from tier/remote bytes without chain verify
+
+
+class UnverifiedPrefixPublish(Rule):
+    """Origin: ISSUE 17's cluster prefix cache. The prefix tree's
+    chained content hash (PrefixTree._key: sha1 over parent-key +
+    token chunk) is the ONLY thing that makes a cached block safe to
+    serve — it binds the block's bytes to the exact token prefix that
+    produced them. Local prefill publishes are self-verifying (the
+    tokens ARE the ground truth the executor just consumed), but
+    bytes that re-enter from a colder domain are not: a host-tier
+    entry may have rotted in RAM, and a remote pull trusts a peer's
+    claim about which prefix its pages encode. Publishing either into
+    the tree without recomputing the chain serves corrupt or
+    mis-keyed KV to every future request that matches the prefix —
+    silently, because the allocator and the wire checksum both pass.
+
+    The mechanical contract: in serving/kvcache/ and serving/router/,
+    a function that re-publishes foreign bytes — calls
+    ``attach_restored`` (tier restore), ``insert(..., origin=...)``
+    (an origin-tagged publish: ``origin=`` is exactly the marker that
+    the blocks did NOT come from local prefill), or
+    ``_tier_import_block`` (tier bytes scattered into the pool) —
+    must also call ``verify_block_tokens`` (kvcache/tiering.py, the
+    one blessed helper that recomputes the chained hash) somewhere in
+    the same function.
+
+    Near-misses that stay silent: the same publishes with the verify
+    call present, the plain two-argument ``insert(tokens, blocks)``
+    (local prefill — tokens are ground truth), tier ``checkout``/
+    ``put`` traffic that never touches the tree, and identical code
+    outside the two scoped directories."""
+
+    rule_id = "GL019"
+    severity = SEVERITY_ERROR
+    title = "prefix publish from tier/remote bytes without chain verify"
+    hint = ("a tier restore or remote pull must recompute the chained "
+            "prefix hash via verify_block_tokens "
+            "(serving/kvcache/tiering.py) before the blocks are "
+            "published into the PrefixTree — attach_restored / "
+            "insert(origin=...) / _tier_import_block without it "
+            "serves rotted or mis-keyed KV to every later prefix hit")
+
+    _PUBLISH = {"attach_restored", "_tier_import_block"}
+    _VERIFY = "verify_block_tokens"
+
+    @classmethod
+    def _is_publish(cls, call: ast.Call) -> bool:
+        leaf = _terminal_name(call.func)
+        if leaf in cls._PUBLISH:
+            return True
+        if leaf == "insert":
+            return any(kw.arg == "origin" for kw in call.keywords)
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not (module.in_dir("kvcache") or module.in_dir("router")):
+            return
+        for fn, qual in module.functions:
+            publishes = []
+            verified = False
+            for n in _walk_through_lambdas(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                if _terminal_name(n.func) == self._VERIFY:
+                    verified = True
+                elif self._is_publish(n):
+                    publishes.append(n)
+            if verified:
+                continue
+            for call in publishes:
+                leaf = _terminal_name(call.func)
+                yield self.finding(
+                    module, call,
+                    f"'{leaf}' in '{qual}' publishes tier/remote bytes "
+                    f"into the prefix tree with no verify_block_tokens "
+                    f"call in the same function — the chained hash is "
+                    f"the only binding between these blocks and the "
+                    f"prefix they claim to encode")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1709,4 +1796,5 @@ def default_rules() -> List[Rule]:
             CopyInTransportLoop(), InconsistentLockDiscipline(),
             LockOrderInversion(), WallClockDurationMath(),
             Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
-            PlanTimeCollectStateWrite(), InlineShardKVGeometry()]
+            PlanTimeCollectStateWrite(), InlineShardKVGeometry(),
+            UnverifiedPrefixPublish()]

@@ -39,10 +39,11 @@ from .api import (Draining, GenerateRequest, QueueFull, ServingError,
 from .disagg import DisaggPool, KVSpec, KVSpecMismatch
 from .executor import (Executor, LocalExecutor, ReplicaPool,
                        SyntheticExecutor)
-from .kvcache import (KVBlockAllocator, KVCacheOOM, KVLease,
-                      PagedKVExecutor, PrefixTree,
+from .kvcache import (HostKVTier, KVBlockAllocator, KVCacheOOM,
+                      KVLease, PagedKVExecutor, PrefixTree,
                       ShardedPagedKVExecutor, SyntheticKVExecutor)
 from .queue import AdmissionQueue
+from .router import PrefixRouter, RouterReplica
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
 from .spec import NO_TOKEN, OracleDraft, SpecConfig, TruncatedDraft
@@ -57,6 +58,7 @@ __all__ = [
     "Executor",
     "FabricExecutor",
     "GenerateRequest",
+    "HostKVTier",
     "KVBlockAllocator",
     "KVCacheOOM",
     "KVLease",
@@ -66,8 +68,10 @@ __all__ = [
     "NO_TOKEN",
     "OracleDraft",
     "PagedKVExecutor",
+    "PrefixRouter",
     "PrefixTree",
     "QueueFull",
+    "RouterReplica",
     "ReplicaPool",
     "ServingError",
     "ServingServer",

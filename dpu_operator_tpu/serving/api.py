@@ -90,6 +90,11 @@ class GenerateRequest:
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     arrival: float = field(default_factory=time.monotonic)
     admitted_at: Optional[float] = None  # scheduler placed it in a slot
+    # First decoded token settled (TTFT's right edge): stamped by the
+    # retire paths on the first append only, so it covers queue +
+    # admission + the whole prefill — exactly what a prefix-cache hit
+    # (ISSUE 17) shrinks and what serving_ttft_p99_ms measures.
+    first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     tokens: List[int] = field(default_factory=list)
     truncated: bool = False              # deadline hit mid-decode
@@ -152,8 +157,12 @@ class GenerateRequest:
                     else (end - self.arrival)) * 1000.0
         decode_ms = ((end - admitted) * 1000.0
                      if admitted is not None else 0.0)
-        return {
+        out = {
             "queue_ms": round(queue_ms, 3),
             "decode_ms": round(decode_ms, 3),
             "total_ms": round((end - self.arrival) * 1000.0, 3),
         }
+        if self.first_token_at is not None:
+            out["ttft_ms"] = round(
+                (self.first_token_at - self.arrival) * 1000.0, 3)
+        return out

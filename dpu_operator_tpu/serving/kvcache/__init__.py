@@ -32,9 +32,11 @@ from .executor import (NO_TOKEN, KVExecutorBase, PagedKVExecutor,
 from .paged import kv_bytes_per_slot, paged_kv_error_bound
 from .sharded import (KVShardProcessSet, ShardedPagedKVExecutor,
                       SyntheticKVShardSet, resolve_shard_axis)
+from .tiering import HostKVTier, verify_block_tokens
 
 __all__ = [
     "CACHE_OWNER",
+    "HostKVTier",
     "KVBlockAllocator",
     "KVCacheOOM",
     "KVExecutorBase",
@@ -49,4 +51,5 @@ __all__ = [
     "kv_bytes_per_slot",
     "paged_kv_error_bound",
     "resolve_shard_axis",
+    "verify_block_tokens",
 ]

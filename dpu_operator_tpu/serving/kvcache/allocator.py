@@ -48,7 +48,7 @@ import heapq
 import logging
 import threading
 from collections import Counter
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -198,17 +198,25 @@ class KVLease:
     never leave the lease ahead of (or behind) what the client saw."""
 
     __slots__ = ("allocator", "exec_id", "owner", "blocks", "prompt",
-                 "cached_tokens", "_released", "_in_transit", "_lock")
+                 "cached_tokens", "cached_by_tier", "_released",
+                 "_in_transit", "_lock")
 
     def __init__(self, allocator: KVBlockAllocator, exec_id: str,
                  owner: str, blocks: List[int],
-                 prompt: Tuple[int, ...], cached_tokens: int):
+                 prompt: Tuple[int, ...], cached_tokens: int,
+                 cached_by_tier: Optional[Dict[str, int]] = None):
         self.allocator = allocator
         self.exec_id = exec_id
         self.owner = owner
         self.blocks = list(blocks)
         self.prompt = tuple(int(t) for t in prompt)
         self.cached_tokens = int(cached_tokens)
+        # Where the cached prefix came from (ISSUE 17): the response
+        # body's per-tier ``cached_tokens`` decomposition. Defaults to
+        # all-HBM, the only tier that existed before tiering.
+        self.cached_by_tier = dict(
+            cached_by_tier if cached_by_tier is not None
+            else {"hbm": self.cached_tokens})
         self._released = False
         self._in_transit = False
         self._lock = threading.Lock()
@@ -297,16 +305,22 @@ class KVLease:
 
 class _Node:
     __slots__ = ("key", "parent", "tokens", "block", "children",
-                 "last_used")
+                 "last_used", "origin")
 
     def __init__(self, key: str, parent: str, tokens: Tuple[int, ...],
-                 block: int, last_used: int):
+                 block: int, last_used: int, origin: str = "hbm"):
         self.key = key
         self.parent = parent
         self.tokens = tokens
         self.block = block
         self.children = 0
         self.last_used = last_used
+        # Where this block's bytes came from, pending first credit:
+        # "hbm" for locally computed KV, "remote" for a cross-replica
+        # pull (ISSUE 17) — the first match consumes the tag so the
+        # pull is credited to the request it actually saved prefill
+        # for, and every later hit counts as the HBM hit it is.
+        self.origin = origin
 
 
 _ROOT = "root"
@@ -328,11 +342,27 @@ class PrefixTree:
         self._nodes: Dict[str, _Node] = {}
         self._clock = 0
         # Token-denominated hit accounting for the scrape-time
-        # serving_kv_prefix_hit_frac gauge.
-        self.hit_tokens = 0
+        # serving_kv_prefix_hit_frac gauge — split by WHERE the hit's
+        # bytes came from (ISSUE 17): plain HBM residency, a host-tier
+        # restore, or a cross-replica pull. ``hit_tokens`` (the sum)
+        # keeps its historical meaning for existing callers.
+        self.hit_tokens_by_tier: Dict[str, int] = {
+            "hbm": 0, "host": 0, "remote": 0}
         self.lookup_tokens = 0
         self.inserted_blocks = 0
         self.evicted_blocks = 0
+        # Evict-to-tier seam (ISSUE 17): when set, ``evict`` offers
+        # each victim's (parent_key, tokens, key, block) here BEFORE
+        # releasing the cache ref, still under the tree lock — the
+        # lock is what resolves the spill-vs-match race (a concurrent
+        # match_and_fork either sees the node and forks it live, or
+        # runs after the spill completed and takes the restore path;
+        # never a freed-block fork).
+        self.spill_hook = None
+
+    @property
+    def hit_tokens(self) -> int:
+        return sum(self.hit_tokens_by_tier.values())
 
     @staticmethod
     def _key(parent: str, tokens: Tuple[int, ...]) -> str:
@@ -344,20 +374,24 @@ class PrefixTree:
         with self._lock:
             return len(self._nodes)
 
-    def match_and_fork(self, tokens: Sequence[int], owner: str
+    def match_and_fork(self, tokens: Sequence[int], owner: str,
+                       by_tier: Optional[Dict[str, int]] = None
                        ) -> Tuple[List[int], int]:
         """Longest cached full-block prefix of `tokens`, capped at
         ``len(tokens) - 1`` (the last prompt token always recomputes —
         it emits the first decode token). The matched blocks are
         forked to `owner` UNDER THE TREE LOCK, so eviction can never
         recycle them between lookup and ref. Returns (blocks,
-        cached_token_count)."""
+        cached_token_count); when `by_tier` is given, per-tier hit
+        token counts are added into it (remote-pulled blocks credit
+        "remote" on their first serve, "hbm" after)."""
         bs = self.block_size
         with self._lock:
             self.lookup_tokens += len(tokens)
             limit = max(0, (len(tokens) - 1) // bs)
             node_key = _ROOT
             blocks: List[int] = []
+            matched: List[_Node] = []
             for i in range(limit):
                 chunk = tuple(int(t)
                               for t in tokens[i * bs:(i + 1) * bs])
@@ -368,19 +402,28 @@ class PrefixTree:
                 self._clock += 1
                 node.last_used = self._clock
                 blocks.append(node.block)
+                matched.append(node)
                 node_key = key
             if blocks:
                 self.allocator.fork(blocks, owner)
-                self.hit_tokens += len(blocks) * bs
+                for node in matched:
+                    self.hit_tokens_by_tier[node.origin] += bs
+                    if by_tier is not None:
+                        by_tier[node.origin] = (
+                            by_tier.get(node.origin, 0) + bs)
+                    node.origin = "hbm"
             return blocks, len(blocks) * bs
 
-    def insert(self, tokens: Sequence[int], blocks: Sequence[int]
-               ) -> int:
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               origin: str = "hbm") -> int:
         """Cache every full block of `tokens` (block i must be
         ``blocks[i]``). The TREE takes its own ref on each newly
         cached block; already-cached chunks keep their original block
         (first insert wins — both hold identical KV by construction).
-        Returns the number of blocks newly cached."""
+        ``origin`` tags newly created nodes ("remote" for a
+        cross-replica pull, so their first serve is credited to the
+        pull that fetched them). Returns the number of blocks newly
+        cached."""
         bs = self.block_size
         added = 0
         with self._lock:
@@ -394,7 +437,7 @@ class PrefixTree:
                     self.allocator.fork([blocks[i]], CACHE_OWNER)
                     self._clock += 1
                     node = _Node(key, node_key, chunk, blocks[i],
-                                 self._clock)
+                                 self._clock, origin=origin)
                     self._nodes[key] = node
                     parent = self._nodes.get(node_key)
                     if parent is not None:
@@ -406,13 +449,57 @@ class PrefixTree:
                 node_key = key
         return added
 
-    def evict(self, want_free: int) -> int:
+    def attach_restored(self, parent_key: str, tokens: Sequence[int],
+                        block: int, owner: str, tier: str = "host"
+                        ) -> Tuple[int, bool]:
+        """Publish ONE restored block (host-tier or remote-pulled
+        bytes, already written into `block`) as the cache node for
+        `tokens` under `parent_key`, and fork the winning block to
+        `owner` — all under the tree lock. The caller must already
+        hold an owner ref on `block` (its fresh acquire).
+
+        Returns ``(block_to_use, created)``: when the chain node
+        already exists (a concurrent request re-inserted the same
+        chunk — first insert wins, same as ``insert``), the EXISTING
+        node's block is forked instead and the caller must release its
+        now-redundant copy. The hit is credited to `tier` only when
+        this restore actually created the node; a lost race is the
+        HBM hit it turned out to be."""
+        chunk = tuple(int(t) for t in tokens)
+        key = self._key(parent_key, chunk)
+        with self._lock:
+            self._clock += 1
+            node = self._nodes.get(key)
+            if node is not None and node.tokens == chunk:
+                node.last_used = self._clock
+                self.allocator.fork([node.block], owner)
+                self.hit_tokens_by_tier[node.origin] += len(chunk)
+                node.origin = "hbm"
+                return node.block, False
+            self.allocator.fork([block], CACHE_OWNER)
+            node = _Node(key, parent_key, chunk, block, self._clock)
+            self._nodes[key] = node
+            parent = self._nodes.get(parent_key)
+            if parent is not None:
+                parent.children += 1
+            self.inserted_blocks += 1
+            self.hit_tokens_by_tier[tier] += len(chunk)
+            return block, True
+
+    def evict(self, want_free: int, spill: bool = True) -> int:
         """Drop LRU leaf entries until `want_free` blocks actually hit
         the free list (or no leaves remain). A victim still shared
         with a live request frees nothing — its cache entry goes, the
         pages live on with the request — so the loop keeps going until
-        real capacity appears. Returns blocks actually freed."""
+        real capacity appears. With a ``spill_hook`` installed (and
+        ``spill`` true), each victim's bytes are offered to the host
+        tier BEFORE its ref is released — still under the tree lock,
+        so a concurrent match can never fork the freed block (the
+        ISSUE 17 spill-vs-fork contract). Spilling is opportunistic:
+        a hook failure degrades to plain drop-on-evict. Returns blocks
+        actually freed."""
         freed = 0
+        hook = self.spill_hook if spill else None
         with self._lock:
             # One leaf scan, then an incrementally-maintained heap:
             # last_used is frozen while we hold the lock (match/insert
@@ -431,14 +518,31 @@ class PrefixTree:
                     if parent.children == 0:
                         heapq.heappush(
                             heap, (parent.last_used, parent.key))
+                if hook is not None:
+                    try:
+                        hook(victim.parent, victim.tokens, victim.key,
+                             victim.block)
+                    except Exception:
+                        log.exception(
+                            "prefix tree: spill hook failed for block "
+                            "%d (dropping)", victim.block)
                 freed += self.allocator.release([victim.block],
                                                 CACHE_OWNER)
                 self.evicted_blocks += 1
         return freed
 
     def flush(self) -> int:
-        """Release every cached ref (teardown / tests)."""
-        return self.evict(self.allocator.num_blocks)
+        """Release every cached ref (teardown / tests) — no spill:
+        flushing exists to FREE memory, parking the flushed bytes in
+        host RAM would defeat it."""
+        return self.evict(self.allocator.num_blocks, spill=False)
+
+    def keys(self) -> List[str]:
+        """Resident chain keys — the gossip publisher's HBM half
+        (ISSUE 17 router): membership is all the router needs, the
+        chain construction already encodes each key's whole prefix."""
+        with self._lock:
+            return list(self._nodes)
 
     def hit_frac(self) -> float:
         return (self.hit_tokens / self.lookup_tokens

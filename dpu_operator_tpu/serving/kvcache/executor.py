@@ -59,7 +59,9 @@ from ..executor import Executor, _GuardedWorker
 # one-token and speculative collect paths share one definition.
 from ..spec import (NO_TOKEN, SpecConfig, accept_length, clamp_spec_k,
                     synthetic_next_token)
-from .allocator import KVBlockAllocator, KVCacheOOM, KVLease, PrefixTree
+from .allocator import (_ROOT as _TREE_ROOT, KVBlockAllocator,
+                        KVCacheOOM, KVLease, PrefixTree)
+from .tiering import HostKVTier, verify_block_tokens
 
 log = logging.getLogger(__name__)
 
@@ -140,7 +142,8 @@ class KVExecutorBase(Executor):
                  prefill_chunk: int = 8,
                  prefill_budget: Optional[int] = None,
                  prefix_cache: bool = True, pipelined: bool = True,
-                 spec: Optional[SpecConfig] = None):
+                 spec: Optional[SpecConfig] = None,
+                 host_tier_bytes: Optional[int] = None):
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
@@ -161,6 +164,14 @@ class KVExecutorBase(Executor):
                                           self.block_size)
         self.prefix: Optional[PrefixTree] = (
             PrefixTree(self.allocator) if prefix_cache else None)
+        # Host-RAM KV tier (ISSUE 17): opt-in via a byte budget. The
+        # tree's LRU leaf eviction becomes evict-to-tier, and attach
+        # extends a prefix hit past the HBM chain by restoring spilled
+        # blocks (chained-hash re-verified, see tiering.py).
+        self.tier: Optional[HostKVTier] = None
+        if host_tier_bytes is not None and self.prefix is not None:
+            self.tier = HostKVTier(host_tier_bytes)
+            self.prefix.spill_hook = self._spill_block
         self._exec_id = f"kvexec-{id(self):x}"
         self._slock = threading.RLock()
         self._states: List[Optional[_SlotState]] = [None] * self.slots
@@ -261,9 +272,17 @@ class KVExecutorBase(Executor):
             owner = req.request_id
             cached_blocks: List[int] = []
             cached = 0
+            cached_by_tier: dict = {}
             if self.prefix is not None:
                 cached_blocks, cached = self.prefix.match_and_fork(
-                    tokens, owner)
+                    tokens, owner, by_tier=cached_by_tier)
+                if self.tier is not None:
+                    # Continue the hit past the HBM-resident chain:
+                    # spilled blocks restore from the host tier
+                    # (re-verified) before prefill of the suffix.
+                    cached = self._extend_from_tier(
+                        tokens, owner, cached_blocks, cached,
+                        cached_by_tier)
             need_total = -(-(plen + req.max_tokens) // self.block_size)
             need = need_total - len(cached_blocks)
             try:
@@ -274,7 +293,7 @@ class KVExecutorBase(Executor):
                 raise
             lease = KVLease(self.allocator, self._exec_id, owner,
                             cached_blocks + fresh, tuple(tokens),
-                            cached)
+                            cached, cached_by_tier=cached_by_tier)
             req.kv_lease = lease
             self._states[slot] = _SlotState(
                 owner, lease, ctx=cached, prefill_pos=cached,
@@ -322,9 +341,131 @@ class KVExecutorBase(Executor):
         except KVCacheOOM:
             if self.prefix is None:
                 raise
-            self.prefix.evict(n - self.allocator.free_count())
+            # Under _slock BEFORE the tree lock: the evict-to-tier
+            # spill hook exports pool bytes (which takes _slock on the
+            # paged backend), and kv_attach already holds _slock when
+            # it matches — one lock order everywhere, no deadlock.
+            with self._slock:
+                self.prefix.evict(n - self.allocator.free_count())
             # graftlint: disable=GL009
             return self.allocator.acquire(n, owner)
+
+    # -- host tier (ISSUE 17) --------------------------------------------------
+
+    def _spill_block(self, parent_key: str, tokens, key: str,
+                     block: int) -> None:
+        """PrefixTree evict hook — runs UNDER the tree lock, before
+        the victim's cache ref is released, so a concurrent match
+        either forked the block live or finds it already parked. The
+        bytes move verbatim (the kv_export representation), so a
+        later restore is bit-identical to the block being dropped."""
+        faults.fire("kvtier.spill")
+        planes = self._tier_export_block(block, tokens)
+        self.tier.put(key, parent_key, tokens, planes)
+
+    def _extend_from_tier(self, tokens, owner: str,
+                          blocks: List[int], cached: int,
+                          by_tier: dict) -> int:
+        """Walk the prompt's chain past the HBM-matched depth and
+        restore each spilled block from the host tier: checkout under
+        an owner-tagged tier lease, re-verify the chained hash against
+        the tokens THIS request brought (GL019's discipline — a stale
+        or corrupted entry degrades to re-prefill, never wrong KV),
+        write the bytes into a freshly acquired HBM block, and publish
+        it through ``attach_restored`` under the tree lock. Appends
+        the restored blocks to `blocks` (owner refs held, same unwind
+        as the matched chain) and returns the new cached-token count."""
+        bs = self.block_size
+        limit = max(0, (len(tokens) - 1) // bs)
+        parent = _TREE_ROOT
+        for i in range(cached // bs):
+            parent = PrefixTree._key(
+                parent,
+                tuple(int(t) for t in tokens[i * bs:(i + 1) * bs]))
+        i = cached // bs
+        while i < limit:
+            chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            key = PrefixTree._key(parent, chunk)
+            entry = self.tier.checkout(key, owner)
+            if entry is None:
+                break
+            restored = corrupt = advanced = False
+            try:
+                try:
+                    faults.fire("kvtier.restore")
+                except Exception:
+                    # An injected restore fault degrades to prefilling
+                    # the suffix — the tier is an optimization, never
+                    # a failure domain.
+                    break
+                if not verify_block_tokens(parent, chunk, key,
+                                           entry.tokens):
+                    corrupt = True
+                    break
+                try:
+                    fresh = self._acquire_with_evict(1, owner)
+                except KVCacheOOM:
+                    break  # no room to restore into; prefill covers it
+                try:
+                    self._tier_import_block(fresh[0], entry.planes,
+                                            chunk)
+                except Exception:
+                    log.warning(
+                        "host tier: restored content diverges for "
+                        "block %s — dropping entry, re-prefilling",
+                        key[:12], extra={"request_id": owner})
+                    self.allocator.release(fresh, owner)
+                    corrupt = True
+                    break
+                blk, created = self.prefix.attach_restored(
+                    parent, chunk, fresh[0], owner, tier="host")
+                if not created:
+                    # Lost the publish race: the tree already serves
+                    # this chunk — use its block, drop our copy.
+                    self.allocator.release(fresh, owner)
+                blocks.append(blk)
+                cached += bs
+                tname = "host" if created else "hbm"
+                by_tier[tname] = by_tier.get(tname, 0) + bs
+                restored = created
+                advanced = True
+            finally:
+                self.tier.checkin(key, owner, restored=restored,
+                                  corrupt=corrupt)
+            if not advanced:
+                break
+            parent = key
+            i += 1
+        return cached
+
+    def kv_match_prefix(self, tokens, owner: str
+                        ) -> Tuple[List[int], int]:
+        """Fork the longest cached prefix of `tokens` — the HBM chain
+        plus host-tier restores — to `owner`, WITHOUT binding a slot:
+        the router pull's source-side primitive (ISSUE 17). The caller
+        owns releasing the forked refs (success and failure paths
+        both). Returns (blocks, cached_token_count)."""
+        if self.prefix is None:
+            return [], 0
+        with self._slock:
+            by_tier: dict = {}
+            blocks, cached = self.prefix.match_and_fork(
+                tokens, owner, by_tier=by_tier)
+            try:
+                if self.tier is not None:
+                    cached = self._extend_from_tier(
+                        tokens, owner, blocks, cached, by_tier)
+            except Exception:
+                self.allocator.release(blocks, owner)
+                raise
+            return blocks, cached
+
+    def _tier_export_block(self, block: int, tokens) -> list:
+        raise NotImplementedError
+
+    def _tier_import_block(self, block: int, planes: list,
+                           tokens) -> None:
+        raise NotImplementedError
 
     def kv_release_slot(self, slot: int, cache: bool = True) -> None:
         """Unbind `slot` and release its lease exactly once; when
@@ -769,6 +910,11 @@ class KVExecutorBase(Executor):
         if self.prefix is not None:
             out["prefix_hit_tokens"] = self.prefix.hit_tokens
             out["prefix_lookup_tokens"] = self.prefix.lookup_tokens
+            for tname, v in self.prefix.hit_tokens_by_tier.items():
+                out[f"prefix_hit_tokens_{tname}"] = v
+        if self.tier is not None:
+            for k, v in self.tier.stats().items():
+                out[f"tier_{k}"] = v
         if self.spec is not None:
             st = self.spec.stats
             out["spec_proposed_tokens"] = st.proposed
@@ -827,7 +973,8 @@ class PagedKVExecutor(KVExecutorBase):
                  kernel: Optional[str] = None,
                  pool_dtype: str = "int8",
                  interpret: Optional[bool] = None,
-                 spec_k: int = 4, draft=None):
+                 spec_k: int = 4, draft=None,
+                 host_tier_bytes: Optional[int] = None):
         if mode not in ("pipelined", "sync", "speculative"):
             raise ValueError(f"mode must be pipelined|sync|speculative"
                              f", got {mode!r}")
@@ -838,7 +985,8 @@ class PagedKVExecutor(KVExecutorBase):
                          prefill_chunk=prefill_chunk,
                          prefill_budget=prefill_budget,
                          prefix_cache=prefix_cache,
-                         pipelined=mode == "pipelined")
+                         pipelined=mode == "pipelined",
+                         host_tier_bytes=host_tier_bytes)
         from ..spec import TruncatedDraft
         from .paged import PagedDecodeStep
 
@@ -915,6 +1063,37 @@ class PagedKVExecutor(KVExecutorBase):
                 jnp.asarray(v, self._vpool.dtype))
             self._vscale = self._vscale.at[idx].set(jnp.asarray(vsc))
 
+    def _tier_export_block(self, block: int, tokens) -> list:
+        """Single-block HBM→host gather for the tier spill: the
+        resident int8 codes + scales move VERBATIM (no re-quantize),
+        so restore is byte-exact by construction. Same _slock
+        discipline as _export_pages."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(np.asarray([block], np.int32))
+        with self._slock:
+            k = np.asarray(self._kpool[idx])
+            ksc = np.asarray(self._kscale[idx])
+            v = np.asarray(self._vpool[idx])
+            vsc = np.asarray(self._vscale[idx])
+        return [(k, ksc), (v, vsc)]
+
+    def _tier_import_block(self, block: int, planes: list,
+                           tokens) -> None:
+        """Host→HBM scatter of one restored block (the _import_pages
+        .at[].set idiom — an in-flight step keeps its own buffers)."""
+        import jax.numpy as jnp
+
+        (k, ksc), (v, vsc) = planes
+        idx = jnp.asarray(np.asarray([block], np.int32))
+        with self._slock:
+            self._kpool = self._kpool.at[idx].set(
+                jnp.asarray(k, self._kpool.dtype))
+            self._kscale = self._kscale.at[idx].set(jnp.asarray(ksc))
+            self._vpool = self._vpool.at[idx].set(
+                jnp.asarray(v, self._vpool.dtype))
+            self._vscale = self._vscale.at[idx].set(jnp.asarray(vsc))
+
     def _dispatch(self, plan: _StepPlan):
         import jax.numpy as jnp
 
@@ -959,14 +1138,15 @@ class SyntheticKVExecutor(KVExecutorBase):
                  token_time_s: float = 0.0,
                  seed: int = 0, pipelined: bool = True,
                  fault_site: Optional[str] = None,
-                 spec: Optional[SpecConfig] = None):
+                 spec: Optional[SpecConfig] = None,
+                 host_tier_bytes: Optional[int] = None):
         super().__init__(slots, vocab=vocab, block_size=block_size,
                          num_blocks=num_blocks,
                          max_blocks_per_req=max_blocks_per_req,
                          prefill_chunk=prefill_chunk,
                          prefill_budget=prefill_budget,
                          prefix_cache=prefix_cache, pipelined=pipelined,
-                         spec=spec)
+                         spec=spec, host_tier_bytes=host_tier_bytes)
         self.step_time_s = float(step_time_s)
         # Per-PLANNED-TOKEN cost on top of the fixed floor: the knob
         # that makes prefill REAL in the cost model — a step co-running
@@ -1103,6 +1283,33 @@ class SyntheticKVExecutor(KVExecutorBase):
             raise ValueError(
                 f"transferred page content diverges for request "
                 f"{meta.get('req')} (transport corruption)")
+
+    def _chunk_content(self, tokens) -> np.ndarray:
+        """One cached prefix block's synthetic "KV": prefill position
+        p consumed prompt[p], and a prefix-tree block covers prompt
+        positions only — so the block's content IS its chunk's token
+        ids (the _page_content rule restricted to one block)."""
+        arr = np.zeros((1, self.block_size, 1, 1), np.float32)
+        vals = [float(t) for t in tokens]
+        arr.reshape(-1)[:len(vals)] = vals
+        return arr
+
+    def _tier_export_block(self, block: int, tokens) -> list:
+        content = self._chunk_content(tokens)
+        return [(content, np.ones((1,), np.float32))]
+
+    def _tier_import_block(self, block: int, planes: list,
+                           tokens) -> None:
+        """Verify, don't store (the _import_pages idiom): restored
+        content must equal the chunk the chain says this block holds —
+        a corrupted host payload surfaces HERE, and the caller
+        degrades to re-prefill."""
+        (payload, _scales), = planes
+        expect = self._chunk_content(tokens)
+        got = np.rint(np.asarray(payload, np.float32))
+        if not np.array_equal(got, np.rint(expect)):
+            raise ValueError(
+                "restored page content diverges (tier corruption)")
 
     def close(self) -> None:
         self._worker.close()

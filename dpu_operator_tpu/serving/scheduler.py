@@ -327,6 +327,8 @@ class ContinuousBatcher:
         request outcomes must never diverge (the token-stream
         equivalence contract)."""
         req.tokens.append(int(token))
+        if req.first_token_at is None:
+            req.first_token_at = now
         finished = len(req.tokens) >= req.max_tokens
         if not finished and now >= req.deadline:
             # Deadline mid-decode: return what exists, marked, at the
@@ -693,6 +695,8 @@ class ContinuousBatcher:
             # a run of length <= 1) — the hoisted idiom, literally.
             run = token_run(tokens[i])
             emitted = bool(run)
+            if emitted and req.first_token_at is None:
+                req.first_token_at = now
             finished = False
             for t in run:
                 req.tokens.append(t)

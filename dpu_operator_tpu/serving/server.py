@@ -155,6 +155,8 @@ class ServingServer:
             # Same discipline for the speculative-decoding counters
             # (present only on executors running mode="speculative").
             self._spec_pub: dict = {}
+            # Per-tier prefix-hit deltas (ISSUE 17): hbm/host/remote.
+            self._tier_pub: dict = {}
         dims = {ex.d for ex in executors}
         if len(dims) != 1:
             # prompt_vec width is validated once at the front door; a
@@ -435,6 +437,7 @@ class ServingServer:
             agg = {"used": 0, "free": 0, "shared": 0,
                    "hit": 0, "lookup": 0}
             deltas = {"prefill": 0, "decode": 0}
+            tier_deltas = {"hbm": 0, "host": 0, "remote": 0}
             spec_agg = {"proposed": 0, "accepted": 0, "runs": 0}
             spec_deltas = {"proposed": 0, "accepted": 0}
             spec_seen = False
@@ -458,6 +461,19 @@ class ServingServer:
                                     + rst[f"blocks_{state}"])
                     agg["hit"] += st["prefix_hit_tokens"]
                     agg["lookup"] += st["prefix_lookup_tokens"]
+                    # Per-tier hit split (ISSUE 17): counters as
+                    # deltas, like every executor-authoritative total.
+                    # Executors predating the split report the sum as
+                    # hbm — the only tier that existed.
+                    tlast = self._tier_pub.get(idx, (0, 0, 0))
+                    tcur = (st.get("prefix_hit_tokens_hbm",
+                                   st["prefix_hit_tokens"]),
+                            st.get("prefix_hit_tokens_host", 0),
+                            st.get("prefix_hit_tokens_remote", 0))
+                    for j, tname in enumerate(("hbm", "host",
+                                               "remote")):
+                        tier_deltas[tname] += tcur[j] - tlast[j]
+                    self._tier_pub[idx] = tcur
                     last = self._kv_pub.get(idx, (0, 0))
                     deltas["prefill"] += st["prefill_tokens"] - last[0]
                     deltas["decode"] += st["decode_tokens"] - last[1]
@@ -499,6 +515,20 @@ class ServingServer:
                 if agg["lookup"] else 0.0,
                 help="fraction of looked-up prompt tokens served from "
                      "the prefix cache")
+            for tname in ("hbm", "host", "remote"):
+                self.registry.counter_inc(
+                    "serving_prefix_hit_tokens_total",
+                    {"tier": tname},
+                    by=float(max(0, tier_deltas[tname])),
+                    help="prefix-cache hit tokens by the tier that "
+                         "served them (hbm resident, host-tier "
+                         "restore, cross-replica pull)")
+            self.registry.gauge_set(
+                "serving_prefix_hit_frac",
+                round(agg["hit"] / agg["lookup"], 6)
+                if agg["lookup"] else 0.0,
+                help="fraction of looked-up prompt tokens served from "
+                     "any prefix-cache tier (scrape-time, cumulative)")
             self.registry.counter_inc(
                 "serving_prefill_tokens_total", by=float(
                     max(0, deltas["prefill"])),
@@ -701,9 +731,13 @@ class ServingServer:
         if lease is not None:
             # How much prefill the prefix cache skipped — the client-
             # visible proof that sharing worked (bench section 8 keys
-            # on it).
+            # on it) — and WHERE the skip was served from (ISSUE 17:
+            # cached_tokens alone can't distinguish an HBM hit from a
+            # host-tier restore or a cross-replica pull).
             body_out["kv"] = {"cached_tokens": lease.cached_tokens,
-                              "blocks": len(lease.blocks)}
+                              "blocks": len(lease.blocks),
+                              "cached_by_tier": dict(
+                                  lease.cached_by_tier)}
         self._finish(handler, 200, body_out, "ok", elapsed_s=elapsed,
                      req=req)
 

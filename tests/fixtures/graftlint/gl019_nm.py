@@ -1,0 +1,37 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl019_nm.py
+"""GL019 near-misses that must stay silent: the same publishes with
+the chained-hash verify present, the plain local-prefill insert
+(tokens ARE the ground truth the executor just consumed), and tier
+checkout/put traffic that never touches the tree."""
+
+from .tiering import verify_block_tokens
+
+
+class Restorer:
+    def restore_chain(self, key, owner):
+        # NM 1: the blessed path — chain recomputed before publish.
+        entry = self.tier.checkout(key, owner)
+        if not verify_block_tokens(entry.parent, entry.tokens, key,
+                                   entry.tokens):
+            self.tier.checkin(key, owner, corrupt=True)
+            return None
+        blk, created = self.prefix.attach_restored(
+            entry.parent, entry.tokens, self._scatter(entry), owner)
+        self.tier.checkin(key, owner, restored=created)
+        return blk
+
+    def accept_pull(self, meta, blocks):
+        # NM 2: remote publish behind the same verify helper.
+        for parent, chunk, key in self._chain(meta):
+            if not verify_block_tokens(parent, chunk, key):
+                raise ValueError("pull chain mismatch")
+        self.prefix.insert(meta["tokens"], blocks, origin="remote")
+
+    def publish_prefill(self, lease, full, bs):
+        # NM 3: plain two-argument insert — local prefill, the tokens
+        # are ground truth; no foreign bytes involved.
+        self.prefix.insert(lease.prompt[:full], lease.blocks[:full // bs])
+
+    def spill(self, parent, tokens, key, block):
+        # NM 4: tier put/checkout traffic with no tree publish at all.
+        self.tier.put(key, parent, tokens, self._gather(block))

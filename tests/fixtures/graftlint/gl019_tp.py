@@ -1,0 +1,23 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl019_tp.py
+"""GL019 true positives: foreign bytes published into the prefix tree
+with no chained-hash re-verification anywhere in the function. Two
+findings: a host-tier restore that attaches the entry straight into
+the tree, and a remote pull that inserts with an origin tag on a
+peer's unchecked claim."""
+
+
+class Restorer:
+    def restore_chain(self, key, owner):
+        # TP 1: tier bytes re-enter the tree without recomputing the
+        # chain — a rotted entry now serves on every prefix hit.
+        entry = self.tier.checkout(key, owner)
+        blk, created = self.prefix.attach_restored(
+            entry.parent, entry.tokens, self._scatter(entry), owner)
+        self.tier.checkin(key, owner, restored=created)
+        return blk
+
+    def accept_pull(self, meta, blocks):
+        # TP 2: origin= is exactly the marker that these blocks did
+        # NOT come from local prefill — publishing on the peer's
+        # say-so alone mis-keys the whole chain if the peer is wrong.
+        self.prefix.insert(meta["tokens"], blocks, origin="remote")

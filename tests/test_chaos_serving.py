@@ -1151,3 +1151,173 @@ def test_vsp_ping_fault_seam():
         plan.inject("vsp.ping", corrupt=unhealthy, at_calls=[3])
         assert not vsp.Ping(None, None).healthy
         assert vsp.Ping(None, None).healthy
+
+
+# -- the cluster prefix cache (ISSUE 17) ---------------------------------------
+
+
+def _kv_req(prompt, max_tokens=5):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + 60.0,
+                           prompt_tokens=list(prompt))
+
+
+def _drive_kv(ex, queue, req, timeout=20.0):
+    from dpu_operator_tpu.serving import ContinuousBatcher
+
+    b = ContinuousBatcher(ex, queue)
+    b.start()
+    try:
+        assert req.wait(timeout=timeout), "request lost"
+    finally:
+        b.stop()
+    assert req.error is None, req.error
+    return list(req.tokens)
+
+
+def test_kvtier_restore_fault_and_corruption_degrade_to_reprefill(
+        settle_counts):
+    """Tier chaos: an injected restore fault AND a corrupted host
+    entry (caught by the chained-hash re-verification) both degrade to
+    re-prefilling the SAME byte-identical stream — the tier is an
+    optimization, never a failure domain — with both leak ledgers
+    clean after every round."""
+    from dpu_operator_tpu.serving import SyntheticKVExecutor
+    from dpu_operator_tpu.serving.kvcache import PrefixTree
+    from dpu_operator_tpu.serving.kvcache.allocator import _ROOT
+
+    t_start = time.monotonic()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    ex = SyntheticKVExecutor(slots=2, vocab=32, block_size=4,
+                             num_blocks=32, host_tier_bytes=1 << 20)
+
+    def drive():
+        q = AdmissionQueue(max_depth=2)
+        r = _kv_req(prompt)
+        q.submit(r)
+        return _drive_kv(ex, q, r)
+
+    try:
+        baseline = drive()
+
+        # Round 1: the restore path itself fails (host RAM read
+        # error, a dying tier) — prefill covers the whole prompt.
+        ex.prefix.evict(99)
+        with faults.injected() as plan:
+            plan.inject("kvtier.restore", exc=FaultError("tier dead"),
+                        at_calls=[1])
+            assert drive() == baseline
+        assert ex.kv_stats()["prefix_hit_tokens_host"] == 0
+
+        # Round 2: the tier answers, but its entry rotted — the
+        # chained-hash re-verification refuses it BEFORE any bytes
+        # are published, drops the entry, and prefill covers it.
+        ex.prefix.evict(99)
+        first_key = PrefixTree._key(_ROOT, tuple(prompt[:4]))
+        entry = ex.tier._entries[first_key]
+        entry.tokens = tuple(t + 1 for t in entry.tokens)
+        assert drive() == baseline
+        assert ex.kv_stats()["tier_corrupt_blocks"] >= 1
+        assert first_key not in ex.tier.keys()
+
+        ex.prefix.flush()
+        ex.allocator.assert_clean()
+        ex.tier.assert_clean()
+        assert set(settle_counts.values()) == {1}, settle_counts
+        assert time.monotonic() - t_start < CASE_BUDGET_S
+    finally:
+        ex.close()
+
+
+def test_router_pull_cut_midstream_falls_back_to_local_prefill(
+        settle_counts, tmp_path):
+    """Router chaos: the cross-replica prefix pull is cut mid-stream
+    (injected socket death between segments). The request must
+    complete on the chosen replica by LOCAL prefill with the exact
+    stream an unrouted run produces, both replicas' allocator AND
+    tier ledgers stay clean, and one flight-recorder timeline carries
+    the whole story: router decision -> failed pull -> the replica's
+    queue leg."""
+    from dpu_operator_tpu.serving import SyntheticKVExecutor
+    from dpu_operator_tpu.serving.router import (PrefixRouter,
+                                                 RouterReplica)
+
+    t_start = time.monotonic()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+
+    def mk(name):
+        ex = SyntheticKVExecutor(slots=2, vocab=32, block_size=4,
+                                 num_blocks=32,
+                                 host_tier_bytes=1 << 20)
+        return RouterReplica(name, AdmissionQueue(max_depth=64), ex)
+
+    reg = Registry()
+    with obs_trace.scoped() as tr:
+        # Inside the scoped tracer: the queues capture it at
+        # construction, which is what stitches the replicas' queue
+        # legs into the same timeline as the router's events.
+        a, b = mk("a"), mk("b")
+        recorder = FlightRecorder(tracer=tr,
+                                  flight_dir=str(tmp_path))
+        router = PrefixRouter([a, b], cadence_s=0.0, max_load_skew=2,
+                              registry=reg, tracer=tr)
+        try:
+            r1 = _kv_req(prompt)
+            assert router.submit(r1) is a
+            baseline = _drive_kv(a.executor, a.queue, r1)
+
+            # Swamp a past the skew so the router places on b and
+            # tries to move the prefix there.
+            for _ in range(5):
+                a.queue.submit(_kv_req(prompt))
+
+            with faults.injected() as plan:
+                plan.inject("kvstream.send",
+                            exc=OSError("mid-stream cut"),
+                            at_calls=[1])
+                r2 = _kv_req(prompt)
+                chosen = router.submit(r2)
+            assert chosen is b
+            assert reg.counter_value(
+                "serving_router_pull_failed_total") == 1
+            assert _drive_kv(b.executor, b.queue, r2) == baseline
+            # Nothing remote was published: the pull died, prefill
+            # covered the whole prompt.
+            st = b.executor.kv_stats()
+            assert st["prefix_hit_tokens_remote"] == 0
+
+            for rep in (a, b):
+                rep.executor.prefix.flush()
+                rep.executor.allocator.assert_clean()
+                rep.executor.tier.assert_clean()
+            recorder.snapshot("router-pull-cut",
+                              extra={"request_id": r2.request_id})
+        finally:
+            router.close()
+            a.executor.close()
+            b.executor.close()
+        spans = tr.spans_snapshot()
+
+    # One timeline, three legs, one request id.
+    mine = [s for s in spans if s.request_id == r2.request_id]
+    route = [s for s in mine if s.name == "router.route"]
+    pull = [s for s in mine if s.name == "router.pull"]
+    queued = [s for s in mine if s.name.startswith("queue.")]
+    assert route and route[0].attrs["outcome"] == "load"
+    assert pull and pull[0].attrs["outcome"] == "failed"
+    assert "mid-stream cut" in pull[0].attrs.get("error", "")
+    assert queued, "the replica's queue leg is missing"
+    # The pull resolves INSIDE the routing decision (route's event is
+    # the decision record, emitted after); both precede the queue leg.
+    assert pull[0].t0 <= route[0].t0 <= min(s.t0 for s in queued)
+
+    # The same timeline persisted as a flight document.
+    files = sorted(tmp_path.glob("flight-router-pull-cut-*.json"))
+    assert files, sorted(p.name for p in tmp_path.iterdir())
+    doc = json.loads(files[0].read_text())
+    names = {s["name"] for s in doc["spans"]
+             if s.get("request_id") == r2.request_id}
+    assert {"router.route", "router.pull"} <= names
+
+    assert set(settle_counts.values()) == {1}, settle_counts
+    assert time.monotonic() - t_start < CASE_BUDGET_S

@@ -1,0 +1,286 @@
+"""Prefix-aware router (ISSUE 17): content-addressed chain keys,
+gossip staleness, contiguous-prefix scoring, affinity vs load-skew
+placement, the cross-replica KV pull (hello-checked both ends,
+chained-hash re-verified on import), and every fallback's ledger
+hygiene — a failed or refused pull must leave BOTH replicas' allocator
+and tier ledgers clean and degrade to local prefill of the same
+stream."""
+
+import time
+
+import pytest
+
+from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
+                                      GenerateRequest,
+                                      SyntheticKVExecutor)
+from dpu_operator_tpu.serving.kvcache import CACHE_OWNER, PrefixTree
+from dpu_operator_tpu.serving.kvcache.allocator import _ROOT
+from dpu_operator_tpu.serving.router import (GossipBoard, PrefixRouter,
+                                             ReplicaGossip,
+                                             RouterReplica, chain_keys)
+from dpu_operator_tpu.utils.metrics import Registry
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 3 blocks at bs=4
+
+
+def _req(prompt=PROMPT, max_tokens=5, deadline_s=60.0):
+    return GenerateRequest(prompt_vec=None, max_tokens=max_tokens,
+                           deadline=time.monotonic() + deadline_s,
+                           prompt_tokens=list(prompt))
+
+
+def _replica(name, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("vocab", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("host_tier_bytes", 1 << 20)
+    ex = SyntheticKVExecutor(**kw)
+    return RouterReplica(name, AdmissionQueue(max_depth=64), ex)
+
+
+def _run_on(rep, reqs, timeout=30.0):
+    b = ContinuousBatcher(rep.executor, rep.queue)
+    b.start()
+    try:
+        for r in reqs:
+            assert r.wait(timeout=timeout), "request lost"
+    finally:
+        b.stop()
+    for r in reqs:
+        assert r.error is None, r.error
+    return [list(r.tokens) for r in reqs]
+
+
+def _assert_clean(rep):
+    ex = rep.executor
+    ex.prefix.flush()
+    ex.allocator.assert_clean()
+    if ex.tier is not None:
+        ex.tier.assert_clean()
+
+
+# -- chain keys and gossip ---------------------------------------------------
+
+
+def test_chain_keys_match_the_prefix_tree_chain():
+    keys = chain_keys(PROMPT, 4)
+    # (len - 1) // bs: the last prompt token always recomputes.
+    assert len(keys) == 2
+    parent = _ROOT
+    for i, key in enumerate(keys):
+        chunk = tuple(PROMPT[i * 4:(i + 1) * 4])
+        parent = PrefixTree._key(parent, chunk)
+        assert key == parent
+    assert chain_keys(PROMPT[:4], 4) == []  # no FULL cacheable block
+
+
+def test_gossip_staleness_reads_as_empty():
+    board = GossipBoard()
+    board.publish("a", {"k1": "hbm"}, now=100.0)
+    board.publish("b", {"k2": "host"}, now=104.0)
+    view = board.snapshot(max_age_s=5.0, now=106.0)
+    assert view["a"] == {}            # 6s old: stale, reads empty
+    assert view["b"] == {"k2": "host"}
+    # No age filter: everything reads.
+    assert board.snapshot()["a"] == {"k1": "hbm"}
+
+
+def test_replica_gossip_collects_hbm_over_host_and_rate_limits():
+    rep = _replica("a")
+    try:
+        _run_on(rep, [rep.queue.submit(r) or r for r in [_req()]])
+        board = GossipBoard()
+        g = ReplicaGossip(board, "a", [rep.executor], cadence_s=30.0)
+        assert g.maybe_publish()
+        keymap = board.snapshot()["a"]
+        assert set(keymap.values()) == {"hbm"}
+        assert len(keymap) == 3
+        # Cadence: a second publish inside the window is a no-op...
+        assert not g.maybe_publish()
+        # ...unless forced (the router's route-time refresh path).
+        rep.executor.prefix.evict(99)
+        assert g.maybe_publish(force=True)
+        assert set(board.snapshot()["a"].values()) == {"host"}
+        _assert_clean(rep)
+    finally:
+        rep.close()
+        rep.executor.close()
+
+
+# -- construction contracts --------------------------------------------------
+
+
+def test_router_refuses_mixed_block_sizes_and_bad_policy():
+    a, b = _replica("a"), _replica("b", block_size=8)
+    try:
+        with pytest.raises(ValueError, match="block_size"):
+            PrefixRouter([a, b])
+        with pytest.raises(ValueError, match="policy"):
+            PrefixRouter([a], policy="sticky")
+        with pytest.raises(ValueError, match="at least one"):
+            PrefixRouter([])
+    finally:
+        for r in (a, b):
+            r.close()
+            r.executor.close()
+
+
+# -- scoring and placement ---------------------------------------------------
+
+
+def test_scores_require_contiguous_chain_from_root():
+    a, b = _replica("a"), _replica("b")
+    router = PrefixRouter([a, b], cadence_s=0.0)
+    try:
+        keys = chain_keys(PROMPT, 4)
+        router.board.publish("a", {k: "hbm" for k in keys})
+        # An island past a gap is unreachable by the restore walk.
+        router.board.publish("b", {keys[1]: "hbm"})
+        scored = router.scores(PROMPT)
+        assert scored == {"a": 8, "b": 0}
+    finally:
+        router.close()
+        for r in (a, b):
+            r.executor.close()
+
+
+def test_affinity_routes_to_the_replica_holding_the_prefix():
+    a, b = _replica("a"), _replica("b")
+    reg = Registry()
+    router = PrefixRouter([a, b], cadence_s=0.0, registry=reg)
+    try:
+        r1 = _req()
+        chosen = router.submit(r1)
+        first = _run_on(chosen, [r1])[0]
+
+        r2 = _req()
+        chosen2 = router.submit(r2)
+        assert chosen2 is chosen      # the prefix pins the request
+        again = _run_on(chosen2, [r2])[0]
+        assert again == first
+        assert chosen2.executor.kv_stats()["prefix_hit_tokens_hbm"] == 8
+        assert reg.counter_value("serving_router_routed_total",
+                                 {"outcome": "affinity"}) == 1
+        for rep in (a, b):
+            _assert_clean(rep)
+    finally:
+        router.close()
+        for r in (a, b):
+            r.executor.close()
+
+
+def test_round_robin_policy_alternates_and_never_pulls():
+    a, b = _replica("a"), _replica("b")
+    reg = Registry()
+    router = PrefixRouter([a, b], policy="round_robin", registry=reg)
+    try:
+        picks = [router.route(_req()).name for _ in range(4)]
+        assert picks == ["a", "b", "a", "b"]
+        assert reg.counter_value("serving_router_routed_total",
+                                 {"outcome": "rr"}) == 4
+    finally:
+        router.close()
+        for r in (a, b):
+            r.executor.close()
+
+
+# -- the cross-replica pull --------------------------------------------------
+
+
+def test_load_skew_pulls_prefix_to_the_cold_replica():
+    """The affinity-miss pull end to end: the owner is swamped, the
+    request lands on the least-loaded replica, and the prefix blocks
+    arrive there over KVPageStream before prefill — first serve is
+    credited to the REMOTE tier and the stream is identical."""
+    a, b = _replica("a"), _replica("b")
+    reg = Registry()
+    router = PrefixRouter([a, b], cadence_s=0.0, max_load_skew=2,
+                          registry=reg)
+    try:
+        r1 = _req()
+        assert router.submit(r1) is a  # cold: ties break to a
+        first = _run_on(a, [r1])[0]
+
+        # Swamp a's queue past the skew (never driven — pure load).
+        for _ in range(5):
+            a.queue.submit(_req())
+
+        r2 = _req()
+        chosen = router.submit(r2)
+        assert chosen is b
+        assert reg.counter_value("serving_router_routed_total",
+                                 {"outcome": "load"}) == 1
+        assert reg.counter_value(
+            "serving_router_pulled_blocks_total") == 2
+        again = _run_on(b, [r2])[0]
+        assert again == first
+        st = b.executor.kv_stats()
+        assert st["prefix_hit_tokens_remote"] == 8
+        for rep in (a, b):
+            _assert_clean(rep)
+    finally:
+        router.close()
+        for r in (a, b):
+            r.executor.close()
+
+
+def test_pull_refused_on_kv_spec_mismatch_falls_back_to_prefill():
+    """KVSpec hello-checks both ends: replicas with different model
+    geometry refuse the stream at hello, the pull counts as failed,
+    and the request still completes by local prefill — both ledgers
+    clean."""
+    a = _replica("a")
+    # Same model (identical streams), different pool layout: the spec
+    # fingerprint disagrees on max_blocks_per_req, so the hello must
+    # refuse the stream before any payload moves.
+    b = _replica("b", max_blocks_per_req=8)
+    reg = Registry()
+    router = PrefixRouter([a, b], cadence_s=0.0, max_load_skew=2,
+                          registry=reg)
+    try:
+        r1 = _req()
+        assert router.submit(r1) is a
+        first = _run_on(a, [r1])[0]
+        for _ in range(5):
+            a.queue.submit(_req())
+
+        r2 = _req()
+        chosen = router.submit(r2)
+        assert chosen is b            # placement still by load
+        assert reg.counter_value(
+            "serving_router_pull_failed_total") == 1
+        again = _run_on(b, [r2])[0]
+        assert again == first         # deterministic either way
+        assert b.executor.kv_stats()["prefix_hit_tokens_remote"] == 0
+        for rep in (a, b):
+            _assert_clean(rep)
+    finally:
+        router.close()
+        for r in (a, b):
+            r.executor.close()
+
+
+def test_pull_import_rejects_lying_chain_keys():
+    """The import side re-derives every claimed chain key from the
+    shipped token ids (GL019): a sender whose keys do not match its
+    tokens is refused before any block is acquired."""
+    a = _replica("a")
+    try:
+        keys = chain_keys(PROMPT, 4)
+        meta = {"prefix_pull": True, "req": "x", "xfer": "x",
+                "tokens": 8, "n_blocks": 2,
+                "prompt_tokens": PROMPT[:8], "settled": [],
+                "max_tokens": 0, "keys": [keys[0], "forged"]}
+        with pytest.raises(ValueError, match="re-verification"):
+            a._pull_import(meta, [])
+        meta["keys"] = keys            # right keys, wrong geometry
+        meta["n_blocks"] = 3
+        with pytest.raises(ValueError, match="geometry"):
+            a._pull_import(meta, [])
+        assert not a._pull_import.__self__.executor.allocator.leaked(
+            ignore=(CACHE_OWNER,))
+        _assert_clean(a)
+    finally:
+        a.close()
+        a.executor.close()
