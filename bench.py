@@ -801,6 +801,14 @@ def bench_serving() -> dict:
             f"tok/s/slot = {out.get('serving_spec_speedup')}x (accept "
             f"rate {out.get('serving_spec_accept_rate')}, "
             f"{out.get('serving_spec_tokens_per_step')} tok/step); "
+            f"pipelined-spec {out.get('serving_pspec_tokens_per_s')} vs "
+            f"sync {out.get('serving_pspec_sync_tokens_per_s')} accepted "
+            f"tok/s/slot = {out.get('serving_pspec_speedup')}x "
+            f"({out.get('serving_pspec_speedup_vs_onetok')}x vs "
+            f"one-token, accept {out.get('serving_pspec_accept_rate')}, "
+            f"replan rate {out.get('serving_pspec_replan_rate')}, step "
+            f"{out.get('serving_pspec_step_ms')} vs "
+            f"{out.get('serving_pspec_sync_step_ms')} ms); "
             f"cluster-prefix hit {out.get('serving_prefix_hit_frac')} "
             f"vs rr {out.get('serving_prefix_hit_frac_rr')} = "
             f"{out.get('serving_prefix_route_uplift_x')}x uplift, ttft "
@@ -909,6 +917,16 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
     spx = metrics.get("serving_spec_speedup")
     if spx is not None:
         gates["serving_spec_speedup_ge_15"] = bool(spx >= 1.5)
+    # Pipelined + tree speculation (ISSUE 18), ABSOLUTE: the
+    # acceptance criterion itself — the pipelined plan-ahead loop's
+    # accepted tokens/s/slot must beat the PR 15 sync-spec loop
+    # >= 1.25x on the SAME priced-draft cost model. Deterministic
+    # sleep-based floors again: a miss means the overlap stopped
+    # hiding the draft or stale plan-ahead windows got out of hand
+    # (replan-rate regression), never box weather.
+    pspx = metrics.get("serving_pspec_speedup")
+    if pspx is not None:
+        gates["serving_pspec_speedup_ge_125"] = bool(pspx >= 1.25)
     # Context-parallel paged KV (ISSUE 16), ABSOLUTE: the acceptance
     # criterion itself — resident context per replica at world 2 must
     # be >= 1.7x the single-worker figure. Pure KVSpec arithmetic from
@@ -1017,6 +1035,15 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # the absolute speedup gate still clears.
         ("serving_spec_tokens_per_s", 0.85,
          "serving_spec_tokens_ge_085_median"),
+        # Pipelined speculation (ISSUE 18): the pipelined-spec arm's
+        # accepted tokens/s/slot holds 0.85x its rolling median — a
+        # regression in the plan-ahead overlap (draft leaking back
+        # onto the device critical path), the watermark rollback, or
+        # the stale-window accounting lands here even while the
+        # absolute >= 1.25x over-sync gate still clears because both
+        # arms slowed together.
+        ("serving_pspec_tokens_per_s", 0.85,
+         "serving_pspec_tokens_ge_085_median"),
         # Context-parallel paged KV (ISSUE 16): world-2 sharded decode
         # tokens/s holds 0.85x its rolling median — a regression in
         # the coordinator hand-off, the per-rank step, or the partial
@@ -1151,6 +1178,16 @@ def main() -> int:
         "serving_spec_tokens_per_step": "tok/step",
         "serving_spec_step_ms": "ms",
         "serving_spec_baseline_step_ms": "ms",
+        "serving_pspec_tokens_per_s": "tok/s/slot",
+        "serving_pspec_sync_tokens_per_s": "tok/s/slot",
+        "serving_pspec_onetok_tokens_per_s": "tok/s/slot",
+        "serving_pspec_speedup": "x",
+        "serving_pspec_speedup_vs_onetok": "x",
+        "serving_pspec_accept_rate": "frac",
+        "serving_pspec_replan_rate": "replans/run",
+        "serving_pspec_step_ms": "ms",
+        "serving_pspec_sync_step_ms": "ms",
+        "serving_pspec_onetok_step_ms": "ms",
         "serving_ctx_per_replica_scaling": "x",
         "serving_ctx_per_replica_scaling_w4": "x",
         "serving_shard_kv_tokens_per_s": "tok/s",

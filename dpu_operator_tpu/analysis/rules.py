@@ -40,6 +40,10 @@ GL018  per-rank KV geometry computed inline instead of derived from
 GL019  prefix-tree publish from a tier restore or remote pull with no
        chained-hash re-verification in the same function
        (serving/kvcache/ + serving/router/)
+GL020  read of the provisionally-advanced plan cursor (slot-state
+       ``ctx``, which runs past the confirmed watermark between plan
+       and collect) outside the rollback-aware sites
+       (serving/kvcache/ + serving/spec.py)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1784,6 +1788,88 @@ class UnverifiedPrefixPublish(Rule):
                     f"prefix they claim to encode")
 
 
+# --------------------------------------------------------------------------
+# GL020 — provisional plan-cursor read outside a rollback-aware site
+
+
+class ProvisionalCursorRead(Rule):
+    """Origin: ISSUE 18's pipelined speculation. The slot-state plan
+    cursor ``ctx`` is PROVISIONAL: ``_plan_step`` advances it the
+    moment a window is planned — k+1 draft rows, or a whole
+    plan-ahead window drafted from the previous window's unverified
+    proposals — and only collect's owner-guarded acceptance decides
+    how much of that advance survives (mis-speculation truncates it
+    back to the confirmed watermark). Between plan and collect,
+    ``st.ctx`` therefore names positions whose KV may be REJECTED
+    bytes. Any consumer that treats it as 'tokens that exist' —
+    sizing a cache insert, exporting pages, reporting progress,
+    deciding completion — resurrects the bug class speculation
+    almost shipped: publishing unverified KV through an honest-
+    looking cursor. The durable truth is ``confirmed`` (the
+    watermark); ``ctx`` is plan-plumbing.
+
+    The mechanical contract: in serving/kvcache/ and serving/spec.py,
+    an attribute READ of ``ctx`` may appear only in rollback-aware
+    sites — functions whose name contains ``plan`` (the advance's
+    owner) or ``collect`` (the rollback's owner), ``__init__`` /
+    ``_reattach`` / ``kv_attach`` (construction and the settled-token
+    rebuild), or a function that ALSO reads ``confirmed`` (consulting
+    the watermark is exactly what makes a ctx read rollback-aware).
+
+    Near-misses that stay silent: reads of a STEP PLAN's frozen
+    ``ctx`` snapshot (receiver named ``plan`` — dispatch geometry,
+    immutable after planning), ctx reads next to a ``confirmed``
+    read, the plan/collect/reattach sites themselves, locals that
+    merely share the name, and identical code outside the two scoped
+    locations."""
+
+    rule_id = "GL020"
+    severity = SEVERITY_ERROR
+    title = "provisional plan-cursor read outside a rollback-aware site"
+    hint = ("slot-state ctx runs PAST the confirmed watermark between "
+            "plan and collect (speculative windows, pipelined "
+            "plan-ahead) — read `confirmed` for anything that must "
+            "mean 'tokens that exist', or do the ctx read inside the "
+            "plan/collect/_reattach sites that own the rollback")
+
+    _ALLOWED_LEAVES = {"__init__", "_reattach", "kv_attach"}
+
+    @classmethod
+    def _allowed(cls, qual: str) -> bool:
+        leaf = qual.rsplit(".", 1)[-1]
+        return (leaf in cls._ALLOWED_LEAVES or "plan" in leaf
+                or "collect" in leaf)
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not (module.in_dir("kvcache")
+                or module.relpath.endswith("serving/spec.py")):
+            return
+        for fn, qual in module.functions:
+            if self._allowed(qual):
+                continue
+            reads = []
+            watermark_aware = False
+            for n in _walk_through_lambdas(fn):
+                if not (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Load)):
+                    continue
+                if n.attr == "confirmed":
+                    watermark_aware = True
+                elif (n.attr == "ctx"
+                        and _terminal_name(n.value) != "plan"):
+                    reads.append(n)
+            if watermark_aware:
+                continue
+            for n in reads:
+                yield self.finding(
+                    module, n,
+                    f"'{ast.unparse(n)}' read in '{qual}' — the slot "
+                    f"ctx cursor is provisionally advanced at plan "
+                    f"time and may name rejected speculative KV "
+                    f"until collect settles it; rollback-unaware "
+                    f"consumers must read the confirmed watermark")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1797,4 +1883,4 @@ def default_rules() -> List[Rule]:
             LockOrderInversion(), WallClockDurationMath(),
             Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
             PlanTimeCollectStateWrite(), InlineShardKVGeometry(),
-            UnverifiedPrefixPublish()]
+            UnverifiedPrefixPublish(), ProvisionalCursorRead()]

@@ -50,6 +50,19 @@ projects ALL C rows to logits/argmax after the kernel; this kernel
 needed no change for speculation beyond honoring that contract, and
 rows past ``n_new`` are garbage the collect path never reads.
 
+The mask contract is strictly PER-ROW CAUSAL: row ``j`` attends
+``<= ctx + j``, monotone in ``j``. TREE speculation (ISSUE 18) needs
+more — sibling rows that share one position (``ctx + j`` for several
+rows) while attending the prefix but NOT each other, i.e. a
+non-monotone tree-causal mask — and this kernel cannot express it:
+the online-softmax accumulator normalizes in-kernel per row, so
+in-window partial results for rows outside a row's mask cannot be
+merged after the fact. Tree-armed executors therefore route every
+step through the XLA composition (one executable for the whole
+stream keeps reduction shapes, and thus argmax ties, deterministic);
+``kernel="pallas"`` stays available for chain-only speculation,
+where per-row causal is exactly the verify window's mask.
+
 Off-TPU the same kernel runs under the Pallas interpreter
 (``interpret=True``), which is how tier-1 proves Pallas-vs-XLA
 equivalence on CPU (tests/test_paged_attn.py); on a TPU backend it

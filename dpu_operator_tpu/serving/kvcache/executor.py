@@ -57,8 +57,8 @@ from ..executor import Executor, _GuardedWorker
 # NO_TOKEN re-exported here for back-compat: the sentinel and the
 # emit-masking idiom live in serving/spec.py (ISSUE 15 cleanup) so the
 # one-token and speculative collect paths share one definition.
-from ..spec import (NO_TOKEN, SpecConfig, accept_length, clamp_spec_k,
-                    synthetic_next_token)
+from ..spec import (NO_TOKEN, SpecConfig, accept_tree, clamp_spec_k,
+                    propose_full, synthetic_next_token)
 from .allocator import (_ROOT as _TREE_ROOT, KVBlockAllocator,
                         KVCacheOOM, KVLease, PrefixTree)
 from .tiering import HostKVTier, verify_block_tokens
@@ -69,7 +69,8 @@ log = logging.getLogger(__name__)
 class _SlotState:
     __slots__ = ("req_id", "lease", "ctx", "prefill_pos", "last_token",
                  "chain_device", "pending_emit", "confirmed",
-                 "max_total")
+                 "max_total", "spec_ahead", "spec_epoch", "spec_ewma",
+                 "repair")
 
     def __init__(self, req_id: str, lease: KVLease, ctx: int,
                  prefill_pos: int, last_token: Optional[int],
@@ -81,6 +82,27 @@ class _SlotState:
         self.last_token = last_token
         self.chain_device = False
         self.pending_emit = False
+        # Pipelined speculation (ISSUE 18): the draft's own prediction
+        # of the in-flight verify window's BONUS token — the seed for
+        # planning window w+1 before window w collects. The true bonus
+        # chains on DEVICE (the window's base row is use_host=False);
+        # this host-side prediction only feeds the draft.
+        self.spec_ahead: Optional[int] = None
+        # Plan-ahead validity epoch: bumped by every rollback at
+        # collect, recorded into each spec plan — a collected plan
+        # whose epoch is stale was drafted from a provisional ctx a
+        # rollback revoked, and settles NOTHING (a pure re-plan).
+        self.spec_epoch = 0
+        # Per-slot accept-rate EWMA, the adaptive draft-depth dial
+        # (SpecConfig.k_for/width_for). Starts optimistic: a fresh
+        # slot drafts at full depth until the target disagrees.
+        self.spec_ewma = 1.0
+        # Tree speculation: accepted tokens whose KV row was NOT
+        # appended (a sibling path won — the trunk's append at that
+        # position holds the rejected trunk token). The next window
+        # re-feeds them as leading repair rows, closing the hole
+        # before any later query can attend it.
+        self.repair: List[int] = []
         # Positions whose KV writes a COLLECTED step has confirmed on
         # device. ctx advances at plan time — one step ahead in the
         # pipelined loop, and a full speculative window ahead in
@@ -100,10 +122,13 @@ class _SlotState:
 class _StepPlan:
     __slots__ = ("gen", "step_no", "host_tok", "use_host", "ctx",
                  "n_new", "tables", "emit", "owners", "spec_k",
-                 "stale")
+                 "stale", "spec_off", "spec_w", "spec_epoch", "n_app",
+                 "roff", "plim", "win")
 
     def __init__(self, gen, step_no, host_tok, use_host, ctx, n_new,
-                 tables, emit, owners=None, spec_k=None, stale=False):
+                 tables, emit, owners=None, spec_k=None, stale=False,
+                 spec_off=None, spec_w=None, spec_epoch=None,
+                 n_app=None, roff=None, plim=None, win=None):
         self.gen = gen
         self.step_no = step_no
         self.host_tok = host_tok
@@ -118,10 +143,34 @@ class _StepPlan:
         self.owners = owners
         # Speculative plans only: per-slot drafted-token count (>= 0
         # marks a verify slot; the drafts themselves are
-        # host_tok[s, 1:1+spec_k[s]], so collect can re-derive the
-        # acceptance comparison from the plan alone).
+        # host_tok[s, spec_off[s]+1 : spec_off[s]+1+spec_k[s]], so
+        # collect can re-derive the acceptance comparison from the
+        # plan alone).
         self.spec_k = spec_k
         self.stale = stale
+        # Tree/pipelined speculation (ISSUE 18). Window row layout per
+        # verify slot: [repair rows (spec_off), base row, trunk rows
+        # (spec_k), sibling rows (spec_w)] — the first n_app rows
+        # APPEND KV at positions ctx..ctx+n_app-1; sibling rows score
+        # only. spec_epoch snapshots the slot's rollback epoch at plan
+        # time (stale epoch at collect = invalidated plan-ahead).
+        self.spec_off = spec_off
+        self.spec_w = spec_w
+        self.spec_epoch = spec_epoch
+        self.n_app = n_app
+        # Tree-step geometry (None unless tree_width > 1): per-row
+        # position offset (pos = ctx + roff — siblings share the first
+        # trunk position), per-row POOL attention limit (tpos < plim:
+        # appended rows include their own scattered position,
+        # score-only rows stop at their deepest appended ancestor),
+        # and the in-window tree-causal mask win[s, i, j] (row i
+        # attends row j's freshly computed K/V — our depth-1 sibling
+        # topology only needs the sibling diagonal: a sibling's
+        # ancestors are all appended, so only its SELF attention is
+        # missing from the pool).
+        self.roff = roff
+        self.plim = plim
+        self.win = win
 
 
 class _KVHandle:
@@ -186,31 +235,41 @@ class KVExecutorBase(Executor):
         self.steps_mixed = 0
         self.resumed_total = 0
         self.spec: Optional[SpecConfig] = None
+        self._spec_inflight = 0  # spec windows submitted, uncollected
         if spec is not None:
             self._install_spec(spec)
 
     def _install_spec(self, spec: SpecConfig) -> None:
-        """Arm speculative decoding (the third executor mode). Must
-        run before the first submit. Structural constraints, checked
-        here once: the verify window rides the compiled chunk width
-        (``k + 1 <= prefill_chunk``), and the executor must present
-        the SYNC loop shape to the batcher — the next plan needs the
-        previous step's ACCEPTED length (ctx rolls back at collect),
-        so a pipelined plan-ahead would plan against provisional
-        cursors. The two-phase submit/collect seam itself is
-        unchanged; only ``pipelined=False`` routing selects the
-        collect-before-plan shape."""
+        """Arm speculative decoding. Must run before the first
+        submit. Structural constraints, checked here once: the verify
+        window rides the compiled chunk width (``k + 1 <=
+        prefill_chunk``), with room for the sibling rows and one
+        repair row when the draft is a tree.
+
+        Since ISSUE 18 speculation composes with BOTH loop shapes.
+        The sync shape is PR 15 verbatim: collect-before-plan, every
+        window drafted from the previous step's accepted length. The
+        pipelined shape drafts window w+1 while the device still
+        verifies window w — from window w's PROPOSED tokens: under
+        full acceptance every settled token except the bonus is
+        host-known, the bonus chains on DEVICE (the plan-ahead
+        window's base row is use_host=False), and the draft continues
+        from its own prediction of it (spec.propose_full). A window
+        drafted from a provisional ctx that a rollback later revokes
+        is invalidated by the slot's epoch (recorded at plan, checked
+        at collect) and settles nothing — the existing watermark
+        rollback plus a re-plan, no new device state."""
         if spec.k + 1 > self.prefill_chunk:
             raise ValueError(
                 f"spec k={spec.k} needs a verify window of k+1 <= "
                 f"prefill_chunk={self.prefill_chunk}")
-        if self.pipelined:
+        if spec.tree_width + 1 > self.prefill_chunk:
             raise ValueError(
-                "speculative decoding requires the sync loop shape "
-                "(pipelined=False): the next plan depends on the "
-                "previous step's accepted length")
+                f"tree_width={spec.tree_width} needs a verify window "
+                f"of width+1 <= prefill_chunk={self.prefill_chunk}")
         self.spec = spec
         self.speculative = True
+        self._spec_inflight = 0
 
     # -- attach / detach (called by the batcher under its settle lock) --------
 
@@ -305,7 +364,17 @@ class KVExecutorBase(Executor):
         the durable truth a kill between dispatch and settle cannot
         skew. k settled tokens mean prompt + k-1 generated positions
         are (re)appendable; the next step feeds tokens[-1] and emits
-        token k+1 — identical to the unfailed stream."""
+        token k+1 — identical to the unfailed stream.
+
+        ctx = plen + k - 1 deliberately treats the LAST settled
+        token's own KV position as unwritten, which also covers tree
+        speculation's one legal KV hole: a sibling-accepted token was
+        verified on a score-only row (never appended) and normally
+        healed by the next window's repair row — a kill between the
+        sibling accept and that repair collect lands here, and
+        re-feeding tokens[-1] re-appends exactly the missing
+        position. Any pending st.repair dies with the old slot state;
+        the rebuilt cursor needs none."""
         plen = len(lease.prompt)
         k = len(req.tokens)
         if k > 0:
@@ -618,6 +687,7 @@ class KVExecutorBase(Executor):
         with self._slock:
             self._gen += 1
             self._states = [None] * self.slots
+            self._spec_inflight = 0
             self._backend_reset()
 
     def submit(self, updates: Sequence = (), step=None,
@@ -702,6 +772,19 @@ class KVExecutorBase(Executor):
                 st.chain_device = bool(finishes) and spec is None
                 st.pending_emit = bool(finishes)
             elif spec is not None:
+                if st.last_token is None and st.spec_ahead is None:
+                    if not self.pipelined:
+                        raise RuntimeError(
+                            f"slot {s}: speculative decode with no "
+                            f"prior token (request {st.req_id})")
+                    # Pipelined prefill finish: the slot's first emit
+                    # is still in flight and the draft has nothing to
+                    # chain from — bubble ONE step (n_new stays 0)
+                    # until collect stamps last_token. Once the chain
+                    # starts, spec_ahead carries it forward and the
+                    # bubble never recurs.
+                    st.chain_device = False
+                    continue
                 # Speculative decode: defer to the batched draft call
                 # below (one propose per step — a jitted draft wants
                 # one fixed-shape dispatch, not a per-slot loop).
@@ -725,45 +808,117 @@ class KVExecutorBase(Executor):
                 st.ctx += 1
                 st.chain_device = True
                 st.pending_emit = True
+        tree = spec is not None and spec.tree_width > 1
+        spec_off = spec_w = spec_epoch = n_app_v = None
+        roff = plim = win = None
+        if spec is not None:
+            spec_off = np.zeros((S,), np.int32)
+            spec_w = np.zeros((S,), np.int32)
+            spec_epoch = np.zeros((S,), np.int32)
+            n_app_v = n_new  # rebound to a tree copy below
         if spec_slots:
             # One fixed-shape propose over ALL slots (idle/prefill
             # rows carry zeros and are ignored): the draft's AOT
             # executable compiles once, like every other step shape.
             last = np.zeros((S,), np.int32)
             base = np.zeros((S,), np.int32)
+            ahead_v = [False] * S
             for s in spec_slots:
                 st = self._states[s]
-                if st.last_token is None:
-                    raise RuntimeError(
-                        f"slot {s}: speculative decode with no prior "
-                        f"token (request {st.req_id})")
-                last[s] = st.last_token
-                base[s] = st.ctx
-            drafts = np.asarray(spec.draft.propose(last, base),
-                                np.int32)
+                # Plan-ahead seam: a device-chained slot's base row
+                # takes the TRUE bonus from the in-flight window on
+                # device; the draft chains from its host-side
+                # PREDICTION of it. Repair rows force the host path
+                # (they are row 0, and only row 0 can device-chain) —
+                # and a rollback broke the chain anyway.
+                ahead_v[s] = (self.pipelined and st.chain_device
+                              and st.spec_ahead is not None
+                              and not st.repair)
+                last[s] = (st.spec_ahead if ahead_v[s]
+                           else st.last_token)
+                base[s] = st.ctx + len(st.repair)
+            if self.pipelined:
+                pf = propose_full(spec.draft, last, base)
+                drafts = pf[:, :spec.k]
+            else:
+                pf = None
+                drafts = np.asarray(spec.draft.propose(last, base),
+                                    np.int32)
+            sibs = (np.asarray(spec.draft.propose_sibs(last, base),
+                               np.int32) if tree else None)
             for s in spec_slots:
                 st = self._states[s]
+                R = len(st.repair)
+                w_want = spec.width_for(st.spec_ewma) - 1
                 # Clamp inside the admission-time page reservation:
                 # the max position a verify step writes equals the
                 # one-token loop's max, so speculation never needs
-                # slack pages (see spec.clamp_spec_k).
-                ks = clamp_spec_k(spec.k, st.ctx, st.max_total, C)
-                host_tok[s, 0] = st.last_token
+                # slack pages (see spec.clamp_spec_k). Repair and
+                # sibling rows ride the same chunk width.
+                ks = clamp_spec_k(spec.k_for(st.spec_ewma),
+                                  int(base[s]), st.max_total,
+                                  C - R - w_want)
+                w = w_want if ks >= 1 else 0
+                n_app = R + 1 + ks
+                for i, rt in enumerate(st.repair):
+                    host_tok[s, i] = rt
+                if ahead_v[s]:
+                    use_host[s] = False
+                else:
+                    host_tok[s, R] = st.last_token
+                    use_host[s] = True
                 if ks:
-                    host_tok[s, 1:1 + ks] = drafts[s, :ks]
-                use_host[s] = True
-                n_new[s] = ks + 1
+                    host_tok[s, R + 1:R + 1 + ks] = drafts[s, :ks]
+                if w:
+                    host_tok[s, n_app:n_app + w] = sibs[s, :w]
+                n_new[s] = n_app + w
                 spec_k[s] = ks
+                spec_off[s] = R
+                spec_w[s] = w
+                spec_epoch[s] = st.spec_epoch
                 emit[s] = True
                 step_decode += 1
-                # Provisional FULL-ACCEPTANCE advance: collect rolls
-                # ctx back to the accepted extent. The confirmed
-                # watermark never moves here — that is exactly what
-                # makes rejection a pure truncation.
-                st.ctx += ks + 1
-                st.chain_device = False
+                # Provisional FULL-ACCEPTANCE advance over the
+                # APPENDED rows: collect rolls ctx back to the
+                # accepted extent. The confirmed watermark never
+                # moves here — that is exactly what makes rejection
+                # a pure truncation.
+                st.ctx += n_app
+                st.repair = []
+                st.chain_device = bool(self.pipelined)
+                st.spec_ahead = int(pf[s, ks]) if pf is not None \
+                    else None
                 st.pending_emit = True
-                spec.stats.proposed += ks
+                spec.stats.proposed += ks + w
+            self._spec_inflight += 1
+            if self._spec_inflight > spec.stats.pipeline_peak:
+                spec.stats.pipeline_peak = self._spec_inflight
+        if tree:
+            # Tree-step geometry for EVERY row (prefill chunks too —
+            # a tree-armed executor routes all steps through the one
+            # tree executable, so chain rows carry their degenerate
+            # layout: roff = row index, all rows append, empty
+            # in-window mask). Sibling rows share the first trunk
+            # position and stop their pool attention BEFORE it (the
+            # trunk's append there is a different branch).
+            n_app_v = n_new - np.maximum(spec_w, 0)
+            roff = np.tile(np.arange(C, dtype=np.int32), (S, 1))
+            for s in spec_slots:
+                if spec_w[s]:
+                    na = int(n_app_v[s])
+                    roff[s, na:na + int(spec_w[s])] = \
+                        int(spec_off[s]) + 1
+            rows = np.arange(C, dtype=np.int32)[None, :]
+            pos = ctx[:, None] + roff
+            app_row = rows < n_app_v[:, None]
+            valid_row = rows < n_new[:, None]
+            plim = np.where(valid_row, pos + app_row, 0
+                            ).astype(np.int32)
+            win = np.zeros((S, C, C), bool)
+            for s in spec_slots:
+                na, w = int(n_app_v[s]), int(spec_w[s])
+                for i in range(na, na + w):
+                    win[s, i, i] = True
         self._step_no += 1
         self.prefill_tokens += step_prefill
         if step_decode:
@@ -772,7 +927,9 @@ class KVExecutorBase(Executor):
                 self.steps_mixed += 1
         return _StepPlan(self._gen, self._step_no, host_tok, use_host,
                          ctx, n_new, tables, emit, owners,
-                         spec_k=spec_k)
+                         spec_k=spec_k, spec_off=spec_off,
+                         spec_w=spec_w, spec_epoch=spec_epoch,
+                         n_app=n_app_v, roff=roff, plim=plim, win=win)
 
     def collect(self, handle: _KVHandle) -> np.ndarray:
         """[slots] int32: the emitted token per slot, NO_TOKEN (-1)
@@ -850,7 +1007,29 @@ class KVExecutorBase(Executor):
         check keep the zero-work-slot no-op contract (a budget-
         starved slot raced by retire+re-admit between submit and
         collect must neither advance a watermark nor stamp a
-        last_token) — the guard speculative rollback leans on."""
+        last_token) — the guard speculative rollback leans on.
+
+        ISSUE 18 adds three cases, all inside the same guard:
+
+        * EPOCH-STALE plan-ahead (pipelined): the plan was drafted
+          from a provisional ctx a rollback has since revoked — it
+          settles NOTHING and bumps nothing (the re-plan after the
+          rollback already owns the slot's cursors); counted as a
+          replan. Its device writes are dead bytes a later valid
+          window overwrites, the standard watermark argument.
+        * FULL acceptance under pipelining leaves ``st.ctx`` ALONE —
+          the in-flight plan-ahead already advanced it past this
+          window, and rolling it back here would replay positions the
+          plan-ahead owns. Rollback (and the epoch bump invalidating
+          in-flight plans) happens only when something was actually
+          rejected.
+        * TREE windows accept the longest matching root-to-leaf path
+          (spec.accept_tree). A winning sibling settles its token
+          WITHOUT an appended KV row (the trunk's append at that
+          position holds the rejected trunk token), so confirmed
+          stops before it and the token re-feeds as the next window's
+          repair row — the hole closes before any later query can
+          attend it."""
         C = self.prefill_chunk
         out = np.full((self.slots, C), NO_TOKEN, np.int32)
         if handle.plan.stale:
@@ -858,9 +1037,12 @@ class KVExecutorBase(Executor):
         raw = np.asarray(self._materialize(handle.raw), np.int32)
         plan = handle.plan
         spec = self.spec
+        alpha = spec.ewma_alpha
         with self._slock:
             if plan.gen != self._gen:
                 return out
+            if plan.spec_k is not None and (plan.spec_k >= 0).any():
+                self._spec_inflight = max(0, self._spec_inflight - 1)
             for s in range(self.slots):
                 st = self._states[s]
                 if st is None or st.req_id != plan.owners[s]:
@@ -883,16 +1065,49 @@ class KVExecutorBase(Executor):
                     continue
                 if not st.pending_emit:
                     continue
-                target = raw[s, :ks + 1]
-                a = accept_length(plan.host_tok[s, 1:1 + ks], target)
-                run = target[:a + 1]
-                out[s, :a + 1] = run
-                st.ctx = base + a + 1          # the rollback
-                st.confirmed = max(st.confirmed, base + a + 1)
+                if int(plan.spec_epoch[s]) != st.spec_epoch:
+                    spec.stats.replans += 1
+                    continue
+                R = int(plan.spec_off[s])
+                w = int(plan.spec_w[s])
+                n_app = R + 1 + ks
+                run, sib = accept_tree(
+                    plan.host_tok[s, R + 1:R + 1 + ks],
+                    plan.host_tok[s, n_app:n_app + w],
+                    raw[s, R:R + ks + 1],
+                    raw[s, n_app:n_app + w])
+                a = len(run) - 1 if sib < 0 else 0
+                out[s, :len(run)] = run
+                if sib >= 0:
+                    # Sibling path: t_0 is settled truth but the KV at
+                    # its position holds the REJECTED trunk token —
+                    # confirm up to the base row only and queue the
+                    # repair re-append.
+                    st.ctx = base + R + 1
+                    st.confirmed = max(st.confirmed, base + R + 1)
+                    st.repair = [int(run[0])]
+                    st.spec_epoch += 1
+                    st.chain_device = False
+                    st.spec_ahead = None
+                elif a < ks:
+                    st.ctx = base + R + a + 1      # the rollback
+                    st.confirmed = max(st.confirmed, base + R + a + 1)
+                    st.spec_epoch += 1
+                    st.chain_device = False
+                    st.spec_ahead = None
+                else:
+                    # Full acceptance: the provisional advance stands
+                    # (a pipelined plan-ahead may already sit past
+                    # it); only the watermark catches up.
+                    st.confirmed = max(st.confirmed, base + n_app)
                 st.last_token = int(run[-1])
-                self.decode_tokens += a + 1
-                spec.stats.accepted += a
-                spec.stats.runs += 1
+                self.decode_tokens += len(run)
+                if ks > 0:
+                    rate = (a if sib < 0 else 1) / ks
+                    st.spec_ewma = ((1.0 - alpha) * st.spec_ewma
+                                    + alpha * min(1.0, rate))
+                spec.stats.record_run(accepted=len(run) - 1,
+                                      path_len=len(run))
         return out
 
     def kv_stats(self) -> dict:
@@ -923,6 +1138,10 @@ class KVExecutorBase(Executor):
             out["spec_accept_rate"] = round(st.accept_rate(), 6)
             out["spec_tokens_per_step"] = round(st.tokens_per_step(),
                                                 6)
+            out["spec_replans"] = st.replans
+            out["spec_pipeline_depth"] = self._spec_inflight
+            out["spec_pipeline_peak"] = st.pipeline_peak
+            out["spec_path_len"] = dict(st.path_len)
         return out
 
     # -- backend hooks --------------------------------------------------------
@@ -954,13 +1173,22 @@ class PagedKVExecutor(KVExecutorBase):
     executor plans k-token verify windows against ``draft`` (default:
     a spec.TruncatedDraft built from this step's own embed/positional/
     output weights), behind the unchanged submit/collect seam in the
-    sync loop shape. ``kernel=`` selects the fused Pallas
-    paged-attention kernel or the XLA reference composition (default:
-    pallas on a TPU backend, xla elsewhere) and ``pool_dtype=`` the
-    resident KV layout (int8 codes + per-block scales by default — 4x
-    resident context per HBM byte; "fp32" is the exact reference) —
-    both pass straight through to PagedDecodeStep, so the scheduler,
-    chaos matrix and sharded plane ride any mode untouched."""
+    sync loop shape; ``mode="speculative-pipelined"`` (ISSUE 18)
+    overlaps the draft with the verify — window w+1 is planned from
+    window w's proposed tokens while the device still verifies w, the
+    true bonus chains on device, and a mis-speculation is the epoch-
+    gated watermark rollback. ``spec_tree_width >= 2`` widens either
+    speculative mode to a token tree (trunk chain + first-position
+    siblings under a tree-causal mask; the step routes through the
+    XLA tree composition — the Pallas kernel normalizes in-kernel and
+    cannot merge in-window partials, the documented fallback).
+    ``kernel=`` selects the fused Pallas paged-attention kernel or
+    the XLA reference composition (default: pallas on a TPU backend,
+    xla elsewhere) and ``pool_dtype=`` the resident KV layout (int8
+    codes + per-block scales by default — 4x resident context per HBM
+    byte; "fp32" is the exact reference) — both pass straight through
+    to PagedDecodeStep, so the scheduler, chaos matrix and sharded
+    plane ride any mode untouched."""
 
     def __init__(self, slots: int = 4, vocab: int = 64, d: int = 16,
                  heads: int = 2, block_size: int = 4,
@@ -974,18 +1202,22 @@ class PagedKVExecutor(KVExecutorBase):
                  pool_dtype: str = "int8",
                  interpret: Optional[bool] = None,
                  spec_k: int = 4, draft=None,
+                 spec_tree_width: int = 1,
+                 spec_adaptive: bool = False,
                  host_tier_bytes: Optional[int] = None):
-        if mode not in ("pipelined", "sync", "speculative"):
+        if mode not in ("pipelined", "sync", "speculative",
+                        "speculative-pipelined"):
             raise ValueError(f"mode must be pipelined|sync|speculative"
-                             f", got {mode!r}")
-        speculative = mode == "speculative"
+                             f"|speculative-pipelined, got {mode!r}")
+        speculative = mode in ("speculative", "speculative-pipelined")
         super().__init__(slots, vocab=vocab, block_size=block_size,
                          num_blocks=num_blocks,
                          max_blocks_per_req=max_blocks_per_req,
                          prefill_chunk=prefill_chunk,
                          prefill_budget=prefill_budget,
                          prefix_cache=prefix_cache,
-                         pipelined=mode == "pipelined",
+                         pipelined=mode in ("pipelined",
+                                            "speculative-pipelined"),
                          host_tier_bytes=host_tier_bytes)
         from ..spec import TruncatedDraft
         from .paged import PagedDecodeStep
@@ -997,11 +1229,15 @@ class PagedKVExecutor(KVExecutorBase):
             max_blocks_per_req=max_blocks_per_req, chunk=prefill_chunk,
             seed=seed, donate=donate, kernel=kernel,
             pool_dtype=pool_dtype, interpret=interpret,
-            per_pos=speculative)
+            per_pos=speculative,
+            tree=speculative and spec_tree_width > 1)
         if speculative:
             if draft is None:
-                draft = TruncatedDraft.from_paged(self._paged, spec_k)
-            self._install_spec(SpecConfig(draft, spec_k))
+                draft = TruncatedDraft.from_paged(
+                    self._paged, spec_k, tree_width=spec_tree_width)
+            self._install_spec(SpecConfig(
+                draft, spec_k, tree_width=spec_tree_width,
+                adaptive=spec_adaptive))
         (self._kpool, self._kscale,
          self._vpool, self._vscale) = self._paged.init_pools()
         self._prev = self._paged.init_prev()
@@ -1097,20 +1333,39 @@ class PagedKVExecutor(KVExecutorBase):
     def _dispatch(self, plan: _StepPlan):
         import jax.numpy as jnp
 
-        (self._kpool, self._kscale, self._vpool, self._vscale,
-         out) = self._paged(
-            self._kpool, self._kscale, self._vpool, self._vscale,
-            self._prev,
-            jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
-            jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
-            jnp.asarray(plan.tables))
+        if self.spec is not None and plan.roff is not None:
+            (self._kpool, self._kscale, self._vpool, self._vscale,
+             out) = self._paged.tree_step(
+                self._kpool, self._kscale, self._vpool, self._vscale,
+                self._prev,
+                jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
+                jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
+                jnp.asarray(plan.tables), jnp.asarray(plan.roff),
+                jnp.asarray(plan.n_app), jnp.asarray(plan.plim),
+                jnp.asarray(plan.win))
+        else:
+            (self._kpool, self._kscale, self._vpool, self._vscale,
+             out) = self._paged(
+                self._kpool, self._kscale, self._vpool, self._vscale,
+                self._prev,
+                jnp.asarray(plan.host_tok), jnp.asarray(plan.use_host),
+                jnp.asarray(plan.ctx), jnp.asarray(plan.n_new),
+                jnp.asarray(plan.tables))
         if self.spec is None:
             # out is the [slots] token recurrence the next pipelined
-            # step may chain on device. The speculative step's out is
-            # [slots, chunk] per-position argmax and NEVER chains —
-            # every verify window is host-fed from the last ACCEPTED
-            # token, so _prev stays the zeroed init.
+            # step may chain on device. The sync speculative step's
+            # out is [slots, chunk] per-position argmax and never
+            # chains — every verify window is host-fed from the last
+            # ACCEPTED token, so _prev stays the zeroed init.
             self._prev = out
+        elif self.pipelined:
+            # Pipelined speculation: the NEXT window's base row
+            # device-chains the TRUE bonus — the trunk leaf's
+            # per-position output (row n_app-1). A tiny jitted
+            # gather keeps the value device-resident; rows with no
+            # work keep their previous chain value.
+            self._prev = self._paged.take_prev(
+                out, jnp.asarray(plan.n_app), self._prev)
         return out
 
     def _materialize(self, raw) -> np.ndarray:
@@ -1125,10 +1380,13 @@ class SyntheticKVExecutor(KVExecutorBase):
     produces a visibly different stream. With ``pipelined=True``
     steps run FIFO on a worker thread with a dialable ``step_time_s``
     (the SyntheticExecutor overlap idiom); ``fault_site`` names the
-    in-device chaos seam. ``spec=`` (requires ``pipelined=False``)
-    arms the draft/verify third mode — the SpecConfig's draft is
-    typically spec.OracleDraft, whose dialed acceptance rate is what
-    the bench's controlled-speedup measurement turns."""
+    in-device chaos seam. ``spec=`` arms the draft/verify mode — the
+    SpecConfig's draft is typically spec.OracleDraft, whose dialed
+    acceptance rate is what the bench's controlled-speedup
+    measurement turns; combined with ``pipelined=True`` (ISSUE 18)
+    the executor plans window w+1 from window w's proposals while
+    the worker thread still runs w — the overlap the pipelined-spec
+    bench measures."""
 
     def __init__(self, slots: int = 4, vocab: int = 64,
                  block_size: int = 4, num_blocks: int = 128,
@@ -1182,21 +1440,42 @@ class SyntheticKVExecutor(KVExecutorBase):
             time.sleep(cost)
         if self.spec is not None:
             # Per-position outputs, the verify contract: out[s, j] is
-            # the target's next token after consuming input j at
-            # position ctx+j. The synthetic recurrence is Markov on
-            # (input, position), so the per-position form IS the
-            # one-token recurrence applied at each fed position.
+            # the target's next token after consuming input j at its
+            # row position (ctx + roff[j]; roff == j for chain rows —
+            # tree siblings share the first trunk position). The
+            # synthetic recurrence is Markov on (input, position), so
+            # the per-position form IS the one-token recurrence
+            # applied at each fed position. Row 0 alone may
+            # device-chain (a pipelined plan-ahead's base row takes
+            # the in-flight window's true bonus); rows >= 1 are
+            # always host-fed drafts/siblings. The chain value
+            # carries the trunk LEAF's output (row n_app-1) — the
+            # bonus the next plan-ahead window chains from.
             C = self.prefill_chunk
             out = np.full((self.slots, C), NO_TOKEN, np.int32)
+            prev = self._dev_prev.copy()
             for s in range(self.slots):
                 n = int(plan.n_new[s])
                 for j in range(n):
-                    tok_in = (int(plan.host_tok[s, j])
-                              if plan.use_host[s]
-                              else int(self._dev_prev[s]))
+                    if j == 0:
+                        tok_in = (int(plan.host_tok[s, 0])
+                                  if plan.use_host[s]
+                                  else int(prev[s]))
+                    else:
+                        tok_in = int(plan.host_tok[s, j])
+                    ro = (int(plan.roff[s, j])
+                          if plan.roff is not None else j)
                     out[s, j] = synthetic_next_token(
-                        tok_in, int(plan.ctx[s]) + j, self.seed,
+                        tok_in, int(plan.ctx[s]) + ro, self.seed,
                         self.vocab)
+                if n > 0:
+                    na = (int(plan.n_app[s])
+                          if plan.n_app is not None else n)
+                    prev[s] = out[s, na - 1]
+            # Whole-attribute publish (copy-update-swap), never an
+            # in-place mutation of the shared array: reset() and the
+            # worker thread race only against an atomic swap.
+            self._dev_prev = prev
             return out
         out = np.zeros((self.slots,), np.int32)
         for s in range(self.slots):

@@ -163,12 +163,16 @@ class PagedDecodeStep:
                  pool_dtype: str = "int8",
                  scale_margin: float = 1.5,
                  interpret: Optional[bool] = None,
-                 per_pos: bool = False):
+                 per_pos: bool = False, tree: bool = False):
         import jax
         import jax.numpy as jnp
 
         if d % heads:
             raise ValueError(f"d={d} must divide by heads={heads}")
+        if tree and not per_pos:
+            raise ValueError("tree verify windows need per_pos=True "
+                             "(per-position argmax is the verify "
+                             "contract)")
         if kernel is None:
             # Deploy default: the fused kernel on a real TPU backend,
             # the XLA composition on CPU tier-1 (where pallas would
@@ -387,6 +391,123 @@ class PagedDecodeStep:
         self._step = jax.jit(step, donate_argnums=dn).lower(
             kp, ksc, vp, vsc, pt, ht, uh, i32, i32, tb).compile()
 
+        self.tree = bool(tree)
+        self._tree_step = None
+        if tree:
+            # Tree-topology verify step (ISSUE 18): rows carry an
+            # explicit per-row position offset (siblings share the
+            # first trunk position), only the first n_app rows APPEND
+            # (the contiguous repair+base+trunk layout — score-only
+            # sibling rows drop-scatter to block N), pool attention
+            # is bounded per row by plim (appended rows include their
+            # own scattered position; score-only rows stop at their
+            # deepest appended ancestor, so a sibling never attends
+            # the other branch's KV at its own position), and the
+            # in-window tree-causal mask `win` wires row-to-row
+            # attention over the step's FRESH K/V — the only path a
+            # score-only row can reach its own key/value, which never
+            # enters the pool. ALWAYS the XLA composition, both
+            # kernel modes: the fused Pallas kernel normalizes its
+            # softmax in-kernel and cannot merge the in-window
+            # partials — the documented per-row-mask fallback (see
+            # parallel/pallas_paged_attn.py). A tree-armed executor
+            # routes EVERY step through this one executable, so
+            # within-stream determinism never depends on mixing two
+            # reduction shapes.
+            def tree_step(kpool, kscale, vpool, vscale, prev_tok,
+                          host_tok, use_host, ctx, n_new, tables,
+                          roff, n_app, plim, win):
+                tok0 = jnp.where(use_host, host_tok[:, 0], prev_tok)
+                toks = jnp.concatenate(
+                    [tok0[:, None], host_tok[:, 1:]], axis=1)
+                pos = ctx[:, None] + roff                 # [S, C]
+                x = embed[toks] + wpos[jnp.clip(pos, 0, T - 1)]
+                q = (x @ wq).reshape(S, C, H, dh)
+                k = (x @ wk).reshape(S, C, H, dh)
+                v = (x @ wv).reshape(S, C, H, dh)
+                app = jnp.arange(C)[None, :] < n_app[:, None]
+                blk_all = jnp.take_along_axis(
+                    tables, jnp.clip(pos // bs, 0, B - 1), axis=1)
+                blk = jnp.where(app, blk_all, N)
+                off = pos % bs
+                if int8:
+                    kscale = update_scales(kscale, k, blk, pos, app,
+                                           ctx)
+                    vscale = update_scales(vscale, v, blk, pos, app,
+                                           ctx)
+                    ksc_rows = kscale[blk_all]
+                    vsc_rows = vscale[blk_all]
+                    kpool = kpool.at[blk, off].set(
+                        quantize_rows(k, ksc_rows), mode="drop")
+                    vpool = vpool.at[blk, off].set(
+                        quantize_rows(v, vsc_rows), mode="drop")
+                    keys = int8_block_decode_xp(
+                        kpool[tables], kscale[tables],
+                        xp=jnp).reshape(S, T, H, dh)
+                    vals = int8_block_decode_xp(
+                        vpool[tables], vscale[tables],
+                        xp=jnp).reshape(S, T, H, dh)
+                else:
+                    kpool = kpool.at[blk, off].set(k, mode="drop")
+                    vpool = vpool.at[blk, off].set(v, mode="drop")
+                    keys = kpool[tables].reshape(S, T, H, dh)
+                    vals = vpool[tables].reshape(S, T, H, dh)
+                limit = ctx + n_app
+                tpos = jnp.arange(T)
+                t_ok = (tpos[None, :] < limit[:, None]
+                        )[:, :, None, None]
+                keys = jnp.where(t_ok, keys, 0.0)
+                vals = jnp.where(t_ok, vals, 0.0)
+                scores = jnp.einsum("schd,sthd->shct", q,
+                                    keys) / np.sqrt(dh)
+                causal = tpos[None, None, :] < plim[:, :, None]
+                scores = jnp.where(causal[:, None, :, :], scores,
+                                   jnp.float32(-1e30))
+                swin = jnp.einsum("schd,swhd->shcw", q,
+                                  k) / np.sqrt(dh)
+                swin = jnp.where(win[:, None, :, :], swin,
+                                 jnp.float32(-1e30))
+                # One softmax over pool + in-window columns: masked
+                # columns underflow to exact 0.0 weight, and a fully
+                # masked (invalid) row degrades to a uniform
+                # distribution over garbage the collect path never
+                # reads (n_new bounds every comparison).
+                full = jnp.concatenate([scores, swin], axis=-1)
+                attn = jax.nn.softmax(full, axis=-1)
+                vfull = jnp.concatenate([vals, v], axis=1)
+                o = jnp.einsum("shct,sthd->schd", attn,
+                               vfull).reshape(S, C, H * dh)
+                y = x + o @ wo
+                y = y + jax.nn.relu(y @ w1) @ w2
+                logits = y @ wout                        # [S, C, V]
+                out = jnp.argmax(logits, axis=2).astype(jnp.int32)
+                return kpool, kscale, vpool, vscale, out
+
+            rf = jnp.zeros((S, C), jnp.int32)
+            wn = jnp.zeros((S, C, C), jnp.bool_)
+            self._tree_step = jax.jit(
+                tree_step, donate_argnums=dn).lower(
+                kp, ksc, vp, vsc, pt, ht, uh, i32, i32, tb,
+                rf, i32, rf, wn).compile()
+
+        self._take_prev = None
+        if self.per_pos:
+            # The pipelined-speculation chain gather: the NEXT verify
+            # window's base row device-chains the trunk LEAF's output
+            # (the window's bonus under full acceptance) — row
+            # n_app-1 of the per-position argmax. Rows that planned
+            # nothing keep their previous chain value.
+            def take_prev(out, n_app, prev):
+                idx = jnp.clip(n_app - 1, 0, C - 1)
+                leaf = jnp.take_along_axis(
+                    out, idx[:, None], axis=1)[:, 0]
+                return jnp.where(n_app > 0, leaf,
+                                 prev).astype(jnp.int32)
+
+            oz = jnp.zeros((S, C), jnp.int32)
+            self._take_prev = jax.jit(take_prev).lower(
+                oz, i32, pt).compile()
+
     def init_pools(self):
         """Fresh zeroed (kpool, kscale, vpool, vscale) device arrays —
         int8 codes + per-block scales in the resident default, fp32
@@ -446,6 +567,27 @@ class PagedDecodeStep:
         linearly."""
         return self._step(kpool, kscale, vpool, vscale, prev_tok,
                           host_tok, use_host, ctx, n_new, tables)
+
+    def tree_step(self, kpool, kscale, vpool, vscale, prev_tok,
+                  host_tok, use_host, ctx, n_new, tables, roff,
+                  n_app, plim, win):
+        """The tree-topology verify executable (tree=True only): the
+        chain step's signature plus the tree geometry — per-row
+        position offsets, the appended-row count, per-row pool
+        attention limits, and the in-window tree-causal mask."""
+        if self._tree_step is None:
+            raise RuntimeError("step compiled without tree=True")
+        return self._tree_step(kpool, kscale, vpool, vscale, prev_tok,
+                               host_tok, use_host, ctx, n_new, tables,
+                               roff, n_app, plim, win)
+
+    def take_prev(self, out, n_app, prev):
+        """Device-side chain gather for pipelined speculation: the
+        trunk leaf's per-position output (row n_app-1), or the
+        previous chain value where nothing was planned."""
+        if self._take_prev is None:
+            raise RuntimeError("take_prev needs per_pos=True")
+        return self._take_prev(out, n_app, prev)
 
 
 class PagedRankStep:

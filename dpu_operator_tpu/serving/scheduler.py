@@ -95,14 +95,19 @@ class ContinuousBatcher:
         # [slots, d] rows: admission binds a block-table lease and the
         # loop is _run_kv (chunked prefill + NO_TOKEN-aware retire).
         self.kv_mode = bool(getattr(executor, "kv", False))
-        if getattr(executor, "speculative", False) and self.pipelined:
-            # The executor enforces its OWN pipelined=False; this
-            # guards the batcher's override knob — the plan-ahead
-            # loop would plan verify windows against provisional
-            # (un-rolled-back) cursors.
+        if (getattr(executor, "speculative", False) and self.pipelined
+                and not bool(executor.pipelined)):
+            # Speculation rides BOTH loop shapes since ISSUE 18, but
+            # the plan-ahead discipline (draft from proposed tokens,
+            # epoch-gated rollback) lives in the EXECUTOR — it must
+            # have been built pipelined. Overriding a sync-built
+            # speculative executor into the pipelined loop would plan
+            # verify windows from stale last_token cursors (collect
+            # has not run yet) and silently fork the stream.
             raise ValueError(
-                "speculative executors require the sync loop shape; "
-                "pipelined=True override is invalid")
+                "speculative executor was built for the sync loop "
+                "shape; pipelined=True override is invalid (build it "
+                "with pipelined speculation instead)")
         # Role hand-off (serving/disagg): when set, this batcher is a
         # PREFILL replica — a request that emits a token and is not
         # finished leaves its slot through kv_detach_slot and
@@ -808,14 +813,17 @@ class ContinuousBatcher:
         NO_TOKEN. `pipelined` picks the shape: True settles step k-1
         while step k runs on the device (the decode recurrence chains
         on device, so dispatch needs no host token); False collects
-        every step before the next dispatch — the measured baseline,
-        and the shape speculative executors REQUIRE (their next plan
-        drafts from the previous step's accepted tokens, so they
-        construct with pipelined=False and this loop needs no
-        speculative branch at all: collect just returns runs).
-        Token STREAMS are identical either way: rows decode
-        independently and the plan depends only on committed cursors
-        (the ISSUE 3 equivalence argument, carried to tokens).
+        every step before the next dispatch — the measured baseline.
+        Speculative executors ride EITHER shape with no loop branch
+        here (collect just returns runs): sync drafts from the
+        previous step's accepted tokens; pipelined (ISSUE 18) drafts
+        window w+1 from window w's PROPOSED tokens inside the
+        executor's plan, with epoch-gated rollback on
+        mis-speculation. Token STREAMS are identical either way:
+        rows decode independently and the plan depends only on
+        committed cursors (the ISSUE 3 equivalence argument, carried
+        to tokens — extended to speculation by the exact greedy
+        prefix-match acceptance).
 
         The `gen` captured under the settle lock makes the
         documented dispatch-outside-the-lock window safe on the KV

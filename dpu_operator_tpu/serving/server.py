@@ -438,8 +438,10 @@ class ServingServer:
                    "hit": 0, "lookup": 0}
             deltas = {"prefill": 0, "decode": 0}
             tier_deltas = {"hbm": 0, "host": 0, "remote": 0}
-            spec_agg = {"proposed": 0, "accepted": 0, "runs": 0}
-            spec_deltas = {"proposed": 0, "accepted": 0}
+            spec_agg = {"proposed": 0, "accepted": 0, "runs": 0,
+                        "depth": 0, "peak": 0}
+            spec_deltas = {"proposed": 0, "accepted": 0, "replans": 0}
+            spec_path_deltas: dict = {}
             spec_seen = False
             rank_agg: dict = {}
             with self._trace_pub_lock:
@@ -489,14 +491,33 @@ class ServingServer:
                         spec_agg["accepted"] += st[
                             "spec_accepted_tokens"]
                         spec_agg["runs"] += st["spec_verify_steps"]
-                        slast = self._spec_pub.get(idx, (0, 0))
+                        # Pipelined speculation (ISSUE 18): in-flight
+                        # plan-ahead depth is a live gauge; re-plans
+                        # and the accepted path-length histogram are
+                        # deltas like every executor total.
+                        spec_agg["depth"] += st.get(
+                            "spec_pipeline_depth", 0)
+                        spec_agg["peak"] = max(
+                            spec_agg["peak"],
+                            st.get("spec_pipeline_peak", 0))
+                        slast = self._spec_pub.get(
+                            idx, (0, 0, 0, {}))
                         spec_deltas["proposed"] += (
                             st["spec_proposed_tokens"] - slast[0])
                         spec_deltas["accepted"] += (
                             st["spec_accepted_tokens"] - slast[1])
+                        spec_deltas["replans"] += (
+                            st.get("spec_replans", 0) - slast[2])
+                        paths = dict(st.get("spec_path_len", {}))
+                        for plen, n in paths.items():
+                            d = n - slast[3].get(plen, 0)
+                            if d > 0:
+                                spec_path_deltas[plen] = (
+                                    spec_path_deltas.get(plen, 0) + d)
                         self._spec_pub[idx] = (
                             st["spec_proposed_tokens"],
-                            st["spec_accepted_tokens"])
+                            st["spec_accepted_tokens"],
+                            st.get("spec_replans", 0), paths)
             for state in ("used", "free", "shared"):
                 self.registry.gauge_set(
                     "serving_kv_blocks", float(agg[state]),
@@ -561,6 +582,32 @@ class ServingServer:
                     help="emitted tokens per verify step (accepted "
                          "drafts + the bonus; 1.0 = the one-token "
                          "baseline)")
+                self.registry.counter_inc(
+                    "serving_spec_replans_total", by=float(
+                        max(0, spec_deltas["replans"])),
+                    help="pipelined plan-ahead windows invalidated by "
+                         "a mis-speculated verify (watermark rollback "
+                         "+ re-plan; always 0 in sync spec mode)")
+                self.registry.gauge_set(
+                    "serving_spec_pipeline_depth",
+                    float(spec_agg["depth"]),
+                    help="speculative verify windows currently in "
+                         "flight across replicas (0 = drained; 2 = "
+                         "draft overlapping verify)")
+                self.registry.gauge_set(
+                    "serving_spec_pipeline_peak",
+                    float(spec_agg["peak"]),
+                    help="max simultaneous in-flight speculative "
+                         "windows any replica reached (lifetime)")
+                for plen in sorted(spec_path_deltas):
+                    for _ in range(spec_path_deltas[plen]):
+                        self.registry.observe(
+                            "serving_spec_tree_path_len", float(plen),
+                            help="tokens emitted per verify window "
+                                 "(accepted root-to-leaf path + "
+                                 "bonus; 1 = full rejection)",
+                            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0,
+                                     12.0, 16.0))
         # Per-replica host-gap share of the decode loop: the overlap
         # number an operator watches — near 0 means host scheduling
         # hides behind device steps; climbing toward 1 means the device

@@ -47,6 +47,23 @@ construction and must satisfy ``k + 1 <= prefill_chunk`` (the verify
 window rides the prefill chunk's compiled width). Draft proposals
 chain on the draft's OWN tokens (after a mispredict the tail is dead
 anyway — it can never be accepted past the first mismatch).
+
+ISSUE 18 widens the contract two ways, both optional:
+
+* PIPELINED plan-ahead needs one proposal PAST the chain —
+  ``propose_full`` wraps any chain draft and returns ``[S, k+1]``
+  (two fixed-shape propose calls), so the planner can seed window
+  ``w+1`` from window ``w``'s own predicted bonus token while the
+  device still verifies window ``w``.
+* TREE drafts branch at the FIRST draft position (where acceptance
+  entropy concentrates — the Medusa/SpecInfer observation):
+  ``draft.tree_width = W >= 2`` plus
+  ``draft.propose_sibs(last[S], ctx[S]) -> [S, W-1] int32`` —
+  alternative candidates for the trunk's first proposal. The verify
+  window scores trunk AND siblings in one batched step under a
+  tree-causal mask; ``accept_tree`` picks the longest matching
+  root-to-leaf path (trunk wins ties), still exact greedy prefix
+  match.
 """
 
 from __future__ import annotations
@@ -108,12 +125,27 @@ class SpecStats:
     a proposal exists whether or not its step survives, and a stale
     step's proposals correctly depress the measured rate)."""
 
-    __slots__ = ("proposed", "accepted", "runs")
+    __slots__ = ("proposed", "accepted", "runs", "replans",
+                 "path_len", "pipeline_peak")
 
     def __init__(self):
         self.proposed = 0   # draft tokens fed to verify steps
         self.accepted = 0   # draft tokens the target confirmed
         self.runs = 0       # verify steps collected
+        self.replans = 0    # plan-ahead windows invalidated by a
+        #                     rollback (collected as epoch-stale no-ops)
+        self.path_len: dict = {}  # accepted path length -> count
+        #                     (root-to-leaf tokens settled per run)
+        self.pipeline_peak = 0  # max spec windows in flight at once
+
+    def record_run(self, accepted: int, path_len: int) -> None:
+        """One collected verify step: ``accepted`` draft tokens
+        confirmed, ``path_len`` tokens settled (accepted + bonus, or
+        the sibling path's 2)."""
+        self.runs += 1
+        self.accepted += int(accepted)
+        n = int(path_len)
+        self.path_len[n] = self.path_len.get(n, 0) + 1
 
     def accept_rate(self) -> float:
         """Accepted fraction of proposed draft tokens (positions after
@@ -131,13 +163,24 @@ class SpecStats:
 
 class SpecConfig:
     """One executor's speculative-decoding configuration: the draft,
-    the per-slot proposal depth ``k``, and the acceptance stats. The
-    executor validates ``k + 1 <= prefill_chunk`` (the verify window
-    is the compiled chunk width) and that it runs the sync loop shape
-    — the next plan needs the previous step's ACCEPTED length, so
-    collect-before-plan is structural, not a tuning choice."""
+    the per-slot proposal depth ``k``, the tree width, the adaptive
+    dial, and the acceptance stats. The executor validates
+    ``k + 1 <= prefill_chunk`` (the verify window is the compiled
+    chunk width). Since ISSUE 18 the config no longer forces the sync
+    loop shape: a pipelined executor drafts window ``w+1`` from window
+    ``w``'s PROPOSED tokens (provisional ctx, the same provisional-
+    advance discipline the plan already uses) and a mis-speculation is
+    the existing watermark rollback plus a re-plan.
 
-    def __init__(self, draft, k: int):
+    ``adaptive=True`` turns on the per-slot accept-rate EWMA dial: a
+    slot whose realized rate decays stops paying full draft depth
+    (``k`` shrinks toward ``k_min`` through ``clamp_spec_k``) and a
+    hot slot climbs back; tree width drops to 1 while the trunk is
+    hot (siblings only pay when the first position misses)."""
+
+    def __init__(self, draft, k: int, tree_width: Optional[int] = None,
+                 adaptive: bool = False, k_min: int = 1,
+                 ewma_alpha: float = 0.3):
         if k < 1:
             raise ValueError(f"spec k must be >= 1, got {k}")
         draft_k = getattr(draft, "k", None)
@@ -145,9 +188,48 @@ class SpecConfig:
             raise ValueError(
                 f"draft proposes k={draft_k} tokens but the config "
                 f"asks for k={k}")
+        if tree_width is None:
+            tree_width = int(getattr(draft, "tree_width", 1) or 1)
+        if tree_width < 1:
+            raise ValueError(
+                f"tree_width must be >= 1, got {tree_width}")
+        if tree_width > 1 and not hasattr(draft, "propose_sibs"):
+            raise ValueError(
+                "tree_width > 1 needs a draft with propose_sibs()")
+        if not 1 <= int(k_min) <= int(k):
+            raise ValueError(
+                f"k_min must be in [1, k={k}], got {k_min}")
+        if not 0.0 < float(ewma_alpha) <= 1.0:
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
         self.draft = draft
         self.k = int(k)
+        self.tree_width = int(tree_width)
+        self.adaptive = bool(adaptive)
+        self.k_min = int(k_min)
+        self.ewma_alpha = float(ewma_alpha)
         self.stats = SpecStats()
+
+    def k_for(self, ewma: float) -> int:
+        """The adaptive dial: map a slot's accept-rate EWMA onto a
+        draft depth in ``[k_min, k]`` (linear — the EWMA is already
+        the realized fraction of drafts that paid off). Inert when
+        ``adaptive=False``."""
+        if not self.adaptive:
+            return self.k
+        r = min(1.0, max(0.0, float(ewma)))
+        return self.k_min + int(round(r * (self.k - self.k_min)))
+
+    def width_for(self, ewma: float) -> int:
+        """Adaptive tree width: siblings only earn tokens when the
+        trunk's FIRST position misses, so a hot slot (EWMA >= 0.9)
+        drops back to a pure chain and stops paying the sibling
+        verify rows."""
+        if self.tree_width <= 1:
+            return 1
+        if self.adaptive and float(ewma) >= 0.9:
+            return 1
+        return self.tree_width
 
 
 class OracleDraft:
@@ -160,15 +242,25 @@ class OracleDraft:
 
     def __init__(self, k: int, accept_rate: float = 0.7,
                  vocab: int = 64, target_seed: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, tree_width: int = 1,
+                 sib_rate: float = 0.5):
         if not 0.0 <= accept_rate <= 1.0:
             raise ValueError(f"accept_rate must be in [0, 1], got "
                              f"{accept_rate}")
+        if tree_width < 1:
+            raise ValueError(f"tree_width must be >= 1, got "
+                             f"{tree_width}")
+        if not 0.0 <= sib_rate <= 1.0:
+            raise ValueError(f"sib_rate must be in [0, 1], got "
+                             f"{sib_rate}")
         self.k = int(k)
         self.accept_rate = float(accept_rate)
         self.vocab = int(vocab)
         self.target_seed = int(target_seed)
         self.seed = int(seed)
+        self.tree_width = int(tree_width)
+        self.sib_rate = float(sib_rate)  # P(some sibling recovers a
+        #                                  trunk first-position miss)
 
     def _hit(self, tok: int, pos: int) -> bool:
         # LCG-style mix: deterministic, position- and token-sensitive,
@@ -196,6 +288,45 @@ class OracleDraft:
                 t = nxt  # chain on own proposal (dead past a miss)
         return out
 
+    def _sib_hit(self, tok: int, pos: int) -> bool:
+        # Second, independent mix (different multiplier/increment)
+        # dialing the SIBLING recovery rate: given the trunk missed
+        # at the first position, does some sibling carry the true
+        # token? Independence from _hit keeps the two dials
+        # orthogonal in the equivalence matrix.
+        h = (1664525 * (tok * 131 + pos * 7919 + self.seed + 17)
+             + 1013904223) & 0x7FFFFFFF
+        return (h >> 8) < int(round(self.sib_rate * (1 << 23)))
+
+    def propose_sibs(self, last, ctx) -> np.ndarray:
+        """Alternative candidates for the FIRST draft position (the
+        tree's branch point). Pure function of (last, ctx) like
+        propose, so the plan-ahead / resume determinism arguments
+        carry over. When the trunk's first proposal missed and the
+        sib hash fires, sibling 0 carries the TRUE next token —
+        the dial the tree-path tests and bench turn; the remaining
+        siblings are deliberate distinct misses."""
+        last = np.asarray(last, np.int64)
+        ctx = np.asarray(ctx, np.int64)
+        w = self.tree_width - 1
+        out = np.zeros((len(last), max(w, 0)), np.int32)
+        for s in range(len(last)):
+            t = int(last[s])
+            pos = int(ctx[s])
+            true = synthetic_next_token(t, pos, self.target_seed,
+                                        self.vocab)
+            trunk_hit = self._hit(t, pos)
+            recover = (not trunk_hit) and self._sib_hit(t, pos)
+            for i in range(w):
+                if i == 0 and recover:
+                    out[s, i] = true
+                else:
+                    # distinct from the trunk's proposal AND the true
+                    # token, so a non-recovering sibling never
+                    # matches by accident
+                    out[s, i] = (true + 2 + i) % self.vocab
+        return out
+
 
 class TruncatedDraft:
     """The jitted plane's cheap draft: a TRUNCATED-STAGE variant of
@@ -212,11 +343,13 @@ class TruncatedDraft:
     CONTROLLED-rate speedup measurements use OracleDraft on the
     synthetic plane instead."""
 
-    def __init__(self, embed, wpos, wout, k: int, slots: int):
+    def __init__(self, embed, wpos, wout, k: int, slots: int,
+                 tree_width: int = 1):
         import jax
         import jax.numpy as jnp
 
         self.k = int(k)
+        self.tree_width = int(tree_width)
         T = int(wpos.shape[0])
 
         def propose(last, ctx):
@@ -231,14 +364,31 @@ class TruncatedDraft:
 
         z = jnp.zeros((int(slots),), jnp.int32)
         self._fn = jax.jit(propose).lower(z, z).compile()
+        self._sib_fn = None
+        if self.tree_width > 1:
+            import jax.lax as lax
+            W = self.tree_width
+
+            def sibs(last, ctx):
+                # ranks 2..W of the first-position logits: the trunk
+                # already carries rank 1, so siblings are the next
+                # most probable alternatives at the branch point
+                pos = jnp.clip(ctx, 0, T - 1)
+                x = embed[last] + wpos[pos]
+                _, idx = lax.top_k(x @ wout, W)
+                return idx[:, 1:W].astype(jnp.int32)
+
+            self._sib_fn = jax.jit(sibs).lower(z, z).compile()
 
     @classmethod
-    def from_paged(cls, paged_step, k: int) -> "TruncatedDraft":
+    def from_paged(cls, paged_step, k: int,
+                   tree_width: int = 1) -> "TruncatedDraft":
         """Build from a kvcache/paged.PagedDecodeStep — the weights
         are the ones its executable already closed over, so draft and
         target can never disagree on the token space."""
         embed, wpos, wout = paged_step.draft_params
-        return cls(embed, wpos, wout, k, paged_step.slots)
+        return cls(embed, wpos, wout, k, paged_step.slots,
+                   tree_width=tree_width)
 
     def propose(self, last, ctx) -> np.ndarray:
         import jax.numpy as jnp
@@ -246,6 +396,72 @@ class TruncatedDraft:
         return np.asarray(self._fn(jnp.asarray(last, jnp.int32),
                                    jnp.asarray(ctx, jnp.int32)),
                           np.int32)
+
+    def propose_sibs(self, last, ctx) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if self._sib_fn is None:
+            return np.zeros((len(np.asarray(last)), 0), np.int32)
+        return np.asarray(self._sib_fn(jnp.asarray(last, jnp.int32),
+                                       jnp.asarray(ctx, jnp.int32)),
+                          np.int32)
+
+
+def propose_full(draft, last, ctx) -> np.ndarray:
+    """``[S, k+1]`` proposals: the draft's k-chain PLUS one more
+    chained step — the draft's own prediction of the verify window's
+    BONUS token. The pipelined planner needs it to seed window
+    ``w+1`` before window ``w``'s true bonus exists: under full
+    acceptance the window settles ``[d_1 .. d_k, t_k]`` and every
+    token except ``t_k`` is host-known, so the plan-ahead drafts from
+    the PREDICTED ``t_k`` (= column ``ks`` here) while the device row
+    chains the true one. Two fixed-shape propose calls, so a jitted
+    draft stays AOT: column j of propose(last, ctx) is the draft's
+    prediction for the target's output at position ``ctx + j``, and
+    re-seeding at ``(p_k, ctx + k)`` continues the SAME chain.
+
+    A draft may fuse the two calls by exposing its own
+    ``propose_full(last, ctx) -> [S, k+1]`` (one batched invocation —
+    what a real draft model does; also what lets a cost-modelled
+    draft charge ONE window latency instead of two)."""
+    fused = getattr(draft, "propose_full", None)
+    if fused is not None:
+        out = np.asarray(fused(last, ctx), np.int32)
+        if out.shape[1] != draft.k + 1:
+            raise ValueError(
+                f"draft.propose_full returned width {out.shape[1]}, "
+                f"wanted k+1 = {draft.k + 1}")
+        return out
+    p = np.asarray(draft.propose(last, ctx), np.int32)
+    ctx = np.asarray(ctx, np.int64)
+    q = np.asarray(draft.propose(p[:, -1], ctx + draft.k), np.int32)
+    return np.concatenate([p, q[:, :1]], axis=1)
+
+
+def accept_tree(drafts, sibs, target_trunk, target_sibs):
+    """Longest matching root-to-leaf path through the verify window's
+    token tree — still exact greedy prefix match, per branch.
+
+    ``drafts[ks]`` = trunk proposals, ``sibs[w]`` = first-position
+    siblings, ``target_trunk[ks+1]`` = target outputs of the base +
+    trunk rows (``t_0 .. t_ks``), ``target_sibs[w]`` = target outputs
+    of the sibling rows. Returns ``(run, sib_idx)``: the settled
+    token run and which sibling won (-1 = trunk path). The trunk
+    wins ties — its tokens are already APPENDED at their positions,
+    so equal-length paths prefer the one needing no repair. A sibling
+    path only beats the trunk when the trunk's FIRST position missed
+    (trunk path length 1) and a sibling carries the true ``t_0``:
+    then the sibling row's output is the target's next token after
+    it — 2 tokens instead of 1."""
+    a = accept_length(drafts, target_trunk)
+    tt = np.atleast_1d(np.asarray(target_trunk))
+    if a == 0 and len(np.atleast_1d(np.asarray(sibs))):
+        t0 = int(tt[0])
+        ts = np.atleast_1d(np.asarray(target_sibs))
+        for i, sb in enumerate(np.atleast_1d(np.asarray(sibs))):
+            if int(sb) == t0:
+                return [t0, int(ts[i])], int(i)
+    return [int(t) for t in tt[:a + 1]], -1
 
 
 def clamp_spec_k(k: int, ctx: int, max_total: int, chunk: int) -> int:
@@ -271,7 +487,9 @@ __all__ = [
     "SpecStats",
     "TruncatedDraft",
     "accept_length",
+    "accept_tree",
     "clamp_spec_k",
+    "propose_full",
     "synthetic_next_token",
     "token_run",
 ]

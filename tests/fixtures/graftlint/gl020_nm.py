@@ -1,0 +1,44 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl020_nm.py
+"""GL020 near-misses that must stay silent: ctx reads inside the
+plan/collect sites that own the provisional advance and its rollback,
+the settled-token rebuild, watermark-aware consumers, frozen
+step-plan snapshots, and locals that merely share the name."""
+
+
+class Executor:
+    def _plan_step(self):
+        # The advance's owner: planning reads AND moves the cursor.
+        for s, st in enumerate(self._states):
+            if st is not None:
+                self._ctx_vec[s] = st.ctx
+                st.ctx += 1
+
+    def _collect_spec(self, handle, raw):
+        # The rollback's owner: acceptance truncates ctx back to the
+        # watermark under the owner guard.
+        for s, st in enumerate(self._states):
+            if st is not None and st.ctx > st.confirmed:
+                st.ctx = st.confirmed
+
+    def _reattach(self, slot, req):
+        # Cursors rebuilt from SETTLED tokens — durable truth.
+        st = self._states[slot]
+        st.ctx = len(req.prompt_tokens) + len(req.tokens)
+        return st.ctx
+
+    def export_pages(self, slot):
+        # Watermark-aware: clamping to confirmed is exactly the
+        # discipline the rule wants; the ctx read rides along.
+        st = self._states[slot]
+        n = min(st.ctx, st.confirmed)
+        return self._gather(st.lease.blocks, n)
+
+    def _dispatch(self, plan):
+        # A step plan's ctx is a frozen snapshot taken at plan time —
+        # dispatch geometry, not live slot state.
+        return self._step(plan.host_tok, plan.ctx, plan.n_new)
+
+    def window_size(self, base, k):
+        # A local that merely shares the name.
+        ctx = base + k
+        return ctx

@@ -1,0 +1,25 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/kvcache/fx_gl020_tp.py
+"""GL020 true positives: the provisionally-advanced slot cursor read
+by rollback-unaware consumers. Two findings: a stats export that
+reports ctx as 'tokens generated', and a cache-publish helper that
+sizes its insert from ctx — both see positions whose KV may still be
+rejected by the in-flight verify window."""
+
+
+class Executor:
+    def kv_stats(self):
+        # TP 1: ctx runs past the confirmed watermark while a
+        # speculative window is in flight — exporting it as progress
+        # counts tokens the verify step may throw away.
+        total = 0
+        for st in self._states:
+            if st is not None:
+                total += st.ctx
+        return {"generated_tokens": total}
+
+    def publish_finished(self, slot, tokens):
+        # TP 2: sizing the prefix-cache insert from the provisional
+        # cursor publishes unverified speculative KV — the bug class
+        # the watermark exists to prevent.
+        st = self._states[slot]
+        self.prefix.insert(tokens[:st.ctx], st.lease.blocks)

@@ -975,6 +975,107 @@ def test_kv_kill_mid_verify_resumes_from_confirmed_watermark(
     assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
 
 
+@pytest.mark.parametrize("backend", ["synthetic", "paged"])
+def test_kv_kill_mid_pipelined_verify_with_window_in_flight(
+        backend, settle_counts, tmp_path):
+    """Chaos-matrix extension (ISSUE 18): kill a PIPELINED
+    speculative replica while a plan-ahead verify window is in
+    flight. The killed incarnation dies holding (a) an uncollected
+    verify window and (b) the provisional ctx advance of the window
+    planned from its unverified proposals — both must evaporate:
+    _reattach rebuilds cursors from the confirmed watermark's settled
+    tokens, and the restarted replica re-plans from there. Streams
+    byte-identical vs the uninjected pipelined-spec run, settle
+    exactly once, leak ledger clean, flight doc shows the
+    fault + KV-preserving requeue (the rollback's observable).
+
+    int8 stays exact here (paged default): drafts are pure functions
+    of (last, ctx), so the dead window's provisional appends are
+    byte-identical to the restart's re-appends at the same positions
+    — the set-once scale a dead window seeded is the scale the replay
+    would have written (and on a mis-predicted plan-ahead, BOTH runs
+    seeded the same wrong-byte scale before rolling back)."""
+    from dpu_operator_tpu.serving.spec import OracleDraft, SpecConfig
+
+    t0 = time.perf_counter()
+    plen, chunk, max_toks, k = 32, 8, 8, 4
+    prompt = [int(x) for x in range(plen)]
+    if backend == "synthetic":
+        from dpu_operator_tpu.serving import SyntheticKVExecutor
+
+        inner = SyntheticKVExecutor(
+            slots=2, block_size=4, num_blocks=64,
+            max_blocks_per_req=16, prefill_chunk=chunk,
+            pipelined=True,
+            spec=SpecConfig(OracleDraft(k=k, accept_rate=0.6,
+                                        vocab=64, target_seed=0), k))
+    else:
+        from dpu_operator_tpu.serving import PagedKVExecutor
+
+        inner = PagedKVExecutor(slots=2, block_size=4, num_blocks=64,
+                                max_blocks_per_req=16,
+                                prefill_chunk=chunk, d=16, heads=2,
+                                vocab=32,
+                                mode="speculative-pipelined",
+                                spec_k=k)
+
+    def run(inject, flight_dir=None):
+        ex = FaultyExecutor(inner, site="kvs0") if inject else inner
+        reqs = [GenerateRequest(prompt_vec=None, max_tokens=max_toks,
+                                deadline=time.monotonic() + 60.0,
+                                prompt_tokens=list(prompt))]
+        pool, _q = _run_pool([ex], reqs, timeout=20.0,
+                             flight_dir=flight_dir)
+        try:
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        inner.allocator.assert_clean()
+        return [(r.error, list(r.tokens)) for r in reqs], reqs
+
+    baseline, _ = run(inject=False)
+    runs_before = inner.spec.stats.runs
+    assert runs_before > 0, "the baseline never speculated"
+    assert inner.kv_stats()["spec_pipeline_peak"] >= 2, \
+        "the baseline never overlapped draft with verify"
+    with obs_trace.scoped() as tr:
+        with faults.injected() as plan:
+            # Prefix cache primed: prefill is one chunk step, submit
+            # 2 is the post-prefill bubble (last_token in flight), 3
+            # the first verify window. Submit 4 is planned from
+            # window 3's UNVERIFIED proposals while 3 is still in
+            # flight — killing there dies with both a pending collect
+            # and a provisional plan-ahead advance.
+            plan.inject("kvs0.submit",
+                        exc=RuntimeError("injected pipelined kill"),
+                        at_calls=[4])
+            injected, reqs = run(inject=True, flight_dir=tmp_path)
+        spans = tr.spans_snapshot()
+    assert injected == baseline, (injected, baseline)
+    assert all(e is None for e, _ in injected)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    assert inner.resumed_total >= 1
+    assert inner.spec.stats.runs > runs_before
+    victim = reqs[0].request_id
+    requeues = [s for s in spans if s.name == "supervisor.requeue"
+                and s.attrs.get("outcome") == "requeued_kv"]
+    assert [s.request_id for s in requeues] == [victim]
+    flight = _flight_spans(tmp_path, "restart")
+    assert any(s["name"] == "fault.fired"
+               and s["attrs"].get("site") == "kvs0.submit"
+               for s in flight), "flight doc is missing the kill"
+    assert any(s["name"] == "supervisor.requeue"
+               and s["attrs"].get("outcome") == "requeued_kv"
+               for s in flight), \
+        "flight doc is missing the watermark-preserving requeue"
+    if hasattr(inner, "close"):
+        inner.close()
+    assert time.perf_counter() - t0 < 2 * CASE_BUDGET_S
+
+
 # -- health contract over HTTP ------------------------------------------------
 
 

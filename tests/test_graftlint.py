@@ -43,7 +43,7 @@ def _analyze_source(tmp_path, source, name="fx.py", baseline=None):
 
 def test_package_gate_clean_and_fast():
     """The tier-1 gate: zero non-baselined findings over the whole
-    package with ALL 19 rules active (including the interprocedural
+    package with ALL 20 rules active (including the interprocedural
     GL012/GL013 passes), inside the 20 s lint-lane budget docs/ci.md
     carries (measured ~6 s on the 2-cpu container)."""
     t0 = time.perf_counter()
@@ -58,7 +58,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 19
+    assert len(set(ids)) == len(ids) == 20
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -85,6 +85,7 @@ _EXPECT = {
     "GL017": 2,  # plan-time decode_tokens bump + submit last_token stamp
     "GL018": 2,  # inline even split + inline rank*blocks//world range
     "GL019": 2,  # unverified tier restore + unverified origin-tagged insert
+    "GL020": 2,  # ctx-as-progress stats export + ctx-sized cache publish
 }
 
 

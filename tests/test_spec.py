@@ -31,7 +31,8 @@ from dpu_operator_tpu.serving import (AdmissionQueue, ContinuousBatcher,
                                       SyntheticKVExecutor)
 from dpu_operator_tpu.serving.spec import (NO_TOKEN, OracleDraft,
                                            SpecConfig, accept_length,
-                                           clamp_spec_k,
+                                           accept_tree, clamp_spec_k,
+                                           propose_full,
                                            synthetic_next_token,
                                            token_run)
 
@@ -136,11 +137,14 @@ def test_spec_config_validates_k_and_loop_shape():
     with pytest.raises(ValueError, match="prefill_chunk"):
         SyntheticKVExecutor(prefill_chunk=4, pipelined=False,
                             spec=_oracle_spec(k=4))
-    with pytest.raises(ValueError, match="sync loop shape"):
-        SyntheticKVExecutor(pipelined=True, spec=_oracle_spec(k=4))
-    # The batcher's own override knob is guarded too (the executor
-    # flag is what it keys on): forcing the plan-ahead loop over a
-    # speculative executor would plan against provisional cursors.
+    # ISSUE 18: pipelined speculation is now a supported mode — the
+    # executor composes spec with the plan-ahead loop natively.
+    ex = SyntheticKVExecutor(pipelined=True, spec=_oracle_spec(k=4))
+    assert ex.speculative and ex.pipelined
+    ex.close()
+    # What stays guarded: forcing the batcher's pipelined override
+    # over an executor BUILT for the sync shape — its collect
+    # discipline assumes one window in flight from a settled cursor.
     ex = SyntheticKVExecutor(pipelined=False, spec=_oracle_spec(k=4))
     with pytest.raises(ValueError, match="sync loop shape"):
         ContinuousBatcher(ex, AdmissionQueue(max_depth=2),
@@ -321,6 +325,314 @@ def test_truncated_draft_shares_target_token_space():
     assert (0 <= out).all() and (out < MODEL["vocab"]).all()
 
 
+# -- ISSUE 18: pipelined speculation + tree drafts ---------------------------
+
+
+def test_propose_full_extends_chain_by_one():
+    """propose_full returns [S, k+1]: the k-chain plus the draft's
+    prediction of the verify step's bonus token — the token the
+    pipelined plan-ahead chains the NEXT window from."""
+    d = OracleDraft(k=3, accept_rate=1.0, vocab=VOCAB, target_seed=0)
+    last = np.array([7, 2], np.int32)
+    ctx = np.array([10, 4], np.int32)
+    pf = propose_full(d, last, ctx)
+    assert pf.shape == (2, 4)
+    assert np.array_equal(pf[:, :3], d.propose(last, ctx))
+    # With the exact oracle the predicted bonus IS the true chain:
+    for s in range(2):
+        t = int(last[s])
+        for j in range(4):
+            t = synthetic_next_token(t, int(ctx[s]) + j, 0, VOCAB)
+            if j == 3:
+                assert int(pf[s, j]) == t
+
+
+def test_accept_tree_paths():
+    # trunk partial accept: identical to accept_length + bonus
+    assert accept_tree([5, 6, 7], [9, 4], [5, 6, 8, 1],
+                       [0, 0]) == ([5, 6, 8], -1)
+    # trunk miss, no sibling matches: single corrected token
+    assert accept_tree([5, 6, 7], [9, 4], [3, 6, 8, 1],
+                       [0, 7]) == ([3], -1)
+    # trunk miss, sibling 1 == true first token: two tokens via the
+    # side branch (the sibling's own verify output is its bonus)
+    assert accept_tree([5, 6, 7], [9, 3], [3, 6, 8, 1],
+                       [0, 7]) == ([3, 7], 1)
+    # trunk accepts >= 1 token: trunk wins even if a sib also matches
+    assert accept_tree([5, 6, 7], [5, 3], [5, 6, 8, 1],
+                       [0, 7]) == ([5, 6, 8], -1)
+    # no siblings proposed degrades to the chain contract
+    assert accept_tree([5], [], [4, 2], []) == ([4], -1)
+
+
+def test_oracle_draft_sibling_proposals():
+    """sib_rate=1.0 with accept_rate=0.0: the trunk always misses its
+    first token and sibling 0 always carries the true one — the tree
+    rescues exactly one extra token per window."""
+    d = OracleDraft(k=4, accept_rate=0.0, vocab=VOCAB, target_seed=0,
+                    tree_width=3, sib_rate=1.0)
+    last = np.arange(6, dtype=np.int32)
+    ctx = np.arange(6, dtype=np.int32) * 2
+    sibs = d.propose_sibs(last, ctx)
+    assert sibs.shape == (6, 2)
+    trunk = d.propose(last, ctx)
+    for s in range(6):
+        true0 = synthetic_next_token(int(last[s]), int(ctx[s]), 0,
+                                     VOCAB)
+        assert int(trunk[s, 0]) != true0        # trunk misses
+        assert int(sibs[s, 0]) == true0         # sib 0 rescues
+        assert int(sibs[s, 1]) != true0         # later sibs distinct
+
+
+def test_spec_config_tree_and_adaptive_dials():
+    d = OracleDraft(k=6, accept_rate=0.5, vocab=VOCAB, tree_width=2)
+    cfg = SpecConfig(d, 6, adaptive=True, k_min=2)
+    assert cfg.k_for(1.0) == 6 and cfg.k_for(0.0) == 2
+    ks = [cfg.k_for(e) for e in np.linspace(0, 1, 11)]
+    assert ks == sorted(ks)                     # monotone dial
+    # high acceptance collapses the tree to a chain (siblings only
+    # pay off when the trunk's first token is at risk)
+    assert cfg.width_for(0.95) == 1
+    assert cfg.width_for(0.5) == 2
+    fixed = SpecConfig(OracleDraft(k=6, vocab=VOCAB), 6)
+    assert fixed.k_for(0.0) == 6                # non-adaptive: fixed
+    with pytest.raises(ValueError, match="tree_width"):
+        SpecConfig(OracleDraft(k=4, vocab=VOCAB), 4, tree_width=0)
+
+    class _ChainOnly:                   # a draft with no sibling hook
+        k = 4
+
+        def propose(self, last, ctx):
+            return np.zeros((len(last), 4), np.int32)
+
+    with pytest.raises(ValueError, match="propose_sibs"):
+        SpecConfig(_ChainOnly(), 4, tree_width=2)
+    with pytest.raises(ValueError, match="k_min"):
+        SpecConfig(OracleDraft(k=4, vocab=VOCAB), 4, adaptive=True,
+                   k_min=9)
+
+
+def _manual_steps(ex, req, n_steps):
+    for _ in range(n_steps):
+        runs = ex.collect(ex.submit((), gen=ex.kv_gen()))
+        req.tokens.extend(token_run(runs[0]))
+
+
+def test_adaptive_dial_converges_both_directions():
+    """Satellite: the per-slot accept-rate EWMA dials k down on a
+    cold slot and back up on a hot one."""
+    # Down: rate-0 draft, EWMA decays 1.0 -> ~0 and k hits k_min.
+    cfg = SpecConfig(OracleDraft(k=4, accept_rate=0.0, vocab=VOCAB),
+                     4, adaptive=True, k_min=1)
+    ex = _synth(spec=cfg, slots=1, pipelined=False)
+    req = _req(list(np.arange(12) % 7), max_tokens=40)
+    ex.kv_attach(0, req)
+    _manual_steps(ex, req, 10)
+    st = ex._states[0]
+    assert st.spec_ewma < 0.1
+    assert cfg.k_for(st.spec_ewma) == 1
+    ex.kv_release_slot(0, cache=False)
+    ex.close()
+
+    # Up: exact draft but a pessimistic prior — EWMA recovers.
+    cfg = SpecConfig(OracleDraft(k=4, accept_rate=1.0, vocab=VOCAB),
+                     4, adaptive=True, k_min=1)
+    ex = _synth(spec=cfg, slots=1, pipelined=False)
+    req = _req(list(np.arange(12) % 7), max_tokens=50)
+    ex.kv_attach(0, req)
+    ex._states[0].spec_ewma = 0.05
+    _manual_steps(ex, req, 10)
+    st = ex._states[0]
+    assert st.spec_ewma > 0.8
+    assert cfg.k_for(st.spec_ewma) == 4
+    ex.kv_release_slot(0, cache=False)
+    ex.close()
+
+
+@pytest.mark.parametrize("accept_rate", [0.0, 0.6, 1.0])
+def test_synthetic_pipelined_spec_matrix_byte_identical(accept_rate):
+    """ISSUE 18 acceptance: the full equivalence matrix on the
+    synthetic plane — pipelined-spec vs sync-spec vs the one-token
+    loop, byte-identical at every acceptance rate. Rate 0 forces a
+    plan-ahead rollback + re-plan on nearly every window; rate 1
+    keeps the plan-ahead chain unbroken (zero re-plans)."""
+    base = _synth(pipelined=False)
+    golden = _drive(base, [_req(p, max_tokens=6) for p in PROMPTS])
+    base.allocator.assert_clean()
+    base.close()
+
+    streams = {}
+    stats = {}
+    for pipelined in (False, True):
+        ex = _synth(spec=_oracle_spec(accept_rate=accept_rate),
+                    pipelined=pipelined)
+        streams[pipelined] = _drive(
+            ex, [_req(p, max_tokens=6) for p in PROMPTS])
+        stats[pipelined] = ex.kv_stats()
+        ex.allocator.assert_clean()
+        ex.close()
+    assert streams[False] == golden
+    assert streams[True] == golden, (streams[True], golden)
+    assert any(len(set(s)) > 1 for s in golden)
+    st = stats[True]
+    assert st["spec_pipeline_peak"] >= 2     # overlap actually happened
+    assert st["spec_pipeline_depth"] == 0    # drained at stop
+    if accept_rate == 0.0:
+        assert st["spec_replans"] > 0        # every miss re-plans
+    if accept_rate == 1.0:
+        assert st["spec_replans"] == 0       # chain never breaks
+
+
+@pytest.mark.parametrize("accept_rate", [0.0, 0.5, 1.0])
+def test_synthetic_tree_spec_byte_identical_and_rescues(accept_rate):
+    """Tree drafts on the synthetic plane: streams stay byte-identical
+    and, at low trunk acceptance with a hot sibling, the side branch
+    rescues windows the chain would lose (path_len 2 entries)."""
+    base = _synth(pipelined=False)
+    golden = _drive(base, [_req(p, max_tokens=6) for p in PROMPTS])
+    base.allocator.assert_clean()
+    base.close()
+
+    d = OracleDraft(k=4, accept_rate=accept_rate, vocab=VOCAB,
+                    target_seed=0, tree_width=3, sib_rate=1.0)
+    ex = _synth(spec=SpecConfig(d, 4), pipelined=True)
+    streams = _drive(ex, [_req(p, max_tokens=6) for p in PROMPTS])
+    st = ex.kv_stats()
+    ex.allocator.assert_clean()
+    ex.close()
+    assert streams == golden, (streams, golden)
+    if accept_rate == 0.0:
+        # every window: trunk misses, sibling 0 carries the truth
+        assert st["spec_path_len"].get(2, 0) > 0
+        assert st["spec_tokens_per_step"] > 1.0
+
+
+def test_tree_sibling_repair_row_closes_the_kv_hole():
+    """After a sibling acceptance the trunk's wrong token sits
+    appended at the accepted position — the next window's repair row
+    must overwrite it, or every later decode attends to stale KV.
+    Long generation after many sibling accepts proves the repair."""
+    base = _synth(pipelined=False, slots=1)
+    (golden,) = _drive(base, [_req([3, 1, 4, 1, 5], max_tokens=24)])
+    base.allocator.assert_clean()
+    base.close()
+
+    d = OracleDraft(k=3, accept_rate=0.0, vocab=VOCAB, target_seed=0,
+                    tree_width=2, sib_rate=1.0)
+    ex = _synth(spec=SpecConfig(d, 3), pipelined=True, slots=1)
+    (stream,) = _drive(ex, [_req([3, 1, 4, 1, 5], max_tokens=24)])
+    st = ex.kv_stats()
+    ex.allocator.assert_clean()
+    ex.close()
+    assert stream == golden, (stream, golden)
+    assert st["spec_path_len"].get(2, 0) >= 8
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_paged_pipelined_spec_streams_byte_identical(kernel):
+    """The real jitted plane, both kernels: mode
+    \"speculative-pipelined\" (plan-ahead draft + device-chained base
+    row) equals the sync one-token loop byte-for-byte. fp32 pools —
+    the exact lane."""
+    interp = True if kernel == "pallas" else None
+    prompts = PROMPTS[:2]
+    toks = 5 if kernel == "xla" else 4
+    sync = _paged(mode="sync", kernel=kernel, interpret=interp)
+    golden = _drive(sync, [_req(p, max_tokens=toks) for p in prompts])
+    sync.allocator.assert_clean()
+    sync.close()
+
+    spec = _paged(mode="speculative-pipelined", spec_k=3,
+                  kernel=kernel, interpret=interp)
+    streams = _drive(spec, [_req(p, max_tokens=toks)
+                            for p in prompts])
+    st = spec.kv_stats()
+    spec.allocator.assert_clean()
+    spec.close()
+    assert streams == golden, (streams, golden)
+    assert any(len(set(s)) > 1 for s in golden)
+    assert st["spec_verify_steps"] > 0
+    assert st["spec_pipeline_peak"] >= 2
+
+
+def test_paged_tree_spec_streams_byte_identical():
+    """Tree verify on the real model: the XLA tree-mask executable
+    (score-only sibling rows, strict plim on the shared position)
+    produces byte-identical streams. TruncatedDraft's top-k siblings
+    supply the side branches. Pallas falls back to the same XLA
+    composition for tree windows, so one kernel lane suffices."""
+    sync = _paged(mode="sync")
+    golden = _drive(sync, [_req(p, max_tokens=5) for p in PROMPTS[:2]])
+    sync.allocator.assert_clean()
+    sync.close()
+
+    spec = _paged(mode="speculative-pipelined", spec_k=3,
+                  spec_tree_width=3)
+    streams = _drive(spec, [_req(p, max_tokens=5)
+                            for p in PROMPTS[:2]])
+    st = spec.kv_stats()
+    spec.allocator.assert_clean()
+    spec.close()
+    assert streams == golden, (streams, golden)
+    assert st["spec_verify_steps"] > 0
+
+
+def test_truncated_draft_sibling_ranks():
+    from dpu_operator_tpu.serving.spec import TruncatedDraft
+
+    ex = _paged(mode="speculative", spec_k=3, spec_tree_width=3)
+    draft = ex.spec.draft
+    assert isinstance(draft, TruncatedDraft)
+    assert draft.tree_width == 3
+    last = np.zeros(2, np.int32)
+    ctx = np.zeros(2, np.int32)
+    sibs = draft.propose_sibs(last, ctx)
+    trunk = draft.propose(last, ctx)
+    assert sibs.shape == (2, 2)
+    assert (0 <= sibs).all() and (sibs < MODEL["vocab"]).all()
+    for s in range(2):                          # ranks 2..W: disjoint
+        assert int(trunk[s, 0]) not in set(int(x) for x in sibs[s])
+    ex.close()
+
+
+def test_pipelined_spec_resume_reattaches_from_confirmed_watermark():
+    """Kill with a plan-ahead window in flight: reset() drops the
+    uncollected window, re-attach replays only SETTLED tokens, and
+    the resumed stream is byte-identical."""
+    prompt = list(np.arange(16) % 9)
+    ref = _synth(spec=_oracle_spec(accept_rate=0.6), slots=1,
+                 pipelined=True)
+    (golden,) = _drive(ref, [_req(prompt, max_tokens=8)])
+    ref.allocator.assert_clean()
+    ref.close()
+
+    ex = _synth(spec=_oracle_spec(accept_rate=0.6), slots=1,
+                pipelined=True)
+    req = _req(prompt, max_tokens=8)
+    ex.kv_attach(0, req)
+    # pipelined shape: keep one window in flight, then "die" with it
+    pending = ex.submit((), gen=ex.kv_gen())
+    while len(req.tokens) < 3:
+        nxt = ex.submit((), gen=ex.kv_gen())
+        runs = ex.collect(pending)
+        req.tokens.extend(token_run(runs[0]))
+        pending = nxt
+    ex.reset()                      # in-flight window dies with us
+    assert req.kv_lease.resumable
+    ex.kv_attach(0, req)
+    assert ex.resumed_total == 1
+    while len(req.tokens) < 8:
+        runs = ex.collect(ex.submit((), gen=ex.kv_gen()))
+        for t in token_run(runs[0]):
+            if len(req.tokens) < 8:
+                req.tokens.append(t)
+    assert list(req.tokens) == golden
+    ex.kv_release_slot(0)
+    req.finish()
+    ex.allocator.assert_clean()
+    ex.close()
+
+
 # -- /metrics exposition -----------------------------------------------------
 
 
@@ -359,5 +671,56 @@ def test_metrics_exposition_of_spec_series():
             if l.startswith("serving_spec_accept_rate")]
     assert float(acc[0].split()[-1]) > 0        # oracle at rate 1.0
     assert float(rate[0].split()[-1]) == 1.0
+    ex.allocator.assert_clean()
+    ex.close()
+
+
+def test_metrics_exposition_of_pipelined_spec_series():
+    """ISSUE 18 satellite: re-plan counter, tree path-length
+    histogram and pipeline-depth gauges appear in a live /metrics
+    scrape of a pipelined tree-speculative replica. accept_rate 0 +
+    sib_rate 1 forces re-plans AND sibling paths every window, so
+    both new series carry non-trivial values."""
+    import json
+    import urllib.request
+
+    from dpu_operator_tpu.serving import ServingServer
+
+    d = OracleDraft(k=4, accept_rate=0.0, vocab=VOCAB, target_seed=0,
+                    tree_width=2, sib_rate=1.0)
+    ex = SyntheticKVExecutor(slots=2, num_blocks=64, pipelined=True,
+                             spec=SpecConfig(d, 4))
+    srv = ServingServer([ex]).start()
+    try:
+        body = json.dumps({"prompt_tokens": list(range(1, 10)),
+                           "max_tokens": 8,
+                           "deadline_ms": 10000}).encode()
+        for _ in range(2):
+            urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/v1/generate",
+                                       data=body), timeout=10).read()
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=5).read().decode()
+    finally:
+        srv.stop()
+    for series in ("serving_spec_replans_total",
+                   "serving_spec_pipeline_depth",
+                   "serving_spec_pipeline_peak",
+                   "serving_spec_tree_path_len_bucket"):
+        assert series in text, series
+    lines = text.splitlines()
+    replans = [l for l in lines
+               if l.startswith("serving_spec_replans_total")]
+    assert float(replans[0].split()[-1]) > 0    # rate 0 re-plans
+    peak = [l for l in lines
+            if l.startswith("serving_spec_pipeline_peak")]
+    assert float(peak[0].split()[-1]) >= 2      # overlap happened
+    depth = [l for l in lines
+             if l.startswith("serving_spec_pipeline_depth")]
+    assert float(depth[0].split()[-1]) == 0     # drained at scrape
+    # histogram: the sib-rescued two-token paths land in le="2.0"
+    cnt = [l for l in lines
+           if l.startswith("serving_spec_tree_path_len_count")]
+    assert float(cnt[0].split()[-1]) > 0
     ex.allocator.assert_clean()
     ex.close()
