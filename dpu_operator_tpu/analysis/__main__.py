@@ -3,7 +3,7 @@
     python -m dpu_operator_tpu.analysis [paths...]
         [--format text|json|sarif] [--rules GL004,GL013]
         [--baseline FILE | --no-baseline] [--ratchet-report]
-        [--list-rules]
+        [--profile] [--list-rules]
 
 Exit codes: 0 clean (stale baseline entries are notes, not failures),
 1 findings, 2 usage/config error. The tier-1 gate and `make lint` both
@@ -11,7 +11,10 @@ run exactly this entry point. ``--format sarif`` emits SARIF 2.1.0 so
 CI can annotate PRs per finding; ``--rules`` restricts the run to a
 comma-separated rule-id list (one lane per rule class).
 ``--ratchet-report`` appends the per-(rule, path) baseline-vs-current
-table that makes fix-then-delete progress visible.
+table that makes fix-then-delete progress visible, plus every
+fully-unused entry grouped by rule as ONE deletable (and re-parseable)
+``[[suppress]]`` block. ``--profile`` appends per-rule wall time — the
+docs/ci.md lint budget's per-rule breakdown.
 """
 
 from __future__ import annotations
@@ -104,6 +107,45 @@ def _print_stale(stale: list, selected: set) -> None:
                   f"count to {s['used']}")
 
 
+def _print_stale_combined(stale: list, selected: set) -> None:
+    """--ratchet-report companion: every fully-unused entry, grouped
+    by rule, emitted as ONE deletable TOML block — a single paste-
+    delete edit to baseline.toml instead of per-entry hunting. The
+    block (comment lines included) re-parses through the baseline
+    parser verbatim; tests round-trip it."""
+    dead = sorted((s for s in stale
+                   if s["rule"] in selected and s["used"] == 0),
+                  key=lambda s: (s["rule"], s["path"], s["func"]))
+    if not dead:
+        return
+    by_rule: dict = {}
+    for s in dead:
+        by_rule.setdefault(s["rule"], []).append(s)
+    noun = "entry" if len(dead) == 1 else "entries"
+    print(f"ratchet: {len(dead)} fully-unused baseline {noun} across "
+          f"{len(by_rule)} rule(s) — delete this combined block from "
+          f"baseline.toml:")
+    for rule in sorted(by_rule):
+        print(f"    # -- {rule} ({len(by_rule[rule])}) --")
+        for s in by_rule[rule]:
+            print(_toml_block(s))
+
+
+def _print_profile(report) -> None:
+    """Per-rule wall time + raw finding count, slowest first. The
+    whole-program passes (GL012/GL013 lockset, GL021/GL022 typestate)
+    memoize their shared analysis on the Project — that build cost
+    lands on the FIRST rule that touches it, by design."""
+    rows = sorted(report.rule_timings.items(), key=lambda kv: -kv[1])
+    total_ms = sum(report.rule_timings.values()) * 1000
+    print(f"profile: {'rule':6s} {'ms':>9s} {'findings':>8s}   "
+          f"({report.checked_files} files, "
+          f"{total_ms:.0f} ms in rules)")
+    for rule_id, secs in rows:
+        print(f"profile: {rule_id:6s} {secs * 1000:9.1f} "
+              f"{report.rule_findings.get(rule_id, 0):8d}")
+
+
 def _print_ratchet(report, selected: set) -> None:
     """Per-(rule, path): how many findings the baseline tolerates vs
     how many the tree currently produces (absorbed + still reported).
@@ -156,7 +198,11 @@ def main(argv=None) -> int:
                     help="report grandfathered findings too")
     ap.add_argument("--ratchet-report", action="store_true",
                     help="append per-(rule,path) baseline-vs-current "
-                         "counts (text format only)")
+                         "counts plus a combined deletable block of "
+                         "fully-unused entries (text format only)")
+    ap.add_argument("--profile", action="store_true",
+                    help="append per-rule wall time and finding "
+                         "counts, slowest first (text format only)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -210,6 +256,9 @@ def main(argv=None) -> int:
         _print_stale(report.stale_baseline, selected)
         if args.ratchet_report:
             _print_ratchet(report, selected)
+            _print_stale_combined(report.stale_baseline, selected)
+        if args.profile:
+            _print_profile(report)
         print(f"graftlint: {len(report.findings)} finding(s), "
               f"{report.suppressed_baseline} baselined, "
               f"{report.checked_files} files in {elapsed:.2f}s")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import ast
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
@@ -241,6 +242,13 @@ class Report:
     # Per-entry (rule, path, func, count, used) after filtering — the
     # --ratchet-report raw material.
     baseline_usage: List[dict] = field(default_factory=list)
+    # rule_id -> wall seconds spent inside that rule's check() calls
+    # (--profile raw material). Whole-program passes (concurrency,
+    # lifecycle) are memoized on the Project, so their build cost
+    # lands on the FIRST rule that touches them.
+    rule_timings: Dict[str, float] = field(default_factory=dict)
+    # rule_id -> findings produced before baseline filtering.
+    rule_findings: Dict[str, int] = field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -253,6 +261,8 @@ class Report:
             "stale_baseline": self.stale_baseline,
             "checked_files": self.checked_files,
             "clean": self.clean,
+            "rule_timings_ms": {r: round(s * 1000, 1)
+                                for r, s in self.rule_timings.items()},
         }
 
 
@@ -270,15 +280,21 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     project.declared_axes = collect_declared_axes(project.modules)
 
     raw: List[Finding] = []
+    timings = {r.rule_id: 0.0 for r in rules}
+    counts = {r.rule_id: 0 for r in rules}
     for module in project.modules:
         for rule in rules:
-            for f in rule.check(module, project):
-                if not module.line_suppressed(f.line, f.rule):
-                    raw.append(f)
+            t0 = time.perf_counter()
+            found = [f for f in rule.check(module, project)
+                     if not module.line_suppressed(f.line, f.rule)]
+            timings[rule.rule_id] += time.perf_counter() - t0
+            counts[rule.rule_id] += len(found)
+            raw.extend(found)
     raw.sort(key=lambda f: (f.path, f.line, f.rule))
 
     bl = Baseline.load(baseline) if baseline else Baseline([])
     kept, n_suppressed = bl.filter(raw)
     return Report(findings=kept, suppressed_baseline=n_suppressed,
                   stale_baseline=bl.stale(), checked_files=len(files),
-                  baseline_usage=bl.usage())
+                  baseline_usage=bl.usage(), rule_timings=timings,
+                  rule_findings=counts)

@@ -1,0 +1,17 @@
+"""Lifecycle typestate analysis (GL021–GL023).
+
+machines.py  — declarative state machines for the serving plane's
+               lifecycle objects, bound to the real method names.
+cfg.py       — statement-level CFG with explicit exception edges.
+typestate.py — may-state walk + interprocedural function summaries
+               over the strict call-graph edge set.
+rules_life.py— GL021 illegal transition, GL022 leak-on-exception-edge,
+               GL023 fault-site coverage.
+"""
+
+from .machines import MACHINES, MACHINES_BY_NAME, Machine  # noqa: F401
+from .rules_life import (GL023_ALLOWLIST,  # noqa: F401
+                         FaultSiteUncovered,
+                         IllegalLifecycleTransition,
+                         LifecycleAnalysis,
+                         LifecycleLeakOnException)

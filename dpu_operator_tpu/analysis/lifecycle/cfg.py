@@ -1,0 +1,355 @@
+"""Statement-level CFG with explicit exception edges.
+
+One node per simple statement plus one per compound-statement header
+(an `if` test, a `for` iterable, a `with` item list, an
+`except` entry). Three virtual nodes frame every function: `entry`,
+`exit` (normal return / fall-off), and `raise_exit` (an exception
+propagating out of the function). Edges carry an `is_exc` flag — the
+typestate walk taints facts that flow along exception edges, which is
+how "leak on exception path" stays distinct from "lives on past a
+clean return".
+
+Exception routing is deliberately OPTIMISTIC, the safe direction for
+a ratcheting gate (the same stance as callgraph.py's unresolved-call
+rule):
+
+  * a statement can raise iff its own expressions contain a call (or
+    it IS a `raise` / `assert`) — attribute and subscript traps are
+    ignored;
+  * a try's handlers are assumed to catch whatever the body raises
+    (no "handler type doesn't match" bypass edge): `except KVCacheOOM`
+    around an acquire is the DESIGNED shed path, and a bypass edge
+    would report its unwind as a leak on every acquire;
+  * `finally` bodies are built once and their exits fan out to every
+    continuation that can route through them (normal fall-through,
+    outward exception propagation, early return) — a may-analysis
+    over-approximation that merges paths but never hides one.
+
+Exceptions raised INSIDE a handler or an `else` block route outward
+(Python semantics: a try's handlers do not protect its own handler
+or orelse suites), still via the try's `finally` when present.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+
+class Node:
+    __slots__ = ("idx", "stmt", "kind", "expr_root", "succ",
+                 "handler_of")
+
+    def __init__(self, idx: int, stmt: Optional[ast.AST], kind: str,
+                 expr_root: Optional[ast.AST] = None):
+        self.idx = idx
+        self.stmt = stmt
+        self.kind = kind            # entry|exit|raise_exit|stmt|
+        #                             test|iter|with|handler|finally
+        self.expr_root = expr_root  # AST scanned for events
+        self.succ: List[Tuple[int, bool]] = []   # (target, is_exc)
+        #: For handler nodes: index of the Try statement's id() group,
+        #: used by the typestate walk's per-try handler trust.
+        self.handler_of: Optional[int] = None
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self.entry = self._new(None, "entry").idx
+        self.exit = self._new(None, "exit").idx
+        self.raise_exit = self._new(None, "raise_exit").idx
+
+    def _new(self, stmt, kind, expr_root=None) -> Node:
+        n = Node(len(self.nodes), stmt, kind, expr_root)
+        self.nodes.append(n)
+        return n
+
+    def edge(self, src: int, dst: int, is_exc: bool = False) -> None:
+        pair = (dst, is_exc)
+        if pair not in self.nodes[src].succ:
+            self.nodes[src].succ.append(pair)
+
+
+#: Builtins that cannot raise on the values this codebase hands them
+#: (C-level length/identity queries) — calling one is not an exception
+#: edge. Deliberately tiny: `int(x)`/`str.encode` and friends DO raise.
+_CANT_RAISE = frozenset({"len", "isinstance", "id"})
+
+
+def _can_raise(expr_root: Optional[ast.AST]) -> bool:
+    if expr_root is None:
+        return False
+    for n in ast.walk(expr_root):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name) and f.id in _CANT_RAISE:
+                continue
+            return True
+    return False
+
+
+class _Builder:
+    """Recursive builder. Exception targets are resolved against a
+    stack of frames, innermost last:
+
+      ("handlers", [handler entry ids], try_gid)
+      ("finally",  entry id, routed-continuation collector, exit ids)
+
+    Raising from a point routes innermost-out: the first "handlers"
+    frame absorbs it; a "finally" frame interposes the finalbody and
+    keeps routing outward from the finally's exits."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        # (head node, break-exit collector, frame depth at loop entry)
+        self.loop: List[Tuple[int, List[int], int]] = []
+        self._try_gid = 0
+
+    # -- exception routing ----------------------------------------------------
+
+    def exc_targets(self, frames) -> List[int]:
+        """Where an exception raised under `frames` lands first."""
+        for frame in reversed(frames):
+            if frame[0] == "handlers":
+                return list(frame[1])
+            if frame[0] == "finally":
+                return [frame[1]]
+        return [self.cfg.raise_exit]
+
+    def _onward_from_finally(self, frames, depth) -> List[int]:
+        """Exception continuation once the finally at `depth` ran."""
+        return self.exc_targets(frames[:depth])
+
+    # -- statement lists ------------------------------------------------------
+
+    def build_body(self, stmts, frames) -> Tuple[int, List[int]]:
+        """Build a suite; returns (entry id, open normal exits)."""
+        entry: Optional[int] = None
+        open_exits: List[int] = []
+        for stmt in stmts:
+            e, x = self.build_stmt(stmt, frames)
+            if entry is None:
+                entry = e
+            for o in open_exits:
+                self.cfg.edge(o, e)
+            open_exits = x
+            if not open_exits and stmt is not stmts[-1]:
+                # Unreachable tail (after return/raise/break): still
+                # build it (events there are dead) but leave it
+                # disconnected.
+                pass
+        if entry is None:  # empty suite (only possible via pass-elision)
+            n = self.cfg._new(None, "stmt")
+            entry, open_exits = n.idx, [n.idx]
+        return entry, open_exits
+
+    # -- single statements ----------------------------------------------------
+
+    def build_stmt(self, stmt, frames) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.If,)):
+            test = cfg._new(stmt, "test", stmt.test)
+            self._wire_exc(test, frames)
+            b_entry, b_exits = self.build_body(stmt.body, frames)
+            cfg.edge(test.idx, b_entry)
+            exits = list(b_exits)
+            if stmt.orelse:
+                o_entry, o_exits = self.build_body(stmt.orelse, frames)
+                cfg.edge(test.idx, o_entry)
+                exits += o_exits
+            else:
+                exits.append(test.idx)
+            return test.idx, exits
+
+        if isinstance(stmt, (ast.While,)):
+            test = cfg._new(stmt, "test", stmt.test)
+            self._wire_exc(test, frames)
+            brk: List[int] = []
+            self.loop.append((test.idx, brk, len(frames)))
+            b_entry, b_exits = self.build_body(stmt.body, frames)
+            self.loop.pop()
+            cfg.edge(test.idx, b_entry)
+            for x in b_exits:
+                cfg.edge(x, test.idx)
+            exits = [test.idx] + brk
+            if stmt.orelse:
+                o_entry, o_exits = self.build_body(stmt.orelse, frames)
+                cfg.edge(test.idx, o_entry)
+                exits = o_exits + brk
+            return test.idx, exits
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = cfg._new(stmt, "iter", stmt.iter)
+            self._wire_exc(it, frames)
+            brk = []
+            self.loop.append((it.idx, brk, len(frames)))
+            b_entry, b_exits = self.build_body(stmt.body, frames)
+            self.loop.pop()
+            cfg.edge(it.idx, b_entry)
+            for x in b_exits:
+                cfg.edge(x, it.idx)
+            exits = [it.idx] + brk
+            if stmt.orelse:
+                o_entry, o_exits = self.build_body(stmt.orelse, frames)
+                cfg.edge(it.idx, o_entry)
+                exits = o_exits + brk
+            return it.idx, exits
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            hdr = ast.Module(body=[], type_ignores=[])
+            hdr_exprs = ast.Tuple(
+                elts=[i.context_expr for i in stmt.items], ctx=ast.Load())
+            ast.copy_location(hdr_exprs, stmt)
+            w = cfg._new(stmt, "with", hdr_exprs)
+            self._wire_exc(w, frames)
+            b_entry, b_exits = self.build_body(stmt.body, frames)
+            cfg.edge(w.idx, b_entry)
+            del hdr
+            return w.idx, b_exits
+
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, frames)
+
+        if isinstance(stmt, ast.Return):
+            n = cfg._new(stmt, "stmt", stmt)
+            self._wire_exc(n, frames)
+            self._route_through_finallys(n.idx, frames, cfg.exit)
+            return n.idx, []
+
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new(stmt, "stmt", stmt)
+            for t in self.exc_targets(frames):
+                cfg.edge(n.idx, t, is_exc=True)
+            return n.idx, []
+
+        if isinstance(stmt, ast.Assert):
+            n = cfg._new(stmt, "stmt", stmt)
+            for t in self.exc_targets(frames):
+                cfg.edge(n.idx, t, is_exc=True)
+            return n.idx, [n.idx]
+
+        if isinstance(stmt, ast.Break):
+            n = cfg._new(stmt, "stmt")
+            if self.loop:
+                head, brk, depth = self.loop[-1]
+                brk.extend(self._route_loop_jump(n.idx, frames, depth))
+            return n.idx, []
+
+        if isinstance(stmt, ast.Continue):
+            n = cfg._new(stmt, "stmt")
+            if self.loop:
+                head, _brk, depth = self.loop[-1]
+                for c in self._route_loop_jump(n.idx, frames, depth):
+                    cfg.edge(c, head)
+            return n.idx, []
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # Nested definitions run later, elsewhere — opaque here.
+            n = cfg._new(stmt, "stmt", None)
+            return n.idx, [n.idx]
+
+        # Simple statement: Assign / Expr / AugAssign / Delete / ...
+        n = cfg._new(stmt, "stmt", stmt)
+        self._wire_exc(n, frames)
+        return n.idx, [n.idx]
+
+    def _wire_exc(self, node: Node, frames) -> None:
+        if _can_raise(node.expr_root):
+            for t in self.exc_targets(frames):
+                self.cfg.edge(node.idx, t, is_exc=True)
+
+    def _route_through_finallys(self, src, frames, final_dst) -> None:
+        """Early return: run every enclosing finally innermost-out,
+        then reach `final_dst`. With merged finally bodies this adds
+        the needed edges; the over-approximated fan-out is already in
+        place from _build_try."""
+        for frame in reversed(frames):
+            if frame[0] == "finally":
+                self.cfg.edge(src, frame[1])
+                frame[2].append(final_dst)
+                return
+        self.cfg.edge(src, final_dst)
+
+    def _route_loop_jump(self, src, frames, loop_depth) -> List[int]:
+        """`break`/`continue`: run every finally between the jump and
+        its loop, innermost-out (Python runs a try's finalbody before
+        the jump leaves the try). Returns the node set the jump
+        finally departs from — the outermost in-loop finally's exits,
+        or [src] when no finally intervenes."""
+        departs = [src]
+        for frame in reversed(frames[loop_depth:]):
+            if frame[0] == "finally":
+                for d in departs:
+                    self.cfg.edge(d, frame[1])
+                departs = list(frame[3])
+        return departs
+
+    def _build_try(self, stmt: ast.Try, frames) -> Tuple[int, List[int]]:
+        cfg = self.cfg
+        gid = self._try_gid
+        self._try_gid += 1
+        fin_entry: Optional[int] = None
+        fin_extra: List[int] = []  # continuations routed via finally
+        fin_frame = None
+        if stmt.finalbody:
+            # Build the finalbody with OUTER frames (its own raises
+            # propagate past this try).
+            f_entry, f_exits = self.build_body(stmt.finalbody, frames)
+            fin_entry = f_entry
+            fin_exits = f_exits
+            fin_frame = ("finally", fin_entry, fin_extra, fin_exits)
+            # Exception continuation after the finally ran.
+            onward = self.exc_targets(frames)
+            for x in f_exits:
+                for t in onward:
+                    cfg.edge(x, t, is_exc=True)
+        inner = list(frames) + ([fin_frame] if fin_frame else [])
+
+        handler_entries: List[int] = []
+        handler_exit_sets: List[List[int]] = []
+        for h in stmt.handlers:
+            hn = cfg._new(h, "handler", h.type)
+            hn.handler_of = gid
+            handler_entries.append(hn.idx)
+            h_entry, h_exits = self.build_body(h.body, inner)
+            cfg.edge(hn.idx, h_entry)
+            handler_exit_sets.append(h_exits)
+
+        body_frames = list(inner)
+        if stmt.handlers:
+            body_frames.append(("handlers", handler_entries, gid))
+        b_entry, b_exits = self.build_body(stmt.body, body_frames)
+
+        if stmt.orelse:
+            o_entry, o_exits = self.build_body(stmt.orelse, inner)
+            for x in b_exits:
+                cfg.edge(x, o_entry)
+            b_exits = o_exits
+
+        exits: List[int] = []
+        tails = list(b_exits)
+        for hx in handler_exit_sets:
+            tails += hx
+        if fin_entry is not None:
+            for x in tails:
+                cfg.edge(x, fin_entry)
+            for x in fin_exits:
+                for extra in fin_extra:
+                    cfg.edge(x, extra)
+            exits = list(fin_exits)
+        else:
+            exits = tails
+        return b_entry, exits
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one function body (nested defs are opaque nodes)."""
+    cfg = CFG()
+    b = _Builder(cfg)
+    entry, exits = b.build_body(list(fn.body), [])
+    cfg.edge(cfg.entry, entry)
+    for x in exits:
+        cfg.edge(x, cfg.exit)
+    return cfg

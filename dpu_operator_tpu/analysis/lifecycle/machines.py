@@ -1,0 +1,261 @@
+"""Declarative lifecycle state machines for the serving plane's
+resource objects.
+
+Each `Machine` names the states a resource moves through and binds
+every transition to the REAL method names the code uses (`acquire`,
+`match_and_fork`, `lease.detach`, `checkout`/`checkin`,
+`kv_release_slot`, ...) — the same vocabulary the leak ledgers and
+the chaos matrix assert at runtime. The typestate walk
+(`typestate.py`) interprets these specs over the CFG; the rules
+(`rules_life.py`) turn illegal transitions into GL021 and
+non-terminal-on-exception-path objects into GL022.
+
+Modeled machines (states; terminal marked *):
+
+  kvblocks   — allocator block refs (PR 7/17 ledger):
+                 acquired --release--> released*
+                 acquired --KVLease(...)--> leased*   (ownership handoff)
+               created by `acquire` / `fork` / `match_and_fork` on an
+               allocator/prefix-tree receiver; double `release` raises
+               at runtime ("not held by owner") so released is an
+               illegal source for `release`.
+
+  kvlease    — KVLease attach/transfer lifecycle (PR 14/16):
+                 attached --detach--> in_transit --reattach--> attached
+                 any      --release / on_request_settled--> released*
+               `detach` from in_transit raises ValueError ("double
+               detach") at runtime; `release` is idempotent by design
+               (returns False the second time) so released is NOT an
+               illegal source for release.
+
+  tierlease  — HostKVTier checkout pins (PR 17):
+                 checked_out --checkin--> released*
+               keyed by (receiver, key-arg) text because `checkin`
+               names the key, not the entry object; double checkin
+               raises (the tier's double-free discipline).
+
+  slotbind   — executor slot bindings made by `kv_attach` (PR 7):
+                 bound --kv_release_slot / kv_detach_slot--> released*
+               anonymous (the return value is a token count, not a
+               handle): any release-slot call in the function settles
+               the binding. The binding legitimately outlives the
+               function on SUCCESS paths (it lives in the executor's
+               slot table), so only exception-tainted paths are leak
+               candidates — exactly the PR 7 post-attach-raise bug.
+
+  handle     — worker / shard-set step handles (PR 5/8/16):
+                 submitted --collect--> collected*
+                 submitted --abort--> aborted*
+               created by `submit` on a worker/shard-set receiver;
+               nearly every real site returns the handle immediately
+               (escape = the scheduler owns collection), which is
+               exactly the contract.
+
+Breaker / replica supervision states (PR 5) are deliberately NOT a
+machine here: the supervisor's breaker is a failure-timestamp window,
+not an object with transition methods — there is no method vocabulary
+to bind a typestate spec to. Its discipline is enforced dynamically
+by tests/test_serving_failures.py instead.
+
+Two synthetic states belong to the engine, not to any machine:
+`escaped` (returned / stored to a field or container / passed to an
+unresolved call — field-lifetime, exempt from leak checks) and
+`assumed` (entered a handler that visibly releases this machine —
+trusted settled; see typestate.py on per-try handler trust).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
+
+# Engine-level pseudo-states (absorbing, always exempt from checks).
+ESCAPED = "escaped"
+ASSUMED = "assumed"
+
+
+@dataclass(frozen=True)
+class CreateEvent:
+    """A call that mints a tracked object.
+
+    `bind` picks where the new object's name comes from:
+      result   — `x = recv.name(...)`            -> bound to `x`
+      result0  — `x, y = recv.name(...)`         -> bound to `x`
+      arg0     — `recv.name(x, ...)`             -> bound to `x`
+      anon     — no name; matched machine-wide (slot bindings)
+    `recv_hints` must appear (lowercased substring) in the receiver
+    text, same discipline as GL009's receiver hints — `os.fork` and
+    `lock.acquire` stay invisible. Empty hints accept any receiver
+    (only safe for names unique to this codebase, e.g. `kv_attach`).
+    `key_arg` records the unparse of that argument on the object for
+    recv_site-matched transitions (the tier's checkout/checkin key).
+    """
+
+    name: str
+    target: str
+    recv_hints: Tuple[str, ...] = ()
+    bind: str = "result"
+    key_arg: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class TransitionEvent:
+    """A call that moves tracked objects between states.
+
+    `match` picks how the call finds its object:
+      recv      — `obj.name(...)`   (object is the receiver Name)
+      arg0      — `recv.name(obj, ...)`  (object is arg 0, a Name)
+      recv_site — receiver text and key-arg text both equal the
+                  creating call's (tier checkout/checkin pairing)
+      machine   — every live object of the machine (slot bindings)
+    A transition whose source state is in `illegal_from` is a GL021
+    finding (the runtime would raise); the object still moves to
+    `target` so one bug reports once.
+    """
+
+    name: str
+    target: str
+    match: str = "recv"
+    recv_hints: Tuple[str, ...] = ()
+    illegal_from: FrozenSet[str] = frozenset()
+    key_arg: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Machine:
+    name: str
+    title: str
+    states: FrozenSet[str]
+    terminal: FrozenSet[str]
+    creates: Tuple[CreateEvent, ...]
+    transitions: Tuple[TransitionEvent, ...]
+    #: Constructor names that take ownership of any object of this
+    #: machine whose bound name appears anywhere in the call's
+    #: arguments (`KVLease(alloc, ..., cached + fresh, ...)`).
+    handoff_ctors: Tuple[str, ...] = ()
+    handoff_target: str = ""
+    #: False switches GL022 off for this machine entirely.
+    check_leak: bool = True
+    #: When True, untainted non-terminal state at NORMAL exit is fine
+    #: (the object lives on in longer-lived structures by design —
+    #: slot bindings); only exception-tainted facts leak.
+    field_lifetime_at_exit: bool = False
+
+    def release_names(self) -> FrozenSet[str]:
+        """Method names whose presence in a handler body makes that
+        try trusted to settle this machine (typestate handler trust),
+        and whose application to a parameter gives the enclosing
+        function a releasing summary."""
+        names = {t.name for t in self.transitions
+                 if t.target in self.terminal}
+        return frozenset(names | set(self.handoff_ctors))
+
+
+def _m(**kw) -> Machine:
+    kw.setdefault("handoff_ctors", ())
+    kw.setdefault("handoff_target", "")
+    return Machine(**kw)
+
+
+KVBLOCKS = _m(
+    name="kvblocks",
+    title="allocator block refs",
+    states=frozenset({"acquired", "released", "leased"}),
+    terminal=frozenset({"released", "leased"}),
+    creates=(
+        CreateEvent("acquire", "acquired", recv_hints=("alloc",)),
+        CreateEvent("fork", "acquired", recv_hints=("alloc",),
+                    bind="arg0"),
+        CreateEvent("match_and_fork", "acquired",
+                    recv_hints=("prefix", "tree", "cache"),
+                    bind="result0"),
+    ),
+    transitions=(
+        TransitionEvent("release", "released", match="arg0",
+                        recv_hints=("alloc",),
+                        illegal_from=frozenset({"released"})),
+    ),
+    handoff_ctors=("KVLease",),
+    handoff_target="leased",
+)
+
+KVLEASE = _m(
+    name="kvlease",
+    title="KV lease",
+    states=frozenset({"attached", "in_transit", "released"}),
+    terminal=frozenset({"released"}),
+    creates=(
+        CreateEvent("KVLease", "attached"),
+        CreateEvent("kv_import", "attached"),
+    ),
+    transitions=(
+        TransitionEvent("detach", "in_transit",
+                        illegal_from=frozenset({"in_transit"})),
+        TransitionEvent("reattach", "attached"),
+        # Both are idempotent by design — legal from every state.
+        TransitionEvent("release", "released"),
+        TransitionEvent("on_request_settled", "released"),
+    ),
+)
+
+TIERLEASE = _m(
+    name="tierlease",
+    title="host-tier checkout",
+    states=frozenset({"checked_out", "released"}),
+    terminal=frozenset({"released"}),
+    creates=(
+        CreateEvent("checkout", "checked_out", recv_hints=("tier",),
+                    key_arg=0),
+    ),
+    transitions=(
+        TransitionEvent("checkin", "released", match="recv_site",
+                        recv_hints=("tier",), key_arg=0,
+                        illegal_from=frozenset({"released"})),
+    ),
+)
+
+SLOTBIND = _m(
+    name="slotbind",
+    title="executor slot binding",
+    states=frozenset({"bound", "released"}),
+    terminal=frozenset({"released"}),
+    creates=(
+        CreateEvent("kv_attach", "bound", bind="anon"),
+    ),
+    transitions=(
+        TransitionEvent("kv_release_slot", "released",
+                        match="machine"),
+        TransitionEvent("kv_detach_slot", "released",
+                        match="machine"),
+    ),
+    field_lifetime_at_exit=True,
+)
+
+HANDLE = _m(
+    name="handle",
+    title="step handle",
+    states=frozenset({"submitted", "collected", "aborted"}),
+    terminal=frozenset({"collected", "aborted"}),
+    creates=(
+        CreateEvent("submit", "submitted",
+                    recv_hints=("worker", "shard")),
+    ),
+    transitions=(
+        TransitionEvent("collect", "collected", match="arg0",
+                        recv_hints=("worker", "shard", "self")),
+        TransitionEvent("abort", "aborted"),
+    ),
+)
+
+MACHINES: Tuple[Machine, ...] = (
+    KVBLOCKS, KVLEASE, TIERLEASE, SLOTBIND, HANDLE)
+
+MACHINES_BY_NAME: Dict[str, Machine] = {m.name: m for m in MACHINES}
+
+#: Builtins that merely READ an argument — passing a tracked object to
+#: one is not an escape (everything else unresolved is, conservatively).
+NON_ESCAPING_CALLS: FrozenSet[str] = frozenset({
+    "len", "list", "tuple", "set", "sorted", "sum", "min", "max",
+    "enumerate", "reversed", "zip", "any", "all", "bool", "int",
+    "str", "repr", "id", "print", "isinstance", "iter", "range",
+})

@@ -1,0 +1,253 @@
+"""GL021–GL023: lifecycle typestate rules + fault-site coverage.
+
+GL021 (illegal transition) and GL022 (leak on exception edge) slice a
+whole-program `LifecycleAnalysis` computed once per Project — the
+memoize-on-the-Project pattern from analysis/concurrency/rules_conc.py
+— so the CFG + typestate walk runs once however many modules the run
+covers. GL023 is a per-module scan against the repo's tests/ tree.
+
+Origin bugs (see docs/static-analysis.md for the catalog entries):
+  * GL021 — the allocator/tier double-free discipline: `release` of a
+    block not held and `checkin` of a lease not held both raise at
+    runtime; `detach` of an in-transit lease is the PR 14 double-
+    detach ValueError. The rule reports them before the ledger does.
+  * GL022 — PR 17's `kv_match_prefix` forked a prefix chain and lost
+    it when `_extend_from_tier` raised (no unwind); PR 7's admission
+    loop left a slot bound when a post-`kv_attach` statement raised
+    into a handler that failed the request without releasing the
+    slot. Both are one bug class: an object live in a non-terminal
+    state on an exception path with no release in reach.
+  * GL023 — the chaos matrix's completeness claim. Every
+    `faults.fire("<site>")` / `faults.wrap("<site>", ...)` /
+    `fault_site="<site>"` literal is a seam somebody wired in to be
+    exercised; a seam no test references is dead chaos coverage.
+    Deliberately-unexercised seams live in GL023_ALLOWLIST with a
+    one-line reason each.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..concurrency.callgraph import CallGraph
+from ..core import (SEVERITY_ERROR, Finding, Module, Project, Rule)
+from .machines import MACHINES, MACHINES_BY_NAME
+from .typestate import FunctionTypestate, Summaries
+
+#: Machinery modules: the allocator/tier/lease classes IMPLEMENT the
+#: machines (their bodies flip the private state the machines model),
+#: so running the spec against them reports the implementation to
+#: itself. Their discipline is covered directly by
+#: tests/test_kv_allocator.py and tests/test_kv_tiering.py.
+_EXCLUDED_SUFFIXES = (
+    "kvcache/allocator.py",
+    "kvcache/tiering.py",
+)
+
+
+def _scoped(module: Module) -> bool:
+    if not module.in_dir("serving"):
+        return False
+    return not module.relpath.endswith(_EXCLUDED_SUFFIXES)
+
+
+class LifecycleAnalysis:
+    """Whole-program typestate results, grouped by module relpath."""
+
+    def __init__(self, project: Project):
+        mods = [m for m in project.modules if _scoped(m)]
+        graph = CallGraph(mods)
+        summaries = Summaries(mods, graph)
+        # relpath -> [(line, col, qual, message)]
+        self.illegal: Dict[str, List[Tuple[int, int, str, str]]] = {}
+        self.leaks: Dict[str, List[Tuple[int, int, str, str]]] = {}
+        for module in mods:
+            for fn, qual in module.functions:
+                ts = FunctionTypestate(module, fn, qual, graph,
+                                       summaries)
+                for it in ts.illegal:
+                    title = MACHINES_BY_NAME[it.machine].title
+                    self.illegal.setdefault(module.relpath, []).append((
+                        it.line, it.col, qual,
+                        f"illegal `{it.event}` on {title} "
+                        f"'{it.name}': may-state includes "
+                        f"{', '.join(it.bad_states)} — the runtime "
+                        f"raises on this transition"))
+                for lk in ts.leaks:
+                    title = MACHINES_BY_NAME[lk.machine].title
+                    if lk.kind == "propagates":
+                        msg = (
+                            f"{title} '{lk.name}' may still be "
+                            f"{', '.join(lk.states)} when an exception "
+                            f"propagates out of {qual}: no release on "
+                            f"the unwind path")
+                    else:
+                        msg = (
+                            f"{title} '{lk.name}' may be left "
+                            f"{', '.join(lk.states)} at exit of {qual} "
+                            f"after a swallowed exception")
+                    self.leaks.setdefault(module.relpath, []).append((
+                        lk.line, lk.col, qual, msg))
+
+    @classmethod
+    def of(cls, project: Project) -> "LifecycleAnalysis":
+        got = getattr(project, "_lifecycle_analysis", None)
+        if got is None:
+            got = cls(project)
+            project._lifecycle_analysis = got
+        return got
+
+
+def _sliced(rule: Rule, module: Module,
+            rows: Dict[str, List[Tuple[int, int, str, str]]]
+            ) -> Iterator[Finding]:
+    for line, col, qual, msg in rows.get(module.relpath, ()):
+        yield Finding(rule=rule.rule_id, severity=rule.severity,
+                      path=module.relpath, line=line, col=col,
+                      func=qual, message=msg, hint=rule.hint)
+
+
+class IllegalLifecycleTransition(Rule):
+    rule_id = "GL021"
+    severity = SEVERITY_ERROR
+    title = "illegal lifecycle transition"
+    hint = ("this transition raises at runtime (double release, "
+            "double detach, checkin not held) — restructure so every "
+            "path settles the object exactly once; see the machine "
+            "model in docs/static-analysis.md")
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not _scoped(module):
+            return
+        yield from _sliced(self, module,
+                           LifecycleAnalysis.of(project).illegal)
+
+
+class LifecycleLeakOnException(Rule):
+    rule_id = "GL022"
+    severity = SEVERITY_ERROR
+    title = "lifecycle leak on exception edge"
+    hint = ("release on the unwind (try/except: release; raise — the "
+            "kv_match_prefix shape) or hand ownership off before "
+            "anything on the path can raise")
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not _scoped(module):
+            return
+        yield from _sliced(self, module,
+                           LifecycleAnalysis.of(project).leaks)
+
+
+# -- GL023: fault-site coverage ----------------------------------------------
+
+#: Seams deliberately not exercised by the unit chaos matrix. One-line
+#: reason each; GL023 treats these as covered. Adding an entry is the
+#: reviewed alternative to writing the chaos case.
+GL023_ALLOWLIST: Dict[str, str] = {}
+
+
+def _fault_sites(module: Module) -> Iterator[Tuple[ast.AST, str]]:
+    """(node, site) for every fault-seam string literal in a module:
+    `faults.fire("s")` / `faults.wrap("s", ...)` first arguments,
+    `fault_site="s"` call keywords, and `fault_site="s"` function
+    parameter defaults. Dynamic (f-string) sites carry no literal and
+    are out of scope — their base string reaches the seam via the
+    `fault_site=` default or call site, which IS collected."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("fire", "wrap")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "faults"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                yield node, node.args[0].value
+            for kw in node.keywords:
+                if (kw.arg == "fault_site"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    yield kw.value, kw.value.value
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            defaults = args.defaults
+            for arg, default in zip(pos[len(pos) - len(defaults):],
+                                    defaults):
+                if (arg.arg == "fault_site"
+                        and isinstance(default, ast.Constant)
+                        and isinstance(default.value, str)):
+                    yield default, default.value
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if (arg.arg == "fault_site" and default is not None
+                        and isinstance(default, ast.Constant)
+                        and isinstance(default.value, str)):
+                    yield default, default.value
+
+
+def _tests_blob(project: Project, module: Module) -> Optional[str]:
+    """Concatenated source of the repo's tests/ tree (fixtures
+    excluded — a fixture mentioning a site is test INPUT, not
+    coverage), located by walking up from the module's real path.
+    None when no tests tree exists (scratch copies under tmp dirs:
+    the rule stays silent rather than flagging everything)."""
+    cache = getattr(project, "_gl023_blob", _MISSING)
+    if cache is not _MISSING:
+        return cache
+    blob: Optional[str] = None
+    p = Path(module.path).resolve().parent
+    for _ in range(8):
+        tests = p / "tests"
+        if tests.is_dir():
+            parts = []
+            for f in sorted(tests.rglob("*.py")):
+                if "fixtures" in f.parts:
+                    continue
+                try:
+                    parts.append(f.read_text())
+                except OSError:
+                    continue
+            blob = "\n".join(parts)
+            break
+        if p.parent == p:
+            break
+        p = p.parent
+    project._gl023_blob = blob
+    return blob
+
+
+_MISSING = object()
+
+
+class FaultSiteUncovered(Rule):
+    rule_id = "GL023"
+    severity = SEVERITY_ERROR
+    title = "fault seam not exercised by any test"
+    hint = ("drive this seam from the chaos matrix "
+            "(plan.inject(\"<site>\", ...) in tests/test_chaos_*.py) "
+            "or add it to GL023_ALLOWLIST in "
+            "analysis/lifecycle/rules_life.py with a one-line reason")
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        sites = list(_fault_sites(module))
+        if not sites:
+            return
+        blob = _tests_blob(project, module)
+        if blob is None:
+            return
+        seen: Set[Tuple[str, int]] = set()
+        for node, site in sites:
+            if site in GL023_ALLOWLIST or site in blob:
+                continue
+            key = (site, getattr(node, "lineno", 1))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                module, node,
+                f"fault site \"{site}\" is referenced by no test "
+                f"under tests/ — the chaos matrix never drives this "
+                f"seam")

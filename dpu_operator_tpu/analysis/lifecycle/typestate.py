@@ -1,0 +1,723 @@
+"""Intra- + interprocedural typestate walk over the lifecycle CFG.
+
+For every function in scope the walk tracks the objects minted by a
+machine's creation events (`blocks = alloc.acquire(...)`,
+`entry = tier.checkout(...)`, a `kv_attach` slot binding) through the
+exception-edge CFG, computing a MAY set of (state, exc-tainted) pairs
+per program point. Facts are per-path-unioned: one path releasing an
+object never hides another path that leaks it.
+
+Design choices, all in the FP-safe (optimistic) direction — a
+ratcheting gate that cries wolf gets baselined into silence:
+
+  * a statement's own transition applies BEFORE its exception edge
+    (the release that raises still counts as released), but its
+    CREATION does not (an acquire that raises minted nothing — the
+    allocator's atomicity contract);
+  * per-try handler trust: when ANY handler of a try syntactically
+    contains a release event for a machine, every exception edge into
+    that try's handlers maps the machine's live states to `assumed`.
+    Which handler a given raise lands in is type-dependent beyond
+    static reach, and the branch conditions that correlate "did we
+    attach" with "do we release" (the scheduler's `kv_mode`) are
+    invisible to a path-insensitive join — a try that visibly knows
+    how to settle the machine is trusted to;
+  * escape is absorbing: an object that is returned, yielded, stored
+    through an attribute/subscript, passed to an UNRESOLVED call, or
+    handed to an owning constructor (`KVLease(...)`) becomes
+    field-lifetime — some longer-lived structure owns its settlement;
+  * interprocedural summaries run over the strict (≤2 duck owner)
+    call-graph edges only: a resolved callee that releases or escapes
+    its parameter settles the argument at the call site; a resolved
+    callee that RETURNS a fresh tracked object makes its call sites
+    creation sites (`fresh = self._acquire_with_evict(...)`).
+
+Leak verdicts (consumed by GL022 in rules_life.py):
+
+  * at `raise_exit` — any live non-terminal, non-escaped state means
+    the object can be orphaned by a propagating exception (the PR 17
+    `kv_match_prefix` unwind bug);
+  * at the normal `exit` — only exception-TAINTED live states count:
+    the object survived a swallowed exception (the PR 7
+    post-attach-raise slot poisoning). Untainted survival is either
+    field-lifetime by design (slot bindings) or GL009's local-pairing
+    domain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, NamedTuple, Optional, Set, Tuple
+
+from ..concurrency.callgraph import CallGraph, FnInfo, FnKey, walk_own
+from ..core import Module
+from .cfg import CFG, Node, build_cfg
+from .machines import (ASSUMED, ESCAPED, MACHINES, Machine,
+                       NON_ESCAPING_CALLS)
+
+StatePair = Tuple[str, bool]          # (state, exc_tainted)
+Facts = Dict["ObjId", FrozenSet[StatePair]]
+
+
+class ObjId(NamedTuple):
+    node: int                 # creation CFG node index
+    name: Optional[str]       # bound variable name ("" for anonymous)
+    machine: str
+    recv: str                 # creating receiver text (recv_site match)
+    key: str                  # creating key-arg text (recv_site match)
+    line: int                 # creation source line (finding anchor)
+
+
+def _term(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _recv_text(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        try:
+            return ast.unparse(call.func.value)
+        except Exception:
+            return ""
+    return ""
+
+
+def _hint_ok(hints: Tuple[str, ...], recv: str) -> bool:
+    if not hints:
+        return True
+    low = recv.lower()
+    return any(h in low for h in hints)
+
+
+def _names_in(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _arg_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for a in call.args:
+        out |= _names_in(a)
+    for k in call.keywords:
+        out |= _names_in(k.value)
+    return out
+
+
+def _unparse(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+# -- function summaries -------------------------------------------------------
+
+
+class FnSummary:
+    __slots__ = ("param_release", "param_escape", "releases_machines",
+                 "returns_fresh")
+
+    def __init__(self) -> None:
+        #: param name -> machine names it settles (release or handoff)
+        self.param_release: Dict[str, Set[str]] = {}
+        #: param names stored to self / containers (field-lifetime)
+        self.param_escape: Set[str] = set()
+        #: machine-wide release events anywhere in the body
+        self.releases_machines: Set[str] = set()
+        #: (machine, state, result_index|None) for fns returning a
+        #: freshly created object (directly or via a bound name)
+        self.returns_fresh: Optional[Tuple[str, str, Optional[int]]] = None
+
+    def same(self, other: "FnSummary") -> bool:
+        return (self.param_release == other.param_release
+                and self.param_escape == other.param_escape
+                and self.releases_machines == other.releases_machines
+                and self.returns_fresh == other.returns_fresh)
+
+
+def _param_names(fn: ast.AST, is_method: bool) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if is_method and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names + [a.arg for a in args.kwonlyargs]
+
+
+def _call_positional_map(call: ast.Call, params: List[str]) -> Dict[str, str]:
+    """arg Name -> callee param name, positionally and by keyword."""
+    out: Dict[str, str] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Name) and i < len(params):
+            out[a.id] = params[i]
+    for k in call.keywords:
+        if k.arg and isinstance(k.value, ast.Name) and k.arg in params:
+            out[k.value.id] = k.arg
+    return out
+
+
+class Summaries:
+    """Fixpoint (2 rounds — enough for one level of wrappers over
+    wrappers) of per-function summaries over the strict call graph."""
+
+    def __init__(self, modules: List[Module], graph: CallGraph,
+                 machines: Iterable[Machine] = MACHINES,
+                 rounds: int = 2):
+        self.graph = graph
+        self.machines = list(machines)
+        self.by_key: Dict[FnKey, FnSummary] = {}
+        self._params: Dict[FnKey, List[str]] = {}
+        for key, info in graph.fns.items():
+            self._params[key] = _param_names(info.node, bool(info.cls))
+        for _ in range(rounds):
+            changed = False
+            for key, info in graph.fns.items():
+                s = self._summarize(info)
+                prev = self.by_key.get(key)
+                if prev is None or not prev.same(s):
+                    self.by_key[key] = s
+                    changed = True
+            if not changed:
+                break
+
+    def params_of(self, key: FnKey) -> List[str]:
+        return self._params.get(key, [])
+
+    def _summarize(self, info: FnInfo) -> FnSummary:
+        s = FnSummary()
+        params = set(self._params[info.key])
+        created_names: Dict[str, Tuple[str, str]] = {}  # name -> (machine, state)
+        for node in walk_own(info.node):
+            if isinstance(node, ast.Call):
+                self._scan_call(info, node, params, s)
+            elif isinstance(node, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    for p in _names_in(node.value) & params:
+                        s.param_escape.add(p)
+                if isinstance(node.value, ast.Call):
+                    mach = self._creation_of(node.value)
+                    if mach is not None:
+                        tgt = node.targets[0]
+                        name = None
+                        if isinstance(tgt, ast.Name):
+                            name = tgt.id
+                        elif (isinstance(tgt, ast.Tuple) and tgt.elts
+                              and isinstance(tgt.elts[0], ast.Name)):
+                            name = tgt.elts[0].id
+                        if name:
+                            created_names[name] = mach
+        # Second pass: does a return hand a created object out?
+        for node in walk_own(info.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            val = node.value
+            cand: Optional[Tuple[str, str, Optional[int]]] = None
+            if isinstance(val, ast.Call):
+                mach = self._creation_of(val)
+                if mach is not None:
+                    cand = (mach[0], mach[1], None)
+            elif isinstance(val, ast.Name) and val.id in created_names:
+                m2 = created_names[val.id]
+                cand = (m2[0], m2[1], None)
+            elif isinstance(val, ast.Tuple):
+                for i, elt in enumerate(val.elts):
+                    if (isinstance(elt, ast.Name)
+                            and elt.id in created_names):
+                        m2 = created_names[elt.id]
+                        cand = (m2[0], m2[1], i)
+                        break
+            if cand is not None:
+                s.returns_fresh = cand
+                break
+        return s
+
+    def _creation_of(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(machine, state) when `call` mints a fresh object its
+        caller could own — direct creation events and (once known)
+        resolved callees with a returns_fresh summary."""
+        tname = _term(call.func)
+        recv = _recv_text(call)
+        for m in self.machines:
+            for ev in m.creates:
+                if (ev.name == tname and ev.bind in ("result", "result0")
+                        and _hint_ok(ev.recv_hints, recv)):
+                    return (m.name, ev.target)
+        return None
+
+    def _scan_call(self, info: FnInfo, call: ast.Call,
+                   params: Set[str], s: FnSummary) -> None:
+        tname = _term(call.func)
+        recv = _recv_text(call)
+        classified = False
+        for m in self.machines:
+            for tr in m.transitions:
+                if tr.name != tname or tr.target not in m.terminal:
+                    continue
+                if tr.match == "machine":
+                    if _hint_ok(tr.recv_hints, recv) or not recv:
+                        s.releases_machines.add(m.name)
+                        classified = True
+                elif tr.match == "arg0":
+                    if (_hint_ok(tr.recv_hints, recv) and call.args
+                            and isinstance(call.args[0], ast.Name)
+                            and call.args[0].id in params):
+                        s.param_release.setdefault(
+                            call.args[0].id, set()).add(m.name)
+                        classified = True
+                elif tr.match == "recv":
+                    f = call.func
+                    if (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id in params):
+                        s.param_release.setdefault(
+                            f.value.id, set()).add(m.name)
+                        classified = True
+                elif tr.match == "recv_site":
+                    classified = classified or (
+                        _hint_ok(tr.recv_hints, recv))
+            if tname in m.handoff_ctors:
+                for p in _arg_names(call) & params:
+                    s.param_release.setdefault(p, set()).add(m.name)
+                classified = True
+        if classified:
+            return
+        # Propagate through resolved callees (wrapper chains).
+        keys = self.graph.resolve_call_strict(info, call)
+        if not keys:
+            return
+        for key in keys:
+            cs = self.by_key.get(key)
+            if cs is None:
+                continue
+            pmap = _call_positional_map(call, self.params_of(key))
+            for arg_name, param in pmap.items():
+                if arg_name not in params:
+                    continue
+                for mach in cs.param_release.get(param, ()):
+                    s.param_release.setdefault(arg_name, set()).add(mach)
+                if param in cs.param_escape:
+                    s.param_escape.add(arg_name)
+            s.releases_machines |= cs.releases_machines
+
+
+# -- per-node operation extraction --------------------------------------------
+
+
+class _Op:
+    """One pre-extracted effect of a CFG node, applied in list order."""
+    __slots__ = ("kind", "machine", "event", "name", "recv", "key",
+                 "target", "names", "illegal_from", "bind")
+
+    def __init__(self, kind: str, **kw):
+        self.kind = kind
+        for f in ("machine", "event", "name", "recv", "key", "target",
+                  "names", "illegal_from", "bind"):
+            setattr(self, f, kw.get(f))
+
+
+def _binding_name(stmt: Optional[ast.AST], call: ast.Call,
+                  bind: str) -> Optional[str]:
+    """Resolve a creation event's bound name, or None when the fresh
+    object immediately flows somewhere we cannot name (in which case
+    the caller skips tracking: created-and-escaped is exempt anyway)."""
+    if bind == "arg0":
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        return None
+    if isinstance(stmt, ast.Assign) and stmt.value is call:
+        tgt = stmt.targets[0]
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        if (bind == "result0" and isinstance(tgt, ast.Tuple)
+                and tgt.elts and isinstance(tgt.elts[0], ast.Name)):
+            return tgt.elts[0].id
+    return None
+
+
+class _NodeOps:
+    def __init__(self, machines, graph: CallGraph,
+                 summaries: Optional[Summaries], info: Optional[FnInfo]):
+        self.machines = list(machines)
+        self.graph = graph
+        self.summaries = summaries
+        self.info = info
+
+    def extract(self, node: Node) -> List[_Op]:
+        ops: List[_Op] = []
+        stmt, root = node.stmt, node.expr_root
+        if root is None:
+            return ops
+        # Name rebinding kills stale objects before anything else.
+        if isinstance(stmt, ast.Assign):
+            rebound = {t.id for t in stmt.targets
+                       if isinstance(t, ast.Name)}
+            for t in stmt.targets:
+                if isinstance(t, ast.Tuple):
+                    rebound |= {e.id for e in t.elts
+                                if isinstance(e, ast.Name)}
+            if rebound:
+                ops.append(_Op("rebind", names=frozenset(rebound)))
+        for call in [n for n in ast.walk(root)
+                     if isinstance(n, ast.Call)]:
+            ops.extend(self._call_ops(stmt, call))
+        # Non-call escapes.
+        esc: Set[str] = set()
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            esc |= _names_in(root)
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in stmt.targets):
+            esc |= _names_in(stmt.value)
+        if isinstance(stmt, ast.AugAssign) and isinstance(
+                stmt.target, (ast.Attribute, ast.Subscript)):
+            esc |= _names_in(stmt.value)
+        for n in ast.walk(root):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                esc |= _names_in(n)
+        if esc:
+            ops.append(_Op("escape", names=frozenset(esc)))
+        return ops
+
+    def _call_ops(self, stmt, call: ast.Call) -> List[_Op]:
+        ops: List[_Op] = []
+        tname = _term(call.func)
+        recv = _recv_text(call)
+        classified = False
+        for m in self.machines:
+            for ev in m.creates:
+                if ev.name != tname or not _hint_ok(ev.recv_hints, recv):
+                    continue
+                classified = True
+                if ev.bind == "anon":
+                    ops.append(_Op("create", machine=m.name,
+                                   target=ev.target, name="",
+                                   recv=recv, key="", event=ev.name))
+                else:
+                    nm = _binding_name(stmt, call, ev.bind)
+                    if nm is not None:
+                        key = ""
+                        if (ev.key_arg is not None
+                                and ev.key_arg < len(call.args)):
+                            key = _unparse(call.args[ev.key_arg])
+                        ops.append(_Op("create", machine=m.name,
+                                       target=ev.target, name=nm,
+                                       recv=recv, key=key,
+                                       event=ev.name))
+            for tr in m.transitions:
+                if tr.name != tname:
+                    continue
+                if tr.match == "recv":
+                    f = call.func
+                    if (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)):
+                        ops.append(_Op(
+                            "trans", machine=m.name, event=tr.name,
+                            name=f.value.id, target=tr.target,
+                            illegal_from=tr.illegal_from, bind="name"))
+                        classified = True
+                elif tr.match == "arg0":
+                    if (_hint_ok(tr.recv_hints, recv) and call.args
+                            and isinstance(call.args[0], ast.Name)):
+                        ops.append(_Op(
+                            "trans", machine=m.name, event=tr.name,
+                            name=call.args[0].id, target=tr.target,
+                            illegal_from=tr.illegal_from, bind="name"))
+                        classified = True
+                elif tr.match == "recv_site":
+                    if _hint_ok(tr.recv_hints, recv):
+                        key = ""
+                        if (tr.key_arg is not None
+                                and tr.key_arg < len(call.args)):
+                            key = _unparse(call.args[tr.key_arg])
+                        ops.append(_Op(
+                            "trans", machine=m.name, event=tr.name,
+                            name=None, recv=recv, key=key,
+                            target=tr.target,
+                            illegal_from=tr.illegal_from, bind="site"))
+                        classified = True
+                elif tr.match == "machine":
+                    ops.append(_Op(
+                        "trans", machine=m.name, event=tr.name,
+                        name=None, target=tr.target,
+                        illegal_from=tr.illegal_from, bind="machine"))
+                    classified = True
+            if tname in m.handoff_ctors:
+                names = _arg_names(call)
+                if names:
+                    ops.append(_Op("handoff", machine=m.name,
+                                   target=m.handoff_target,
+                                   names=frozenset(names)))
+        if classified:
+            return ops
+        # Unclassified: consult summaries, else conservative escape.
+        keys: List[FnKey] = []
+        if self.summaries is not None and self.info is not None:
+            keys = self.graph.resolve_call_strict(self.info, call)
+        if keys:
+            for key in keys:
+                cs = self.summaries.by_key.get(key)
+                if cs is None:
+                    continue
+                pmap = _call_positional_map(
+                    call, self.summaries.params_of(key))
+                for arg_name, param in pmap.items():
+                    for mach in cs.param_release.get(param, ()):
+                        ops.append(_Op("trans", machine=mach,
+                                       event=tname, name=arg_name,
+                                       target=ASSUMED,
+                                       illegal_from=frozenset(),
+                                       bind="name"))
+                    if param in cs.param_escape:
+                        ops.append(_Op("escape",
+                                       names=frozenset({arg_name})))
+                for mach in cs.releases_machines:
+                    ops.append(_Op("trans", machine=mach, event=tname,
+                                   name=None, target="released",
+                                   illegal_from=frozenset(),
+                                   bind="machine"))
+                if cs.returns_fresh is not None:
+                    mach, state, idx = cs.returns_fresh
+                    bind = "result" if idx is None else (
+                        "result0" if idx == 0 else None)
+                    if bind is not None:
+                        nm = _binding_name(stmt, call, bind)
+                        if nm is not None:
+                            ops.append(_Op("create", machine=mach,
+                                           target=state, name=nm,
+                                           recv=recv, key="",
+                                           event=tname))
+        else:
+            if tname not in NON_ESCAPING_CALLS:
+                names = _arg_names(call)
+                if names:
+                    ops.append(_Op("escape", names=frozenset(names)))
+        return ops
+
+
+# -- the walk -----------------------------------------------------------------
+
+
+class IllegalTransition(NamedTuple):
+    line: int
+    col: int
+    machine: str
+    event: str
+    name: str
+    bad_states: Tuple[str, ...]
+
+
+class Leak(NamedTuple):
+    line: int
+    col: int
+    machine: str
+    name: str
+    states: Tuple[str, ...]
+    kind: str      # "propagates" | "swallowed"
+
+
+class FunctionTypestate:
+    """Run the walk over one function; findings land on .illegal and
+    .leaks."""
+
+    def __init__(self, module: Module, fn: ast.AST, qual: str,
+                 graph: CallGraph, summaries: Optional[Summaries],
+                 machines: Iterable[Machine] = MACHINES):
+        self.module = module
+        self.fn = fn
+        self.qual = qual
+        self.machines = {m.name: m for m in machines}
+        self.cfg = build_cfg(fn)
+        info = graph.fns.get((module.relpath, qual))
+        self._ops = _NodeOps(machines, graph, summaries, info)
+        self._node_ops: Dict[int, List[_Op]] = {}
+        self._trust: Dict[int, Set[str]] = self._handler_trust()
+        self.illegal: List[IllegalTransition] = []
+        self.leaks: List[Leak] = []
+        self._illegal_seen: Set[Tuple[int, ObjId, str]] = set()
+        self._run()
+
+    # A try is trusted for a machine when any of its handlers contains
+    # a terminal-transition (or handoff) call name for that machine.
+    def _handler_trust(self) -> Dict[int, Set[str]]:
+        by_gid: Dict[int, Set[str]] = {}
+        for node in self.cfg.nodes:
+            if node.kind != "handler" or node.handler_of is None:
+                continue
+            handler = node.stmt  # ast.ExceptHandler
+            names = {_term(n.func) for n in ast.walk(handler)
+                     if isinstance(n, ast.Call)}
+            got = by_gid.setdefault(node.handler_of, set())
+            for m in self.machines.values():
+                if names & m.release_names():
+                    got.add(m.name)
+        return by_gid
+
+    def _ops_of(self, idx: int) -> List[_Op]:
+        ops = self._node_ops.get(idx)
+        if ops is None:
+            ops = self._ops.extract(self.cfg.nodes[idx])
+            self._node_ops[idx] = ops
+        return ops
+
+    def _run(self) -> None:
+        n = len(self.cfg.nodes)
+        IN: List[Facts] = [dict() for _ in range(n)]
+        work = [self.cfg.entry]
+        on_work = {self.cfg.entry}
+        visited = [False] * n
+        exempt = (ESCAPED, ASSUMED)
+        while work:
+            idx = work.pop()
+            on_work.discard(idx)
+            visited[idx] = True
+            node = self.cfg.nodes[idx]
+            out_norm = self._transfer(idx, IN[idx], allow_create=True)
+            out_exc = self._transfer(idx, IN[idx], allow_create=False)
+            for dst, is_exc in node.succ:
+                facts = out_exc if is_exc else out_norm
+                # The hop INTO raise_exit keeps each fact's taint as
+                # is: taint records "survived an earlier exception
+                # edge" (a handler or finally continuation), which is
+                # what the field-lifetime filter keys on — the final
+                # propagation hop adds no survival.
+                if is_exc and dst != self.cfg.raise_exit:
+                    facts = self._taint(facts, dst, exempt)
+                changed = self._merge(IN, dst, facts)
+                if ((changed or not visited[dst])
+                        and dst not in on_work):
+                    work.append(dst)
+                    on_work.add(dst)
+        self._verdicts(IN, exempt)
+
+    def _taint(self, facts: Facts, dst: int, exempt) -> Facts:
+        dnode = self.cfg.nodes[dst]
+        trusted: Set[str] = set()
+        if dnode.kind == "handler" and dnode.handler_of is not None:
+            trusted = self._trust.get(dnode.handler_of, set())
+        out: Facts = {}
+        for obj, pairs in facts.items():
+            machine = self.machines[obj.machine]
+            new: Set[StatePair] = set()
+            for state, _t in pairs:
+                if state in exempt:
+                    new.add((state, True))
+                elif (obj.machine in trusted
+                        and state not in machine.terminal):
+                    new.add((ASSUMED, True))
+                else:
+                    new.add((state, True))
+            out[obj] = frozenset(new)
+        return out
+
+    @staticmethod
+    def _merge(IN: List[Facts], dst: int, facts: Facts) -> bool:
+        cur = IN[dst]
+        changed = False
+        for obj, pairs in facts.items():
+            old = cur.get(obj, frozenset())
+            new = old | pairs
+            if new != old:
+                cur[obj] = new
+                changed = True
+        return changed
+
+    def _transfer(self, idx: int, facts_in: Facts,
+                  allow_create: bool) -> Facts:
+        facts: Facts = dict(facts_in)
+        for op in self._ops_of(idx):
+            if op.kind == "rebind":
+                for obj in [o for o in facts
+                            if o.name and o.name in op.names
+                            and o.node != idx]:
+                    del facts[obj]
+            elif op.kind == "create":
+                if not allow_create:
+                    continue
+                node = self.cfg.nodes[idx]
+                line = getattr(node.stmt, "lineno", 1)
+                col = getattr(node.stmt, "col_offset", 0)
+                obj = ObjId(idx, op.name, op.machine, op.recv or "",
+                            op.key or "", line)
+                facts[obj] = frozenset({(op.target, False)})
+            elif op.kind == "trans":
+                self._apply_trans(idx, op, facts)
+            elif op.kind == "handoff":
+                for obj in list(facts):
+                    if (obj.machine == op.machine and obj.name
+                            and obj.name in op.names):
+                        facts[obj] = frozenset(
+                            (op.target, t) for _s, t in facts[obj])
+            elif op.kind == "escape":
+                for obj in list(facts):
+                    if obj.name and obj.name in op.names:
+                        facts[obj] = frozenset(
+                            (ESCAPED, t) for _s, t in facts[obj])
+        return facts
+
+    def _apply_trans(self, idx: int, op: _Op, facts: Facts) -> None:
+        node = self.cfg.nodes[idx]
+        for obj in list(facts):
+            if obj.machine != op.machine:
+                continue
+            if op.bind == "name":
+                if not obj.name or obj.name != op.name:
+                    continue
+            elif op.bind == "site":
+                if obj.recv != op.recv or obj.key != op.key:
+                    continue
+            # bind == "machine": every object matches.
+            pairs = facts[obj]
+            live = {s for s, _t in pairs if s not in (ESCAPED, ASSUMED)}
+            bad = tuple(sorted(live & set(op.illegal_from or ())))
+            if bad:
+                seen_key = (idx, obj, op.event)
+                if seen_key not in self._illegal_seen:
+                    self._illegal_seen.add(seen_key)
+                    self.illegal.append(IllegalTransition(
+                        getattr(node.stmt, "lineno", 1),
+                        getattr(node.stmt, "col_offset", 0),
+                        op.machine, op.event,
+                        obj.name or "<anonymous>", bad))
+            new: Set[StatePair] = set()
+            for s, t in pairs:
+                if s in (ESCAPED, ASSUMED):
+                    new.add((s, t))
+                else:
+                    new.add((op.target, t))
+            facts[obj] = frozenset(new)
+
+    def _verdicts(self, IN: List[Facts], exempt) -> None:
+        flagged: Set[ObjId] = set()
+        for obj, pairs in IN[self.cfg.raise_exit].items():
+            machine = self.machines[obj.machine]
+            if not machine.check_leak:
+                continue
+            # Field-lifetime machines (slot bindings) legitimately stay
+            # live past a clean path — only exception-tainted facts are
+            # leak candidates even at the propagating exit.
+            live = tuple(sorted({
+                s for s, t in pairs
+                if s not in machine.terminal and s not in exempt
+                and (t or not machine.field_lifetime_at_exit)}))
+            if live:
+                flagged.add(obj)
+                self.leaks.append(Leak(
+                    obj.line, 0, obj.machine, obj.name or "",
+                    live, "propagates"))
+        for obj, pairs in IN[self.cfg.exit].items():
+            machine = self.machines[obj.machine]
+            if not machine.check_leak or obj in flagged:
+                continue
+            live = tuple(sorted({
+                s for s, t in pairs
+                if t and s not in machine.terminal and s not in exempt}))
+            if live:
+                self.leaks.append(Leak(
+                    obj.line, 0, obj.machine, obj.name or "",
+                    live, "swallowed"))

@@ -44,6 +44,14 @@ GL020  read of the provisionally-advanced plan cursor (slot-state
        ``ctx``, which runs past the confirmed watermark between plan
        and collect) outside the rollback-aware sites
        (serving/kvcache/ + serving/spec.py)
+GL021  illegal lifecycle transition — double release / double detach /
+       checkin-not-held per the typestate machines
+       (analysis/lifecycle/, serving/)
+GL022  lifecycle object live in a non-terminal state on an exception
+       path with no release in reach (subsumes GL009's local pairing;
+       analysis/lifecycle/, serving/)
+GL023  faults.fire / fault_site seam string referenced by no test
+       under tests/ (chaos-matrix completeness, whole package)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1873,6 +1881,9 @@ class ProvisionalCursorRead(Rule):
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
+    from .lifecycle import (FaultSiteUncovered,
+                            IllegalLifecycleTransition,
+                            LifecycleLeakOnException)
 
     return [MaskMultiplyInGrad(), HostSyncInHotLoop(),
             ExceptReadsTryBinding(), LockAcrossBlockingCall(),
@@ -1883,4 +1894,6 @@ def default_rules() -> List[Rule]:
             LockOrderInversion(), WallClockDurationMath(),
             Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
             PlanTimeCollectStateWrite(), InlineShardKVGeometry(),
-            UnverifiedPrefixPublish(), ProvisionalCursorRead()]
+            UnverifiedPrefixPublish(), ProvisionalCursorRead(),
+            IllegalLifecycleTransition(), LifecycleLeakOnException(),
+            FaultSiteUncovered()]

@@ -339,9 +339,18 @@ class KVExecutorBase(Executor):
                     # Continue the hit past the HBM-resident chain:
                     # spilled blocks restore from the host tier
                     # (re-verified) before prefill of the suffix.
-                    cached = self._extend_from_tier(
-                        tokens, owner, cached_blocks, cached,
-                        cached_by_tier)
+                    try:
+                        cached = self._extend_from_tier(
+                            tokens, owner, cached_blocks, cached,
+                            cached_by_tier)
+                    except Exception:
+                        # Blocks restored before the failure are
+                        # already appended to cached_blocks; drop the
+                        # whole forked chain (the kv_match_prefix
+                        # unwind) so a tier fault can't strand refs.
+                        if cached_blocks:
+                            self.allocator.release(cached_blocks, owner)
+                        raise
             need_total = -(-(plen + req.max_tokens) // self.block_size)
             need = need_total - len(cached_blocks)
             try:

@@ -292,7 +292,6 @@ class PrefixRouter:
         owner = f"__pull__{uuid.uuid4().hex[:8]}"
         ex = src.executor
         t0 = time.monotonic()
-        rid = getattr(req, "request_id", None)
         try:
             faults.fire("router.pull",
                         attrs={"src": src.name, "dst": dst.name})

@@ -1,0 +1,50 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl021_nm.py
+"""GL021 near-misses that must stay silent: idempotent lease settle
+(release is legal from every state, by design), detach made legal
+again by the failure-path reattach, a tier pin checked in exactly
+once per path, and a conditional shed where no single path releases
+twice."""
+
+
+class Plane:
+    def lease_settle_is_idempotent(self, owner):
+        lease = KVLease(self.allocator, 1, owner, [1], (), 0)
+        try:
+            self.audit(owner)
+        finally:
+            lease.release()
+        # Legal: release/on_request_settled are idempotent settle
+        # funnels — every settle path may call them again.
+        lease.release()
+
+    def detach_reattach_detach(self, owner):
+        lease = KVLease(self.allocator, 1, owner, [1], (), 0)
+        try:
+            lease.detach()
+            lease.reattach()
+            # Legal: the reattach restored `attached`.
+            lease.detach()
+        finally:
+            lease.release()
+
+    def tier_roundtrip(self, key, owner):
+        entry = self.tier.checkout(key, owner)
+        if entry is None:
+            return 0
+        try:
+            self.decode_segments(key)
+        finally:
+            self.tier.checkin(key, owner)
+        return 1
+
+    def conditional_shed(self, owner):
+        blocks = self.allocator.acquire(4, owner)
+        try:
+            ok = self.admit(owner)
+        except Exception:
+            self.allocator.release(blocks, owner)
+            raise
+        if not ok:
+            self.allocator.release(blocks, owner)
+            return []
+        return self.finish(blocks, owner)

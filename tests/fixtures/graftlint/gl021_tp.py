@@ -1,0 +1,32 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl021_tp.py
+"""GL021 true positives: transitions the runtime ledgers raise on.
+Three findings, one per machine family: allocator blocks released
+twice, a lease detached while already mid-transfer, and a host-tier
+checkin of a key/owner pair the tier no longer holds."""
+
+
+class Plane:
+    def double_release(self, owner):
+        blocks = self.allocator.acquire(4, owner)
+        self.allocator.release(blocks, owner)
+        # TP 1: released twice — the refcount ledger raises here.
+        self.allocator.release(blocks, owner)
+
+    def double_detach(self, owner):
+        lease = KVLease(self.allocator, 1, owner, [1], (), 0)
+        try:
+            lease.detach()
+            # TP 2: detach of an in-transit lease — the PR 14
+            # double-detach ValueError, caught before runtime.
+            lease.detach()
+        finally:
+            lease.release()
+
+    def double_checkin(self, key, owner):
+        entry = self.tier.checkout(key, owner)
+        if entry is None:
+            return None
+        self.tier.checkin(key, owner)
+        # TP 3: checkin of a pin already returned — "not held by".
+        self.tier.checkin(key, owner)
+        return entry

@@ -1,0 +1,50 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl022_nm.py
+"""GL022 near-misses that must stay silent: the kv_match_prefix
+unwind (except: release; raise), a finally-checkin covering every
+path including break, ownership handed off to a KVLease before
+anything can raise, and a handler that releases before swallowing —
+the designed shed shape."""
+
+
+class Plane:
+    def match_with_unwind(self, tokens, owner):
+        blocks, cached = self.prefix.match_and_fork(tokens, owner)
+        try:
+            meta = self.spec.fingerprint(tokens)
+        except Exception:
+            self.allocator.release(blocks, owner)
+            raise
+        self.allocator.release(blocks, owner)
+        return meta, cached
+
+    def finally_checkin(self, keys, owner):
+        for key in keys:
+            entry = self.tier.checkout(key, owner)
+            if entry is None:
+                break
+            try:
+                if not self.decode_segments(key):
+                    break
+            finally:
+                # Covers the normal step, the raise, AND the break.
+                self.tier.checkin(key, owner)
+        return owner
+
+    def handoff_before_raise(self, tokens, owner):
+        blocks, cached = self.prefix.match_and_fork(tokens, owner)
+        lease = KVLease(self.allocator, 0, owner, blocks,
+                        tuple(tokens), cached)
+        self.registry[owner] = lease
+        # May raise: the blocks are leased (the lease's idempotent
+        # release runs on every settle path) and the lease escaped.
+        self.audit(owner)
+        return lease
+
+    def handler_releases_then_sheds(self, owner):
+        blocks = self.allocator.acquire(2, owner)
+        try:
+            self.admit(owner)
+        except Exception:
+            self.allocator.release(blocks, owner)
+            return []
+        return self.finish(blocks, owner)

@@ -1,0 +1,32 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl022_tp.py
+"""GL022 true positives: a lifecycle object live in a non-terminal
+state on an exception path with no release in reach. Two findings:
+the bench/kv_match_prefix shape (forked blocks released on the happy
+path only — a raise between fork and release strands them, which
+GL009's local pairing cannot see), and a tier pin surviving a
+swallowed exception to the function's normal exit."""
+
+
+class Plane:
+    def match_then_release_happy_path_only(self, tokens, owner):
+        blocks, cached = self.prefix.match_and_fork(tokens, owner)
+        # TP 1: fingerprint() can raise -> `blocks` still acquired on
+        # the unwind, and nothing up-stack holds them.
+        meta = self.spec.fingerprint(tokens)
+        self.allocator.release(blocks, owner)
+        return meta, cached
+
+    def swallow_keeps_pin(self, key, owner):
+        entry = self.tier.checkout(key, owner)
+        if entry is None:
+            return False
+        try:
+            self.decode_segments(key)
+        except Exception:
+            # TP 2: the failure is swallowed but the pin is never
+            # checked in on this path — tier.assert_clean() will name
+            # it at teardown.
+            log.warning("restore failed for %s", key)
+            return False
+        self.tier.checkin(key, owner)
+        return True

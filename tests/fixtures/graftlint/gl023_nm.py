@@ -1,0 +1,22 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl023_nm.py
+"""GL023 near-misses that must stay silent: seams the chaos matrix
+already drives (their literals appear in tests/), a covered site
+reaching the seam through a fault_site= default, and a dynamic
+f-string site (no literal — the base string is collected at its
+declaration site instead, never here)."""
+from dpu_operator_tpu import faults
+
+
+def restore(buf):
+    faults.fire("kvtier.restore")
+    return buf
+
+
+def send(payload, fault_site="kvstream.send"):
+    faults.fire(fault_site)
+    return payload
+
+
+def dynamic(name):
+    faults.fire(f"dyn.{name}")
+    return name

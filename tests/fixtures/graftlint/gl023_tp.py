@@ -1,0 +1,20 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl023_tp.py
+"""GL023 true positives: fault seams wired in but referenced by no
+test under tests/. Three findings, one per collection form: a
+faults.fire literal, a faults.wrap literal, and a fault_site=
+parameter default (the sharded-executor idiom)."""
+from dpu_operator_tpu import faults
+
+
+def spill(buf):
+    faults.fire("fxgl023.spill-seam-nobody-drives")
+    return buf
+
+
+def restore(thunk):
+    return faults.wrap("fxgl023.restore-seam-nobody-drives", thunk)
+
+
+def submit(payload, fault_site="fxgl023.submit-seam-nobody-drives"):
+    faults.fire(fault_site)
+    return payload

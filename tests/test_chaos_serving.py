@@ -1330,6 +1330,49 @@ def test_kvtier_restore_fault_and_corruption_degrade_to_reprefill(
         ex.close()
 
 
+def test_kvtier_spill_fault_degrades_to_drop_on_evict(settle_counts):
+    """Tier chaos in the OTHER direction: the spill hook itself dies
+    while the prefix tree evicts (host buffer allocation failing
+    mid-put). The contract is drop-on-evict — the victim block frees
+    anyway (admission is never blocked on a sick tier), the entry
+    just never reaches the host, and the next request degrades to
+    re-prefilling the SAME byte-identical stream with every ledger
+    clean."""
+    from dpu_operator_tpu.serving import SyntheticKVExecutor
+
+    t_start = time.monotonic()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    ex = SyntheticKVExecutor(slots=2, vocab=32, block_size=4,
+                             num_blocks=32, host_tier_bytes=1 << 20)
+
+    def drive():
+        q = AdmissionQueue(max_depth=2)
+        r = _kv_req(prompt)
+        q.submit(r)
+        return _drive_kv(ex, q, r)
+
+    try:
+        baseline = drive()
+        with faults.injected() as plan:
+            # No at_calls: EVERY spill this evict attempts fails.
+            plan.inject("kvtier.spill",
+                        exc=FaultError("host buffer alloc failed"))
+            freed = ex.prefix.evict(99)
+            assert plan.fired.get("kvtier.spill", 0) >= 1
+        assert freed > 0               # eviction still freed capacity
+        assert not ex.tier.keys()      # nothing made it to the host
+        assert drive() == baseline     # degrade = plain re-prefill
+        assert ex.kv_stats()["prefix_hit_tokens_host"] == 0
+
+        ex.prefix.flush()
+        ex.allocator.assert_clean()
+        ex.tier.assert_clean()
+        assert set(settle_counts.values()) == {1}, settle_counts
+        assert time.monotonic() - t_start < CASE_BUDGET_S
+    finally:
+        ex.close()
+
+
 def test_router_pull_cut_midstream_falls_back_to_local_prefill(
         settle_counts, tmp_path):
     """Router chaos: the cross-replica prefix pull is cut mid-stream

@@ -503,6 +503,48 @@ def test_kill_transfer_mid_stream_recovers_exactly_once(
     assert time.perf_counter() - t0 < 24.0
 
 
+def test_connect_and_import_faults_requeue_to_prefill(settle_counts):
+    """The transfer plane's other two seams, same contract as the
+    mid-stream cut: a failed dial (kvstream.connect — the decode
+    listener unreachable on the first hand-off) and a server-side
+    import blowup (kvstream.import — the decode pool rejecting pages
+    before attach) both degrade to requeue-to-prefill with
+    byte-identical streams and clean ledgers on both sides."""
+
+    def run(site=None):
+        pre, dec = _synth(), _synth()
+        reg = Registry()
+        q = AdmissionQueue(max_depth=16)
+        pool = DisaggPool([pre], [dec], q, registry=reg, seg_bytes=16,
+                          pool_opts=dict(POOL_OPTS))
+        pool.start()
+        try:
+            if site is None:
+                streams, _ = _drive_disagg(pool, q, PROMPTS)
+            else:
+                with faults.injected() as plan:
+                    plan.inject(site,
+                                exc=RuntimeError(f"{site} down"),
+                                at_calls=[1])
+                    streams, _ = _drive_disagg(pool, q, PROMPTS)
+        finally:
+            pool.stop()
+        pre.allocator.assert_clean()
+        dec.allocator.assert_clean()
+        pre.close()
+        dec.close()
+        return streams, reg
+
+    baseline, _ = run()
+    for site in ("kvstream.connect", "kvstream.import"):
+        streams, reg = run(site)
+        assert streams == baseline, site
+        assert reg.counter_value(
+            "serving_kv_transfers_total",
+            {"outcome": "requeued_prefill"}) >= 1, site
+    assert set(settle_counts.values()) == {1}, settle_counts
+
+
 def test_kill_prefill_replica_mid_run_recovers(settle_counts):
     """The replica-level kill composed with disagg: the PREFILL
     batcher dies mid-run (executor fault), its supervisor seizes and

@@ -169,6 +169,33 @@ def test_absent_peer_fails_fast_not_forever():
     t.close()
 
 
+def test_injected_dial_fault_retries_within_deadline():
+    """Chaos at the fabric.connect seam: the first dial of the ring
+    dies with a transient OSError (injected — a peer's listener not
+    yet up, RST mid-bringup). The dial loop's backoff-retry must
+    absorb it inside the connect deadline, and the ring formed on the
+    retry must allreduce correctly — a refused first SYN is bringup
+    noise, never a wiring failure."""
+    import errno
+
+    from dpu_operator_tpu import faults
+
+    def fn(t, r):
+        local = np.arange(512, dtype=np.float32) * (r + 1)
+        return t.allreduce(local)
+
+    with faults.injected() as plan:
+        plan.inject("fabric.connect",
+                    exc=OSError(errno.ECONNREFUSED,
+                                "injected: connection refused"),
+                    at_calls=[1])
+        results = _ring(2, fn)
+        assert plan.fired.get("fabric.connect") == 1
+    want = np.arange(512, dtype=np.float32) * 3
+    for out in results:
+        assert np.array_equal(out, want)
+
+
 def test_dead_peer_typed_error_with_backoff_not_busy_spin():
     """Regression (ISSUE 5 satellite): the dial loop used to retry a
     refused connect on a fixed 50 ms beat — ~20 socket churns in a 1 s

@@ -43,22 +43,26 @@ def _analyze_source(tmp_path, source, name="fx.py", baseline=None):
 
 def test_package_gate_clean_and_fast():
     """The tier-1 gate: zero non-baselined findings over the whole
-    package with ALL 20 rules active (including the interprocedural
-    GL012/GL013 passes), inside the 20 s lint-lane budget docs/ci.md
-    carries (measured ~6 s on the 2-cpu container)."""
+    package with ALL 23 rules active (including the interprocedural
+    GL012/GL013 lockset and GL021/GL022 typestate passes), inside the
+    30 s lint-lane budget docs/ci.md carries (measured ~9 s on the
+    2-cpu container) — and no single rule above 10 s, so one rule
+    regressing cannot silently eat the whole lane."""
     t0 = time.perf_counter()
     report = run_analysis([str(REPO / "dpu_operator_tpu")],
                           baseline=DEFAULT_BASELINE)
     elapsed = time.perf_counter() - t0
     assert report.clean, "\n".join(f.format() for f in report.findings)
     assert report.checked_files > 100  # really saw the package
-    assert elapsed < 20.0, f"analyzer took {elapsed:.1f}s (budget 20s)"
+    assert elapsed < 30.0, f"analyzer took {elapsed:.1f}s (budget 30s)"
+    slow = {r: s for r, s in report.rule_timings.items() if s > 10.0}
+    assert not slow, f"per-rule 10s budget blown: {slow}"
 
 
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 20
+    assert len(set(ids)) == len(ids) == 23
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -86,6 +90,9 @@ _EXPECT = {
     "GL018": 2,  # inline even split + inline rank*blocks//world range
     "GL019": 2,  # unverified tier restore + unverified origin-tagged insert
     "GL020": 2,  # ctx-as-progress stats export + ctx-sized cache publish
+    "GL021": 3,  # double release, double detach, checkin-not-held
+    "GL022": 2,  # happy-path-only release + swallowed-exception tier pin
+    "GL023": 3,  # fire, wrap, and fault_site=default seams nobody tests
 }
 
 
@@ -347,6 +354,67 @@ def test_reintroducing_pr8_lock_across_reap_fails(tmp_path):
         f.format() for f in hits]
 
 
+def test_reintroducing_pr17_match_prefix_unwind_loss_fails(tmp_path):
+    """The ISSUE 19 acceptance scratch-test, side A: strip PR 17's
+    unwind (except: release; raise) back out of the REAL
+    kv_match_prefix — a raise inside _extend_from_tier once again
+    strands the forked chain — and GL022 must fail it, while the
+    unmodified module stays clean against the checked-in baseline."""
+    real = (REPO / "dpu_operator_tpu" / "serving" / "kvcache"
+            / "executor.py").read_text()
+    header = ("# graftlint-fixture-path: "
+              "dpu_operator_tpu/serving/kvcache/executor.py\n")
+    report = _analyze_source(tmp_path, header + real, name="control.py",
+                             baseline=DEFAULT_BASELINE)
+    assert report.clean, "\n".join(f.format() for f in report.findings)
+
+    wanted = (
+        "            try:\n"
+        "                if self.tier is not None:\n"
+        "                    cached = self._extend_from_tier(\n"
+        "                        tokens, owner, blocks, cached, by_tier)\n"
+        "            except Exception:\n"
+        "                self.allocator.release(blocks, owner)\n"
+        "                raise\n")
+    assert wanted in real, "kv_match_prefix unwind site moved"
+    bugged = header + real.replace(
+        wanted,
+        "            if self.tier is not None:\n"
+        "                cached = self._extend_from_tier(\n"
+        "                    tokens, owner, blocks, cached, by_tier)\n")
+    report = _analyze_source(tmp_path, bugged, name="bugged.py",
+                             baseline=DEFAULT_BASELINE)
+    hits = [f for f in report.findings if f.rule == "GL022"]
+    assert any(f.func == "KVExecutorBase.kv_match_prefix"
+               and "'blocks'" in f.message for f in hits), [
+        f.format() for f in report.findings]
+
+
+def test_reintroducing_pr7_slot_poison_on_admit_unwind_fails(tmp_path):
+    """The ISSUE 19 acceptance scratch-test, side B: drop the admit
+    handler's kv_release_slot back out of the REAL scheduler — a
+    post-kv_attach raise once again leaves the slot bound (poisoned
+    for every future admit) while the handler swallows into
+    req.fail — and GL022 must fail it; the unmodified module stays
+    clean."""
+    real = (REPO / "dpu_operator_tpu" / "serving"
+            / "scheduler.py").read_text()
+    header = ("# graftlint-fixture-path: "
+              "dpu_operator_tpu/serving/scheduler.py\n")
+    report = _analyze_source(tmp_path, header + real, name="control.py",
+                             baseline=DEFAULT_BASELINE)
+    assert report.clean, "\n".join(f.format() for f in report.findings)
+
+    wanted = "self.executor.kv_release_slot(i, cache=False)"
+    assert wanted in real, "admit-unwind release site moved"
+    bugged = header + real.replace(wanted, "pass", 1)
+    report = _analyze_source(tmp_path, bugged, name="bugged.py",
+                             baseline=DEFAULT_BASELINE)
+    hits = [f for f in report.findings if f.rule == "GL022"]
+    assert hits and all("slot binding" in f.message for f in hits), [
+        f.format() for f in report.findings]
+
+
 def test_reintroducing_pr3_except_binding_fails(tmp_path):
     """Move `i = free.pop(0)` back inside the try in a scratch copy of
     the REAL scheduler: the handler's `self._slots[i]` NameErrors when
@@ -519,3 +587,67 @@ def test_stale_note_includes_deletable_toml_block(tmp_path):
     assert '    path = "dpu_operator_tpu/cni/fx_ratchet.py"' in out
     assert '    func = "teardown"' in out
     assert '    count = 2' in out
+
+
+def test_ratchet_combined_block_round_trips(tmp_path):
+    """--ratchet-report groups every fully-unused entry by rule into
+    ONE deletable block — and that block (indentation and per-rule
+    comment headers included) must re-parse through the baseline
+    parser verbatim, so pasting it next to baseline.toml for
+    comparison can never produce a different key set."""
+    from dpu_operator_tpu.analysis.baseline import _parse_toml_subset
+
+    clean = _TWO_SILENT.replace("pass", "raise")
+    proc = _run_cli(
+        tmp_path, clean,
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        'count = 2\n'
+        '\n'
+        '[[suppress]]\n'
+        'rule = "GL001"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "setup"\n',
+        "--ratchet-report")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = proc.stdout.splitlines()
+    head = next(i for i, l in enumerate(lines)
+                if "fully-unused baseline" in l)
+    assert "2 fully-unused baseline entries across 2 rule(s)" in lines[head]
+    block = []
+    for l in lines[head + 1:]:
+        if not l.startswith("    "):
+            break
+        block.append(l)
+    # Per-rule comment headers, sorted rule order.
+    assert block[0].lstrip().startswith("# -- GL001")
+    entries = _parse_toml_subset("\n".join(block), "stdout")
+    assert [e["rule"] for e in entries] == ["GL001", "GL005"]
+    assert entries[0]["func"] == "setup"
+    assert entries[1] == {"rule": "GL005",
+                          "path": "dpu_operator_tpu/cni/fx_ratchet.py",
+                          "func": "teardown", "count": 2}
+
+
+def test_profile_flag_reports_per_rule_time_and_findings(tmp_path):
+    """--profile appends a per-rule wall-time table (the docs/ci.md
+    lint-budget breakdown): every registered rule gets a row, and the
+    finding column counts RAW findings (before baseline filtering) so
+    a fully-baselined rule still shows its cost."""
+    proc = _run_cli(
+        tmp_path, _TWO_SILENT,
+        '[[suppress]]\n'
+        'rule = "GL005"\n'
+        'path = "dpu_operator_tpu/cni/fx_ratchet.py"\n'
+        'func = "teardown"\n'
+        'count = 2\n',
+        "--profile")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [l for l in proc.stdout.splitlines()
+            if l.startswith("profile: GL")]
+    assert len(rows) == len(default_rules())
+    gl005 = next(l for l in rows if l.startswith("profile: GL005"))
+    assert gl005.split()[-1] == "2"  # raw findings despite baseline
+    assert "ms in rules)" in proc.stdout

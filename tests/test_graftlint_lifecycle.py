@@ -1,0 +1,283 @@
+"""Units for the ISSUE 19 lifecycle layer (analysis/lifecycle/):
+CFG exception-edge structure — including the two subtleties the
+whole-package triage surfaced (break/continue must route through
+in-loop ``finally`` bodies; ``len``/``isinstance``/``id`` are not
+exception edges) — plus machine-vocabulary drift guards and focused
+typestate behaviour the per-rule fixtures don't isolate. The fixture
+pairs and acceptance scratch-copies live in test_graftlint.py; this
+file is the white-box half.
+"""
+
+import ast
+import textwrap
+from pathlib import Path
+
+from dpu_operator_tpu.analysis import run_analysis
+from dpu_operator_tpu.analysis.lifecycle.cfg import build_cfg
+from dpu_operator_tpu.analysis.lifecycle.machines import (
+    KVBLOCKS, KVLEASE, MACHINES, SLOTBIND)
+from dpu_operator_tpu.analysis.lifecycle.rules_life import (
+    IllegalLifecycleTransition, LifecycleLeakOnException)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- CFG helpers --------------------------------------------------------------
+
+
+def _cfg(src: str):
+    fn = ast.parse(textwrap.dedent(src)).body[0]
+    return build_cfg(fn)
+
+
+def _node_of(cfg, pred):
+    hits = [n for n in cfg.nodes if pred(n)]
+    assert len(hits) == 1, [(n.idx, n.kind) for n in hits]
+    return hits[0]
+
+
+def _stmt_node(cfg, stmt_type):
+    return _node_of(cfg, lambda n: isinstance(n.stmt, stmt_type))
+
+
+def _call_node(cfg, text):
+    def pred(n):
+        try:
+            return (n.expr_root is not None
+                    and text in ast.unparse(n.expr_root))
+        except Exception:
+            return False
+    return _node_of(cfg, pred)
+
+
+def _reaches(cfg, src, dst, normal_only=False):
+    seen, work = set(), [src]
+    while work:
+        i = work.pop()
+        if i == dst:
+            return True
+        if i in seen:
+            continue
+        seen.add(i)
+        work.extend(t for t, exc in cfg.nodes[i].succ
+                    if not (normal_only and exc))
+    return False
+
+
+# -- CFG structure ------------------------------------------------------------
+
+
+def test_virtual_frame_nodes():
+    cfg = _cfg("def f():\n    pass\n")
+    assert [cfg.nodes[i].kind for i in
+            (cfg.entry, cfg.exit, cfg.raise_exit)] == [
+        "entry", "exit", "raise_exit"]
+
+
+def test_call_statement_gets_exception_edge_to_raise_exit():
+    cfg = _cfg("def f(x):\n    x.work()\n")
+    node = _call_node(cfg, "x.work()")
+    assert (cfg.raise_exit, True) in node.succ
+
+
+def test_cant_raise_builtins_make_no_exception_edge():
+    """len/isinstance/id are C-level queries on values this codebase
+    hands them — modelling them as can-raise produced the kv_attach
+    false positive (`need = need_total - len(cached)` read as an
+    unprotected seam between fork and release)."""
+    cfg = _cfg("def f(x):\n"
+               "    n = len(x)\n"
+               "    ok = isinstance(x, list)\n"
+               "    k = id(x)\n"
+               "    return n + ok + k\n")
+    assert not any(exc for n in cfg.nodes for _t, exc in n.succ)
+    # ...but any other call keeps its edge.
+    cfg = _cfg("def f(x):\n    n = int(x)\n")
+    assert (cfg.raise_exit, True) in _call_node(cfg, "int(x)").succ
+
+
+def test_try_body_exceptions_land_in_handler_not_raise_exit():
+    cfg = _cfg("def f(x):\n"
+               "    try:\n"
+               "        x.work()\n"
+               "    except Exception:\n"
+               "        x.undo()\n")
+    node = _call_node(cfg, "x.work()")
+    handler = _node_of(cfg, lambda n: n.kind == "handler")
+    exc_targets = [t for t, exc in node.succ if exc]
+    assert exc_targets == [handler.idx]
+    assert handler.handler_of is not None
+
+
+def test_break_routes_through_in_loop_finally():
+    """A `break` inside try/finally must run the finalbody before
+    leaving the loop — without this edge, a finally-released resource
+    looked live at the loop exit (the _extend_from_tier false
+    positive this PR fixed)."""
+    cfg = _cfg("def f(items, res):\n"
+               "    for it in items:\n"
+               "        try:\n"
+               "            if it:\n"
+               "                break\n"
+               "        finally:\n"
+               "            res.close()\n"
+               "    return 1\n")
+    brk = _stmt_node(cfg, ast.Break)
+    fin = _call_node(cfg, "res.close()")
+    ret = _stmt_node(cfg, ast.Return)
+    # break -> finally body, and no direct break -> after-loop edge.
+    assert [t for t, _e in brk.succ] == [fin.idx]
+    assert _reaches(cfg, fin.idx, ret.idx, normal_only=True)
+
+
+def test_continue_routes_through_in_loop_finally():
+    cfg = _cfg("def f(items, res):\n"
+               "    for it in items:\n"
+               "        try:\n"
+               "            if it:\n"
+               "                continue\n"
+               "            it.work()\n"
+               "        finally:\n"
+               "            res.close()\n")
+    cont = _stmt_node(cfg, ast.Continue)
+    fin = _call_node(cfg, "res.close()")
+    head = _node_of(cfg, lambda n: n.kind == "iter")
+    assert [t for t, _e in cont.succ] == [fin.idx]
+    assert _reaches(cfg, fin.idx, head.idx, normal_only=True)
+
+
+def test_raise_in_try_routes_through_finally_to_raise_exit():
+    cfg = _cfg("def f(x, res):\n"
+               "    try:\n"
+               "        raise ValueError(x)\n"
+               "    finally:\n"
+               "        res.close()\n")
+    rs = _stmt_node(cfg, ast.Raise)
+    fin = _call_node(cfg, "res.close()")
+    assert all(t == fin.idx for t, _e in rs.succ)
+    assert _reaches(cfg, fin.idx, cfg.raise_exit)
+    # The raise never shortcuts past the finalbody.
+    assert (cfg.raise_exit, True) not in rs.succ
+
+
+# -- machine-vocabulary drift -------------------------------------------------
+
+
+def _serving_defs():
+    names = set()
+    for p in (REPO / "dpu_operator_tpu" / "serving").rglob("*.py"):
+        names.update(n.name for n in ast.walk(ast.parse(p.read_text()))
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)))
+        names.update(n.name for n in ast.walk(ast.parse(p.read_text()))
+                     if isinstance(n, ast.ClassDef))
+    return names
+
+
+def test_machine_vocabulary_binds_to_real_serving_names():
+    """Every create/transition/handoff name a machine declares must
+    exist as a def or class under serving/ — a renamed runtime method
+    silently blinds the typestate walk otherwise."""
+    defs = _serving_defs()
+    for m in MACHINES:
+        for ev in m.creates + m.transitions:
+            assert ev.name in defs, f"{m.name}: {ev.name} not in serving/"
+        for ctor in m.handoff_ctors:
+            assert ctor in defs, f"{m.name}: ctor {ctor} not in serving/"
+
+
+def test_release_names_are_terminal_transitions_plus_handoffs():
+    assert KVBLOCKS.release_names() == {"release", "KVLease"}
+    # detach is a transfer, not a settle: it must NOT make a handler
+    # trusted for leases.
+    assert "detach" not in KVLEASE.release_names()
+    assert {"release", "on_request_settled"} <= KVLEASE.release_names()
+    assert SLOTBIND.field_lifetime_at_exit  # the PR 7 shape depends on it
+
+
+# -- focused typestate behaviour ----------------------------------------------
+
+_HEADER = "# graftlint-fixture-path: dpu_operator_tpu/serving/fx_unit.py\n"
+_LIFE_RULES = (IllegalLifecycleTransition, LifecycleLeakOnException)
+
+
+def _life_findings(tmp_path, body):
+    p = tmp_path / "fx.py"
+    p.write_text(_HEADER + textwrap.dedent(body))
+    report = run_analysis([str(p)], rules=[r() for r in _LIFE_RULES])
+    return report.findings
+
+
+def test_continue_through_finally_release_stays_clean(tmp_path):
+    findings = _life_findings(tmp_path, """\
+        class P:
+            def drain(self, items, owner):
+                for it in items:
+                    blocks = self.allocator.acquire(4, owner)
+                    try:
+                        if not self.admit(it):
+                            continue
+                        self.consume(it)
+                    finally:
+                        self.allocator.release(blocks, owner)
+        """)
+    assert not findings, [f.format() for f in findings]
+
+
+def test_unwind_shape_stays_clean_and_its_loss_fires(tmp_path):
+    unwound = textwrap.dedent("""\
+        class P:
+            def pull(self, tokens, owner):
+                blocks, n = self.prefix.match_and_fork(tokens, owner)
+                try:
+                    meta = self.spec.fingerprint(tokens)
+                except Exception:
+                    self.allocator.release(blocks, owner)
+                    raise
+                self.allocator.release(blocks, owner)
+                return n, meta
+        """)
+    findings = _life_findings(tmp_path, unwound)
+    assert not findings, [f.format() for f in findings]
+    bare = unwound.replace(
+        "        try:\n"
+        "            meta = self.spec.fingerprint(tokens)\n"
+        "        except Exception:\n"
+        "            self.allocator.release(blocks, owner)\n"
+        "            raise\n",
+        "        meta = self.spec.fingerprint(tokens)\n")
+    assert bare != unwound
+    findings = _life_findings(tmp_path, bare)
+    assert [f.rule for f in findings] == ["GL022"]
+
+
+def test_double_release_fires_gl021_once(tmp_path):
+    findings = _life_findings(tmp_path, """\
+        class P:
+            def shed(self, owner):
+                blocks = self.allocator.acquire(4, owner)
+                self.allocator.release(blocks, owner)
+                self.allocator.release(blocks, owner)
+        """)
+    assert [f.rule for f in findings] == ["GL021"]
+
+
+def test_escape_by_return_absorbs_the_object(tmp_path):
+    """Returning the blocks hands ownership to the caller — absorbed,
+    no leak. The can-raise call sits BEFORE the acquire on purpose:
+    a raise between acquire and return is a real leak and must keep
+    firing (the second function pins that)."""
+    findings = _life_findings(tmp_path, """\
+        class P:
+            def lend(self, owner):
+                self.audit(owner)
+                blocks = self.allocator.acquire(4, owner)
+                return blocks
+
+            def lend_risky(self, owner):
+                blocks = self.allocator.acquire(4, owner)
+                self.audit(owner)
+                return blocks
+        """)
+    assert [f.rule for f in findings] == ["GL022"]
+    assert findings[0].func == "P.lend_risky"

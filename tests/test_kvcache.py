@@ -488,6 +488,53 @@ def test_admit_unwind_releases_executor_slot_binding():
     ex.close()
 
 
+def test_kv_attach_unwinds_forked_blocks_when_tier_restore_raises():
+    """Regression (found by GL022): kv_attach forks the HBM-resident
+    prefix chain, then extends it from the host tier. When the tier
+    itself RAISES mid-restore (a dying host buffer — distinct from
+    the injected kvtier.restore fault, which degrades to prefill),
+    the already-forked and already-restored blocks must be released
+    on the unwind, not stranded: the attach failed, nobody owns
+    them."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    ex = SyntheticKVExecutor(slots=2, vocab=32, block_size=4,
+                             num_blocks=32, host_tier_bytes=1 << 20,
+                             pipelined=False)
+    try:
+        _drive(ex, [_req(prompt, max_tokens=4)])
+        ex.prefix.evict(99)          # spill the whole chain to host
+        assert ex.tier.keys()
+
+        real_checkout = ex.tier.checkout
+        calls = {"n": 0}
+
+        def dying_checkout(key, owner):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("host tier read error")
+            return real_checkout(key, owner)
+
+        ex.tier.checkout = dying_checkout
+        victim = _req(prompt, max_tokens=4)
+        with pytest.raises(RuntimeError, match="host tier read"):
+            ex.kv_attach(0, victim)
+        assert calls["n"] >= 2       # one block restored, then died
+        assert victim.kv_lease is None
+        ex.tier.checkout = real_checkout
+
+        # The pool still serves the same prompt normally...
+        ok = _req(prompt, max_tokens=4)
+        _drive(ex, [ok])
+        assert len(ok.tokens) == 4
+        # ...and the unwind left NOTHING held: not the forked chain,
+        # not the block restored before the failure, not a tier pin.
+        ex.prefix.flush()
+        ex.allocator.assert_clean()
+        ex.tier.assert_clean()
+    finally:
+        ex.close()
+
+
 # -- admission control -------------------------------------------------------
 
 
