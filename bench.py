@@ -817,7 +817,13 @@ def bench_serving() -> dict:
             f"{out.get('serving_ttft_vs_rr_x')}x (tier spill "
             f"{out.get('serving_tier_spill_gbps')} Gb/s, restore "
             f"{out.get('serving_tier_restore_gbps')} Gb/s, pulled "
-            f"{out.get('serving_router_pulled_blocks')} blocks)",
+            f"{out.get('serving_router_pulled_blocks')} blocks); "
+            f"qos good-tenant p99 "
+            f"{out.get('serving_tenant_p99_contended_ms')} ms under "
+            f"flood vs {out.get('serving_tenant_p99_solo_ms')} solo = "
+            f"{out.get('serving_tenant_p99_isolation')}x isolation "
+            f"(flood shed {out.get('serving_tenant_flood_shed_frac')}, "
+            f"burst recovery {out.get('serving_burst_recovery_ms')} ms)",
             file=sys.stderr,
         )
         return out
@@ -954,6 +960,15 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
     ttx = metrics.get("serving_ttft_vs_rr_x")
     if ttx is not None:
         gates["serving_ttft_vs_rr_le_07"] = bool(ttx <= 0.7)
+    # Multi-tenant QoS (ISSUE 20), ABSOLUTE: the good tenant's p99
+    # with an adversarial 10x batch-class flood running must stay
+    # within 1.35x of its solo p99 — the isolation claim itself
+    # (per-tenant buckets + strict priority + weighted-fair pop).
+    # Both arms ride the same deterministic fixed-step cost model, so
+    # a miss means admission stopped isolating, never box weather.
+    iso = metrics.get("serving_tenant_p99_isolation")
+    if iso is not None:
+        gates["serving_tenant_isolation_le_135"] = bool(iso <= 1.35)
 
     for key, band, label in (
         ("fabric_tcp_gbps", 0.85, "fabric_tcp_ge_085_median"),
@@ -1068,6 +1083,16 @@ def evaluate_gates(metrics: dict, history: dict) -> dict:
         # while BOTH arms drift slower (queue or restore-path creep),
         # and this band is what catches that drift.
         ("serving_ttft_p99_ms", 1.35, "serving_ttft_p99_le_135_median"),
+        # Multi-tenant QoS (ISSUE 20): time for an interactive probe's
+        # latency to return under 2x its pre-burst median after a
+        # batch-class burst lands — the strict-priority classes are
+        # what keep this small, so creep here means batch work is
+        # holding the interactive class hostage again (a pop-order or
+        # preemption regression) even while the isolation ratio gate
+        # above still clears. First-run-safe like every rolling band:
+        # no artifact history, no gate.
+        ("serving_burst_recovery_ms", 1.35,
+         "serving_burst_recovery_le_135_median"),
     ):
         cur = metrics.get(key)
         past = history.get(key) or []
@@ -1209,6 +1234,11 @@ def main() -> int:
         "serving_tier_spill_gbps": "Gb/s",
         "serving_tier_restore_gbps": "Gb/s",
         "serving_router_pull_gbps": "Gb/s",
+        "serving_tenant_p99_solo_ms": "ms",
+        "serving_tenant_p99_contended_ms": "ms",
+        "serving_tenant_p99_isolation": "x",
+        "serving_tenant_flood_shed_frac": "frac",
+        "serving_burst_recovery_ms": "ms",
     }
     for key, unit in units.items():
         if key in metrics:

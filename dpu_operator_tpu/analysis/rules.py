@@ -52,6 +52,10 @@ GL022  lifecycle object live in a non-terminal state on an exception
        analysis/lifecycle/, serving/)
 GL023  faults.fire / fault_site seam string referenced by no test
        under tests/ (chaos-matrix completeness, whole package)
+GL024  shed/5xx/requeue path drops a request around the finish()
+       settle choke point — hand-set done event, request error store,
+       or kv_lease = None with no settle/route call in the function
+       (serving/, except api.py where the choke point lives)
 
 Rules lean conservative: a near-miss that must stay silent is as much a
 part of each rule's contract as its true positive, and both ship as
@@ -1878,6 +1882,119 @@ class ProvisionalCursorRead(Rule):
                     f"consumers must read the confirmed watermark")
 
 
+# --------------------------------------------------------------------------
+# GL024 — request dropped around the finish() settle choke point
+
+
+class SettleBypassDropsLease(Rule):
+    """Origin: ISSUE 20's KV-aware preemption. A request may now carry
+    its KV across the queue in THREE shapes — an attached slot, a
+    detached-but-resumable ``KVLease``, a tier-pinned ``ParkedKV`` —
+    and the ONLY thing that settles all three exactly once is
+    ``GenerateRequest.finish()`` (``fail()`` is its error spelling):
+    the ``on_request_settled`` hook chain releases whichever lease
+    object rides ``req.kv_lease`` at settle time. Every shed, 5xx and
+    requeue path therefore has exactly two legal moves: route the
+    request onward (``requeue``), or settle it through the choke
+    point. The bug class this guards: a drop path 'helpfully'
+    hand-rolls the settle — sets the done event, stamps ``error``, or
+    clears ``kv_lease`` to make the request look fresh — and the pins
+    behind the bypassed hook leak until teardown's ledger assert (or
+    production's OOM).
+
+    Fires on, in serving/ functions (EXCEPT api.py, where the choke
+    point's own internals live) that neither settle nor route —
+    no call to ``finish`` / ``fail`` / ``on_request_settled`` /
+    ``requeue`` / ``release*`` anywhere in the function:
+
+      * ``X._done.set()`` with a non-self receiver (settling someone
+        else's event is exactly the hook bypass);
+      * ``X.error = ...`` where the receiver names a request
+        (contains ``req``) and is not self;
+      * ``X.kv_lease = None`` (the literal None store: oblivion for
+        whatever lease object was riding there).
+
+    Near-misses that stay silent: the same stores alongside a
+    settle/route call in the same function (kv_attach clears
+    ``kv_lease`` AFTER releasing the foreign lease — legal),
+    ``self.error`` / ``self._done`` (an object managing its own
+    state), non-request ``error`` stores (worker tickets, pending
+    handles), and ``kv_lease = <lease>`` rebinds (attach paths
+    installing a new lease)."""
+
+    rule_id = "GL024"
+    severity = SEVERITY_ERROR
+    title = "request dropped without the finish() settle choke point"
+    hint = ("every shed/5xx/requeue path must either requeue() the "
+            "request or settle it through finish()/fail() — the "
+            "on_request_settled hook behind them is what releases the "
+            "KVLease/ParkedKV riding req.kv_lease; hand-rolling the "
+            "settle (done-event set, error store, kv_lease = None) "
+            "leaks the pages or tier pins behind the bypassed hook")
+
+    _SETTLE = {"finish", "fail", "on_request_settled", "requeue"}
+
+    @classmethod
+    def _settles(cls, fn: ast.AST) -> bool:
+        for n in _walk_through_lambdas(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            leaf = _terminal_name(n.func)
+            if leaf in cls._SETTLE or leaf.startswith("release"):
+                return True
+        return False
+
+    def check(self, module: Module, project: Project) -> Iterator[Finding]:
+        if not module.in_dir("serving"):
+            return
+        if module.relpath.endswith("serving/api.py"):
+            return
+        for fn, qual in module.functions:
+            if self._settles(fn):
+                continue
+            for n in _walk_through_lambdas(fn):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "set"
+                        and isinstance(n.func.value, ast.Attribute)
+                        and n.func.value.attr == "_done"
+                        and _terminal_name(
+                            n.func.value.value) != "self"):
+                    yield self.finding(
+                        module, n,
+                        f"'{ast.unparse(n)}' in '{qual}' settles a "
+                        f"request's done event by hand with no "
+                        f"finish()/fail()/requeue() in the function — "
+                        f"the on_request_settled hook (and the lease "
+                        f"release behind it) never runs")
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if not isinstance(t, ast.Attribute):
+                            continue
+                        recv = _terminal_name(t.value)
+                        if (t.attr == "error" and recv != "self"
+                                and "req" in recv):
+                            yield self.finding(
+                                module, n,
+                                f"'{ast.unparse(t)}' stored in "
+                                f"'{qual}' with no finish()/fail()/"
+                                f"requeue() in the function — an "
+                                f"error stamped outside the settle "
+                                f"choke point strands the handler "
+                                f"and the lease both")
+                        elif (t.attr == "kv_lease" and recv != "self"
+                                and isinstance(n.value, ast.Constant)
+                                and n.value.value is None):
+                            yield self.finding(
+                                module, n,
+                                f"'{ast.unparse(t)} = None' in "
+                                f"'{qual}' with no release/finish/"
+                                f"fail/requeue in the function — "
+                                f"whatever KVLease/ParkedKV rode "
+                                f"there still holds its pages or "
+                                f"tier pins")
+
+
 def default_rules() -> List[Rule]:
     from .concurrency import (InconsistentLockDiscipline,
                               LockOrderInversion)
@@ -1895,5 +2012,6 @@ def default_rules() -> List[Rule]:
             Fp32ResidentPoolWithoutPolicy(), KVDetachWithoutAck(),
             PlanTimeCollectStateWrite(), InlineShardKVGeometry(),
             UnverifiedPrefixPublish(), ProvisionalCursorRead(),
+            SettleBypassDropsLease(),
             IllegalLifecycleTransition(), LifecycleLeakOnException(),
             FaultSiteUncovered()]

@@ -34,15 +34,17 @@ Importing this package stays jax-free; jax loads only when a
 LocalExecutor or PagedKVExecutor is constructed.
 """
 
-from .api import (Draining, GenerateRequest, QueueFull, ServingError,
-                  encode_prompt, encode_prompt_tokens)
+from .api import (PRIORITIES, Draining, GenerateRequest, QueueFull,
+                  ServingError, TenantOverBudget, encode_prompt,
+                  encode_prompt_tokens)
+from .autoscale import RoleAutoscaler
 from .disagg import DisaggPool, KVSpec, KVSpecMismatch
 from .executor import (Executor, LocalExecutor, ReplicaPool,
                        SyntheticExecutor)
 from .kvcache import (HostKVTier, KVBlockAllocator, KVCacheOOM,
-                      KVLease, PagedKVExecutor, PrefixTree,
+                      KVLease, PagedKVExecutor, ParkedKV, PrefixTree,
                       ShardedPagedKVExecutor, SyntheticKVExecutor)
-from .queue import AdmissionQueue
+from .queue import AdmissionQueue, TenantBudget
 from .router import PrefixRouter, RouterReplica
 from .scheduler import ContinuousBatcher
 from .server import ServingServer
@@ -67,10 +69,13 @@ __all__ = [
     "LocalExecutor",
     "NO_TOKEN",
     "OracleDraft",
+    "PRIORITIES",
     "PagedKVExecutor",
+    "ParkedKV",
     "PrefixRouter",
     "PrefixTree",
     "QueueFull",
+    "RoleAutoscaler",
     "RouterReplica",
     "ReplicaPool",
     "ServingError",
@@ -81,6 +86,8 @@ __all__ = [
     "SyntheticExecutor",
     "SyntheticKVExecutor",
     "SyntheticShardSet",
+    "TenantBudget",
+    "TenantOverBudget",
     "TruncatedDraft",
     "encode_prompt",
     "encode_prompt_tokens",

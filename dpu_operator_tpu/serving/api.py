@@ -40,6 +40,45 @@ class Draining(ServingError):
     In-flight requests keep running; new ones must go elsewhere."""
 
 
+class TenantOverBudget(ServingError):
+    """Admission refused: this tenant's token bucket is empty. Carries
+    the refill hint the HTTP layer turns into a 429 + Retry-After —
+    per-tenant backpressure, distinct from QueueFull's 503: the SERVER
+    has capacity, this tenant has spent its share of it."""
+
+    def __init__(self, tenant: str, retry_after_s: float):
+        super().__init__(f"tenant {tenant!r} over admission budget")
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+#: Priority classes, in strict pop order: every queued interactive
+#: request is served before any batch request, and a batch occupant is
+#: the only legal preemption victim. Unknown classes are rejected at
+#: the HTTP door (400) — a typo must not silently become a new class.
+PRIORITIES = ("interactive", "batch")
+
+#: Default cap on distinct tenant label values any one metrics series
+#: may carry. Tenant names arrive from the wire, so an adversarial
+#: client could otherwise mint unbounded label cardinality.
+TENANT_LABEL_CAP = 16
+
+
+def bounded_tenant_label(tenant: str, seen: set,
+                         cap: int = TENANT_LABEL_CAP) -> str:
+    """Metrics-safe tenant label: the first `cap` distinct tenants keep
+    their own label value, everyone later folds into "other". `seen` is
+    the caller-owned admitted-label set (callers mutate it under their
+    own lock — the queue and server each bound their series
+    independently, so one plane's overflow never renames the other's)."""
+    if tenant in seen:
+        return tenant
+    if len(seen) < cap:
+        seen.add(tenant)
+        return tenant
+    return "other"
+
+
 # The queue's shed-at-pop error, matched EXACTLY by the HTTP layer to
 # pick 503 (back off and retry elsewhere) over 500 (replica failure) —
 # a substring match would misclassify executor errors that merely
@@ -103,6 +142,15 @@ class GenerateRequest:
     # every re-admission after a replica death/wedge; past the pool's
     # attempts budget the request 500s with RETRIES_EXHAUSTED_ERROR.
     attempts: int = 0
+    # Multi-tenant QoS (ISSUE 20): who this request bills to and which
+    # priority class it rides. Preemption is policy, not failure — a
+    # preempted request requeues WITHOUT touching `attempts` (that
+    # budget counts replica faults survived, and a batch request parked
+    # N times under interactive pressure has survived zero of them);
+    # `preemptions` counts the parks separately for tracing/tests.
+    tenant: str = "default"
+    priority: str = "interactive"
+    preemptions: int = 0
     # Span id (int) of the HTTP handler's root "request" span: the
     # explicit parent every cross-thread span for this request hangs
     # off (queue, admit/retire, supervisor requeue). None for requests

@@ -2071,6 +2071,199 @@ def cluster_prefix(trace, n_tenants: int = 7, reqs_per_tenant: int = 8,
     return out
 
 
+def multi_tenant_qos(trace, slots: int = 4, step_ms: float = 2.0,
+                     good_rate: float = 40.0, flood_x: float = 10.0,
+                     seconds: float = 2.0,
+                     flood_budget_rate: float = 40.0,
+                     prompt_tokens: int = 16, good_tokens: int = 16,
+                     flood_tokens: int = 16,
+                     burst_n: int = 24, reps: int = 3) -> dict:
+    """Section 17 (ISSUE 20): trace-driven open-loop multi-tenant QoS
+    on the paged-KV plane.
+
+    One submission thread walks a precomputed arrival schedule into
+    the AdmissionQueue (a "trace", in the request-log sense) and the
+    requests settle through a real kv-mode ContinuousBatcher —
+    latency is stamped server-side (arrival -> finished_at), so the
+    figures move on admission/preemption regressions, never on bench
+    client threads. SyntheticKVExecutor with a fixed per-step cost is
+    the accelerator model; the host tier is armed so preemption has
+    somewhere to park.
+
+    Two claims, each its own arm pair:
+
+      * isolation — the good tenant's interactive p99 with an
+        adversarial tenant submitting batch-class work at ``flood_x``
+        its rate vs the same schedule alone.
+        ``serving_tenant_p99_isolation`` is the contended/solo ratio,
+        gated ABSOLUTE (<= 1.35) in bench.py. Three mechanisms carry
+        it: the flood's token bucket sheds most of its arrivals
+        (429s it pays for itself), strict priority pops interactive
+        ahead of every queued flood, and — the ISSUE 20 tentpole —
+        KV-aware preemption parks a batch occupant the moment an
+        interactive arrival finds every slot full, so the tail never
+        waits out a flood request's full decode.
+        ``serving_tenant_preemptions`` rides along: the gate passing
+        WITHOUT parks would mean the test stopped exercising the
+        mechanism. Each arm runs ``reps`` times and reports its BEST
+        p99 — OS scheduler jitter only ever inflates a wall-clock
+        tail, so the minimum over repetitions is the estimator
+        closest to the arm's true p99 and keeps an absolute gate from
+        flaking on a noisy host.
+      * burst recovery — ``burst_n`` batch-class requests land at
+        once on a quiet batcher; sequential interactive probes
+        measure how long until latency returns under 2x the
+        pre-burst baseline. ``serving_burst_recovery_ms`` rides the
+        1.35x rolling-median band in bench.py (first-run-safe: no
+        history, no gate).
+    """
+    import numpy as _np
+
+    from .api import GenerateRequest, ServingError
+    from .kvcache import SyntheticKVExecutor
+    from .queue import AdmissionQueue, TenantBudget
+    from .scheduler import ContinuousBatcher
+
+    step_s = step_ms / 1000.0
+    vocab = 32
+    rng = _np.random.RandomState(2020)
+    out: dict = {}
+
+    def mk_req(tenant, priority, max_tokens):
+        return GenerateRequest(
+            prompt_vec=None, max_tokens=max_tokens,
+            deadline=time.monotonic() + 30.0,
+            prompt_tokens=[int(t) for t in
+                           rng.randint(0, vocab, size=prompt_tokens)],
+            tenant=tenant, priority=priority)
+
+    def mk_plane():
+        # prefill_budget covers every occupant's chunk in one step so
+        # a flood prefill can delay a good-tenant prefill only through
+        # slot occupancy (which preemption resolves), not by
+        # serializing the chunk queue.
+        ex = SyntheticKVExecutor(
+            slots=slots, vocab=vocab, block_size=4, num_blocks=256,
+            max_blocks_per_req=16, prefill_chunk=8,
+            prefill_budget=8 * slots,
+            step_time_s=step_s, host_tier_bytes=1 << 20)
+        q = AdmissionQueue(
+            max_depth=max(64, 4 * slots),
+            tenants={"good": TenantBudget(weight=4.0),
+                     "flood": TenantBudget(rate=flood_budget_rate,
+                                           burst=8.0, weight=1.0)})
+        return ex, q, ContinuousBatcher(ex, q)
+
+    def run_arm(with_flood):
+        ex, q, b = mk_plane()
+        # The arrival trace: (t_offset, tenant) merged in time order.
+        sched = [(i / good_rate, "good")
+                 for i in range(int(good_rate * seconds))]
+        if with_flood:
+            fr = flood_x * good_rate
+            sched += [(i / fr, "flood")
+                      for i in range(int(fr * seconds))]
+        sched.sort()
+        good, sheds, flood_n = [], 0, 0
+        b.start()
+        t0 = time.perf_counter()
+        try:
+            for t_at, tenant in sched:
+                dt = t0 + t_at - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                if tenant == "good":
+                    r = mk_req("good", "interactive", good_tokens)
+                    q.submit(r)
+                    good.append(r)
+                else:
+                    flood_n += 1
+                    try:
+                        q.submit(mk_req("flood", "batch",
+                                        flood_tokens))
+                    except ServingError:
+                        sheds += 1  # 429/503: the flood pays itself
+            for r in good:
+                if not r.wait(timeout=30.0):
+                    raise RuntimeError("good-tenant request lost")
+                if r.error is not None:
+                    raise RuntimeError(f"good-tenant request failed: "
+                                       f"{r.error}")
+        finally:
+            b.stop()
+        lat = sorted(r.timings_ms()["total_ms"] for r in good)
+        stats = dict(p99=nearest_rank(lat, 0.99),
+                     preempted=ex.preempted_total,
+                     resumed=ex.preempt_resumed_total,
+                     shed_frac=sheds / max(1, flood_n))
+        ex.prefix.flush()
+        ex.tier.flush()
+        ex.allocator.assert_clean()
+        ex.tier.assert_clean()
+        ex.close()
+        return stats
+
+    solos = [run_arm(with_flood=False) for _ in range(reps)]
+    conts = [run_arm(with_flood=True) for _ in range(reps)]
+    solo_p99 = min(s["p99"] for s in solos)
+    cont_p99 = min(c["p99"] for c in conts)
+    out["serving_tenant_p99_solo_ms"] = round(solo_p99, 3)
+    out["serving_tenant_p99_contended_ms"] = round(cont_p99, 3)
+    out["serving_tenant_p99_isolation"] = round(
+        cont_p99 / max(1e-9, solo_p99), 3)
+    out["serving_tenant_flood_shed_frac"] = round(
+        sum(c["shed_frac"] for c in conts) / len(conts), 3)
+    out["serving_tenant_preemptions"] = sum(
+        c["preempted"] for c in conts)
+
+    # Burst recovery: quiet batcher, pre-burst probe baseline, then a
+    # batch-class wall of work and sequential interactive probes until
+    # latency settles back under 2x the baseline.
+    ex, q, b = mk_plane()
+    b.start()
+    try:
+        def probe():
+            r = mk_req("good", "interactive", good_tokens)
+            q.submit(r)
+            if not r.wait(timeout=30.0) or r.error is not None:
+                raise RuntimeError(f"probe failed: {r.error}")
+            return r.timings_ms()["total_ms"]
+
+        base = sorted(probe() for _ in range(8))
+        base_med = base[len(base) // 2]
+        burst = [mk_req("good", "batch", flood_tokens)
+                 for _ in range(burst_n)]
+        t_burst = time.perf_counter()
+        for r in burst:
+            q.submit(r)
+        recovery_ms = None
+        while time.perf_counter() - t_burst < 10.0:
+            if probe() <= 2.0 * base_med:
+                recovery_ms = (time.perf_counter() - t_burst) * 1000
+                break
+        if recovery_ms is None:
+            raise RuntimeError("burst never recovered inside 10s")
+        for r in burst:
+            r.wait(timeout=30.0)
+        out["serving_burst_recovery_ms"] = round(recovery_ms, 3)
+    finally:
+        b.stop()
+    ex.prefix.flush()
+    ex.tier.flush()
+    ex.allocator.assert_clean()
+    ex.tier.assert_clean()
+    ex.close()
+
+    trace(f"multi-tenant qos: good p99 "
+          f"{out['serving_tenant_p99_contended_ms']} ms contended vs "
+          f"{out['serving_tenant_p99_solo_ms']} ms solo = "
+          f"{out['serving_tenant_p99_isolation']}x (flood shed "
+          f"{out['serving_tenant_flood_shed_frac']}, "
+          f"{out['serving_tenant_preemptions']} preemption(s)); "
+          f"burst recovery {out['serving_burst_recovery_ms']} ms")
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--slots", type=int, default=8)
@@ -2250,6 +2443,16 @@ def main(argv: Optional[list] = None) -> int:
     except Exception as e:
         out["serving_cluster_prefix_error"] = str(e)[:200]
         trace(f"cluster-prefix section failed: {e}")
+
+    # 17: multi-tenant QoS (ISSUE 20) — tenant-isolation p99 ratio
+    # under an adversarial batch-class flood (ABSOLUTE <= 1.35 gate in
+    # bench.py) + interactive burst-recovery time (1.35x rolling-
+    # median band), all on the synthetic fixed-step cost model.
+    try:
+        out.update(multi_tenant_qos(trace))
+    except Exception as e:
+        out["serving_qos_error"] = str(e)[:200]
+        trace(f"multi-tenant-qos section failed: {e}")
 
     # 4: the real jitted path — forward-only train_step model on a mesh.
     if not args.skip_local:

@@ -164,7 +164,8 @@ class DisaggPool:
 
     @property
     def executors(self) -> List:
-        return list(self.prefill_pool.executors) + self.decode_executors
+        return (list(self.prefill_pool.executors)
+                + list(self.decode_pool.executors))
 
     @property
     def supervised(self) -> bool:
@@ -254,6 +255,56 @@ class DisaggPool:
             time.sleep(poll_s)
         return idle()
 
+    # -- role autoscaling (ISSUE 20) ------------------------------------------
+
+    def transfer_backlog(self) -> int:
+        """Hand-offs enqueued or in flight on the transfer plane —
+        the decode-side pressure signal the RoleAutoscaler reads
+        alongside decode queue depth."""
+        with self._tlock:
+            return self._txq.qsize() + self._transferring
+
+    def flip_role(self, from_role: str) -> Optional[str]:
+        """Move one replica between the role pools, live. The executor
+        object — allocator, prefix tree, tier, pages — survives the
+        move; only the batcher is rebuilt, with the DESTINATION pool's
+        batcher_kwargs (gaining or losing the handoff hook is what
+        changes the role). In-flight occupants requeue exactly once
+        through the policy path (no `attempts` burn), resume via the
+        ordinary attach dispositions, and each pool always keeps at
+        least one live replica (returns None instead of violating
+        that).
+
+        Transfer-target note: the page-stream import servers are
+        index-coupled to the ORIGINAL decode executors, so a replica
+        flipped INTO the decode pool serves the decode queue but is
+        never a transfer target, and one flipped OUT stops being
+        preferred by _pick_target — no server is rebound live."""
+        if from_role == "prefill":
+            src, dst = self.prefill_pool, self.decode_pool
+        elif from_role == "decode":
+            src, dst = self.decode_pool, self.prefill_pool
+        else:
+            raise ValueError(
+                f"from_role must be prefill|decode, got {from_role!r}")
+        ex = src.detach_replica(min_live=1)
+        if ex is None:
+            return None
+        name = dst.attach_replica(ex)
+        direction = f"{from_role}_to_{dst.role}"
+        self._count("serving_autoscale_flips_total",
+                    {"direction": direction},
+                    help="role-autoscaler replica flips between the "
+                         "prefill and decode pools")
+        self.tracer.event("disagg.flip_role",
+                          attrs={"direction": direction,
+                                 "replica": name})
+        self.tracer.decision("flip_role", direction=direction,
+                             replica=name)
+        log.info("role flip %s: replica now serving as %s",
+                 direction, name)
+        return name
+
     # -- the transfer plane ----------------------------------------------------
 
     def transfer_addrs(self) -> List:
@@ -318,8 +369,17 @@ class DisaggPool:
     def _pick_target(self) -> int:
         """Emptiest decode pool wins (free blocks = admission
         headroom — the decode-side OOM nack is the pressure valve,
-        this just steers away from it)."""
-        return max(range(len(self.decode_executors)),
+        this just steers away from it). Among targets, prefer
+        executors still serving IN the decode pool: one flipped out
+        by the autoscaler can still import pages, but the requeued
+        request would then pop on another replica and re-prefill via
+        the foreign-lease path — correct, just wasted transfer."""
+        live = {id(e) for e in list(self.decode_pool.executors)}
+        idxs = [i for i, e in enumerate(self.decode_executors)
+                if id(e) in live]
+        if not idxs:
+            idxs = list(range(len(self.decode_executors)))
+        return max(idxs,
                    key=lambda i:
                    self.decode_executors[i].allocator.free_count())
 

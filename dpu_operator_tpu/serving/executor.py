@@ -493,6 +493,12 @@ class ReplicaPool:
         # thread vs readers like live_count); the per-batcher settle
         # lock guards request ownership.
         self._plock = threading.Lock()
+        # Replica names are STABLE across attach/detach splices (the
+        # autoscaler's role flips): index-derived names would rename
+        # every later replica's metric series on each flip.
+        self._names: List[str] = [f"{self.name_prefix}{i}"
+                                  for i in range(len(self.executors))]
+        self._name_seq = len(self.executors)
         self.batchers: List = [
             self._make_batcher(i, ex)
             for i, ex in enumerate(self.executors)
@@ -511,6 +517,8 @@ class ReplicaPool:
         self._sup_thread: Optional[threading.Thread] = None
 
     def _rname(self, i: int) -> str:
+        if i < len(self._names):
+            return self._names[i]
         return f"{self.name_prefix}{i}"
 
     def _make_batcher(self, i: int, ex: Executor):
@@ -571,9 +579,9 @@ class ReplicaPool:
     def _publish_state(self) -> None:
         if self.registry is None:
             return
-        shard_dim = ["true" if getattr(ex, "sharded", False)
-                     else "false" for ex in self.executors]
         with self._plock:
+            shard_dim = ["true" if getattr(ex, "sharded", False)
+                         else "false" for ex in self.executors]
             counts = {(st, sh): 0.0
                       for st in (REPLICA_LIVE, REPLICA_BACKOFF,
                                  REPLICA_PARKED)
@@ -603,6 +611,10 @@ class ReplicaPool:
                 # at most this replica this cycle, never the thread.
                 try:
                     with self._plock:
+                        if i >= len(self.batchers):
+                            # A detach_replica spliced the arrays
+                            # mid-cycle; the next cycle re-ranges.
+                            break
                         st = self._state[i]
                         b = self.batchers[i]
                         restart_at = self._restart_at[i]
@@ -766,6 +778,199 @@ class ReplicaPool:
                        "attempts": req.attempts})
             self.tracer.decision("requeue", request_id=req.request_id,
                                  replica=replica, outcome=outcome)
+
+    # -- autoscaler surface (ISSUE 20) ----------------------------------------
+
+    def _requeue_policy(self, name: str, reqs: List[GenerateRequest],
+                        why: str) -> None:
+        """Requeue requests displaced by POLICY (role flip, park-to-
+        zero) rather than failure. Same exactly-once dispositions as
+        the supervisor's `_requeue`, with one deliberate difference:
+        `attempts` is NOT burned — the replica did nothing wrong and
+        neither did the request, so a flip must never push a request
+        toward RETRIES_EXHAUSTED_ERROR."""
+        now = time.monotonic()
+        for req in reqs:
+            if req.done:
+                outcome = "already_done"
+            elif req.deadline <= now:
+                if req.tokens:
+                    req.truncated = True
+                    req.finish()
+                    outcome = "deadline_truncated"
+                else:
+                    req.fail(DEADLINE_QUEUED_ERROR)
+                    outcome = "deadline_lapsed"
+            else:
+                lease = getattr(req, "kv_lease", None)
+                if lease is not None and lease.resumable:
+                    # The executor object survives the flip, so the
+                    # lease's pages do too: tokens are KEPT and the
+                    # next attach either resumes (same executor) or
+                    # releases-and-reprefills (foreign) — byte-
+                    # identical either way.
+                    outcome = f"{why}_kv"
+                else:
+                    req.tokens.clear()
+                    req.truncated = False
+                    outcome = why
+                self.queue.requeue(req)
+            self._count("serving_requeue_total",
+                        {"replica": name, "outcome": outcome},
+                        help="in-flight requests seized from failed "
+                             "replicas, by disposition")
+            self.tracer.event(
+                "supervisor.requeue", request_id=req.request_id,
+                parent_id=req.trace_parent,
+                attrs={"replica": name, "outcome": outcome,
+                       "attempts": req.attempts})
+
+    def detach_replica(self, min_live: int = 1):
+        """Remove one LIVE replica from the pool (the autoscaler's
+        role-flip donor side). Seizes the batcher under its settle
+        lock, requeues its in-flight occupants exactly once WITHOUT
+        burning `attempts`, splices every parallel array, and returns
+        the executor — still warm, pages intact — for
+        `attach_replica` on the destination pool. Returns None rather
+        than dropping the pool below `min_live` live replicas."""
+        with self._plock:
+            live = [j for j, s in enumerate(self._state)
+                    if s == REPLICA_LIVE]
+            if len(live) <= max(1, int(min_live)):
+                return None
+            i = live[-1]
+            b = self.batchers[i]
+            name = self._rname(i)
+            self._seizing += 1
+        try:
+            seized = b.seize()
+            b.stop(timeout=5.0)  # slots already empty: fails nothing
+            self._requeue_policy(name, seized, "requeued_flip")
+            with self._plock:
+                ex = self.executors[i]
+                for arr in (self.executors, self.batchers, self._state,
+                            self._restart_at, self._fail_times,
+                            self.restarts, self._names):
+                    del arr[i]
+                # A shrunk pool must not read as permanently degraded.
+                self.quorum = max(1, min(self.quorum,
+                                         len(self.executors)))
+        finally:
+            with self._plock:
+                self._seizing -= 1
+        self.tracer.event("pool.detach_replica",
+                          attrs={"role": self.role, "replica": name,
+                                 "seized": len(seized)})
+        self._publish_state()
+        return ex
+
+    def attach_replica(self, ex: Executor) -> str:
+        """Adopt an executor (the role-flip recipient side): build a
+        batcher with THIS pool's `batcher_kwargs` — that is what makes
+        the replica's new role real (a prefill pool's kwargs carry the
+        handoff hook; a decode pool's do not) — and start serving from
+        this pool's queue. Returns the replica's stable name."""
+        if self.registry is not None:
+            bind = getattr(ex, "bind_registry", None)
+            if bind is not None:
+                bind(self.registry)
+        with self._plock:
+            self.executors.append(ex)
+            i = len(self.executors) - 1
+            name = f"{self.name_prefix}{self._name_seq}"
+            self._name_seq += 1
+            self._names.append(name)
+            b = self._make_batcher(i, ex)
+            self.batchers.append(b)
+            self._state.append(REPLICA_LIVE)
+            self._restart_at.append(None)
+            self._fail_times.append(deque())
+            self.restarts.append(0)
+        b.start()
+        self.tracer.event("pool.attach_replica",
+                          attrs={"role": self.role, "replica": name})
+        self._publish_state()
+        return name
+
+    def park_replica(self, i: Optional[int] = None,
+                     min_live: int = 0) -> Optional[str]:
+        """Scale-to-zero: stop a LIVE replica and PARK it — the same
+        terminal state the restart breaker uses, so the supervisor
+        leaves it alone and states()/serving_pool_replicas read it as
+        parked capacity. In-flight occupants requeue exactly once via
+        the policy path (no `attempts` burn). Returns the replica
+        name, or None when parking would drop live below
+        `min_live` (or nothing is live)."""
+        with self._plock:
+            live = [j for j, s in enumerate(self._state)
+                    if s == REPLICA_LIVE]
+            if not live or len(live) - 1 < max(0, int(min_live)):
+                return None
+            if i is None:
+                i = live[-1]
+            elif self._state[i] != REPLICA_LIVE:
+                return None
+            b = self.batchers[i]
+            name = self._rname(i)
+            # State flips BEFORE the seize so the monitor never reads
+            # the stopping batcher as a death to requeue+restart.
+            self._state[i] = REPLICA_PARKED
+            self._restart_at[i] = None
+            self._seizing += 1
+        try:
+            seized = b.seize()
+            b.stop(timeout=5.0)
+            self._requeue_policy(name, seized, "requeued_park")
+        finally:
+            with self._plock:
+                self._seizing -= 1
+        self.tracer.event("pool.park_replica",
+                          attrs={"role": self.role, "replica": name,
+                                 "seized": len(seized)})
+        self._publish_state()
+        return name
+
+    def unpark_replica(self, i: Optional[int] = None) -> Optional[str]:
+        """Wake a PARKED replica (scale-from-zero). Builds a fresh
+        batcher over the same executor — distinct from `_restart` so
+        autoscale wakes never count as failure-recovery restarts and
+        never touch the breaker window."""
+        with self._plock:
+            parked = [j for j, s in enumerate(self._state)
+                      if s == REPLICA_PARKED]
+            if i is None:
+                if not parked:
+                    return None
+                i = parked[0]
+            elif self._state[i] != REPLICA_PARKED:
+                return None
+            ex = self.executors[i]
+            name = self._rname(i)
+        try:
+            b = self._make_batcher(i, ex)
+        except Exception:
+            log.exception("%s: unpark construction failed", name)
+            return None
+        with self._plock:
+            if self._state[i] != REPLICA_PARKED:
+                return None  # raced a concurrent unpark
+            self.batchers[i] = b
+            self._state[i] = REPLICA_LIVE
+            self._restart_at[i] = None
+            # Fresh start, fresh breaker window: the park that put it
+            # here may have been policy, and even a breaker park's
+            # stale failures should not instantly re-park the wake.
+            self._fail_times[i].clear()
+        b.start()
+        if self.registry is not None:
+            self.registry.gauge_set(
+                "serving_breaker_state", 0.0, {"replica": name},
+                help="1 when the replica's restart breaker is "
+                     "open (replica parked)")
+        self.tracer.event("pool.unpark_replica",
+                          attrs={"role": self.role, "replica": name})
+        self._publish_state()
+        return name
 
     def _restart(self, i: int) -> None:
         ex = self.executors[i]

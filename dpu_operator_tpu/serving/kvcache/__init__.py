@@ -32,7 +32,7 @@ from .executor import (NO_TOKEN, KVExecutorBase, PagedKVExecutor,
 from .paged import kv_bytes_per_slot, paged_kv_error_bound
 from .sharded import (KVShardProcessSet, ShardedPagedKVExecutor,
                       SyntheticKVShardSet, resolve_shard_axis)
-from .tiering import HostKVTier, verify_block_tokens
+from .tiering import HostKVTier, ParkedKV, verify_block_tokens
 
 __all__ = [
     "CACHE_OWNER",
@@ -44,6 +44,7 @@ __all__ = [
     "KVShardProcessSet",
     "NO_TOKEN",
     "PagedKVExecutor",
+    "ParkedKV",
     "PrefixTree",
     "ShardedPagedKVExecutor",
     "SyntheticKVExecutor",

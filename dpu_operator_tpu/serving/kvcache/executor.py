@@ -61,7 +61,7 @@ from ..spec import (NO_TOKEN, SpecConfig, accept_tree, clamp_spec_k,
                     propose_full, synthetic_next_token)
 from .allocator import (_ROOT as _TREE_ROOT, KVBlockAllocator,
                         KVCacheOOM, KVLease, PrefixTree)
-from .tiering import HostKVTier, verify_block_tokens
+from .tiering import HostKVTier, ParkedKV, verify_block_tokens
 
 log = logging.getLogger(__name__)
 
@@ -234,6 +234,9 @@ class KVExecutorBase(Executor):
         self.steps_decode = 0
         self.steps_mixed = 0
         self.resumed_total = 0
+        # KV-aware preemption (ISSUE 20): victims parked / resumed.
+        self.preempted_total = 0
+        self.preempt_resumed_total = 0
         self.spec: Optional[SpecConfig] = None
         self._spec_inflight = 0  # spec windows submitted, uncollected
         if spec is not None:
@@ -318,16 +321,30 @@ class KVExecutorBase(Executor):
                 # Shared prefix blocks are never scatter targets at
                 # all — appends land at positions >= the block-aligned
                 # cached prefix, in the request's own fresh blocks.
-                if lease.exec_id == self._exec_id:
+                if isinstance(lease, ParkedKV):
+                    if (lease.exec_id == self._exec_id
+                            and self.prefix is not None
+                            and self.tier is not None):
+                        return self._attach_parked(slot, req, lease)
+                    # Parked on a different replica (or this one lost
+                    # its tier): the pins mean nothing here — return
+                    # them and re-prefill; deterministic decode makes
+                    # the stream identical either way.
+                    lease.release()
+                    req.kv_lease = None
+                    req.tokens.clear()
+                    req.truncated = False
+                elif lease.exec_id == self._exec_id:
                     return self._reattach(slot, req, lease)
-                # Foreign pages mean nothing in this pool: release
-                # them and restart the stream from the prompt (the
-                # deterministic recurrence makes the retried stream
-                # identical either way).
-                lease.release()
-                req.kv_lease = None
-                req.tokens.clear()
-                req.truncated = False
+                else:
+                    # Foreign pages mean nothing in this pool: release
+                    # them and restart the stream from the prompt (the
+                    # deterministic recurrence makes the retried stream
+                    # identical either way).
+                    lease.release()
+                    req.kv_lease = None
+                    req.tokens.clear()
+                    req.truncated = False
             owner = req.request_id
             cached_blocks: List[int] = []
             cached = 0
@@ -367,6 +384,59 @@ class KVExecutorBase(Executor):
                 owner, lease, ctx=cached, prefill_pos=cached,
                 last_token=None, max_total=plen + req.max_tokens)
             return cached
+
+    def _attach_parked(self, slot: int, req, parked: ParkedKV) -> int:
+        """Resume a preempted request from its host-parked KV (called
+        under ``_slock`` from kv_attach). The parked chain covers
+        prompt + settled tokens up to the preemption's confirmed
+        extent, content-addressed exactly like any spilled prefix — so
+        resume IS the tier-restore path: match the HBM tree first (the
+        preemption's retire hook cached the prompt blocks), then
+        restore the pinned suffix chain (chained-hash re-verified),
+        then prefill only what neither covered. The final prefill
+        position is seq[-1] — the last SETTLED token — whose step emits
+        the next unsettled one: no duplicate, no gap, byte-identical to
+        the unpreempted stream.
+
+        The pins release only AFTER the fresh lease is built; a
+        KVCacheOOM here leaves ``req.kv_lease`` as the ParkedKV, so the
+        caller's fail() still settles the pins through finish()."""
+        faults.fire("kvpreempt.resume")
+        seq = list(parked.prompt) + [int(t) for t in req.tokens]
+        plen = len(parked.prompt)
+        owner = req.request_id
+        cached_by_tier: dict = {}
+        cached_blocks, cached = self.prefix.match_and_fork(
+            seq, owner, by_tier=cached_by_tier)
+        try:
+            cached = self._extend_from_tier(
+                seq, owner, cached_blocks, cached, cached_by_tier)
+        except Exception:
+            if cached_blocks:
+                self.allocator.release(cached_blocks, owner)
+            raise
+        # Worst case from the ORIGINAL geometry: plen + max_tokens is
+        # what admission reserved, and len(seq) + remaining budget
+        # equals it exactly.
+        need_total = -(-(plen + req.max_tokens) // self.block_size)
+        need = need_total - len(cached_blocks)
+        try:
+            fresh = self._acquire_with_evict(need, owner)
+        except KVCacheOOM:
+            if cached_blocks:
+                self.allocator.release(cached_blocks, owner)
+            raise
+        lease = KVLease(self.allocator, self._exec_id, owner,
+                        cached_blocks + fresh, tuple(seq),
+                        cached, cached_by_tier=cached_by_tier)
+        req.kv_lease = lease
+        parked.release()
+        self._states[slot] = _SlotState(
+            owner, lease, ctx=cached, prefill_pos=cached,
+            last_token=None, max_total=plen + req.max_tokens)
+        self.resumed_total += 1
+        self.preempt_resumed_total += 1
+        return cached
 
     def _reattach(self, slot: int, req, lease: KVLease) -> int:
         """Rebuild decode cursors from the request's SETTLED tokens —
@@ -623,6 +693,111 @@ class KVExecutorBase(Executor):
             return None
         return {"lease": st.lease, "confirmed": int(st.confirmed),
                 "req_id": st.req_id, "executor": self}
+
+    def kv_preempt_slot(self, slot: int, req) -> Optional[dict]:
+        """Preempt `slot`'s occupant for a higher-priority arrival
+        (ISSUE 20): park its CONFIRMED KV in the host tier and free the
+        HBM pages, so the request can requeue carrying a ParkedKV and
+        resume later with only its uncovered suffix re-prefilled —
+        strictly fewer replayed steps than re-decoding from the prompt.
+
+        Two-phase, all-or-nothing, called under the batcher's settle
+        lock like every attach/detach:
+
+          * **Park (fallible).** Export each full confirmed block
+            verbatim into the tier under its chained content key and
+            pin it (``checkout``) for the victim. Any failure here
+            unwinds the pins and leaves the victim BOUND — a crash-only
+            exit mid-park looks exactly like a replica fault, and the
+            supervisor's seize→requeue→_reattach path already lands the
+            lease exactly once.
+          * **Commit.** ``detach()`` the HBM lease (False → the request
+            settled concurrently: unwind, unbind, nothing to requeue),
+            swap ``req.kv_lease`` to the ParkedKV, and release the HBM
+            pages through the ordinary retire hook (confirmed prompt
+            blocks go to the prefix cache, everything else frees).
+
+        Without a tier — or when nothing confirmed fills one block —
+        falls back to detach-and-reattach: the pages stay reserved (no
+        HBM freed) but the SLOT frees, which is the resource the
+        interactive arrival is actually queued on. Returns the hand-off
+        descriptor, or None when the victim settled concurrently."""
+        with self._slock:
+            st = self._states[slot]
+            if st is None:
+                raise ValueError(
+                    f"slot {slot}: nothing bound to preempt")
+            lease = st.lease
+            owner = st.req_id
+            bs = self.block_size
+            pins: List[str] = []
+            parent = _TREE_ROOT
+            if (self.tier is not None and self.prefix is not None
+                    and not lease.released):
+                seq = list(lease.prompt) + [int(t) for t in req.tokens]
+                nspill = min(int(st.confirmed), len(seq)) // bs
+                nspill = min(nspill, len(lease.blocks))
+                try:
+                    for i in range(nspill):
+                        chunk = tuple(seq[i * bs:(i + 1) * bs])
+                        key = PrefixTree._key(parent, chunk)
+                        planes = self._tier_export_block(
+                            lease.blocks[i], chunk)
+                        faults.fire("kvpreempt.park")
+                        if not self.tier.put(key, parent, chunk,
+                                             planes):
+                            break  # tier full: park the prefix we got
+                        if self.tier.checkout(key, owner) is None:
+                            break
+                        pins.append(key)
+                        parent = key
+                except BaseException:
+                    # Crash-only: unwind the pins, leave the victim
+                    # bound — the supervisor's seize path owns it now.
+                    for pinned in pins:
+                        self.tier.checkin(pinned, owner)
+                    raise
+            if not pins:
+                # Nothing parkable (no tier, cold victim, or tier
+                # full): free the SLOT, keep the pages — resume rides
+                # the ordinary _reattach path.
+                if not lease.detach():
+                    self._states[slot] = None
+                    return None
+                lease.reattach()
+                self._states[slot] = None
+                self.preempted_total += 1
+                return {"lease": lease, "confirmed": int(st.confirmed),
+                        "req_id": st.req_id, "executor": self,
+                        "parked_blocks": 0}
+            if not lease.detach():
+                # Settled concurrently (handler-thread finish() between
+                # the caller's done-check and here): the pages already
+                # returned through the choke point — unpin and unbind.
+                for key in pins:
+                    self.tier.checkin(key, owner)
+                self._states[slot] = None
+                return None
+            parked = ParkedKV(self.tier, self._exec_id, owner, pins,
+                              lease.prompt,
+                              cached_tokens=len(pins) * bs,
+                              cached_by_tier={"host": len(pins) * bs})
+            req.kv_lease = parked
+            # Release the HBM pages through the ordinary retire hook:
+            # confirmed prompt blocks feed the prefix cache, the rest
+            # free for the arrival that triggered the preemption.
+            lease.release(
+                cache_hook=self.prefix_cache_hook(st.confirmed))
+            self._states[slot] = None
+            self.preempted_total += 1
+            if req.done:
+                # finish() raced the swap: it settled the OLD lease;
+                # the pins are ours to return.
+                parked.release()
+                return None
+            return {"lease": parked, "confirmed": int(st.confirmed),
+                    "req_id": st.req_id, "executor": self,
+                    "parked_blocks": len(pins)}
 
     def kv_export(self, req, detach: dict) -> Tuple[dict, list]:
         """Read the detached lease's WRITTEN pages out of this pool:
@@ -1130,6 +1305,8 @@ class KVExecutorBase(Executor):
                "steps_decode": self.steps_decode,
                "steps_mixed": self.steps_mixed,
                "resumed": self.resumed_total,
+               "preempted": self.preempted_total,
+               "preempt_resumed": self.preempt_resumed_total,
                "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0}
         if self.prefix is not None:
             out["prefix_hit_tokens"] = self.prefix.hit_tokens

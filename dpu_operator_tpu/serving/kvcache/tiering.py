@@ -43,7 +43,8 @@ import numpy as np
 
 from .allocator import PrefixTree
 
-__all__ = ["HostKVTier", "TierEntry", "verify_block_tokens"]
+__all__ = ["HostKVTier", "ParkedKV", "TierEntry",
+           "verify_block_tokens"]
 
 
 def verify_block_tokens(parent_key: str, tokens: Sequence[int],
@@ -253,3 +254,77 @@ class HostKVTier:
                 del self._entries[e.key]
                 self.bytes_used -= e.nbytes
             return len(victims)
+
+
+class ParkedKV:
+    """A preempted request's KV, parked in the host tier (ISSUE 20).
+
+    When the batcher preempts a batch-class occupant, the executor
+    spills its settled KV blocks into the HostKVTier and pins each
+    chain entry under an owner-tagged ``checkout`` — then rides THIS
+    object on ``req.kv_lease`` through the requeue. It duck-types
+    ``KVLease`` for every consumer on that path:
+
+      * the queue's requeue trace reads ``blocks`` (here: the pinned
+        chain keys, in chain order);
+      * ``resumable`` tells the resume path whether the pins are still
+        held;
+      * ``on_request_settled()`` — the ``finish()`` choke point —
+        releases the pins exactly once, so a request that dies while
+        parked (deadline, drain, server stop) can never leak a tier
+        lease;
+      * ``release()`` is idempotent, and ``HostKVTier.checkin`` is
+        safe after a ``flush`` dropped the entry (the ledger, not the
+        entry, is what must balance).
+
+    The resume path (``kv_attach`` on the SAME executor) restores the
+    pinned chain via the ordinary tier-hit machinery — chained-hash
+    re-verification included — then releases this object; a foreign
+    executor just releases it and re-prefills (deterministic decode
+    makes the streams byte-identical either way).
+    """
+
+    def __init__(self, tier: "HostKVTier", exec_id: str, owner: str,
+                 keys: Sequence[str], prompt: Sequence[int],
+                 cached_tokens: int,
+                 cached_by_tier: Optional[Dict[str, int]] = None):
+        self.tier = tier
+        self.exec_id = exec_id
+        self.owner = owner
+        self.keys: Tuple[str, ...] = tuple(keys)
+        self.prompt: Tuple[int, ...] = tuple(int(t) for t in prompt)
+        self.cached_tokens = int(cached_tokens)
+        self.cached_by_tier: Dict[str, int] = dict(cached_by_tier or {})
+        self.in_transit = False
+        self._released = False
+        self._lock = threading.Lock()
+
+    @property
+    def blocks(self) -> Tuple[str, ...]:
+        """Duck-typed KVLease.blocks: the parked chain keys (len() is
+        what the requeue trace and response body record)."""
+        return self.keys
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    @property
+    def resumable(self) -> bool:
+        return not self._released
+
+    def release(self, cache_hook=None) -> None:
+        """Unpin every parked chain entry, exactly once (idempotent —
+        second and later calls no-op, like KVLease.release). The
+        ``cache_hook`` parameter exists only for call-shape parity;
+        parked blocks are already content-addressed tier residents."""
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        for key in self.keys:
+            self.tier.checkin(key, self.owner)
+
+    def on_request_settled(self) -> None:
+        """GenerateRequest.finish() hook — same contract as KVLease."""
+        self.release()

@@ -322,6 +322,66 @@ class ContinuousBatcher:
                 self.queue.mark_placed(1)
         return placed
 
+    def _maybe_preempt_kv(self) -> None:
+        """QoS preemption (ISSUE 20), called under the settle lock
+        right before admissions: when every slot is occupied and an
+        INTERACTIVE request is waiting, park the coldest batch-class
+        occupant (fewest settled tokens — the least work at stake)
+        through ``kv_preempt_slot`` and requeue it at the front of its
+        own class. Preemption is policy, not failure: the victim's
+        ``attempts`` budget is untouched, its ``preemptions`` counter
+        ticks, and its KV rides the requeue as a ParkedKV (or a
+        reattached lease when nothing was parkable), so resume replays
+        strictly less than a re-decode. One victim per loop iteration —
+        the freed slot admits in the SAME _pop_admissions call, and the
+        next iteration re-evaluates with fresh queue state."""
+        if not self.kv_mode:
+            return
+        waiting = getattr(self.queue, "waiting", None)
+        if waiting is None or waiting("interactive") <= 0:
+            return
+        if any(r is None for r in self._slots):
+            return
+        victims = [(len(r.tokens), i, r)
+                   for i, r in enumerate(self._slots)
+                   if r is not None and not r.done
+                   and getattr(r, "priority", "interactive") == "batch"]
+        if not victims:
+            return
+        _, i, victim = min(victims, key=lambda v: (v[0], v[1]))
+        try:
+            res = self.executor.kv_preempt_slot(i, victim)
+        except Exception:
+            if self.crash_only:
+                raise
+            # Park failed (tier fault): the victim is still BOUND and
+            # still decoding — skip preemption this round rather than
+            # turning a QoS decision into a request failure.
+            log.exception("batcher %s: preempt park failed "
+                          "(request %s)", self.replica,
+                          victim.request_id)
+            return
+        self._slots[i] = None
+        if res is None:
+            # Settled concurrently: the slot freed through the choke
+            # point, nothing to requeue.
+            return
+        victim.preemptions += 1
+        self._count("serving_preempted_total",
+                    {"replica": self.replica},
+                    help="batch-class occupants preempted for an "
+                         "interactive arrival (KV parked, requeued)")
+        self.tracer.event(
+            "batcher.preempt", request_id=victim.request_id,
+            parent_id=victim.trace_parent,
+            attrs={"replica": self.replica, "slot": i,
+                   "tokens": len(victim.tokens),
+                   "parked_blocks": res.get("parked_blocks", 0),
+                   "preemptions": victim.preemptions})
+        self.tracer.decision("preempt", request_id=victim.request_id,
+                             replica=self.replica, slot=i)
+        self.queue.requeue(victim, preempted=True)
+
     # -- sync loop (fallback + measured baseline) -----------------------------
 
     def _settle(self, req: GenerateRequest, token: int,
@@ -843,6 +903,7 @@ class ContinuousBatcher:
                 with self._settle_lock:
                     if self._abandoned:
                         return
+                    self._maybe_preempt_kv()
                     block = self.active == 0 and prev is None
                     for _i, req, _vec in self._pop_admissions(
                             block=block):

@@ -55,9 +55,11 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.flight import FlightRecorder
 from ..utils.metrics import Registry
-from .api import (DEADLINE_QUEUED_ERROR, KV_OOM_ERROR,
+from .api import (DEADLINE_QUEUED_ERROR, KV_OOM_ERROR, PRIORITIES,
                   RETRIES_EXHAUSTED_ERROR, Draining, QueueFull,
-                  GenerateRequest, encode_prompt, encode_prompt_tokens)
+                  TenantOverBudget, GenerateRequest,
+                  bounded_tenant_label, encode_prompt,
+                  encode_prompt_tokens)
 from .executor import Executor, ReplicaPool
 from .queue import AdmissionQueue
 
@@ -75,6 +77,8 @@ class ServingServer:
                  max_tokens_cap: int = 1024,
                  default_deadline_s: float = 30.0,
                  retry_after_s: float = 1.0,
+                 tenants: Optional[dict] = None,
+                 default_budget=None,
                  registry: Optional[Registry] = None,
                  drainer=None, node_name: Optional[str] = None,
                  pool_opts: Optional[dict] = None,
@@ -94,10 +98,21 @@ class ServingServer:
         self.flight = FlightRecorder(tracer=self.tracer,
                                      flight_dir=flight_dir,
                                      registry=self.registry)
+        # tenants maps tenant name → queue.TenantBudget (rate/burst/
+        # weight); default_budget meters tenants not named there. Both
+        # None (the default) keeps the single-tenant contract: one
+        # global depth bound, FIFO, nobody ever sees a 429.
         self.queue = AdmissionQueue(max_depth=max_queue_depth,
                                     retry_after_s=retry_after_s,
                                     registry=self.registry,
-                                    tracer=self.tracer)
+                                    tracer=self.tracer,
+                                    tenants=tenants,
+                                    default_budget=default_budget)
+        # Bounded tenant label values for THIS server's request series
+        # (api.bounded_tenant_label): tenant names arrive from the
+        # wire, and metrics cardinality must not be client-controlled.
+        self._tenant_seen: set = set()
+        self._tenant_seen_lock = threading.Lock()
         # pool_opts passes supervision knobs through (supervise,
         # watchdog_s, max_attempts, quorum, backoff/breaker tuning) —
         # the pool's defaults are the production contract.
@@ -412,6 +427,23 @@ class ServingServer:
                     name, round(est, 6),
                     help=f"estimated q={q} of serving_request_seconds "
                          f"(ok outcomes)")
+        # Per-tenant p99 (ISSUE 20): same estimator over the tenant-
+        # labelled histogram, one gauge per admitted tenant label —
+        # the isolation number the QoS bench gates on, visible to an
+        # operator without PromQL.
+        for key in self.registry.histogram_totals(
+                "serving_tenant_request_seconds"):
+            lbl = dict(key)
+            if lbl.get("outcome") != "ok":
+                continue
+            est = self.registry.quantile(
+                "serving_tenant_request_seconds", 0.99, lbl)
+            if est is not None:
+                self.registry.gauge_set(
+                    "serving_tenant_request_p99_seconds",
+                    round(est, 6), {"tenant": lbl["tenant"]},
+                    help="estimated q=0.99 of per-tenant request wall "
+                         "time (ok outcomes, bounded tenant label)")
         # The ring bound, proven: spans lost to either tracer bound
         # (per-thread overflow, ring eviction) surface as a counter —
         # published as the delta since the last scrape so the series
@@ -628,16 +660,32 @@ class ServingServer:
     def _finish(self, handler, code: int, body: dict, outcome: str,
                 headers: Optional[dict] = None,
                 elapsed_s: Optional[float] = None,
-                req: Optional[GenerateRequest] = None) -> None:
+                req: Optional[GenerateRequest] = None,
+                tenant: Optional[str] = None) -> None:
+        if tenant is None:
+            tenant = req.tenant if req is not None else "default"
+        with self._tenant_seen_lock:
+            tlabel = bounded_tenant_label(tenant, self._tenant_seen)
         self.registry.counter_inc(
             "serving_requests_total", {"code": str(code),
-                                       "outcome": outcome},
+                                       "outcome": outcome,
+                                       "tenant": tlabel},
             help="generate requests by outcome")
         if elapsed_s is not None:
             self.registry.observe(
                 "serving_request_seconds", elapsed_s,
                 {"outcome": outcome},
                 help="end-to-end request wall time")
+            # Per-tenant latency rides a SEPARATE histogram: the p50/
+            # p99 derived gauges key on serving_request_seconds'
+            # exact label set {outcome}, and the registry matches
+            # label keys exactly — adding tenant there would orphan
+            # those series.
+            self.registry.observe(
+                "serving_tenant_request_seconds", elapsed_s,
+                {"outcome": outcome, "tenant": tlabel},
+                help="end-to-end request wall time by tenant "
+                     "(bounded label)")
         if req is not None:
             # Every response for a request that got an id carries it —
             # the handle a client quotes to /debug/traces.
@@ -664,13 +712,33 @@ class ServingServer:
         if not isinstance(body, dict):
             return self._finish(handler, 400,
                                 {"error": "body must be an object"}, "bad")
+        # Multi-tenant QoS (ISSUE 20): tenant from the JSON body, then
+        # the X-Tenant header, then "default"; priority must be a known
+        # class — a typo'd priority is a 400, not a silent new class.
+        tenant = body.get("tenant")
+        if tenant is None:
+            tenant = handler.headers.get("X-Tenant") or "default"
+        if not isinstance(tenant, str) or not tenant \
+                or len(tenant) > 256:
+            return self._finish(
+                handler, 400,
+                {"error": "tenant must be a non-empty string "
+                          "(<= 256 chars)"}, "bad")
+        priority = body.get("priority", "interactive")
+        if priority not in PRIORITIES:
+            return self._finish(
+                handler, 400,
+                {"error": f"unknown priority class {priority!r} "
+                          f"(expected one of {list(PRIORITIES)})"},
+                "bad", tenant=tenant)
         try:
             vec = self._prompt_vec(body) if not self.kv else None
         except (ValueError, TypeError) as e:
             # TypeError too: np.asarray raises it for non-numeric JSON
             # (e.g. prompt_vec as an object) — that's a client error,
             # not a dropped connection.
-            return self._finish(handler, 400, {"error": str(e)}, "bad")
+            return self._finish(handler, 400, {"error": str(e)}, "bad",
+                                tenant=tenant)
         try:
             max_tokens = int(body.get("max_tokens",
                                       self.default_max_tokens))
@@ -679,12 +747,14 @@ class ServingServer:
         except (TypeError, ValueError):
             return self._finish(
                 handler, 400,
-                {"error": "max_tokens/deadline_ms must be numbers"}, "bad")
+                {"error": "max_tokens/deadline_ms must be numbers"},
+                "bad", tenant=tenant)
         if not 1 <= max_tokens <= self.max_tokens_cap:
             return self._finish(
                 handler, 400,
                 {"error": f"max_tokens must be in [1, "
-                          f"{self.max_tokens_cap}]"}, "bad")
+                          f"{self.max_tokens_cap}]"}, "bad",
+                tenant=tenant)
         # Finite and capped, not just positive: json.loads accepts
         # Infinity/NaN, and a NaN deadline poisons every expiry
         # comparison while an astronomic one overflows Event.wait.
@@ -693,7 +763,8 @@ class ServingServer:
             return self._finish(
                 handler, 400,
                 {"error": f"deadline_ms must be a finite number in "
-                          f"(0, {_DEADLINE_CAP_MS:.0f}]"}, "bad")
+                          f"(0, {_DEADLINE_CAP_MS:.0f}]"}, "bad",
+                tenant=tenant)
 
         toks = None
         if self.kv:
@@ -701,11 +772,12 @@ class ServingServer:
                 toks = self._prompt_tokens(body, max_tokens)
             except (ValueError, TypeError) as e:
                 return self._finish(handler, 400, {"error": str(e)},
-                                    "bad")
+                                    "bad", tenant=tenant)
 
         req = GenerateRequest(prompt_vec=vec, max_tokens=max_tokens,
                               deadline=t0 + deadline_ms / 1000.0,
-                              prompt_tokens=toks)
+                              prompt_tokens=toks,
+                              tenant=tenant, priority=priority)
         # Root span of the request's trace: every downstream span
         # (queue, admit, retire, supervisor requeue) parents onto it
         # through req.trace_parent; _finish closes it with the outcome.
@@ -718,6 +790,15 @@ class ServingServer:
             req._root_span = span
         try:
             self.queue.submit(req)
+        except TenantOverBudget as e:
+            # 429, not 503: the SERVER has headroom, this tenant has
+            # spent its share — the client-side fix is slow down, not
+            # retry elsewhere.
+            return self._finish(
+                handler, 429,
+                {"error": str(e), "tenant": e.tenant}, "over_budget",
+                {"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+                req=req)
         except QueueFull as e:
             return self._finish(
                 handler, 503,

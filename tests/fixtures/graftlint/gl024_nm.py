@@ -1,0 +1,37 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl024_nm.py
+"""GL024 near-misses that must stay silent: the same stores made
+legal by a settle/route call in the same function, self-owned state,
+and non-request error stores."""
+
+
+class Settler:
+    def shed_oldest(self, req):
+        # Routed through the choke point: fail() settles the event
+        # AND fires on_request_settled (lease release included).
+        req.fail("queue full")
+
+    def reprefill_foreign(self, req):
+        # kv_lease cleared AFTER the release call — the kv_attach
+        # foreign-lease shape.
+        req.kv_lease.release()
+        req.kv_lease = None
+        req.tokens.clear()
+
+    def requeue_preempted(self, req):
+        # Routing onward is the other legal move.
+        self.queue.requeue(req, preempted=True)
+
+    def rebind(self, req, lease):
+        # A lease REBIND is an attach, not a drop (None stores only).
+        req.kv_lease = lease
+        return self.finish(req)
+
+    def own_state(self, exc):
+        # Self-owned bookkeeping: a worker ticket managing itself.
+        self.error = exc
+        self._done.set()
+
+    def ticket_error(self, pending, exc):
+        # Non-request receiver: worker handles stamp errors freely.
+        pending.error = exc
+        pending.event.set()

@@ -1,0 +1,24 @@
+# graftlint-fixture-path: dpu_operator_tpu/serving/fx_gl024_tp.py
+"""GL024 true positives: drop paths that bypass the finish() settle
+choke point in functions with no settle/route call. Three findings:
+a hand-set done event on a shed path, an error stamped directly on a
+request, and a kv_lease cleared to None with the lease object (and
+its pages or tier pins) still live behind it."""
+
+
+class Shedder:
+    def shed_oldest(self, req):
+        # TP 1: settling someone else's done event by hand — the
+        # on_request_settled hook chain never runs.
+        req.tokens.clear()
+        req._done.set()
+
+    def mark_failed(self, victim_req, exc):
+        # TP 2: error stamped outside the choke point; the handler
+        # waits forever and the lease never releases.
+        victim_req.error = str(exc)
+
+    def forget_lease(self, req):
+        # TP 3: oblivion for whatever KVLease/ParkedKV rode there.
+        req.kv_lease = None
+        return req

@@ -1465,3 +1465,139 @@ def test_router_pull_cut_midstream_falls_back_to_local_prefill(
 
     assert set(settle_counts.values()) == {1}, settle_counts
     assert time.monotonic() - t_start < CASE_BUDGET_S
+
+
+# -- QoS preemption chaos (ISSUE 20): the park and resume seams ---------------
+
+
+def test_preempt_park_fault_crashes_replica_and_lease_lands_once(
+        settle_counts):
+    """Chaos at the park seam: the host tier dies MID-PARK (after the
+    victim's slot is already mid-export). kv_preempt_slot unwinds its
+    partial pins and re-raises; under crash-only the replica dies with
+    the victim still BOUND, so the supervisor's seize/requeue owns the
+    lease — it lands in the queue exactly once, resumes through the
+    ordinary reattach, and both streams match an uninjected run with
+    every leak ledger clean."""
+    from dpu_operator_tpu.serving import ReplicaPool, SyntheticKVExecutor
+
+    t_start = time.monotonic()
+    plen, max_toks = 16, 8
+    b_prompt = [int(x) for x in range(plen)]
+    i_prompt = [int(x) + 1 for x in range(plen)]
+
+    def run(inject):
+        ex = SyntheticKVExecutor(slots=1, block_size=4, num_blocks=64,
+                                 max_blocks_per_req=16,
+                                 prefill_chunk=8, pipelined=True,
+                                 step_time_s=0.02,
+                                 host_tier_bytes=1 << 20)
+        q = AdmissionQueue(max_depth=8)
+        pool = ReplicaPool([ex], q, watchdog_s=0.25,
+                           restart_backoff_s=0.01, poll_s=0.005)
+        victim = GenerateRequest(prompt_vec=None, max_tokens=max_toks,
+                                 deadline=time.monotonic() + 60.0,
+                                 prompt_tokens=list(b_prompt),
+                                 priority="batch")
+        inter = GenerateRequest(prompt_vec=None, max_tokens=3,
+                                deadline=time.monotonic() + 60.0,
+                                prompt_tokens=list(i_prompt))
+        q.submit(victim)
+        pool.start()
+        try:
+            # Interactive lands mid-decode with the single slot full:
+            # the next loop iteration parks the batch occupant — and
+            # with the fault armed, dies doing it.
+            _wait(lambda: len(victim.tokens) >= 1, msg="mid-decode")
+            q.submit(inter)
+            assert victim.wait(20), "victim lost"
+            assert inter.wait(20), "interactive lost"
+            if inject:
+                _wait(lambda: pool.live_count() == 1,
+                      msg="replica restarted")
+                assert sum(pool.restarts) >= 1
+        finally:
+            pool.stop()
+        assert victim.error is None and inter.error is None
+        ex.prefix.flush()
+        ex.tier.assert_clean()   # partial-park pins were unwound
+        ex.tier.flush()
+        ex.allocator.assert_clean()
+        streams = (list(victim.tokens), list(inter.tokens))
+        ex.close()
+        return streams, victim
+
+    baseline, base_victim = run(inject=False)
+    assert base_victim.preemptions >= 1  # uninjected park committed
+    with faults.injected() as plan:
+        plan.inject("kvpreempt.park",
+                    exc=FaultError("tier died mid-park"), at_calls=[1])
+        injected, victim = run(inject=True)
+        assert plan.fired.get("kvpreempt.park", 0) >= 1
+    assert injected == baseline, (injected, baseline)
+    assert set(settle_counts.values()) == {1}, settle_counts
+    # The crashed park never committed: no preemption was recorded,
+    # the requeue rode the supervisor's replica-fault path instead
+    # (which DOES bill the attempts budget — a dead replica is a
+    # fault, a committed park is policy).
+    assert victim.preemptions == 0
+    assert victim.attempts >= 1
+    assert time.monotonic() - t_start < 2 * CASE_BUDGET_S
+
+
+def test_preempt_resume_fault_settles_exactly_once_with_pins_released(
+        settle_counts):
+    """Chaos at the resume seam: the tier restore dies while a parked
+    victim re-admits. The admission guard fails the request through
+    the finish() choke point — settled exactly once, the ParkedKV's
+    tier pins checked back in by the settle hook, no wedge, no leak —
+    and the replica keeps serving (an admission failure is not a
+    replica fault)."""
+    from dpu_operator_tpu.serving import ReplicaPool, SyntheticKVExecutor
+
+    t_start = time.monotonic()
+    plen = 16
+    b_prompt = [int(x) for x in range(plen)]
+    i_prompt = [int(x) + 1 for x in range(plen)]
+
+    ex = SyntheticKVExecutor(slots=1, block_size=4, num_blocks=64,
+                             max_blocks_per_req=16, prefill_chunk=8,
+                             pipelined=True, step_time_s=0.02,
+                             host_tier_bytes=1 << 20)
+    q = AdmissionQueue(max_depth=8)
+    pool = ReplicaPool([ex], q, watchdog_s=0.25,
+                       restart_backoff_s=0.01, poll_s=0.005)
+    victim = GenerateRequest(prompt_vec=None, max_tokens=8,
+                             deadline=time.monotonic() + 60.0,
+                             prompt_tokens=list(b_prompt),
+                             priority="batch")
+    inter = GenerateRequest(prompt_vec=None, max_tokens=3,
+                            deadline=time.monotonic() + 60.0,
+                            prompt_tokens=list(i_prompt))
+    with faults.injected() as plan:
+        plan.inject("kvpreempt.resume",
+                    exc=FaultError("tier restore died"), at_calls=[1])
+        q.submit(victim)
+        pool.start()
+        try:
+            _wait(lambda: len(victim.tokens) >= 1, msg="mid-decode")
+            q.submit(inter)
+            assert victim.wait(20), "victim lost"
+            assert inter.wait(20), "interactive lost"
+            # The fault cost one request, never the replica.
+            assert pool.live_count() == 1
+            assert sum(pool.restarts) == 0
+        finally:
+            pool.stop()
+        assert plan.fired.get("kvpreempt.resume", 0) >= 1
+    assert inter.error is None
+    assert victim.error is not None \
+        and "admission failed" in victim.error
+    assert victim.preemptions == 1  # the park itself committed
+    assert set(settle_counts.values()) == {1}, settle_counts
+    ex.prefix.flush()
+    ex.tier.assert_clean()  # fail() -> finish() hook released the pins
+    ex.tier.flush()
+    ex.allocator.assert_clean()
+    ex.close()
+    assert time.monotonic() - t_start < CASE_BUDGET_S

@@ -43,7 +43,7 @@ def _analyze_source(tmp_path, source, name="fx.py", baseline=None):
 
 def test_package_gate_clean_and_fast():
     """The tier-1 gate: zero non-baselined findings over the whole
-    package with ALL 23 rules active (including the interprocedural
+    package with ALL 24 rules active (including the interprocedural
     GL012/GL013 lockset and GL021/GL022 typestate passes), inside the
     30 s lint-lane budget docs/ci.md carries (measured ~9 s on the
     2-cpu container) — and no single rule above 10 s, so one rule
@@ -62,7 +62,7 @@ def test_package_gate_clean_and_fast():
 def test_rule_ids_unique_and_documented():
     rules = default_rules()
     ids = [r.rule_id for r in rules]
-    assert len(set(ids)) == len(ids) == 23
+    assert len(set(ids)) == len(ids) == 24
     for r in rules:
         assert r.title and r.hint and r.severity in ("error", "warning")
 
@@ -93,6 +93,7 @@ _EXPECT = {
     "GL021": 3,  # double release, double detach, checkin-not-held
     "GL022": 2,  # happy-path-only release + swallowed-exception tier pin
     "GL023": 3,  # fire, wrap, and fault_site=default seams nobody tests
+    "GL024": 3,  # hand-set done event, request error store, kv_lease=None
 }
 
 

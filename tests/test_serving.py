@@ -107,7 +107,8 @@ def test_generate_http_roundtrip(batched_server):
     assert urllib.request.urlopen(url + "/healthz").status == 200
     assert urllib.request.urlopen(url + "/readyz").status == 200
     metrics = urllib.request.urlopen(url + "/metrics").read().decode()
-    assert 'serving_requests_total{code="200",outcome="ok"}' in metrics
+    assert ('serving_requests_total{code="200",outcome="ok",'
+            'tenant="default"}' in metrics)
     assert "serving_batch_occupancy_bucket" in metrics
     assert "serving_queue_depth" in metrics
     assert "serving_request_seconds_bucket" in metrics
